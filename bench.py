@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Headline benchmark: distributed 3D C2C forward FFT, reference taxonomy.
+
+Runs the flagship problem (512^3, cf. ``/root/reference/README.md:44-58``) on
+the available TPU device(s) and prints ONE JSON line with the headline
+GFlops/s (5 N log2 N / t, ``fftSpeed3d_c2c.cpp:128``) versus the reference's
+heFFTe baseline (324.4 GFlops/s at 512^3 on 4 GPUs, ``README.md:65-77``).
+
+TPU note: TPUs have no complex128 (C128 unsupported), so the on-chip bench
+runs complex64; double-precision correctness at the 1e-11 tier is validated
+by the CPU-backend test suite (tests/test_fft3d.py).
+"""
+
+import functools
+import json
+import math
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu.utils.timing import gflops, sync, time_fn
+
+HEFFTE_BASELINE_GFLOPS = 324.4  # README.md:65-77, 512^3 / 4 ranks / rocfft
+
+
+def main() -> None:
+    shape = (512, 512, 512)
+    n_dev = len(jax.devices())
+    mesh = dfft.make_mesh(n_dev) if n_dev > 1 else None
+    dtype = jnp.complex64  # TPU: no C128
+
+    plan = dfft.plan_dft_c2c_3d(
+        shape, mesh, direction=dfft.FORWARD, dtype=dtype, donate=False
+    )
+    iplan = dfft.plan_dft_c2c_3d(
+        shape, mesh, direction=dfft.BACKWARD, dtype=dtype, donate=False
+    )
+
+    # Deterministic on-device init (host->device of 1 GiB through the tunnel
+    # is avoided; the reference also inits on device, fftSpeed3d_c2c.cpp:61-72).
+    mk_kw = {}
+    if plan.in_sharding is not None:
+        mk_kw["out_shardings"] = plan.in_sharding  # generate each shard in place
+
+    @functools.partial(jax.jit, **mk_kw)
+    def make_input():
+        k1, k2 = jax.random.split(jax.random.PRNGKey(4242))
+        re = jax.random.normal(k1, shape, jnp.float32)
+        im = jax.random.normal(k2, shape, jnp.float32)
+        return (re + 1j * im).astype(dtype)
+
+    x = make_input()
+    sync(x)
+
+    # Roundtrip error check (the reference's inline validation,
+    # fftSpeed3d_c2c.cpp:85-91).
+    y = plan(x)
+    r = iplan(y)
+    err_fn = jax.jit(lambda a, b: jnp.max(jnp.abs(a - b)) / jnp.max(jnp.abs(b)))
+    max_err = float(err_fn(r, x))
+
+    seconds, _ = time_fn(lambda: plan(x), iters=5, warmup=1)
+    gf = gflops(shape, seconds)
+
+    print(
+        json.dumps(
+            {
+                "metric": "fft3d_c2c_512_forward_gflops",
+                "value": round(gf, 1),
+                "unit": "GFlops/s",
+                "vs_baseline": round(gf / HEFFTE_BASELINE_GFLOPS, 3),
+                "seconds": round(seconds, 6),
+                "max_roundtrip_err": max_err,
+                "dtype": "complex64",
+                "devices": n_dev,
+                "decomposition": plan.decomposition,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""distributedfft_tpu — a TPU-native distributed FFT framework.
+
+A from-scratch JAX/XLA re-design with the capability surface of the reference
+GPU framework (lueelu/DistributedFFT): large distributed 3D complex-to-complex
+FFTs, slab and pencil decompositions over a device mesh, pluggable local FFT
+executors, per-stage t0..t3 timing, and a heFFTe-style correctness suite.
+
+Quick start::
+
+    import distributedfft_tpu as dfft
+
+    mesh = dfft.make_mesh(8)                       # 1D slab mesh
+    plan = dfft.plan_dft_c2c_3d((512, 512, 512), mesh)
+    y = plan(x)                                    # X-slabs in, Y-slabs out
+"""
+
+from .api import (  # noqa: F401
+    BACKWARD,
+    FORWARD,
+    Plan3D,
+    alloc_local,
+    destroy_plan,
+    execute,
+    plan_dft_c2c_3d,
+)
+from .geometry import Box3, world_box  # noqa: F401
+from .ops.executors import Scale, available_executors  # noqa: F401
+from .parallel.mesh import make_mesh  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,261 @@
+"""Domain-decomposition geometry for distributed 3D FFTs.
+
+TPU-native re-design of the geometry layer of the reference framework
+(lueelu/DistributedFFT). The reference expresses decompositions as inclusive
+``box3d`` index boxes with processor-grid search helpers
+(``heffte/heffteBenchmark/include/heffte_geometry.h:67`` ``box3d``,
+``:303`` ``make_procgrid``, ``:376`` ``split_world``, ``:516`` ``make_pencils``,
+``:546`` ``make_slabs``, ``:589`` ``proc_setup_min_surface``) and, in the
+first-party engine, as X/Y slab tables with asymmetric last-device counts
+(``3dmpifft_opt/include/fft_mpi_3d_api.cpp:274-316``).
+
+Here the same concepts are pure Python over half-open intervals. Uneven
+divisions are expressed with *ceil-division padding* rather than per-peer
+asymmetric count tables, because TPU collectives (``jax.lax.all_to_all``)
+require equal shard sizes — see :func:`ceil_shards`.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from dataclasses import dataclass
+from typing import Iterable, Sequence
+
+
+@dataclass(frozen=True, order=True)
+class Box3:
+    """A half-open axis-aligned index box ``[low, high)`` in 3D.
+
+    Unlike the reference's inclusive-``high`` convention
+    (``heffte_geometry.h:67``), ``high`` is exclusive, so ``shape`` is simply
+    ``high - low`` and empty boxes are representable with ``low == high``.
+    """
+
+    low: tuple[int, int, int]
+    high: tuple[int, int, int]
+
+    def __post_init__(self) -> None:
+        if len(self.low) != 3 or len(self.high) != 3:
+            raise ValueError("Box3 requires 3D low/high tuples")
+        if any(h < l for l, h in zip(self.low, self.high)):
+            raise ValueError(f"Box3 high must be >= low, got {self.low}..{self.high}")
+
+    @property
+    def shape(self) -> tuple[int, int, int]:
+        return tuple(h - l for l, h in zip(self.low, self.high))  # type: ignore[return-value]
+
+    @property
+    def size(self) -> int:
+        s = self.shape
+        return s[0] * s[1] * s[2]
+
+    @property
+    def empty(self) -> bool:
+        return self.size == 0
+
+    def contains(self, other: "Box3") -> bool:
+        return all(
+            sl <= ol and oh <= sh
+            for sl, sh, ol, oh in zip(self.low, self.high, other.low, other.high)
+        )
+
+    def intersect(self, other: "Box3") -> "Box3":
+        low = tuple(max(a, b) for a, b in zip(self.low, other.low))
+        high = tuple(max(l, min(a, b)) for l, a, b in zip(low, self.high, other.high))
+        return Box3(low, high)  # type: ignore[arg-type]
+
+    def slices(self) -> tuple[slice, slice, slice]:
+        """Numpy-style slices selecting this box out of the world array."""
+        return tuple(slice(l, h) for l, h in zip(self.low, self.high))  # type: ignore[return-value]
+
+    def surface(self) -> int:
+        """Total surface area (the min-surface processor-grid cost metric,
+        cf. ``proc_setup_min_surface``, ``heffte_geometry.h:589``)."""
+        a, b, c = self.shape
+        return 2 * (a * b + b * c + a * c)
+
+    def r2c(self, axis: int) -> "Box3":
+        """Shrink along ``axis`` to the r2c non-redundant half, size n//2+1
+        (cf. ``box3d::r2c``, ``heffte_geometry.h:94``)."""
+        n = self.high[axis] - self.low[axis]
+        high = list(self.high)
+        high[axis] = self.low[axis] + n // 2 + 1
+        return Box3(self.low, tuple(high))  # type: ignore[arg-type]
+
+
+def world_box(shape: Sequence[int]) -> Box3:
+    """The full-problem index box for a global grid ``shape``."""
+    return Box3((0, 0, 0), tuple(int(s) for s in shape))  # type: ignore[arg-type]
+
+
+def find_world(boxes: Iterable[Box3]) -> Box3:
+    """Bounding box of a set of boxes (cf. ``find_world``,
+    ``heffte_geometry.h:196``)."""
+    boxes = list(boxes)
+    if not boxes:
+        raise ValueError("find_world of no boxes")
+    low = tuple(min(b.low[i] for b in boxes) for i in range(3))
+    high = tuple(max(b.high[i] for b in boxes) for i in range(3))
+    return Box3(low, high)  # type: ignore[arg-type]
+
+
+def world_complete(boxes: Sequence[Box3], world: Box3) -> bool:
+    """True iff ``boxes`` tile ``world`` exactly: disjoint and covering
+    (cf. ``world_complete``, ``heffte_geometry.h:233``)."""
+    total = sum(b.size for b in boxes)
+    if total != world.size:
+        return False
+    for a, b in itertools.combinations([b for b in boxes if not b.empty], 2):
+        if not a.intersect(b).empty:
+            return False
+    return all(world.contains(b) for b in boxes)
+
+
+def even_splits(n: int, parts: int) -> list[tuple[int, int]]:
+    """Split ``[0, n)`` into ``parts`` contiguous chunks differing by at most
+    one, matching the reference's balanced splitter (``split_world``,
+    ``heffte_geometry.h:376``). Returns (start, stop) pairs."""
+    base, rem = divmod(n, parts)
+    out = []
+    start = 0
+    for i in range(parts):
+        stop = start + base + (1 if i < rem else 0)
+        out.append((start, stop))
+        start = stop
+    return out
+
+
+def ceil_splits(n: int, parts: int) -> list[tuple[int, int]]:
+    """Split ``[0, n)`` into chunks of ``ceil(n/parts)`` with the remainder on
+    the *last* part — the reference engine's slab rule (``ceil`` slabs with the
+    short slab on the last device, ``fft_mpi_3d_api.cpp:274-316``). Trailing
+    parts may be empty."""
+    step = -(-n // parts)
+    return [(min(i * step, n), min((i + 1) * step, n)) for i in range(parts)]
+
+
+def ceil_shards(n: int, parts: int) -> int:
+    """Padded per-shard extent for equal-size TPU collectives.
+
+    ``jax.lax.all_to_all`` requires every shard equal, so where the reference
+    builds asymmetric per-peer count tables for the last device
+    (``fft_mpi_3d_api.cpp:93-133``), the TPU design pads the axis to
+    ``parts * ceil(n/parts)`` and crops after the transform.
+    """
+    return -(-n // parts)
+
+
+def split_world(world: Box3, grid: Sequence[int], *, rule=even_splits) -> list[Box3]:
+    """Tile ``world`` with a ``grid[0] x grid[1] x grid[2]`` processor grid.
+
+    Boxes are emitted with the *first* grid axis slowest, matching row-major
+    rank order. ``rule`` selects balanced (heFFTe-style) or ceil (first-party
+    engine-style) splitting.
+    """
+    per_axis = [
+        [(world.low[d] + a, world.low[d] + b) for a, b in rule(world.shape[d], grid[d])]
+        for d in range(3)
+    ]
+    out = []
+    for (x0, x1), (y0, y1), (z0, z1) in itertools.product(*per_axis):
+        out.append(Box3((x0, y0, z0), (x1, y1, z1)))
+    return out
+
+
+def factorizations3(p: int) -> list[tuple[int, int, int]]:
+    """All ordered triples (a, b, c) with a*b*c == p."""
+    out = []
+    for a in range(1, p + 1):
+        if p % a:
+            continue
+        q = p // a
+        for b in range(1, q + 1):
+            if q % b:
+                continue
+            out.append((a, b, q // b))
+    return out
+
+
+def factorizations2(p: int) -> list[tuple[int, int]]:
+    """All ordered pairs (a, b) with a*b == p."""
+    return [(a, p // a) for a in range(1, p + 1) if p % a == 0]
+
+
+def make_procgrid(p: int) -> tuple[int, int]:
+    """Most-square 2D factorization of ``p`` (cf. ``make_procgrid``,
+    ``heffte_geometry.h:303``)."""
+    best = (1, p)
+    for a, b in factorizations2(p):
+        if abs(a - b) < abs(best[0] - best[1]):
+            best = (a, b)
+    return best
+
+
+def proc_setup_min_surface(world: Box3, p: int) -> tuple[int, int, int]:
+    """3D processor grid minimizing total box surface area — the reference's
+    default-grid search (``proc_setup_min_surface``, ``heffte_geometry.h:589``).
+
+    Surface area is a proxy for communication volume; on a TPU mesh it is a
+    proxy for all-to-all payload per ICI hop.
+    """
+    nx, ny, nz = world.shape
+
+    def cost(grid: tuple[int, int, int]) -> float:
+        gx, gy, gz = grid
+        return (nx / gx) * (ny / gy) + (ny / gy) * (nz / gz) + (nx / gx) * (nz / gz)
+
+    return min(factorizations3(p), key=cost)
+
+
+def make_slabs(world: Box3, p: int, axis: int = 0, *, rule=even_splits) -> list[Box3]:
+    """1D slab decomposition over ``axis`` (cf. ``make_slabs``,
+    ``heffte_geometry.h:546``; the first-party engine's only mode, X-slabs,
+    ``fft_mpi_3d_api.cpp:274-287``)."""
+    grid = [1, 1, 1]
+    grid[axis] = p
+    return split_world(world, grid, rule=rule)
+
+
+def make_pencils(
+    world: Box3, grid2: Sequence[int], long_axis: int, *, rule=even_splits
+) -> list[Box3]:
+    """Pencil decomposition: full extent along ``long_axis``, 2D grid over the
+    other two axes (cf. ``make_pencils``, ``heffte_geometry.h:516``)."""
+    if len(grid2) != 2:
+        raise ValueError("grid2 must have two entries")
+    grid = [0, 0, 0]
+    grid[long_axis] = 1
+    others = [d for d in range(3) if d != long_axis]
+    grid[others[0]], grid[others[1]] = int(grid2[0]), int(grid2[1])
+    return split_world(world, grid, rule=rule)
+
+
+def is_slab(boxes: Sequence[Box3], world: Box3, axes: tuple[int, int]) -> bool:
+    """True if every box spans the world along both ``axes`` (cf. ``is_slab``,
+    ``heffte_geometry.h:411``)."""
+    return all(
+        b.low[a] == world.low[a] and b.high[a] == world.high[a]
+        for b in boxes
+        for a in axes
+    )
+
+
+def is_pencil(boxes: Sequence[Box3], world: Box3, axis: int) -> bool:
+    """True if every box spans the world along ``axis``."""
+    return all(
+        b.low[axis] == world.low[axis] and b.high[axis] == world.high[axis]
+        for b in boxes
+    )
+
+
+def pad_to(n: int, parts: int) -> int:
+    """Smallest multiple of ``parts`` that is >= ``n``."""
+    return parts * ceil_shards(n, parts)
+
+
+def fft_flops(shape: Sequence[int]) -> float:
+    """The 5 N log2 N flop model used by every reference benchmark
+    (``3dmpifft_opt/fftSpeed3d_c2c.cpp:128``, ``benchmarks/speed3d.h:159``)."""
+    n = math.prod(shape)
+    return 5.0 * n * math.log2(n)

@@ -1,0 +1,8 @@
+from .executors import (  # noqa: F401
+    Scale,
+    get_executor,
+    register_executor,
+    available_executors,
+    scale_factor,
+    apply_scale,
+)

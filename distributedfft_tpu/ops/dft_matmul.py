@@ -1,0 +1,115 @@
+"""Mixed-radix DFT by matrix multiplication — the MXU-native FFT executor.
+
+TPU-first replacement for templateFFT's runtime-generated Stockham kernels
+(``templateFFT/src/templateFFT.cpp:4699`` ``shaderGenFFT``; scheduler
+``:3941-4100``). On a GPU the natural FFT engine is a hand-scheduled
+shared-memory butterfly kernel; on a TPU the FLOPs live in the 128x128 MXU, so
+the natural engine is the *four-step / Bailey decomposition* expressed as
+batched matrix multiplies against small DFT matrices, with trace-time twiddle
+LUTs (the reference precomputes its twiddle LUTs on the host in double
+precision too, ``templateFFT.cpp:5063-5154``):
+
+    n = n1 * n2, view x as A[j1, j2] (j = j1*n2 + j2)
+    B[k1, j2] = DFT_n1 over j1         (matmul against the n1 x n1 DFT matrix)
+    B       *= w_n^{k1 * j2}           (twiddle LUT, computed at trace time)
+    C[k1, k2] = DFT_n2 over j2         (recurse)
+    X[k2*n1 + k1] = C[k1, k2]          (transpose + reshape)
+
+Factors at or below :data:`DIRECT_MAX` are computed as a single dense matmul;
+everything is jit-traceable with static shapes, so XLA tiles the matmuls onto
+the MXU. Prime lengths above the threshold fall back to the O(n^2) dense
+matmul (the reference's radix set is 2..13, ``templateFFT.cpp:3956-3963``, so
+composite sizes with small prime factors are the parity target; Bluestein is a
+possible extension).
+
+Like every executor in this framework the transform is unnormalized in the
+forward direction and scales by 1/n on the inverse (numpy convention).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# Largest factor handled as a single dense DFT matmul. 128 matches the MXU
+# systolic-array edge, so each stage's matmul has a contraction dim that tiles
+# cleanly onto the hardware.
+DIRECT_MAX = 128
+
+
+@functools.lru_cache(maxsize=None)
+def _dft_matrix_np(n: int, forward: bool) -> np.ndarray:
+    """Dense n x n DFT matrix W[j, k] = exp(-+ 2*pi*i*j*k / n), float64
+    precision at trace time (cast to the working dtype on use)."""
+    sign = -2j if forward else 2j
+    jk = np.outer(np.arange(n), np.arange(n))
+    return np.exp(sign * np.pi * (jk % n) / n)
+
+
+@functools.lru_cache(maxsize=None)
+def _twiddle_np(n: int, n1: int, n2: int, forward: bool) -> np.ndarray:
+    """Inter-stage twiddles w_n^{k1*j2} of shape [n1, n2] (cf. templateFFT's
+    four-step LUT generation, ``templateFFT.cpp:5144-5153``)."""
+    sign = -2j if forward else 2j
+    k1j2 = np.outer(np.arange(n1), np.arange(n2))
+    return np.exp(sign * np.pi * (k1j2 % n) / n)
+
+
+def _best_split(n: int) -> tuple[int, int] | None:
+    """Divisor pair (n1, n2), n1 <= n2, with n1 as close to sqrt(n) as
+    possible while preferring both factors composite-small. Returns None for
+    primes (no nontrivial divisor)."""
+    best = None
+    for d in range(int(math.isqrt(n)), 1, -1):
+        if n % d == 0:
+            best = (d, n // d)
+            break
+    return best
+
+
+def _direct(x: jnp.ndarray, forward: bool) -> jnp.ndarray:
+    """Dense DFT of the last axis: one batched matmul on the MXU."""
+    n = x.shape[-1]
+    w = jnp.asarray(_dft_matrix_np(n, forward), dtype=x.dtype)
+    return jnp.einsum("...j,jk->...k", x, w, precision=lax.Precision.HIGHEST)
+
+
+def _fft_last(x: jnp.ndarray, forward: bool) -> jnp.ndarray:
+    """Unnormalized DFT along the last axis (both directions)."""
+    n = x.shape[-1]
+    if n == 1:
+        return x
+    split = None if n <= DIRECT_MAX else _best_split(n)
+    if split is None:
+        return _direct(x, forward)
+    n1, n2 = split
+    a = x.reshape(x.shape[:-1] + (n1, n2))
+    # DFT_n1 along axis -2: swap to last, recurse, swap back.
+    b = jnp.swapaxes(_fft_last(jnp.swapaxes(a, -1, -2), forward), -1, -2)
+    tw = jnp.asarray(_twiddle_np(n, n1, n2, forward), dtype=x.dtype)
+    b = b * tw
+    c = _fft_last(b, forward)  # DFT_n2 along the last axis
+    # c is indexed [..., k1, k2]; output index is k2*n1 + k1.
+    return jnp.swapaxes(c, -1, -2).reshape(x.shape)
+
+
+def fft_along_axis(x: jnp.ndarray, axis: int, forward: bool = True) -> jnp.ndarray:
+    """C2C FFT along one axis via MXU matmuls. Forward unnormalized, inverse
+    scaled by 1/n (numpy convention)."""
+    if not jnp.issubdtype(x.dtype, jnp.complexfloating):
+        wide = jnp.dtype(x.dtype).itemsize >= 8
+        x = x.astype(jnp.complex128 if wide else jnp.complex64)
+    n = x.shape[axis]
+    moved = axis not in (-1, x.ndim - 1)
+    if moved:
+        x = jnp.moveaxis(x, axis, -1)
+    y = _fft_last(x, forward)
+    if not forward:
+        y = y * jnp.asarray(1.0 / n, dtype=y.real.dtype)
+    if moved:
+        y = jnp.moveaxis(y, -1, axis)
+    return y

@@ -1,0 +1,95 @@
+"""Pluggable local FFT executors.
+
+The reference keeps several interchangeable compute backends side by side —
+``setFFTPlans`` builds hipfft, rocfft, *and* templateFFT plans and picks one
+(``3dmpifft_opt/include/fft_mpi_3d_api.cpp:318-429``); heFFTe abstracts the
+same idea as the ``one_dim_backend`` trait over {stock,fftw,mkl,cufft,rocfft,
+onemkl} (``heffte/heffteBenchmark/include/heffte_common.h:97-127,275``).
+
+The TPU-native equivalent is a registry of *jit-traceable callables*: each
+executor maps ``(x, axes, forward) -> y`` with pure functional semantics, so
+any of them can be dropped into the distributed pipeline under ``jit`` /
+``shard_map``. Backends:
+
+- ``"xla"``    — ``jnp.fft``; XLA's built-in FFT lowering (default).
+- ``"matmul"`` — mixed-radix DFT-by-matrix-multiply on the MXU
+  (:mod:`distributedfft_tpu.ops.dft_matmul`), the TPU-idiomatic analog of
+  templateFFT's runtime-generated Stockham kernels.
+"""
+
+from __future__ import annotations
+
+import enum
+import math
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+ExecutorFn = Callable[..., Array]  # (x, axes, forward=True) -> y
+
+_REGISTRY: dict[str, ExecutorFn] = {}
+
+
+class Scale(enum.Enum):
+    """Result scaling, mirroring heFFTe's ``scale`` enum none/full/symmetric
+    (``heffte_fft3d.h:84-91``) and the roc backend's explicit 1/N
+    normalization kernel (``3dmpifft_roc/include/kernel_func.cpp``
+    ``scale_element``)."""
+
+    NONE = "none"
+    FULL = "full"
+    SYMMETRIC = "symmetric"
+
+
+def scale_factor(scale: Scale, world_size: int) -> float:
+    if scale == Scale.NONE:
+        return 1.0
+    if scale == Scale.FULL:
+        return 1.0 / world_size
+    return 1.0 / math.sqrt(world_size)
+
+
+def apply_scale(x: Array, scale: Scale, world_size: int) -> Array:
+    s = scale_factor(scale, world_size)
+    return x if s == 1.0 else x * jnp.asarray(s, dtype=x.real.dtype)
+
+
+def register_executor(name: str, fn: ExecutorFn) -> None:
+    _REGISTRY[name] = fn
+
+
+def get_executor(name: str) -> ExecutorFn:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown executor {name!r}; available: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def available_executors() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def _xla_executor(x: Array, axes: Sequence[int], forward: bool = True) -> Array:
+    """XLA built-in FFT over ``axes`` (complex-to-complex, unnormalized
+    forward / 1/N inverse, matching numpy conventions)."""
+    axes = tuple(axes)
+    if forward:
+        return jnp.fft.fftn(x, axes=axes)
+    return jnp.fft.ifftn(x, axes=axes)
+
+
+register_executor("xla", _xla_executor)
+
+
+def _matmul_executor(x: Array, axes: Sequence[int], forward: bool = True) -> Array:
+    from . import dft_matmul
+
+    for ax in tuple(axes):
+        x = dft_matmul.fft_along_axis(x, ax, forward=forward)
+    return x
+
+
+register_executor("matmul", _matmul_executor)

@@ -1,0 +1,113 @@
+"""Timing + reporting utilities.
+
+Reproduces the reference's measurement conventions: per-stage wall deltas
+printed as t0..t3 on every execute (``fft_mpi_3d_api.cpp:184-201``), GFlops
+= 5 N log2 N / t (``fftSpeed3d_c2c.cpp:128``), and the README-style result
+block (``/root/reference/README.md:44-58``).
+
+On the axon TPU tunnel ``block_until_ready`` can return before the device
+work is observable, so :func:`sync` forces completion by fetching a scalar
+slice to the host.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+import jax
+import numpy as np
+
+
+def sync(x) -> None:
+    """Force completion of all computation feeding ``x``."""
+    import jax.numpy as jnp
+
+    x = jax.tree_util.tree_leaves(x)[-1]
+    x.block_until_ready()
+    # Fetch one element; device->host read cannot complete before the
+    # producing computation does (robust under the axon async tunnel). The
+    # fetched value is made real-valued: complex host transfers are
+    # unimplemented on some TPU transports.
+    idx = tuple(0 for _ in range(x.ndim))
+    v = x[idx]
+    if jnp.issubdtype(v.dtype, jnp.complexfloating):
+        v = jnp.real(v)
+    np.asarray(jax.device_get(v))
+
+
+def time_fn(fn: Callable, *args, iters: int = 5, warmup: int = 1) -> tuple[float, object]:
+    """Best-of-``iters`` wall time of ``fn(*args)`` with forced completion.
+    Returns (seconds, last_result)."""
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+        sync(out)
+    best = math.inf
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def gflops(shape, seconds: float) -> float:
+    n = math.prod(shape)
+    return 5.0 * n * math.log2(n) / seconds / 1e9
+
+
+@dataclass
+class StageTimes:
+    """t0..t3 stage breakdown (``README.md:44-58`` taxonomy)."""
+
+    times: dict[str, float] = field(default_factory=dict)
+
+    @property
+    def total(self) -> float:
+        return sum(self.times.values())
+
+    def report(self) -> str:
+        lines = [f"  {k}: {v:.6f} s" for k, v in self.times.items()]
+        return "\n".join(lines)
+
+
+def time_staged(stages, x, iters: int = 3) -> tuple[StageTimes, object]:
+    """Time a list of (name, fn) stages; each stage's output feeds the next.
+    Per-stage times are best-of-``iters`` measured on a fresh pipeline pass
+    (stage outputs are re-materialized each iteration since stage jits donate
+    their inputs)."""
+    best: dict[str, float] = {}
+    out = None
+    for it in range(iters + 1):  # +1 warmup/compile pass
+        cur = x
+        for name, fn in stages:
+            sync(cur)
+            t0 = time.perf_counter()
+            cur = fn(cur)
+            sync(cur)
+            dt = time.perf_counter() - t0
+            if it > 0:
+                best[name] = min(best.get(name, math.inf), dt)
+        out = cur
+    return StageTimes(best), out
+
+
+def result_block(
+    shape, ranks: int, seconds: float, max_err: float, stage_times: StageTimes | None = None
+) -> str:
+    """Human-readable result in the spirit of the reference's sample output
+    (``README.md:44-58``)."""
+    n = math.prod(shape)
+    lines = []
+    if stage_times is not None:
+        lines.append(stage_times.report())
+    lines += [
+        f"size: {shape[0]} {shape[1]} {shape[2]}, ranks: {ranks}",
+        f"time: {seconds:.6f} s",
+        f"gflops: {gflops(shape, seconds):.1f}",
+        f"max error: {max_err:.3e}",
+    ]
+    return "\n".join(lines)

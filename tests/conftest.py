@@ -1,0 +1,25 @@
+"""Test harness configuration.
+
+Multi-device tests run on a virtual 8-device CPU mesh — the TPU-framework
+analog of heFFTe's "multiple MPI ranks on one machine" CI strategy
+(``heffte/heffteBenchmark/test/CMakeLists.txt:1-7``). x64 is enabled so the
+double-precision 1e-11 tolerance tier (``test/test_common.h:138``) is
+meaningful; the real-TPU benchmark path runs complex64 (TPU has no C128).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+# The axon TPU plugin (sitecustomize) force-sets jax_platforms="axon,cpu" at
+# interpreter start, overriding the env var — point the config back at cpu
+# before any backend is initialized.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

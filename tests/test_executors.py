@@ -1,0 +1,91 @@
+"""Local-executor unit tests — the analog of heFFTe's 1D-executor-vs-O(N^2)
+reference DFT tier (``test/test_units_nompi.cpp``) and the stock SIMD size
+sweep (``test_units_stock.cpp:291-433``: pow2/pow3/pow4/composite)."""
+
+import numpy as np
+import pytest
+
+from distributedfft_tpu import testing as tu
+from distributedfft_tpu.ops import dft_matmul
+from distributedfft_tpu.ops.executors import (
+    Scale,
+    apply_scale,
+    available_executors,
+    get_executor,
+    scale_factor,
+)
+
+
+def naive_dft(x, axis, forward=True):
+    """O(N^2) reference DFT, the role of heFFTe's test DFT."""
+    n = x.shape[axis]
+    sign = -2j if forward else 2j
+    w = np.exp(sign * np.pi * np.outer(np.arange(n), np.arange(n)) / n)
+    y = np.moveaxis(np.tensordot(np.moveaxis(x, axis, -1), w, axes=([-1], [0])), -1, axis)
+    return y if forward else y / n
+
+
+@pytest.mark.parametrize("n", [2, 3, 4, 5, 7, 8, 9, 12, 16, 27, 32, 64, 81, 125,
+                               128, 240, 256, 360, 512, 1000, 1024])
+def test_matmul_fft_sizes(n):
+    x = tu.make_world_data((3, n), dtype=np.complex128, seed=n)
+    y = np.asarray(dft_matmul.fft_along_axis(x, 1, forward=True))
+    tu.assert_approx(y, np.fft.fft(x, axis=1))
+
+
+@pytest.mark.parametrize("n", [11, 13, 17, 97, 131, 251])
+def test_matmul_fft_primes(n):
+    """Primes above the reference's radix set 2..13 fall back to the dense
+    DFT matmul (templateFFT supports only radices 2..13,
+    ``templateFFT.cpp:3956-3963``)."""
+    x = tu.make_world_data((2, n), dtype=np.complex128, seed=n)
+    y = np.asarray(dft_matmul.fft_along_axis(x, 1))
+    tu.assert_approx(y, np.fft.fft(x, axis=1))
+
+
+@pytest.mark.parametrize("axis", [0, 1, 2])
+def test_matmul_fft_any_axis(axis):
+    x = tu.make_world_data((8, 12, 16))
+    y = np.asarray(dft_matmul.fft_along_axis(x, axis))
+    tu.assert_approx(y, np.fft.fft(x, axis=axis))
+
+
+def test_matmul_inverse_roundtrip():
+    x = tu.make_world_data((4, 360))
+    y = dft_matmul.fft_along_axis(x, 1, forward=True)
+    r = np.asarray(dft_matmul.fft_along_axis(y, 1, forward=False))
+    tu.assert_approx(r, x)
+
+
+def test_matmul_vs_naive_dft():
+    x = tu.make_world_data((2, 30))
+    tu.assert_approx(np.asarray(dft_matmul.fft_along_axis(x, 1)), naive_dft(x, 1))
+
+
+@pytest.mark.parametrize("name", ["xla", "matmul"])
+def test_executor_3d(name):
+    ex = get_executor(name)
+    x = tu.make_world_data((8, 12, 10))
+    tu.assert_approx(np.asarray(ex(x, (0, 1, 2), True)), np.fft.fftn(x))
+    tu.assert_approx(np.asarray(ex(x, (1, 2), True)), np.fft.fftn(x, axes=(1, 2)))
+    tu.assert_approx(np.asarray(ex(x, (0,), False)), np.fft.ifft(x, axis=0))
+
+
+def test_registry():
+    assert {"xla", "matmul"} <= set(available_executors())
+    with pytest.raises(ValueError):
+        get_executor("nope")
+
+
+def test_scale_factors():
+    assert scale_factor(Scale.NONE, 64) == 1.0
+    assert scale_factor(Scale.FULL, 64) == 1.0 / 64
+    assert scale_factor(Scale.SYMMETRIC, 64) == 1.0 / 8
+    x = np.ones((2, 2), np.complex128)
+    assert np.allclose(np.asarray(apply_scale(x, Scale.FULL, 4)), 0.25)
+
+
+def test_best_split_near_sqrt():
+    assert dft_matmul._best_split(512) == (16, 32)
+    assert dft_matmul._best_split(360) == (18, 20)
+    assert dft_matmul._best_split(13) is None

@@ -1,0 +1,108 @@
+"""Geometry unit tests — the analog of heFFTe's no-MPI unit tier
+(``test/test_units_nompi.cpp:12-71``: factorization, proc grids, pencil
+splitting)."""
+
+import numpy as np
+import pytest
+
+from distributedfft_tpu import geometry as g
+
+
+def test_box_basics():
+    b = g.Box3((0, 0, 0), (4, 5, 6))
+    assert b.shape == (4, 5, 6)
+    assert b.size == 120
+    assert not b.empty
+    assert g.Box3((1, 1, 1), (1, 4, 4)).empty
+
+
+def test_box_validation():
+    with pytest.raises(ValueError):
+        g.Box3((0, 0, 0), (-1, 2, 2))
+
+
+def test_intersect_contains():
+    a = g.Box3((0, 0, 0), (4, 4, 4))
+    b = g.Box3((2, 2, 2), (6, 6, 6))
+    assert a.intersect(b) == g.Box3((2, 2, 2), (4, 4, 4))
+    assert a.contains(g.Box3((1, 1, 1), (3, 3, 3)))
+    assert not a.contains(b)
+    # disjoint boxes intersect to an empty box
+    c = g.Box3((8, 8, 8), (9, 9, 9))
+    assert a.intersect(c).empty
+
+
+def test_r2c_shrink():
+    w = g.world_box((8, 8, 8))
+    assert w.r2c(2).shape == (8, 8, 5)
+    assert g.world_box((7, 7, 7)).r2c(0).shape == (4, 7, 7)
+
+
+def test_even_splits_balanced():
+    assert g.even_splits(10, 3) == [(0, 4), (4, 7), (7, 10)]
+    assert g.even_splits(8, 4) == [(0, 2), (2, 4), (4, 6), (6, 8)]
+
+
+def test_ceil_splits_last_short():
+    # reference rule: ceil slabs, remainder on the last device
+    # (fft_mpi_3d_api.cpp:274-316)
+    assert g.ceil_splits(10, 3) == [(0, 4), (4, 8), (8, 10)]
+    assert g.ceil_splits(8, 4) == [(0, 2), (2, 4), (4, 6), (6, 8)]
+    # trailing empty part
+    assert g.ceil_splits(9, 5) == [(0, 2), (2, 4), (4, 6), (6, 8), (8, 9)]
+
+
+def test_split_world_tiles_completely():
+    w = g.world_box((12, 10, 8))
+    for grid in [(2, 2, 2), (4, 1, 2), (1, 1, 8), (3, 5, 1)]:
+        boxes = g.split_world(w, grid)
+        assert len(boxes) == grid[0] * grid[1] * grid[2]
+        assert g.world_complete(boxes, w)
+
+
+def test_world_complete_rejects_overlap_and_gap():
+    w = g.world_box((4, 4, 4))
+    half = g.Box3((0, 0, 0), (2, 4, 4))
+    assert not g.world_complete([half], w)  # gap
+    assert not g.world_complete([half, half, g.Box3((2, 0, 0), (4, 4, 4))], w)
+
+
+def test_find_world():
+    boxes = g.split_world(g.world_box((6, 6, 6)), (2, 3, 1))
+    assert g.find_world(boxes) == g.world_box((6, 6, 6))
+
+
+def test_procgrid_square():
+    assert g.make_procgrid(16) == (4, 4)
+    assert sorted(g.make_procgrid(12)) == [3, 4]
+    assert g.make_procgrid(7) in [(1, 7), (7, 1)]
+
+
+def test_min_surface_prefers_long_axis_split():
+    # heffte_geometry.h:589 — splitting the longest axis minimizes surface
+    w = g.world_box((1024, 64, 64))
+    grid = g.proc_setup_min_surface(w, 8)
+    assert grid[0] == 8
+
+
+def test_slabs_and_pencils():
+    w = g.world_box((8, 8, 8))
+    slabs = g.make_slabs(w, 4, axis=0)
+    assert g.is_slab(slabs, w, (1, 2))
+    assert g.world_complete(slabs, w)
+    pencils = g.make_pencils(w, (2, 2), long_axis=2)
+    assert g.is_pencil(pencils, w, 2)
+    assert g.world_complete(pencils, w)
+
+
+def test_ceil_shards_padding():
+    assert g.ceil_shards(512, 4) == 128
+    assert g.ceil_shards(500, 4) == 125
+    assert g.ceil_shards(10, 4) == 3
+    assert g.pad_to(10, 4) == 12
+    assert g.pad_to(512, 4) == 512
+
+
+def test_fft_flops_formula():
+    n = 512**3
+    assert g.fft_flops((512, 512, 512)) == pytest.approx(5 * n * np.log2(n))
