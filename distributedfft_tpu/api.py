@@ -38,7 +38,12 @@ from .geometry import Box3, world_box
 from .ops.executors import Scale, apply_scale, get_executor
 from .parallel.mesh import SLAB_AXIS, PENCIL_AXES, make_mesh
 from .parallel.pencil import PencilSpec, build_pencil_fft3d
-from .parallel.slab import SlabSpec, build_slab_fft3d, build_slab_stages
+from .parallel.slab import (
+    SlabSpec,
+    build_slab_fft3d,
+    build_slab_rfft3d,
+    build_slab_stages,
+)
 
 FORWARD = -1   # FFTW sign convention (FFTW_FORWARD)
 BACKWARD = +1  # FFTW_BACKWARD
@@ -65,6 +70,23 @@ class Plan3D:
     out_sharding: NamedSharding | None
     in_boxes: list[Box3] = field(default_factory=list)
     out_boxes: list[Box3] = field(default_factory=list)
+    # r2c/c2r plans transform between different shapes/dtypes; c2c plans leave
+    # these as the world shape / complex dtype (set in __post_init__).
+    in_shape: tuple[int, int, int] | None = None
+    out_shape: tuple[int, int, int] | None = None
+    in_dtype: Any = None
+    out_dtype: Any = None
+    real: bool = False
+
+    def __post_init__(self) -> None:
+        if self.in_shape is None:
+            self.in_shape = self.shape
+        if self.out_shape is None:
+            self.out_shape = self.shape
+        if self.in_dtype is None:
+            self.in_dtype = self.dtype
+        if self.out_dtype is None:
+            self.out_dtype = self.dtype
 
     @property
     def forward(self) -> bool:
@@ -183,13 +205,131 @@ def plan_dft_c2c_3d(
     raise ValueError(f"unknown decomposition {decomposition!r}")
 
 
+def plan_dft_r2c_3d(
+    shape: Sequence[int],
+    mesh: Mesh | int | None = None,
+    *,
+    direction: int = FORWARD,
+    decomposition: str | None = None,
+    executor: str = "xla",
+    dtype: Any = None,
+    donate: bool = False,
+) -> Plan3D:
+    """Create a distributed real-to-complex (forward) / complex-to-real
+    (backward) 3D FFT plan — heFFTe ``fft3d_r2c`` parity
+    (``heffte_fft3d_r2c.h``; r2c box shrink ``heffte_geometry.h:94``).
+
+    ``shape`` is the *real-space* world shape. The complex side is shrunk
+    along axis 2 to ``N2//2+1``. Forward input is real; backward output is
+    real with numpy 1/N scaling.
+    """
+    shape = tuple(int(s) for s in shape)
+    if len(shape) != 3:
+        raise ValueError("plan_dft_r2c_3d requires a 3D shape")
+    if direction not in (FORWARD, BACKWARD):
+        raise ValueError("direction must be FORWARD (-1) or BACKWARD (+1)")
+    if dtype is None:
+        dtype = jnp.complex128 if jax.config.jax_enable_x64 else jnp.complex64
+    dtype = jnp.dtype(dtype)
+    rdtype = jnp.float64 if dtype == jnp.complex128 else jnp.float32
+    forward = direction == FORWARD
+    n0, n1, n2 = shape
+    cshape = (n0, n1, n2 // 2 + 1)
+    in_shape, out_shape = (shape, cshape) if forward else (cshape, shape)
+    in_dtype, out_dtype = (rdtype, dtype) if forward else (dtype, rdtype)
+
+    if isinstance(mesh, int):
+        mesh = make_mesh(mesh)
+    if mesh is None or math.prod(mesh.devices.shape) == 1:
+        decomposition = "single"
+    elif decomposition is None:
+        decomposition = "pencil" if len(mesh.axis_names) == 2 else "slab"
+
+    world = world_box(shape)
+    cworld = world_box(cshape)
+    common = dict(
+        shape=shape, direction=direction, dtype=dtype, executor=executor,
+        in_shape=in_shape, out_shape=out_shape,
+        in_dtype=in_dtype, out_dtype=out_dtype, real=True,
+    )
+
+    if decomposition == "single":
+        from .ops.executors import get_c2r, get_r2c
+
+        ex = get_executor(executor)
+        r2c, c2r = get_r2c(executor), get_c2r(executor)
+        if forward:
+            fn = jax.jit(lambda x: ex(r2c(x, 2), (0, 1), True))
+        else:
+            fn = jax.jit(lambda y: c2r(ex(y, (0, 1), False), n2, 2))
+        return Plan3D(
+            decomposition="single", mesh=None, fn=fn, spec=None,
+            in_sharding=None, out_sharding=None,
+            in_boxes=[world if forward else cworld],
+            out_boxes=[cworld if forward else world],
+            **common,
+        )
+
+    if decomposition == "slab":
+        axis_name = mesh.axis_names[0]
+        p = mesh.shape[axis_name]
+        fn, spec = build_slab_rfft3d(
+            mesh, shape, axis_name=axis_name, executor=executor,
+            forward=forward, donate=donate,
+        )
+        x_sh = NamedSharding(mesh, P(axis_name, None, None))
+        y_sh = NamedSharding(mesh, P(None, axis_name, None))
+        in_sh, out_sh = (x_sh, y_sh) if forward else (y_sh, x_sh)
+        xb = geo.make_slabs(world, p, axis=0, rule=geo.ceil_splits)
+        yb = geo.make_slabs(cworld, p, axis=1, rule=geo.ceil_splits)
+        in_boxes, out_boxes = (xb, yb) if forward else (yb, xb)
+        return Plan3D(
+            decomposition="slab", mesh=mesh, fn=fn, spec=spec,
+            in_sharding=in_sh, out_sharding=out_sh,
+            in_boxes=in_boxes, out_boxes=out_boxes,
+            **common,
+        )
+
+    if decomposition == "pencil":
+        from .parallel.pencil import build_pencil_rfft3d
+
+        row, col = mesh.axis_names[:2]
+        fn, spec = build_pencil_rfft3d(
+            mesh, shape, row_axis=row, col_axis=col,
+            executor=executor, forward=forward, donate=donate,
+        )
+        z_sh = NamedSharding(mesh, P(row, col, None))
+        x_sh = NamedSharding(mesh, P(None, row, col))
+        in_sh, out_sh = (z_sh, x_sh) if forward else (x_sh, z_sh)
+        zb = geo.make_pencils(world, (mesh.shape[row], mesh.shape[col]), 2,
+                              rule=geo.ceil_splits)
+        xb = geo.make_pencils(cworld, (mesh.shape[row], mesh.shape[col]), 0,
+                              rule=geo.ceil_splits)
+        in_boxes, out_boxes = (zb, xb) if forward else (xb, zb)
+        return Plan3D(
+            decomposition="pencil", mesh=mesh, fn=fn, spec=spec,
+            in_sharding=in_sh, out_sharding=out_sh,
+            in_boxes=in_boxes, out_boxes=out_boxes,
+            **common,
+        )
+
+    raise ValueError(f"unknown decomposition {decomposition!r}")
+
+
+def plan_dft_c2r_3d(shape, mesh=None, **kw) -> Plan3D:
+    """Convenience alias: the inverse of :func:`plan_dft_r2c_3d` (complex
+    half-spectrum in, real out; heFFTe ``fft3d_r2c::backward``)."""
+    kw.setdefault("direction", BACKWARD)
+    return plan_dft_r2c_3d(shape, mesh, **kw)
+
+
 def execute(plan: Plan3D, x, *, scale: Scale = Scale.NONE):
     """Run a plan (``fft_mpi_execute_dft_3d_c2c``,
     ``fft_mpi_3d_api.cpp:181``). Accepts any array-like of the plan's global
-    shape; device placement follows the plan's input sharding."""
-    x = jnp.asarray(x, dtype=plan.dtype)
-    if x.shape != plan.shape:
-        raise ValueError(f"plan is for shape {plan.shape}, got {x.shape}")
+    input shape; device placement follows the plan's input sharding."""
+    x = jnp.asarray(x, dtype=plan.in_dtype)
+    if x.shape != plan.in_shape:
+        raise ValueError(f"plan input shape is {plan.in_shape}, got {x.shape}")
     y = plan.fn(x)
     if scale != Scale.NONE:
         y = apply_scale(y, scale, plan.world_size)
@@ -200,9 +340,9 @@ def alloc_local(plan: Plan3D, fill=None):
     """Allocate a global array laid out per the plan's input sharding
     (``fft_mpi_alloc_local_memory``, ``fft_mpi_3d_api.h:73``)."""
     if fill is None:
-        arr = jnp.zeros(plan.shape, plan.dtype)
+        arr = jnp.zeros(plan.in_shape, plan.in_dtype)
     else:
-        arr = jnp.asarray(fill, dtype=plan.dtype)
+        arr = jnp.asarray(fill, dtype=plan.in_dtype)
     if plan.in_sharding is not None:
         arr = jax.device_put(arr, plan.in_sharding)
     return arr

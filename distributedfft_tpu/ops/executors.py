@@ -93,3 +93,67 @@ def _matmul_executor(x: Array, axes: Sequence[int], forward: bool = True) -> Arr
 
 
 register_executor("matmul", _matmul_executor)
+
+
+# --- real <-> complex transforms (the heFFTe r2c/c2r executor surface,
+# ``heffte_backend_rocm.h:567`` ``rocfft_executor_r2c``; geometry shrink
+# ``box3d::r2c``, ``heffte_geometry.h:94``). Each executor may register its
+# own pair; unregistered executors fall back to the XLA implementation.
+
+_R2C_REGISTRY: dict[str, Callable] = {}
+_C2R_REGISTRY: dict[str, Callable] = {}
+
+
+def register_real_executor(name: str, r2c: Callable, c2r: Callable) -> None:
+    _R2C_REGISTRY[name] = r2c
+    _C2R_REGISTRY[name] = c2r
+
+
+def _xla_r2c(x: Array, axis: int) -> Array:
+    """Real-to-complex DFT along ``axis``: output extent n//2+1,
+    unnormalized."""
+    return jnp.fft.rfft(x, axis=axis)
+
+
+def _xla_c2r(y: Array, n: int, axis: int) -> Array:
+    """Complex-to-real inverse DFT along ``axis`` back to true extent ``n``;
+    scaled by 1/n (numpy convention)."""
+    return jnp.fft.irfft(y, n=n, axis=axis)
+
+
+register_real_executor("xla", _xla_r2c, _xla_c2r)
+
+
+def _matmul_r2c(x: Array, axis: int) -> Array:
+    from . import dft_matmul
+
+    n = x.shape[axis]
+    y = dft_matmul.fft_along_axis(x, axis, forward=True)
+    import jax.lax as lax
+
+    return lax.slice_in_dim(y, 0, n // 2 + 1, axis=axis)
+
+
+def _matmul_c2r(y: Array, n: int, axis: int) -> Array:
+    from . import dft_matmul
+    import jax.lax as lax
+
+    # Rebuild the full hermitian spectrum from the non-redundant half, then a
+    # plain complex inverse; imaginary residue is dropped.
+    h = y.shape[axis]
+    mirror = lax.slice_in_dim(y, 1, n - h + 1, axis=axis)
+    mirror = jnp.conj(jnp.flip(mirror, axis=axis))
+    full = jnp.concatenate([y, mirror], axis=axis)
+    x = dft_matmul.fft_along_axis(full, axis, forward=False)
+    return jnp.real(x)
+
+
+register_real_executor("matmul", _matmul_r2c, _matmul_c2r)
+
+
+def get_r2c(name: str) -> Callable:
+    return _R2C_REGISTRY.get(name, _xla_r2c)
+
+
+def get_c2r(name: str) -> Callable:
+    return _C2R_REGISTRY.get(name, _xla_c2r)

@@ -38,7 +38,7 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from ..geometry import pad_to
-from ..ops.executors import get_executor
+from ..ops.executors import get_c2r, get_executor, get_r2c
 from .slab import _crop_axis, _pad_axis
 
 
@@ -141,6 +141,85 @@ def build_pencil_fft3d(
     jit_kw: dict = {"donate_argnums": 0} if donate else {}
     if even:
         jit_kw |= {"in_shardings": in_sh, "out_shardings": out_sh}
+
+    @functools.partial(jax.jit, **jit_kw)
+    def fn(x):
+        x = lax.with_sharding_constraint(pre(x), in_sh)
+        return post(mapped(x))
+
+    return fn, spec
+
+
+def build_pencil_rfft3d(
+    mesh: Mesh,
+    shape: tuple[int, int, int],
+    *,
+    row_axis: str = "row",
+    col_axis: str = "col",
+    executor: str = "xla",
+    forward: bool = True,
+    donate: bool = False,
+) -> tuple[Callable, PencilSpec]:
+    """Pencil-decomposed r2c (forward) / c2r (backward) 3D transform.
+
+    The real axis is Z (axis 2), full-extent in the input z-pencils, so the
+    r2c shrink to ``n2//2+1`` happens before the first exchange — mirroring
+    heFFTe's rule that the r2c reduction runs on the first pencil stage
+    (``src/heffte_fft3d.cpp:202-304``). Forward maps real z-pencils
+    ``[N0, N1, N2]`` to complex x-pencils ``[N0, N1, N2//2+1]``.
+    """
+    if not isinstance(executor, str):
+        raise TypeError("r2c builders take a registered executor name")
+    rows, cols = mesh.shape[row_axis], mesh.shape[col_axis]
+    spec = PencilSpec(tuple(int(s) for s in shape), rows, cols, row_axis, col_axis)
+    ex = get_executor(executor)
+    r2c, c2r = get_r2c(executor), get_c2r(executor)
+    n0, n1, n2 = spec.shape
+    n0p, n1pc, n1pr = spec.n0p, spec.n1p_col, spec.n1p_row
+    n2h = n2 // 2 + 1
+    n2hp = pad_to(n2h, cols)
+
+    if forward:
+
+        def local_fn(x):  # real [n0p/rows, n1pc/cols, N2]
+            y = r2c(x, 2)                               # t0: real Z lines
+            y = _pad_axis(y, 2, n2hp)
+            y = lax.all_to_all(y, col_axis, split_axis=2, concat_axis=1, tiled=True)
+            y = _crop_axis(y, 1, n1)
+            y = ex(y, (1,), True)                       # Y lines
+            y = _pad_axis(y, 1, n1pr)
+            y = lax.all_to_all(y, row_axis, split_axis=1, concat_axis=0, tiled=True)
+            y = _crop_axis(y, 0, n0)
+            return ex(y, (0,), True)                    # t3: X lines
+
+        in_spec, out_spec = spec.in_spec, spec.out_spec
+        pre = lambda x: _pad_axis(_pad_axis(x, 0, n0p), 1, n1pc)
+        post = lambda y: _crop_axis(_crop_axis(y, 1, n1), 2, n2h)
+    else:
+
+        def local_fn(y):  # complex [N0, n1pr/rows, n2hp/cols]
+            x = ex(y, (0,), False)                      # inverse X lines
+            x = _pad_axis(x, 0, n0p)
+            x = lax.all_to_all(x, row_axis, split_axis=0, concat_axis=1, tiled=True)
+            x = _crop_axis(x, 1, n1)
+            x = ex(x, (1,), False)                      # inverse Y lines
+            x = _pad_axis(x, 1, n1pc)
+            x = lax.all_to_all(x, col_axis, split_axis=1, concat_axis=2, tiled=True)
+            x = _crop_axis(x, 2, n2h)
+            return c2r(x, n2, 2)                        # real Z lines
+
+        in_spec, out_spec = spec.out_spec, spec.in_spec
+        pre = lambda y: _pad_axis(_pad_axis(y, 1, n1pr), 2, n2hp)
+        post = lambda x: _crop_axis(_crop_axis(x, 0, n0), 1, n1)
+
+    mapped = _shard_map(local_fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec)
+    in_sh = NamedSharding(mesh, in_spec)
+    jit_kw: dict = {"donate_argnums": 0} if donate else {}
+    # The complex extent n2h = n2//2+1 rarely divides the col axis even when
+    # n2 does, so sharding pinning additionally requires n2hp == n2h.
+    if n0p == n0 and n1pc == n1 and n1pr == n1 and n2hp == n2h:
+        jit_kw |= {"in_shardings": in_sh,
+                   "out_shardings": NamedSharding(mesh, out_spec)}
 
     @functools.partial(jax.jit, **jit_kw)
     def fn(x):

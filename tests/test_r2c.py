@@ -1,0 +1,112 @@
+"""Distributed real-to-complex / complex-to-real transforms, modeled on
+heFFTe's r2c tier (``test/test_fft3d_r2c.cpp``): seeded real world data,
+``numpy.fft.rfftn`` as the serial reference, roundtrip back to real."""
+
+import numpy as np
+import pytest
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu import testing as tu
+
+
+def _ref_rfftn(x):
+    return np.fft.rfftn(x.astype(np.float64))
+
+
+def test_single_device_r2c_matches_numpy():
+    shape = (16, 12, 20)
+    x = tu.make_world_data(shape, dtype=np.float64)
+    plan = dfft.plan_dft_r2c_3d(shape)
+    y = np.asarray(plan(x))
+    assert y.shape == (16, 12, 11)
+    assert y.dtype == np.complex128
+    tu.assert_approx(y, _ref_rfftn(x))
+
+
+def test_single_device_c2r_roundtrip():
+    shape = (16, 12, 20)
+    x = tu.make_world_data(shape, dtype=np.float64)
+    fwd = dfft.plan_dft_r2c_3d(shape)
+    bwd = dfft.plan_dft_c2r_3d(shape)
+    r = np.asarray(bwd(fwd(x)))
+    assert r.dtype == np.float64
+    tu.assert_approx(r, x)
+
+
+@pytest.mark.parametrize("nslabs", [2, 4, 8])
+@pytest.mark.parametrize("shape", [(16, 16, 16), (32, 8, 12)])
+def test_slab_r2c_matches_numpy(nslabs, shape):
+    mesh = dfft.make_mesh(nslabs)
+    x = tu.make_world_data(shape, dtype=np.float64)
+    plan = dfft.plan_dft_r2c_3d(shape, mesh)
+    assert plan.decomposition == "slab"
+    y = np.asarray(plan(x))
+    assert y.shape == (shape[0], shape[1], shape[2] // 2 + 1)
+    tu.assert_approx(y, _ref_rfftn(x))
+
+
+@pytest.mark.parametrize("shape", [(10, 14, 6), (7, 9, 5), (13, 16, 11)])
+def test_slab_r2c_uneven_roundtrip(shape):
+    mesh = dfft.make_mesh(4)
+    x = tu.make_world_data(shape, dtype=np.float64)
+    fwd = dfft.plan_dft_r2c_3d(shape, mesh)
+    bwd = dfft.plan_dft_c2r_3d(shape, mesh)
+    y = np.asarray(fwd(x))
+    tu.assert_approx(y, _ref_rfftn(x))
+    tu.assert_approx(np.asarray(bwd(y)), x)
+
+
+@pytest.mark.parametrize("grid", [(2, 2), (2, 4), (4, 2)])
+def test_pencil_r2c_matches_numpy(grid):
+    shape = (16, 16, 16)
+    mesh = dfft.make_mesh(grid)
+    x = tu.make_world_data(shape, dtype=np.float64)
+    plan = dfft.plan_dft_r2c_3d(shape, mesh)
+    assert plan.decomposition == "pencil"
+    y = np.asarray(plan(x))
+    tu.assert_approx(y, _ref_rfftn(x))
+
+
+@pytest.mark.parametrize("shape", [(12, 10, 14), (9, 7, 11)])
+def test_pencil_r2c_uneven_roundtrip(shape):
+    mesh = dfft.make_mesh((2, 4))
+    x = tu.make_world_data(shape, dtype=np.float64)
+    fwd = dfft.plan_dft_r2c_3d(shape, mesh)
+    bwd = dfft.plan_dft_c2r_3d(shape, mesh)
+    y = np.asarray(fwd(x))
+    tu.assert_approx(y, _ref_rfftn(x))
+    tu.assert_approx(np.asarray(bwd(y)), x)
+
+
+@pytest.mark.parametrize("executor", ["xla", "matmul"])
+@pytest.mark.parametrize("n2", [16, 15])
+def test_r2c_executors_agree(executor, n2):
+    """Cross-backend r2c check, even and odd real-axis extents (the hermitian
+    mirror reconstruction differs)."""
+    shape = (8, 8, n2)
+    x = tu.make_world_data(shape, dtype=np.float64)
+    fwd = dfft.plan_dft_r2c_3d(shape, executor=executor)
+    bwd = dfft.plan_dft_c2r_3d(shape, executor=executor)
+    y = np.asarray(fwd(x))
+    tu.assert_approx(y, _ref_rfftn(x))
+    tu.assert_approx(np.asarray(bwd(y)), x)
+
+
+def test_r2c_float32_tier():
+    shape = (16, 16, 16)
+    mesh = dfft.make_mesh(4)
+    x = tu.make_world_data(shape, dtype=np.float32)
+    plan = dfft.plan_dft_r2c_3d(shape, mesh, dtype=np.complex64)
+    y = np.asarray(plan(x))
+    assert y.dtype == np.complex64
+    tu.assert_approx(y, _ref_rfftn(x), dtype=np.complex64)
+
+
+def test_r2c_boxes_tile_worlds():
+    from distributedfft_tpu.geometry import world_box, world_complete
+
+    shape = (10, 14, 6)
+    mesh = dfft.make_mesh(4)
+    plan = dfft.plan_dft_r2c_3d(shape, mesh)
+    assert world_complete(plan.in_boxes, world_box(shape))
+    assert world_complete(plan.out_boxes, world_box((10, 14, 4)))
