@@ -28,5 +28,13 @@ from .api import (  # noqa: F401
 from .geometry import Box3, world_box  # noqa: F401
 from .ops.executors import Scale, available_executors  # noqa: F401
 from .parallel.mesh import make_mesh  # noqa: F401
+from .parallel.reshape import make_reshape3d, reshape3d  # noqa: F401
+from .plan_logic import (  # noqa: F401
+    LogicPlan,
+    PlanOptions,
+    choose_decomposition,
+    default_options,
+    logic_plan3d,
+)
 
 __version__ = "0.1.0"

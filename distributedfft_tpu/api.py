@@ -10,12 +10,16 @@ Mirrors the reference's FFTW-MPI-style C API
     fft_mpi_destroy_plan          -> :func:`destroy_plan` (a no-op: buffers
                                      are GC'd, plans are immutable)
 
+plus the heFFTe-style r2c pair (``heffte_fft3d_r2c.h``):
+:func:`plan_dft_r2c_3d` / :func:`plan_dft_c2r_3d`.
+
 A plan captures everything the reference resolves at plan time — geometry,
 exchange tables, compiled kernels (``setFFTPlans``,
 ``fft_mpi_3d_api.cpp:318-429``; hipRTC compilation,
 ``templateFFT.cpp:5621-5712``) — as jit-compiled XLA executables; execution
 only replays them, exactly as ``launchFFTKernel`` only replays precomputed
-launches (``templateFFT.cpp:6212-6260``).
+launches (``templateFFT.cpp:6212-6260``). Decomposition/mesh/algorithm
+decisions live in :mod:`.plan_logic` (the ``plan_operations`` analog).
 
 Transform convention is numpy's: forward unnormalized, inverse scaled by
 1/N. heFFTe-style ``Scale`` options are applied on top (see
@@ -26,18 +30,23 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass, field
-from typing import Any, Callable, Sequence
+from typing import Any, Sequence, Callable
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import geometry as geo
 from .geometry import Box3, world_box
-from .ops.executors import Scale, apply_scale, get_executor
-from .parallel.mesh import SLAB_AXIS, PENCIL_AXES, make_mesh
-from .parallel.pencil import PencilSpec, build_pencil_fft3d
+from .ops.executors import Scale, apply_scale, get_c2r, get_executor, get_r2c
+from .plan_logic import (
+    DEFAULT_OPTIONS,
+    LogicPlan,
+    PlanOptions,
+    io_boxes,
+    logic_plan3d,
+)
+from .parallel.pencil import PencilSpec, build_pencil_fft3d, build_pencil_rfft3d
 from .parallel.slab import (
     SlabSpec,
     build_slab_fft3d,
@@ -51,7 +60,7 @@ BACKWARD = +1  # FFTW_BACKWARD
 
 @dataclass
 class Plan3D:
-    """A compiled distributed 3D C2C FFT plan (one direction).
+    """A compiled distributed 3D FFT plan (one direction).
 
     The analog of the reference's plan struct
     (``fft_mpi_3d_api.h:11-66``): owns the decomposition geometry, the
@@ -77,6 +86,7 @@ class Plan3D:
     in_dtype: Any = None
     out_dtype: Any = None
     real: bool = False
+    options: PlanOptions = DEFAULT_OPTIONS
 
     def __post_init__(self) -> None:
         if self.in_shape is None:
@@ -103,8 +113,67 @@ class Plan3D:
         return geo.fft_flops(self.shape)
 
 
-def _slab_boxes(shape, p, axis):
-    return geo.make_slabs(world_box(shape), p, axis=axis, rule=geo.ceil_splits)
+def _resolve_options(
+    decomposition: str | None,
+    executor: str,
+    donate: bool,
+    algorithm: str,
+    options: PlanOptions | None,
+) -> PlanOptions:
+    if options is not None:
+        if (decomposition is not None or executor != "xla" or donate
+                or algorithm != "alltoall"):
+            raise ValueError(
+                "pass either options= or individual plan keywords, not both"
+            )
+        return options
+    return PlanOptions(
+        decomposition=decomposition or "auto",
+        algorithm=algorithm,
+        executor=executor,
+        donate=donate,
+    )
+
+
+def _check_direction(shape, direction) -> tuple[tuple[int, int, int], bool]:
+    shape = tuple(int(s) for s in shape)
+    if len(shape) != 3:
+        raise ValueError("3D plans require a 3D shape")
+    if direction not in (FORWARD, BACKWARD):
+        raise ValueError("direction must be FORWARD (-1) or BACKWARD (+1)")
+    return shape, direction == FORWARD
+
+
+def _default_cdtype(dtype):
+    if dtype is None:
+        return jnp.dtype(
+            jnp.complex128 if jax.config.jax_enable_x64 else jnp.complex64
+        )
+    return jnp.dtype(dtype)
+
+
+def _shardings(lp: LogicPlan, forward: bool):
+    """Input/output NamedShardings for the resolved decomposition: slabs go
+    X-slabs <-> Y-slabs, pencils z-pencils <-> x-pencils."""
+    mesh = lp.mesh
+    if mesh is None:
+        return None, None
+    if lp.decomposition == "slab":
+        a = mesh.axis_names[0]
+        x_sh = NamedSharding(mesh, P(a, None, None))
+        y_sh = NamedSharding(mesh, P(None, a, None))
+        return (x_sh, y_sh) if forward else (y_sh, x_sh)
+    row, col = mesh.axis_names[:2]
+    z_sh = NamedSharding(mesh, P(row, col, None))
+    x_sh = NamedSharding(mesh, P(None, row, col))
+    return (z_sh, x_sh) if forward else (x_sh, z_sh)
+
+
+def _boxes(lp: LogicPlan, world_in: Box3, world_out: Box3):
+    """Per-device input/output boxes for the *forward* orientation of the
+    decomposition; r2c plans pass a shrunk complex-side world. Delegates to
+    :func:`.plan_logic.io_boxes` (one source of truth with ``lp.stages``)."""
+    return io_boxes(lp.decomposition, lp.mesh, world_in, world_out)
 
 
 def plan_dft_c2c_3d(
@@ -116,12 +185,15 @@ def plan_dft_c2c_3d(
     executor: str = "xla",
     dtype: Any = None,
     donate: bool = False,
+    algorithm: str = "alltoall",
+    options: PlanOptions | None = None,
 ) -> Plan3D:
     """Create a distributed 3D complex-to-complex FFT plan.
 
     ``mesh`` may be a :class:`jax.sharding.Mesh` (1D -> slab, 2D -> pencil),
-    an int (build a 1D slab mesh of that many devices), or None (single
-    device). ``direction`` uses the FFTW sign convention (-1 forward).
+    an int (decomposition chosen by :func:`~.plan_logic.choose_decomposition`
+    and the mesh built to fit), or None (single device). ``direction`` uses
+    the FFTW sign convention (-1 forward).
 
     cf. ``fft_mpi_plan_dft_c2c_3d`` (``fft_mpi_3d_api.cpp:41``), which also
     fixes direction at plan time and builds one plan per direction.
@@ -131,78 +203,39 @@ def plan_dft_c2c_3d(
     grids) at the cost of repeat-execution on the same array; the default
     keeps FFTW-style repeatable-execute semantics.
     """
-    shape = tuple(int(s) for s in shape)
-    if len(shape) != 3:
-        raise ValueError("plan_dft_c2c_3d requires a 3D shape")
-    if direction not in (FORWARD, BACKWARD):
-        raise ValueError("direction must be FORWARD (-1) or BACKWARD (+1)")
-    if dtype is None:
-        dtype = jnp.complex128 if jax.config.jax_enable_x64 else jnp.complex64
-    forward = direction == FORWARD
-
-    if isinstance(mesh, int):
-        mesh = make_mesh(mesh)
-
-    if mesh is None or math.prod(mesh.devices.shape) == 1:
-        decomposition = "single"
-    elif decomposition is None:
-        decomposition = "pencil" if len(mesh.axis_names) == 2 else "slab"
-
+    shape, forward = _check_direction(shape, direction)
+    opts = _resolve_options(decomposition, executor, donate, algorithm, options)
+    dtype = _default_cdtype(dtype)
+    lp = logic_plan3d(shape, mesh, opts)
     world = world_box(shape)
 
-    if decomposition == "single":
-        ex = get_executor(executor)
-
+    if lp.decomposition == "single":
+        ex = get_executor(opts.executor)
         fn = jax.jit(lambda x: ex(x, (0, 1, 2), forward))
-        return Plan3D(
-            shape=shape, direction=direction, dtype=dtype,
-            decomposition="single", executor=executor, mesh=None, fn=fn,
-            spec=None, in_sharding=None, out_sharding=None,
-            in_boxes=[world], out_boxes=[world],
-        )
-
-    if decomposition == "slab":
-        axis_name = mesh.axis_names[0]
-        p = mesh.shape[axis_name]
+        spec = None
+    elif lp.decomposition == "slab":
         fn, spec = build_slab_fft3d(
-            mesh, shape, axis_name=axis_name, executor=executor,
-            forward=forward, donate=donate,
+            lp.mesh, shape, axis_name=lp.mesh.axis_names[0],
+            executor=opts.executor, forward=forward, donate=opts.donate,
+            algorithm=opts.algorithm,
         )
-        x_sh = NamedSharding(mesh, P(axis_name, None, None))
-        y_sh = NamedSharding(mesh, P(None, axis_name, None))
-        in_sh, out_sh = (x_sh, y_sh) if forward else (y_sh, x_sh)
-        xb = _slab_boxes(shape, p, 0)
-        yb = _slab_boxes(shape, p, 1)
-        in_boxes, out_boxes = (xb, yb) if forward else (yb, xb)
-        return Plan3D(
-            shape=shape, direction=direction, dtype=dtype, decomposition="slab",
-            executor=executor, mesh=mesh, fn=fn, spec=spec,
-            in_sharding=in_sh, out_sharding=out_sh,
-            in_boxes=in_boxes, out_boxes=out_boxes,
-        )
-
-    if decomposition == "pencil":
-        row, col = mesh.axis_names[:2]
+    else:
+        row, col = lp.mesh.axis_names[:2]
         fn, spec = build_pencil_fft3d(
-            mesh, shape, row_axis=row, col_axis=col,
-            executor=executor, forward=forward, donate=donate,
-        )
-        z_sh = NamedSharding(mesh, P(row, col, None))
-        x_sh = NamedSharding(mesh, P(None, row, col))
-        in_sh, out_sh = (z_sh, x_sh) if forward else (x_sh, z_sh)
-        zb = geo.make_pencils(world, (mesh.shape[row], mesh.shape[col]), 2,
-                              rule=geo.ceil_splits)
-        xb = geo.make_pencils(world, (mesh.shape[row], mesh.shape[col]), 0,
-                              rule=geo.ceil_splits)
-        in_boxes, out_boxes = (zb, xb) if forward else (xb, zb)
-        return Plan3D(
-            shape=shape, direction=direction, dtype=dtype,
-            decomposition="pencil", executor=executor, mesh=mesh, fn=fn,
-            spec=spec, in_sharding=in_sh, out_sharding=out_sh,
-            in_boxes=in_boxes, out_boxes=out_boxes,
+            lp.mesh, shape, row_axis=row, col_axis=col,
+            executor=opts.executor, forward=forward, donate=opts.donate,
+            algorithm=opts.algorithm,
         )
 
-    raise ValueError(f"unknown decomposition {decomposition!r}")
+    in_sh, out_sh = _shardings(lp, forward)
+    fb, bb = _boxes(lp, world, world)
+    in_boxes, out_boxes = (fb, bb) if forward else (bb, fb)
+    return Plan3D(
+        shape=shape, direction=direction, dtype=dtype,
+        decomposition=lp.decomposition, executor=opts.executor, mesh=lp.mesh,
+        fn=fn, spec=spec, in_sharding=in_sh, out_sharding=out_sh,
+        in_boxes=in_boxes, out_boxes=out_boxes, options=lp.options,
+    )
 
 
 def plan_dft_r2c_3d(
@@ -214,6 +247,8 @@ def plan_dft_r2c_3d(
     executor: str = "xla",
     dtype: Any = None,
     donate: bool = False,
+    algorithm: str = "alltoall",
+    options: PlanOptions | None = None,
 ) -> Plan3D:
     """Create a distributed real-to-complex (forward) / complex-to-real
     (backward) 3D FFT plan — heFFTe ``fft3d_r2c`` parity
@@ -223,97 +258,56 @@ def plan_dft_r2c_3d(
     along axis 2 to ``N2//2+1``. Forward input is real; backward output is
     real with numpy 1/N scaling.
     """
-    shape = tuple(int(s) for s in shape)
-    if len(shape) != 3:
-        raise ValueError("plan_dft_r2c_3d requires a 3D shape")
-    if direction not in (FORWARD, BACKWARD):
-        raise ValueError("direction must be FORWARD (-1) or BACKWARD (+1)")
-    if dtype is None:
-        dtype = jnp.complex128 if jax.config.jax_enable_x64 else jnp.complex64
-    dtype = jnp.dtype(dtype)
+    shape, forward = _check_direction(shape, direction)
+    opts = _resolve_options(decomposition, executor, donate, algorithm, options)
+    dtype = _default_cdtype(dtype)
+    if not jnp.issubdtype(dtype, jnp.complexfloating):
+        raise ValueError(
+            f"r2c plans take the complex working dtype, got {dtype}; the real "
+            "side is derived from it"
+        )
     rdtype = jnp.float64 if dtype == jnp.complex128 else jnp.float32
-    forward = direction == FORWARD
     n0, n1, n2 = shape
     cshape = (n0, n1, n2 // 2 + 1)
-    in_shape, out_shape = (shape, cshape) if forward else (cshape, shape)
-    in_dtype, out_dtype = (rdtype, dtype) if forward else (dtype, rdtype)
+    lp = logic_plan3d(shape, mesh, opts)
+    world, cworld = world_box(shape), world_box(cshape)
 
-    if isinstance(mesh, int):
-        mesh = make_mesh(mesh)
-    if mesh is None or math.prod(mesh.devices.shape) == 1:
-        decomposition = "single"
-    elif decomposition is None:
-        decomposition = "pencil" if len(mesh.axis_names) == 2 else "slab"
-
-    world = world_box(shape)
-    cworld = world_box(cshape)
-    common = dict(
-        shape=shape, direction=direction, dtype=dtype, executor=executor,
-        in_shape=in_shape, out_shape=out_shape,
-        in_dtype=in_dtype, out_dtype=out_dtype, real=True,
-    )
-
-    if decomposition == "single":
-        from .ops.executors import get_c2r, get_r2c
-
-        ex = get_executor(executor)
-        r2c, c2r = get_r2c(executor), get_c2r(executor)
+    if lp.decomposition == "single":
+        ex = get_executor(opts.executor)
+        r2c, c2r = get_r2c(opts.executor), get_c2r(opts.executor)
         if forward:
             fn = jax.jit(lambda x: ex(r2c(x, 2), (0, 1), True))
         else:
             fn = jax.jit(lambda y: c2r(ex(y, (0, 1), False), n2, 2))
-        return Plan3D(
-            decomposition="single", mesh=None, fn=fn, spec=None,
-            in_sharding=None, out_sharding=None,
-            in_boxes=[world if forward else cworld],
-            out_boxes=[cworld if forward else world],
-            **common,
-        )
-
-    if decomposition == "slab":
-        axis_name = mesh.axis_names[0]
-        p = mesh.shape[axis_name]
+        spec = None
+    elif lp.decomposition == "slab":
         fn, spec = build_slab_rfft3d(
-            mesh, shape, axis_name=axis_name, executor=executor,
-            forward=forward, donate=donate,
+            lp.mesh, shape, axis_name=lp.mesh.axis_names[0],
+            executor=opts.executor, forward=forward, donate=opts.donate,
+            algorithm=opts.algorithm,
         )
-        x_sh = NamedSharding(mesh, P(axis_name, None, None))
-        y_sh = NamedSharding(mesh, P(None, axis_name, None))
-        in_sh, out_sh = (x_sh, y_sh) if forward else (y_sh, x_sh)
-        xb = geo.make_slabs(world, p, axis=0, rule=geo.ceil_splits)
-        yb = geo.make_slabs(cworld, p, axis=1, rule=geo.ceil_splits)
-        in_boxes, out_boxes = (xb, yb) if forward else (yb, xb)
-        return Plan3D(
-            decomposition="slab", mesh=mesh, fn=fn, spec=spec,
-            in_sharding=in_sh, out_sharding=out_sh,
-            in_boxes=in_boxes, out_boxes=out_boxes,
-            **common,
-        )
-
-    if decomposition == "pencil":
-        from .parallel.pencil import build_pencil_rfft3d
-
-        row, col = mesh.axis_names[:2]
+    else:
+        row, col = lp.mesh.axis_names[:2]
         fn, spec = build_pencil_rfft3d(
-            mesh, shape, row_axis=row, col_axis=col,
-            executor=executor, forward=forward, donate=donate,
-        )
-        z_sh = NamedSharding(mesh, P(row, col, None))
-        x_sh = NamedSharding(mesh, P(None, row, col))
-        in_sh, out_sh = (z_sh, x_sh) if forward else (x_sh, z_sh)
-        zb = geo.make_pencils(world, (mesh.shape[row], mesh.shape[col]), 2,
-                              rule=geo.ceil_splits)
-        xb = geo.make_pencils(cworld, (mesh.shape[row], mesh.shape[col]), 0,
-                              rule=geo.ceil_splits)
-        in_boxes, out_boxes = (zb, xb) if forward else (xb, zb)
-        return Plan3D(
-            decomposition="pencil", mesh=mesh, fn=fn, spec=spec,
-            in_sharding=in_sh, out_sharding=out_sh,
-            in_boxes=in_boxes, out_boxes=out_boxes,
-            **common,
+            lp.mesh, shape, row_axis=row, col_axis=col,
+            executor=opts.executor, forward=forward, donate=opts.donate,
+            algorithm=opts.algorithm,
         )
 
-    raise ValueError(f"unknown decomposition {decomposition!r}")
+    in_sh, out_sh = _shardings(lp, forward)
+    fb, bb = _boxes(lp, world, cworld)
+    in_boxes, out_boxes = (fb, bb) if forward else (bb, fb)
+    return Plan3D(
+        shape=shape, direction=direction, dtype=dtype,
+        decomposition=lp.decomposition, executor=opts.executor, mesh=lp.mesh,
+        fn=fn, spec=spec, in_sharding=in_sh, out_sharding=out_sh,
+        in_boxes=in_boxes, out_boxes=out_boxes,
+        in_shape=shape if forward else cshape,
+        out_shape=cshape if forward else shape,
+        in_dtype=rdtype if forward else dtype,
+        out_dtype=dtype if forward else rdtype,
+        real=True, options=lp.options,
+    )
 
 
 def plan_dft_c2r_3d(shape, mesh=None, **kw) -> Plan3D:

@@ -39,6 +39,7 @@ except ImportError:  # pragma: no cover
 
 from ..geometry import pad_to
 from ..ops.executors import get_c2r, get_executor, get_r2c
+from .exchange import exchange
 from .slab import _crop_axis, _pad_axis
 
 
@@ -86,6 +87,7 @@ def build_pencil_fft3d(
     executor: str | Callable = "xla",
     forward: bool = True,
     donate: bool = False,
+    algorithm: str = "alltoall",
 ) -> tuple[Callable, PencilSpec]:
     """Build the jitted end-to-end pencil transform.
 
@@ -104,12 +106,14 @@ def build_pencil_fft3d(
             y = ex(x, (2,), True)                       # t0: Z lines
             y = _pad_axis(y, 2, n2p)
             # z-pencils -> y-pencils: exchange along cols
-            y = lax.all_to_all(y, col_axis, split_axis=2, concat_axis=1, tiled=True)
+            y = exchange(y, col_axis, split_axis=2, concat_axis=1, axis_size=cols,
+                         algorithm=algorithm)
             y = _crop_axis(y, 1, n1)                    # true Y extent
             y = ex(y, (1,), True)                       # Y lines
             y = _pad_axis(y, 1, n1pr)
             # y-pencils -> x-pencils: exchange along rows
-            y = lax.all_to_all(y, row_axis, split_axis=1, concat_axis=0, tiled=True)
+            y = exchange(y, row_axis, split_axis=1, concat_axis=0, axis_size=rows,
+                         algorithm=algorithm)
             y = _crop_axis(y, 0, n0)                    # true X extent
             return ex(y, (0,), True)                    # t3: X lines
 
@@ -121,11 +125,13 @@ def build_pencil_fft3d(
         def local_fn(y):  # [N0, n1pr/rows, n2p/cols]
             x = ex(y, (0,), False)                      # inverse X lines
             x = _pad_axis(x, 0, n0p)
-            x = lax.all_to_all(x, row_axis, split_axis=0, concat_axis=1, tiled=True)
+            x = exchange(x, row_axis, split_axis=0, concat_axis=1, axis_size=rows,
+                         algorithm=algorithm)
             x = _crop_axis(x, 1, n1)
             x = ex(x, (1,), False)                      # inverse Y lines
             x = _pad_axis(x, 1, n1pc)
-            x = lax.all_to_all(x, col_axis, split_axis=1, concat_axis=2, tiled=True)
+            x = exchange(x, col_axis, split_axis=1, concat_axis=2, axis_size=cols,
+                         algorithm=algorithm)
             x = _crop_axis(x, 2, n2)
             return ex(x, (2,), False)                   # inverse Z lines
 
@@ -159,6 +165,7 @@ def build_pencil_rfft3d(
     executor: str = "xla",
     forward: bool = True,
     donate: bool = False,
+    algorithm: str = "alltoall",
 ) -> tuple[Callable, PencilSpec]:
     """Pencil-decomposed r2c (forward) / c2r (backward) 3D transform.
 
@@ -184,11 +191,13 @@ def build_pencil_rfft3d(
         def local_fn(x):  # real [n0p/rows, n1pc/cols, N2]
             y = r2c(x, 2)                               # t0: real Z lines
             y = _pad_axis(y, 2, n2hp)
-            y = lax.all_to_all(y, col_axis, split_axis=2, concat_axis=1, tiled=True)
+            y = exchange(y, col_axis, split_axis=2, concat_axis=1, axis_size=cols,
+                         algorithm=algorithm)
             y = _crop_axis(y, 1, n1)
             y = ex(y, (1,), True)                       # Y lines
             y = _pad_axis(y, 1, n1pr)
-            y = lax.all_to_all(y, row_axis, split_axis=1, concat_axis=0, tiled=True)
+            y = exchange(y, row_axis, split_axis=1, concat_axis=0, axis_size=rows,
+                         algorithm=algorithm)
             y = _crop_axis(y, 0, n0)
             return ex(y, (0,), True)                    # t3: X lines
 
@@ -200,11 +209,13 @@ def build_pencil_rfft3d(
         def local_fn(y):  # complex [N0, n1pr/rows, n2hp/cols]
             x = ex(y, (0,), False)                      # inverse X lines
             x = _pad_axis(x, 0, n0p)
-            x = lax.all_to_all(x, row_axis, split_axis=0, concat_axis=1, tiled=True)
+            x = exchange(x, row_axis, split_axis=0, concat_axis=1, axis_size=rows,
+                         algorithm=algorithm)
             x = _crop_axis(x, 1, n1)
             x = ex(x, (1,), False)                      # inverse Y lines
             x = _pad_axis(x, 1, n1pc)
-            x = lax.all_to_all(x, col_axis, split_axis=1, concat_axis=2, tiled=True)
+            x = exchange(x, col_axis, split_axis=1, concat_axis=2, axis_size=cols,
+                         algorithm=algorithm)
             x = _crop_axis(x, 2, n2h)
             return c2r(x, n2, 2)                        # real Z lines
 

@@ -1,0 +1,49 @@
+"""Standalone distributed reshapes: brick layout A -> brick layout B.
+
+heFFTe's reshape engine (``heffte_reshape3d.h:60-498``) moves data between
+arbitrary box decompositions with four MPI algorithms and explicit
+pack/unpack kernels (``heffte_pack3d.h``). On TPU the same operation is a
+*resharding*: the global array stays logically fixed and only its
+:class:`~jax.sharding.NamedSharding` changes; XLA emits the collective
+(all-to-all / collective-permute / all-gather as needed) and fuses the
+pack/unpack into it — the role of ``direct_packer``/``transpose_packer``
+(``heffte_pack3d.h:83,116``) is played by layout assignment.
+
+Decompositions expressible this way are the regular grids a ``PartitionSpec``
+can name (slabs, pencils, bricks from mesh-axis products) — the arbitrary
+per-rank boxes of heFFTe's C API collapse to these on a mesh, since TPU
+collectives require uniform shards (pad/crop handles ragged extents at the
+plan layer, see :mod:`.slab`).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_reshape3d(
+    mesh: Mesh, in_spec: P, out_spec: P, *, donate: bool = False
+) -> Callable:
+    """Build a jitted reshard: array sharded ``in_spec`` -> ``out_spec``.
+
+    The analog of ``make_reshape3d`` (``heffte_reshape3d.h:498``), with the
+    algorithm menu replaced by XLA's collective selection. Works for any
+    global shape (one compiled executable per shape, cached by jit).
+    """
+    in_sh = NamedSharding(mesh, in_spec)
+    out_sh = NamedSharding(mesh, out_spec)
+
+    def _fn(x):
+        x = lax.with_sharding_constraint(x, in_sh)
+        return lax.with_sharding_constraint(x, out_sh)
+
+    return jax.jit(_fn, donate_argnums=0) if donate else jax.jit(_fn)
+
+
+def reshape3d(x, mesh: Mesh, out_spec: P):
+    """One-shot reshard of ``x`` to ``out_spec`` on ``mesh``."""
+    return jax.device_put(x, NamedSharding(mesh, out_spec))

@@ -47,6 +47,7 @@ except ImportError:  # pragma: no cover
 
 from ..geometry import pad_to
 from ..ops.executors import get_c2r, get_executor, get_r2c
+from .exchange import exchange
 
 
 def _pad_axis(x: jnp.ndarray, axis: int, to: int) -> jnp.ndarray:
@@ -96,6 +97,7 @@ def build_slab_fft3d(
     executor: str | Callable = "xla",
     forward: bool = True,
     donate: bool = False,
+    algorithm: str = "alltoall",
 ) -> tuple[Callable, SlabSpec]:
     """Build the jitted end-to-end slab transform.
 
@@ -116,7 +118,8 @@ def build_slab_fft3d(
         def local_fn(x):  # [n0p/p, N1, N2] per device
             y = ex(x, (1, 2), True)                      # t0: YZ planes
             y = _pad_axis(y, 1, n1p)                     # t1: exchange prep
-            y = lax.all_to_all(y, axis_name, split_axis=1, concat_axis=0, tiled=True)
+            y = exchange(y, axis_name, split_axis=1, concat_axis=0, axis_size=p,
+                         algorithm=algorithm)
             y = _crop_axis(y, 0, n0)                     # drop axis-0 padding
             return ex(y, (0,), True)                     # t3: X lines
 
@@ -128,7 +131,8 @@ def build_slab_fft3d(
         def local_fn(y):  # [N0, N1p/p, N2] per device
             x = ex(y, (0,), False)                       # inverse X lines
             x = _pad_axis(x, 0, n0p)
-            x = lax.all_to_all(x, axis_name, split_axis=0, concat_axis=1, tiled=True)
+            x = exchange(x, axis_name, split_axis=0, concat_axis=1, axis_size=p,
+                         algorithm=algorithm)
             x = _crop_axis(x, 1, n1)
             return ex(x, (1, 2), False)                  # inverse YZ planes
 
@@ -165,6 +169,7 @@ def build_slab_rfft3d(
     executor: str = "xla",
     forward: bool = True,
     donate: bool = False,
+    algorithm: str = "alltoall",
 ) -> tuple[Callable, SlabSpec]:
     """Slab-decomposed real-to-complex (forward) / complex-to-real (backward)
     3D transform — the distributed analog of heFFTe's ``fft3d_r2c``
@@ -191,7 +196,8 @@ def build_slab_rfft3d(
             y = r2c(x, 2)                                # t0a: real Z lines
             y = ex(y, (1,), True)                        # t0b: Y lines
             y = _pad_axis(y, 1, n1p)
-            y = lax.all_to_all(y, axis_name, split_axis=1, concat_axis=0, tiled=True)
+            y = exchange(y, axis_name, split_axis=1, concat_axis=0, axis_size=p,
+                         algorithm=algorithm)
             y = _crop_axis(y, 0, n0)
             return ex(y, (0,), True)                     # t3: X lines
 
@@ -203,7 +209,8 @@ def build_slab_rfft3d(
         def local_fn(y):  # complex [N0, n1p/p, n2h] per device
             x = ex(y, (0,), False)                       # inverse X lines
             x = _pad_axis(x, 0, n0p)
-            x = lax.all_to_all(x, axis_name, split_axis=0, concat_axis=1, tiled=True)
+            x = exchange(x, axis_name, split_axis=0, concat_axis=1, axis_size=p,
+                         algorithm=algorithm)
             x = _crop_axis(x, 1, n1)
             x = ex(x, (1,), False)                       # inverse Y lines
             return c2r(x, n2, 2)                         # real Z lines
@@ -234,6 +241,7 @@ def build_slab_stages(
     axis_name: str = "slab",
     executor: str | Callable = "xla",
     forward: bool = True,
+    algorithm: str = "alltoall",
 ) -> tuple[list[tuple[str, Callable]], SlabSpec]:
     """The same transform split into separately-jitted t0..t3 stages for the
     per-stage timing breakdown the reference prints on every execute
@@ -262,8 +270,9 @@ def build_slab_stages(
                     _pad_axis(x, 0, n0p)), 1, n1p),
                 in_shardings=x_slab, out_shardings=x_slab)),
             ("t2_all_to_all", jax.jit(
-                smap(lambda v: lax.all_to_all(
-                    v, axis_name, split_axis=1, concat_axis=0, tiled=True), xs, ys),
+                smap(lambda v: exchange(
+                    v, axis_name, split_axis=1, concat_axis=0, axis_size=p,
+                    algorithm=algorithm), xs, ys),
                 in_shardings=x_slab, out_shardings=y_slab)),
             ("t3_fft_x", jax.jit(
                 lambda v: _crop_axis(smap(
@@ -277,8 +286,9 @@ def build_slab_stages(
                     _pad_axis(v, 1, n1p)), 0, n0p),
                 in_shardings=y_slab, out_shardings=y_slab)),
             ("t2_all_to_all", jax.jit(
-                smap(lambda v: lax.all_to_all(
-                    v, axis_name, split_axis=0, concat_axis=1, tiled=True), ys, xs),
+                smap(lambda v: exchange(
+                    v, axis_name, split_axis=0, concat_axis=1, axis_size=p,
+                    algorithm=algorithm), ys, xs),
                 in_shardings=y_slab, out_shardings=x_slab)),
             ("t0_ifft_yz", jax.jit(
                 lambda v: _crop_axis(smap(

@@ -1,0 +1,189 @@
+"""Plan logic: option handling and decomposition selection.
+
+The heFFTe analog layer (``heffte/heffteBenchmark/include/heffte_plan_logic.h``,
+``src/heffte_plan_logic.cpp``): ``plan_options`` {algorithm, use_reorder,
+use_pencils, use_gpu_aware} (``heffte_plan_logic.h:69-89``) and
+``plan_operations`` (``heffte_plan_logic.cpp:410-432``), which inspects the
+in/out geometry and picks the cheapest reshape pipeline.
+
+On TPU the decision space is smaller and different: the transport is always
+XLA collectives over the mesh (no gpu-aware/host-staged split — there is no
+host staging to choose), layout reordering belongs to XLA's layout
+assignment, and the real knobs are
+
+- **decomposition**: slab (one exchange) vs pencil (two exchanges, but each
+  on a smaller mesh axis and with more parallel lines per FFT stage);
+- **exchange algorithm**: one fused ``all_to_all`` vs a pipelined
+  ``ppermute`` ring (:mod:`.parallel.exchange`);
+- **mesh geometry**: how to factor the device count into a 2D grid
+  (``make_procgrid``, min-surface heuristics — :mod:`.geometry`).
+
+:func:`logic_plan3d` resolves (shape, mesh/device-count, options) to a
+concrete decomposition + mesh, the role of ``plan_operations``.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field, replace
+from typing import Sequence
+
+from jax.sharding import Mesh
+
+from . import geometry as geo
+from .parallel.exchange import ALGORITHMS
+from .parallel.mesh import make_mesh
+
+
+@dataclass(frozen=True)
+class PlanOptions:
+    """User-tunable plan knobs (``plan_options``,
+    ``heffte_plan_logic.h:69-89``).
+
+    ``decomposition``: "auto" | "single" | "slab" | "pencil".
+    ``algorithm``: "alltoall" | "ppermute" (``reshape_algorithm``,
+    ``heffte_plan_logic.h:47-56``).
+    ``executor``: registered local-FFT backend name (``one_dim_backend``,
+    ``heffte_common.h:275``).
+    ``donate``: consume the input buffer (bufferDev ping-pong analog,
+    ``fft_mpi_3d_api.cpp:66-81``).
+    """
+
+    decomposition: str = "auto"
+    algorithm: str = "alltoall"
+    executor: str = "xla"
+    donate: bool = False
+
+    def __post_init__(self) -> None:
+        if self.algorithm not in ALGORITHMS:
+            raise ValueError(
+                f"unknown algorithm {self.algorithm!r}; use one of {ALGORITHMS}"
+            )
+        if self.decomposition not in ("auto", "single", "slab", "pencil"):
+            raise ValueError(f"unknown decomposition {self.decomposition!r}")
+
+
+DEFAULT_OPTIONS = PlanOptions()
+
+
+def default_options(decomposition: str = "auto", **kw) -> PlanOptions:
+    """cf. ``default_options<backend>()`` (``heffte_plan_logic.h:95``)."""
+    return PlanOptions(decomposition=decomposition, **kw)
+
+
+@dataclass(frozen=True)
+class LogicPlan:
+    """Resolved plan skeleton (the ``logic_plan3d`` analog,
+    ``heffte_plan_logic.h:152-164``): the decomposition, the mesh to run on,
+    and the intermediate layout chain as per-stage box lists."""
+
+    shape: tuple[int, int, int]
+    decomposition: str            # "single" | "slab" | "pencil"
+    mesh: Mesh | None
+    options: PlanOptions
+    # Stage layouts: list of (fft_axes, boxes) pairs, input side first.
+    stages: tuple = ()
+
+    @property
+    def num_exchanges(self) -> int:
+        return {"single": 0, "slab": 1, "pencil": 2}[self.decomposition]
+
+
+def choose_decomposition(shape: Sequence[int], ndev: int) -> str:
+    """Pick slab vs pencil for ``ndev`` devices with no mesh constraint.
+
+    A slab plan moves the whole world once; a pencil plan moves it twice but
+    each FFT stage operates on full lines with ndev-way batching on both
+    remaining axes. The slab plan stops scaling when devices outnumber the
+    planes of the first axis (each device must own >= 1 X-plane and >= 1
+    Y-plane) — the point where the reference would *shrink the device count*
+    (``getProperDeviceNum``, ``fft_mpi_3d_api.cpp:232-272``) and heFFTe
+    would switch to pencils (``use_pencils``, ``heffte_plan_logic.h:69-89``).
+    """
+    n0, n1, _ = shape
+    if ndev <= 1:
+        return "single"
+    if ndev <= min(n0, n1):
+        return "slab"
+    return "pencil"
+
+
+def logic_plan3d(
+    shape: Sequence[int],
+    mesh: Mesh | int | None,
+    options: PlanOptions = DEFAULT_OPTIONS,
+) -> LogicPlan:
+    """Resolve (shape, mesh-or-device-count, options) to a concrete plan
+    skeleton. The role of ``plan_operations``
+    (``heffte_plan_logic.cpp:410-432``): all geometry decisions happen here,
+    and the builders in :mod:`.parallel` only execute them.
+
+    ``mesh`` may be ``None`` (single device), an int device count (the mesh
+    is built here, shaped by the chosen decomposition), or an existing
+    :class:`Mesh` (1D -> slab, 2D -> pencil; the mesh wins over
+    ``options.decomposition == "auto"``).
+    """
+    shape = tuple(int(s) for s in shape)
+    decomp = options.decomposition
+
+    if isinstance(mesh, int):
+        ndev = mesh
+        if decomp == "auto":
+            decomp = choose_decomposition(shape, ndev)
+        if decomp == "single" or ndev == 1:
+            mesh = None
+            decomp = "single"
+        elif decomp == "slab":
+            mesh = make_mesh(ndev)
+        else:  # pencil: most-square grid, larger factor on rows
+            r, c = sorted(geo.make_procgrid(ndev), reverse=True)
+            mesh = make_mesh((r, c))
+
+    if decomp == "single":  # explicit request wins over any provided mesh
+        mesh = None
+    if mesh is None or math.prod(mesh.devices.shape) == 1:
+        decomp = "single"
+        mesh = None
+    elif decomp == "auto":
+        decomp = "pencil" if len(mesh.axis_names) == 2 else "slab"
+
+    if decomp == "slab" and mesh is not None and len(mesh.axis_names) != 1:
+        raise ValueError("slab decomposition requires a 1D mesh")
+    if decomp == "pencil" and mesh is not None and len(mesh.axis_names) != 2:
+        raise ValueError("pencil decomposition requires a 2D mesh")
+
+    stages = stage_layouts(decomp, mesh, geo.world_box(shape))
+    return LogicPlan(
+        shape=shape, decomposition=decomp, mesh=mesh,
+        options=replace(options, decomposition=decomp), stages=stages,
+    )
+
+
+def stage_layouts(decomposition: str, mesh: Mesh | None, world: geo.Box3) -> tuple:
+    """The per-stage (fft_axes, boxes) layout chain of a decomposition over
+    ``world`` — the single source of truth for box geometry (the 4-shape
+    lists of ``logic_plan3d``, ``heffte_plan_logic.h:152-164``)."""
+    if decomposition == "single" or mesh is None:
+        return (((0, 1, 2), (world,)),)
+    if decomposition == "slab":
+        p = mesh.shape[mesh.axis_names[0]]
+        return (
+            ((1, 2), tuple(geo.make_slabs(world, p, axis=0, rule=geo.ceil_splits))),
+            ((0,), tuple(geo.make_slabs(world, p, axis=1, rule=geo.ceil_splits))),
+        )
+    r, c = (mesh.shape[a] for a in mesh.axis_names[:2])
+    return (
+        ((2,), tuple(geo.make_pencils(world, (r, c), 2, rule=geo.ceil_splits))),
+        ((1,), tuple(geo.make_pencils(world, (r, c), 1, rule=geo.ceil_splits))),
+        ((0,), tuple(geo.make_pencils(world, (r, c), 0, rule=geo.ceil_splits))),
+    )
+
+
+def io_boxes(
+    decomposition: str, mesh: Mesh | None, world_in: geo.Box3, world_out: geo.Box3
+) -> tuple[list[geo.Box3], list[geo.Box3]]:
+    """Per-device input/output boxes for the forward orientation; r2c plans
+    pass a shrunk complex-side ``world_out``."""
+    first = stage_layouts(decomposition, mesh, world_in)[0][1]
+    last = stage_layouts(decomposition, mesh, world_out)[-1][1]
+    return list(first), list(last)
