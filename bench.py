@@ -13,15 +13,13 @@ by the CPU-backend test suite (tests/test_fft3d.py).
 
 import functools
 import json
-import math
 import sys
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 import distributedfft_tpu as dfft
-from distributedfft_tpu.utils.timing import gflops, sync, time_fn
+from distributedfft_tpu.utils.timing import gflops, max_rel_err, sync, time_fn_amortized
 
 HEFFTE_BASELINE_GFLOPS = 324.4  # README.md:65-77, 512^3 / 4 ranks / rocfft
 
@@ -57,12 +55,9 @@ def main() -> None:
 
     # Roundtrip error check (the reference's inline validation,
     # fftSpeed3d_c2c.cpp:85-91).
-    y = plan(x)
-    r = iplan(y)
-    err_fn = jax.jit(lambda a, b: jnp.max(jnp.abs(a - b)) / jnp.max(jnp.abs(b)))
-    max_err = float(err_fn(r, x))
+    max_err = max_rel_err(iplan(plan(x)), x)
 
-    seconds, _ = time_fn(lambda: plan(x), iters=5, warmup=1)
+    seconds, _ = time_fn_amortized(lambda: plan(x), iters=10, repeats=3)
     gf = gflops(shape, seconds)
 
     print(

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Batched single-device FFT sweep — the batchTest harness analog.
+
+Reproduces the reference's single-GPU benchmark methodology
+(``templateFFT/batchTest/``): batched 1D transforms at a fixed total element
+count with the length swept over powers of a radix (``runTest1D_opt.sh``
+sweeps powers of 2/3/5/7 up to 48,828,125), and 2D transforms over a shrinking
+grid (``runTest2D_opt.sh``: 2048 -> 128). Timing via forced-completion wall
+clock (the hipEvent analog, ``Test_1D.cpp:123-137``), GFlops =
+5 N log2 N · batch / t (``:139``), FFT->iFFT roundtrip max error
+(``:169-176``), CSV rows (``:186-190``) mirroring ``templateFFT/csv/*.csv``.
+
+Examples::
+
+    python benchmarks/batch_bench.py 1d -radix 2 -total $((1<<24))
+    python benchmarks/batch_bench.py 1d -radix 5 -executor matmul
+    python benchmarks/batch_bench.py 2d -sizes 512 256 128
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def parse_args(argv):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("mode", choices=["1d", "2d"])
+    p.add_argument("-radix", type=int, default=2, help="1d: sweep powers of this radix")
+    p.add_argument("-total", type=int, default=1 << 22,
+                   help="1d: total elements per run (batch = total // n); "
+                        "reference uses 64*32*2^15 (Test_1D.cpp:210)")
+    p.add_argument("-max-n", type=int, default=None, help="1d: largest length")
+    p.add_argument("-sizes", type=int, nargs="+", default=[512, 256, 128],
+                   help="2d: square grid edges to sweep")
+    p.add_argument("-batch", type=int, default=None, help="2d: batch override")
+    p.add_argument("-executor", default="xla")
+    p.add_argument("-precision", choices=["double", "single"], default="single")
+    p.add_argument("-iters", type=int, default=5)
+    p.add_argument("-csv", default=None, help="CSV output path "
+                   "(default benchmarks/csv/batch_result{1D,2D}.csv)")
+    p.add_argument("-cpu", action="store_true")
+    return p.parse_args(argv)
+
+
+def run_one(plan, iplan, x, iters):
+    from distributedfft_tpu.utils.timing import max_rel_err, time_fn_amortized
+
+    err = max_rel_err(iplan(plan(x)), x)
+    seconds, _ = time_fn_amortized(lambda: plan(x), iters=iters, repeats=2)
+    return seconds, err
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv if argv is not None else sys.argv[1:])
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    if args.precision == "double":
+        jax.config.update("jax_enable_x64", True)
+
+    import distributedfft_tpu as dfft
+    from distributedfft_tpu.utils.trace import CsvRecorder
+    from distributedfft_tpu.utils.timing import sync
+
+    dtype = jnp.complex128 if args.precision == "double" else jnp.complex64
+    header = ("n0", "n1", "batch", "seconds", "gflops", "max_err")
+    csv_path = args.csv or (
+        f"benchmarks/csv/batch_result{args.mode.upper()}.csv"
+    )
+    rec = CsvRecorder(csv_path, header)
+
+    def make(shape_full):
+        @jax.jit
+        def mk():
+            k1, k2 = jax.random.split(jax.random.PRNGKey(4242))
+            rdt = jnp.float64 if dtype == jnp.complex128 else jnp.float32
+            return (jax.random.normal(k1, shape_full, rdt)
+                    + 1j * jax.random.normal(k2, shape_full, rdt)).astype(dtype)
+
+        x = mk()
+        sync(x)
+        return x
+
+    if args.mode == "1d":
+        n = args.radix
+        max_n = args.max_n or args.total
+        while n <= max_n:
+            batch = max(1, args.total // n)
+            plan = dfft.plan_dft_c2c_1d(
+                n, batch=batch, executor=args.executor, dtype=dtype)
+            iplan = dfft.plan_dft_c2c_1d(
+                n, batch=batch, executor=args.executor, dtype=dtype,
+                direction=dfft.BACKWARD)
+            x = make((batch, n))
+            seconds, err = run_one(plan, iplan, x, args.iters)
+            gf = plan.flops() / seconds / 1e9
+            print(f"1D n={n:>10} batch={batch:>8} t={seconds:.6f}s "
+                  f"{gf:8.1f} GFlops/s err={err:.3e}")
+            rec.record(n, 1, batch, f"{seconds:.6f}", f"{gf:.1f}", f"{err:.3e}")
+            n *= args.radix
+    else:
+        for edge in args.sizes:
+            shape = (edge, edge)
+            batch = args.batch or max(1, args.total // (edge * edge))
+            plan = dfft.plan_dft_c2c_2d(
+                shape, batch=batch, executor=args.executor, dtype=dtype)
+            iplan = dfft.plan_dft_c2c_2d(
+                shape, batch=batch, executor=args.executor, dtype=dtype,
+                direction=dfft.BACKWARD)
+            x = make((batch,) + shape)
+            seconds, err = run_one(plan, iplan, x, args.iters)
+            gf = plan.flops() / seconds / 1e9
+            print(f"2D {edge}x{edge} batch={batch:>6} t={seconds:.6f}s "
+                  f"{gf:8.1f} GFlops/s err={err:.3e}")
+            rec.record(edge, edge, batch, f"{seconds:.6f}", f"{gf:.1f}",
+                       f"{err:.3e}")
+
+    print(f"results appended to {csv_path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Distributed 3D FFT speed benchmark — the driver-tier parity tool.
+
+Merges the two reference drivers into one CLI:
+
+- the first-party ``fftSpeed3d_c2c`` main (``3dmpifft_opt/fftSpeed3d_c2c.cpp``:
+  positional NX NY NZ + device count, plan/execute/verify/time, t0..t3 stage
+  breakdown, GFlops = 5 N log2 N / t, report block ``README.md:44-58``), and
+- heFFTe's ``speed3d`` benchmark CLI (``benchmarks/speed3d.h:240-253``:
+  ``speed3d_c2c <backend> <precision> <nx> <ny> <nz> -a2a/-p2p_pl/-slabs/
+  -pencils/-ingrid ...``).
+
+Examples::
+
+    python benchmarks/speed3d.py c2c single 512 512 512
+    python benchmarks/speed3d.py c2c double 256 256 256 -ndev 8 -slabs -staged
+    python benchmarks/speed3d.py r2c single 512 512 512 -pencils -grid 2 4
+    python benchmarks/speed3d.py c2c single 512 512 512 -p2p_pl -csv out.csv
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def parse_args(argv):
+    p = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    p.add_argument("kind", choices=["c2c", "r2c"])
+    p.add_argument("precision", choices=["double", "single"])
+    p.add_argument("nx", type=int)
+    p.add_argument("ny", type=int)
+    p.add_argument("nz", type=int)
+    g = p.add_mutually_exclusive_group()
+    g.add_argument("-slabs", action="store_true", help="force slab decomposition")
+    g.add_argument("-pencils", action="store_true", help="force pencil decomposition")
+    a = p.add_mutually_exclusive_group()
+    a.add_argument("-a2a", action="store_true", help="fused all_to_all exchange (default)")
+    a.add_argument("-p2p_pl", action="store_true",
+                   help="pipelined ppermute ring exchange (p2p_plined analog)")
+    p.add_argument("-executor", default="xla", help="local FFT backend (xla|matmul|...)")
+    p.add_argument("-ndev", type=int, default=None, help="device count (default: all)")
+    p.add_argument("-grid", type=int, nargs=2, metavar=("R", "C"),
+                   help="explicit 2D pencil grid (heFFTe -ingrid analog)")
+    p.add_argument("-staged", action="store_true",
+                   help="separately-jitted t0..t3 stage timing (slab c2c only)")
+    p.add_argument("-iters", type=int, default=5)
+    p.add_argument("-cpu", action="store_true",
+                   help="run on (virtual) CPU devices instead of TPU")
+    p.add_argument("-csv", default=None, help="append a result row to this CSV")
+    p.add_argument("-trace", action="store_true", help="write a dfft trace log")
+    p.add_argument("-no-verify", action="store_true",
+                   help="skip the roundtrip error check")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv if argv is not None else sys.argv[1:])
+
+    # Reconcile the requested device count before any backend comes up: an
+    # explicit -grid fixes it (and must agree with -ndev if both are given).
+    if args.grid:
+        want = args.grid[0] * args.grid[1]
+        if args.ndev is not None and args.ndev != want:
+            raise SystemExit(f"-ndev {args.ndev} contradicts -grid {args.grid} "
+                             f"({want} devices)")
+        args.ndev = want
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+        if args.ndev and args.ndev > 1:
+            jax.config.update("jax_num_cpu_devices", args.ndev)
+    if args.precision == "double":
+        jax.config.update("jax_enable_x64", True)
+
+    import distributedfft_tpu as dfft
+    from distributedfft_tpu.utils import trace as tr
+    from distributedfft_tpu.utils.timing import (
+        gflops, max_rel_err, result_block, sync, time_fn_amortized, time_staged,
+    )
+
+    if args.trace:
+        tr.init_tracing("dfft_speed3d")
+
+    shape = (args.nx, args.ny, args.nz)
+    dtype = jnp.complex128 if args.precision == "double" else jnp.complex64
+    ndev = args.ndev or len(jax.devices())
+    algorithm = "ppermute" if args.p2p_pl else "alltoall"
+
+    if args.grid:
+        mesh = dfft.make_mesh(tuple(args.grid))
+        decomposition = None
+    elif args.pencils:
+        from distributedfft_tpu.geometry import make_procgrid
+
+        r, c = sorted(make_procgrid(ndev), reverse=True)
+        mesh = dfft.make_mesh((r, c)) if ndev > 1 else None
+        decomposition = None
+    elif args.slabs:
+        mesh = dfft.make_mesh(ndev) if ndev > 1 else None
+        decomposition = None
+    else:
+        mesh = ndev  # auto decomposition via plan logic
+        decomposition = None
+
+    plan_fn = dfft.plan_dft_r2c_3d if args.kind == "r2c" else dfft.plan_dft_c2c_3d
+    kw = dict(decomposition=decomposition, executor=args.executor,
+              dtype=dtype, algorithm=algorithm)
+    fwd = plan_fn(shape, mesh, direction=dfft.FORWARD, **kw)
+    bwd = plan_fn(shape, mesh, direction=dfft.BACKWARD, **kw)
+    print(dfft.plan_info(fwd))
+
+    # On-device deterministic init (the reference inits on device too,
+    # fftSpeed3d_c2c.cpp:61-72).
+    mk_kw = {}
+    if fwd.in_sharding is not None:
+        mk_kw["out_shardings"] = fwd.in_sharding
+
+    @functools.partial(jax.jit, **mk_kw)
+    def make_input():
+        k1, k2 = jax.random.split(jax.random.PRNGKey(4242))
+        rdt = jnp.float64 if dtype == jnp.complex128 else jnp.float32
+        re = jax.random.normal(k1, shape, rdt)
+        if args.kind == "r2c":
+            return re
+        im = jax.random.normal(k2, shape, rdt)
+        return (re + 1j * im).astype(dtype)
+
+    x = make_input()
+    sync(x)
+
+    max_err = float("nan")
+    if not args.no_verify:
+        max_err = max_rel_err(bwd(fwd(x)), x)
+
+    stage_times = None
+    if args.staged:
+        if fwd.decomposition != "slab" or args.kind != "c2c":
+            print("note: -staged supports the slab c2c pipeline; ignoring",
+                  file=sys.stderr)
+        else:
+            from distributedfft_tpu.parallel.slab import build_slab_stages
+
+            stages, _ = build_slab_stages(
+                fwd.mesh, shape, axis_name=fwd.mesh.axis_names[0],
+                executor=args.executor, algorithm=algorithm,
+            )
+            stage_times, _ = time_staged(stages, x, iters=args.iters)
+
+    seconds, _ = time_fn_amortized(lambda: fwd(x), iters=args.iters, repeats=2)
+    gf = gflops(shape, seconds)
+
+    print(result_block(shape, ndev, seconds, max_err, stage_times))
+
+    if args.csv:
+        rec = tr.CsvRecorder(args.csv, (
+            "kind", "precision", "nx", "ny", "nz", "ndev", "decomposition",
+            "algorithm", "executor", "seconds", "gflops", "max_err",
+        ))
+        rec.record(args.kind, args.precision, *shape, ndev, fwd.decomposition,
+                   algorithm, args.executor, f"{seconds:.6f}", f"{gf:.1f}",
+                   f"{max_err:.3e}")
+    if args.trace:
+        print(f"trace written to {tr.finalize_tracing()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -26,6 +26,12 @@ from .api import (  # noqa: F401
     plan_dft_r2c_3d,
 )
 from .geometry import Box3, world_box  # noqa: F401
+from .local import (  # noqa: F401
+    LocalPlan,
+    plan_dft_c2c,
+    plan_dft_c2c_1d,
+    plan_dft_c2c_2d,
+)
 from .ops.executors import Scale, available_executors  # noqa: F401
 from .parallel.mesh import make_mesh  # noqa: F401
 from .parallel.reshape import make_reshape3d, reshape3d  # noqa: F401
@@ -35,6 +41,12 @@ from .plan_logic import (  # noqa: F401
     choose_decomposition,
     default_options,
     logic_plan3d,
+)
+from .utils.trace import (  # noqa: F401
+    add_trace,
+    finalize_tracing,
+    init_tracing,
+    plan_info,
 )
 
 __version__ = "0.1.0"

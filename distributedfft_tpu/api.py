@@ -321,12 +321,19 @@ def execute(plan: Plan3D, x, *, scale: Scale = Scale.NONE):
     """Run a plan (``fft_mpi_execute_dft_3d_c2c``,
     ``fft_mpi_3d_api.cpp:181``). Accepts any array-like of the plan's global
     input shape; device placement follows the plan's input sharding."""
+    from .utils.trace import add_trace
+
     x = jnp.asarray(x, dtype=plan.in_dtype)
     if x.shape != plan.in_shape:
         raise ValueError(f"plan input shape is {plan.in_shape}, got {x.shape}")
-    y = plan.fn(x)
-    if scale != Scale.NONE:
-        y = apply_scale(y, scale, plan.world_size)
+    if plan.real:
+        kind = "r2c" if plan.forward else "c2r"
+    else:
+        kind = "c2c"
+    with add_trace(f"execute_{kind}_{plan.decomposition}"):
+        y = plan.fn(x)
+        if scale != Scale.NONE:
+            y = apply_scale(y, scale, plan.world_size)
     return y
 
 

@@ -1,0 +1,112 @@
+"""Batched local (single-device) 1D/2D/3D transforms.
+
+The templateFFT public-surface analog (``templateFFT/src/templateFFT.h``:
+``FFTConfiguration`` holds ``size[3]`` + ``numberBatches`` (``:84-132``),
+``initializeFFT``/``launchFFTKernel`` (``:340-344``)), as exercised by the
+batchTest harness (1D batched and 2D benchmarks,
+``templateFFT/batchTest/Test_1D.cpp:29``, ``Test_2D.cpp``).
+
+A :class:`LocalPlan` is the compiled, batched transform of the trailing
+``rank`` axes of a ``[batch, *shape]`` array. On TPU the batch dimension is
+exactly what keeps the MXU/VPU busy — the analog of templateFFT filling the
+GPU with one kernel over ``numberBatches`` lines.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .ops.executors import Scale, apply_scale, get_executor
+
+FORWARD = -1
+BACKWARD = +1
+
+
+@dataclass
+class LocalPlan:
+    """A compiled batched C2C transform over the trailing axes."""
+
+    shape: tuple[int, ...]
+    batch: int
+    direction: int
+    dtype: Any
+    executor: str
+    fn: Callable
+
+    @property
+    def forward(self) -> bool:
+        return self.direction == FORWARD
+
+    @property
+    def transform_size(self) -> int:
+        return math.prod(self.shape)
+
+    def flops(self) -> float:
+        """5 N log2 N per transform times the batch count
+        (``Test_1D.cpp:139``)."""
+        n = self.transform_size
+        return 5.0 * n * math.log2(n) * self.batch
+
+    def __call__(self, x, *, scale: Scale = Scale.NONE):
+        x = jnp.asarray(x, dtype=self.dtype)
+        expect = (self.batch,) + self.shape
+        if x.shape != expect:
+            raise ValueError(f"plan input shape is {expect}, got {x.shape}")
+        y = self.fn(x)
+        if scale != Scale.NONE:
+            y = apply_scale(y, scale, self.transform_size)
+        return y
+
+
+def plan_dft_c2c(
+    shape: Sequence[int] | int,
+    *,
+    batch: int = 1,
+    direction: int = FORWARD,
+    executor: str = "xla",
+    dtype: Any = None,
+    donate: bool = False,
+) -> LocalPlan:
+    """Plan a batched local C2C FFT of rank ``len(shape)`` (1, 2, or 3).
+
+    Input/output shape is ``[batch, *shape]``; the transform runs over the
+    trailing axes. cf. ``initializeFFT`` + ``FFTConfiguration``
+    (``templateFFT.h:84-132,340``).
+    """
+    if isinstance(shape, int):
+        shape = (shape,)
+    shape = tuple(int(s) for s in shape)
+    if not 1 <= len(shape) <= 3:
+        raise ValueError("plan_dft_c2c supports rank 1..3 transforms")
+    if direction not in (FORWARD, BACKWARD):
+        raise ValueError("direction must be FORWARD (-1) or BACKWARD (+1)")
+    if dtype is None:
+        dtype = jnp.complex128 if jax.config.jax_enable_x64 else jnp.complex64
+    ex = get_executor(executor)
+    axes = tuple(range(1, 1 + len(shape)))
+    fwd = direction == FORWARD
+    fn = jax.jit(
+        lambda x: ex(x, axes, fwd), donate_argnums=(0,) if donate else ()
+    )
+    return LocalPlan(
+        shape=shape, batch=int(batch), direction=direction,
+        dtype=jnp.dtype(dtype), executor=executor, fn=fn,
+    )
+
+
+def plan_dft_c2c_1d(n: int, **kw) -> LocalPlan:
+    """Batched 1D plan (the batchTest 1D harness shape,
+    ``Test_1D.cpp:29``)."""
+    return plan_dft_c2c((n,), **kw)
+
+
+def plan_dft_c2c_2d(shape: Sequence[int], **kw) -> LocalPlan:
+    """Batched 2D plan (``Test_2D.cpp``)."""
+    if len(tuple(shape)) != 2:
+        raise ValueError("plan_dft_c2c_2d requires a 2D shape")
+    return plan_dft_c2c(shape, **kw)

@@ -17,10 +17,10 @@ precision too, ``templateFFT.cpp:5063-5154``):
 
 Factors at or below :data:`DIRECT_MAX` are computed as a single dense matmul;
 everything is jit-traceable with static shapes, so XLA tiles the matmuls onto
-the MXU. Prime lengths above the threshold fall back to the O(n^2) dense
-matmul (the reference's radix set is 2..13, ``templateFFT.cpp:3956-3963``, so
-composite sizes with small prime factors are the parity target; Bluestein is a
-possible extension).
+the MXU. Prime lengths in (DIRECT_MAX, BLUESTEIN_MIN] use the O(n^2) dense
+matmul (still MXU-friendly); larger primes switch to Bluestein's chirp-z
+transform — exceeding the reference's radix-2..13 coverage
+(``templateFFT.cpp:3956-3963``), which cannot handle large primes at all.
 
 Like every executor in this framework the transform is unnormalized in the
 forward direction and scales by 1/n on the inverse (numpy convention).
@@ -78,6 +78,43 @@ def _direct(x: jnp.ndarray, forward: bool) -> jnp.ndarray:
     return jnp.einsum("...j,jk->...k", x, w, precision=lax.Precision.HIGHEST)
 
 
+# Prime lengths above this use Bluestein's chirp-z algorithm instead of the
+# O(n^2) dense matmul. Kept well above DIRECT_MAX: the dense matmul IS the
+# fast path on the MXU for moderate n.
+BLUESTEIN_MIN = 512
+
+
+@functools.lru_cache(maxsize=None)
+def _bluestein_tables(n: int, m: int, forward: bool):
+    """Host-precomputed chirp w[j] = exp(-+ i pi j^2 / n) and the length-m DFT
+    of the symmetric chirp kernel b (exact at trace time, like every twiddle
+    LUT here). j^2 is reduced mod 2n to keep the argument small."""
+    j = np.arange(n)
+    sign = -1j if forward else 1j
+    w = np.exp(sign * np.pi * ((j * j) % (2 * n)) / n)
+    b = np.zeros(m, dtype=np.complex128)
+    b[:n] = np.conj(w)
+    b[m - n + 1:] = np.conj(w[1:][::-1])
+    return w, np.fft.fft(b)
+
+
+def _bluestein(x: jnp.ndarray, forward: bool) -> jnp.ndarray:
+    """Bluestein/chirp-z DFT of an arbitrary (here: large-prime) length as a
+    circular convolution at a power-of-two length — the capability templateFFT
+    lacks entirely (its radix set stops at 13, ``templateFFT.cpp:3956-3963``;
+    the batch harness only sweeps smooth sizes, ``runTest1D_opt.sh``)."""
+    n = x.shape[-1]
+    m = 1 << (2 * n - 1).bit_length()
+    w_np, B_np = _bluestein_tables(n, m, forward)
+    w = jnp.asarray(w_np, dtype=x.dtype)
+    B = jnp.asarray(B_np, dtype=x.dtype)
+    a = x * w
+    pad = [(0, 0)] * (x.ndim - 1) + [(0, m - n)]
+    A = _fft_last(jnp.pad(a, pad), True)
+    c = _fft_last(A * B, False)  # unnormalized inverse
+    return c[..., :n] * w * jnp.asarray(1.0 / m, dtype=x.real.dtype)
+
+
 def _fft_last(x: jnp.ndarray, forward: bool) -> jnp.ndarray:
     """Unnormalized DFT along the last axis (both directions)."""
     n = x.shape[-1]
@@ -85,6 +122,8 @@ def _fft_last(x: jnp.ndarray, forward: bool) -> jnp.ndarray:
         return x
     split = None if n <= DIRECT_MAX else _best_split(n)
     if split is None:
+        if n > BLUESTEIN_MIN:  # large prime: chirp-z beats the O(n^2) matmul
+            return _bluestein(x, forward)
         return _direct(x, forward)
     n1, n2 = split
     a = x.reshape(x.shape[:-1] + (n1, n2))

@@ -1,0 +1,132 @@
+"""Event tracing — the heFFTe tracing subsystem, TPU-native.
+
+The reference gates RAII wall-clock events behind a compile flag and writes
+one log file per MPI rank (``heffte/heffteBenchmark/include/heffte_trace.h:48-127``:
+``add_trace name("...")`` objects record ``MPI_Wtime`` pairs;
+``init_tracing``/``finalize_tracing`` manage a per-rank
+``heffte_trace_<id>.log``). The first-party engine prints per-stage wall
+deltas on every execute (``fft_mpi_3d_api.cpp:184-201``).
+
+Here the same surface is a runtime-gated (env ``DFFT_TRACE=1`` or
+:func:`init_tracing`) context manager that records host-side wall-clock
+events per process, doubles as a ``jax.profiler.TraceAnnotation`` so events
+land in XLA profiler timelines too, and writes one log per process
+(``jax.process_index`` plays the MPI-rank role on multi-host).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+
+import jax
+
+_events: list[tuple[str, float, float]] | None = None
+_trace_root: str | None = None
+
+
+def tracing_enabled() -> bool:
+    return _events is not None
+
+
+def init_tracing(root: str = "") -> None:
+    """Start collecting events (``init_tracing``, ``heffte_trace.h:90``).
+    ``root`` prefixes the log filename written by :func:`finalize_tracing`."""
+    global _events, _trace_root
+    _events = []
+    _trace_root = root or "dfft_trace"
+
+
+def finalize_tracing() -> str | None:
+    """Write ``<root>_<process>.log`` and stop tracing
+    (``finalize_tracing``, ``heffte_trace.h:98-118``). Returns the path."""
+    global _events, _trace_root
+    if _events is None:
+        return None
+    path = f"{_trace_root}_{jax.process_index()}.log"
+    t0 = _events[0][1] if _events else 0.0
+    with open(path, "w") as f:
+        f.write(f"process {jax.process_index()} of {jax.process_count()}\n")
+        for name, start, stop in _events:
+            f.write(f"{start - t0:14.6f}  {stop - start:12.6f}  {name}\n")
+    _events, _trace_root = None, None
+    return path
+
+
+if os.environ.get("DFFT_TRACE", "") not in ("", "0"):
+    init_tracing(os.environ.get("DFFT_TRACE_ROOT", "dfft_trace"))
+
+
+@contextmanager
+def add_trace(name: str):
+    """Record one named event (RAII ``add_trace``, ``heffte_trace.h:48-66``).
+
+    Always annotates the XLA profiler timeline; wall-clock capture only when
+    tracing is initialized. Note: under jit tracing this wraps *dispatch*,
+    not device execution — wrap ``block_until_ready`` sections (as the
+    benchmark harness does) for true device timings.
+    """
+    with jax.profiler.TraceAnnotation(name):
+        if _events is None:
+            yield
+            return
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            _events.append((name, start, time.perf_counter()))
+
+
+@dataclass
+class CsvRecorder:
+    """Benchmark CSV writer, the batchTest recording pattern
+    (``templateFFT/batchTest/Test_1D.cpp:186-190`` appends
+    size/batch/time/gflops/error rows; outputs mirror
+    ``templateFFT/csv/*.csv``)."""
+
+    path: str
+    header: tuple[str, ...]
+
+    def __post_init__(self) -> None:
+        if not os.path.exists(self.path) or os.path.getsize(self.path) == 0:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            with open(self.path, "w") as f:
+                f.write(",".join(self.header) + "\n")
+
+    def record(self, *row) -> None:
+        if len(row) != len(self.header):
+            raise ValueError(f"expected {len(self.header)} fields, got {len(row)}")
+        with open(self.path, "a") as f:
+            f.write(",".join(str(v) for v in row) + "\n")
+
+
+def plan_info(plan) -> str:
+    """Human-readable plan dump — the ``outputPlanInfo`` analog
+    (``fft_mpi_3d_api.cpp:433-464`` writes per-rank plan/exchange tables to
+    ``rank_i_gpu_j.txt``); here one string covering every device."""
+    lines = [
+        f"plan: {plan.in_shape} -> {plan.out_shape} "
+        f"({'forward' if plan.forward else 'backward'}"
+        f"{', r2c' if plan.real and plan.forward else ''}"
+        f"{', c2r' if plan.real and not plan.forward else ''})",
+        f"decomposition: {plan.decomposition}",
+        f"executor: {plan.executor}",
+        f"algorithm: {plan.options.algorithm}",
+        f"dtype: {plan.in_dtype} -> {plan.out_dtype}",
+    ]
+    if plan.mesh is not None:
+        lines.append(
+            "mesh: "
+            + " x ".join(f"{n}={s}" for n, s in plan.mesh.shape.items())
+            + f" ({plan.mesh.devices.size} devices)"
+        )
+        lines.append(f"in sharding:  {plan.in_sharding.spec}")
+        lines.append(f"out sharding: {plan.out_sharding.spec}")
+    if plan.spec is not None:
+        lines.append(f"padded extents: {plan.spec}")
+    for label, boxes in (("in", plan.in_boxes), ("out", plan.out_boxes)):
+        for i, b in enumerate(boxes):
+            lines.append(f"{label} box[{i}]: low={b.low} high={b.high} shape={b.shape}")
+    return "\n".join(lines)

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Launcher parity with the reference's speedTest.sh
+# (3dmpifft_opt/speedTest.sh: `mpirun -np $1 ./distFFTOpt $2 $3 $4 1`):
+#
+#   ./speedTest.sh <ndev> <NX> <NY> <NZ> [extra speed3d.py flags...]
+#
+# The MPI rank count becomes the device-mesh size; on a machine without that
+# many accelerators, add -cpu to provision a virtual CPU mesh.
+set -euo pipefail
+if [ $# -lt 4 ]; then
+    echo "usage: $0 <ndev> <NX> <NY> <NZ> [flags...]" >&2
+    exit 1
+fi
+NDEV=$1; NX=$2; NY=$3; NZ=$4; shift 4
+exec python "$(dirname "$0")/benchmarks/speed3d.py" c2c single \
+    "$NX" "$NY" "$NZ" -ndev "$NDEV" "$@"

@@ -1,0 +1,58 @@
+"""Benchmark-CLI smoke tests (the role of heFFTe's benchmark builds in CI:
+the harness itself must keep working, ``.jenkins:22-35``). Runs the CLIs
+in-process with tiny problems on the test fixture's CPU mesh."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+
+import batch_bench  # noqa: E402
+import speed3d  # noqa: E402
+
+
+def test_speed3d_c2c_slab(capsys, tmp_path):
+    csv = str(tmp_path / "s.csv")
+    speed3d.main(["c2c", "double", "16", "16", "16",
+                  "-ndev", "4", "-slabs", "-iters", "1", "-csv", csv])
+    out = capsys.readouterr().out
+    assert "size: 16 16 16, ranks: 4" in out
+    assert "gflops:" in out
+    assert len(open(csv).read().splitlines()) == 2
+
+
+def test_speed3d_r2c_pencil_ppermute(capsys):
+    speed3d.main(["r2c", "double", "16", "16", "16",
+                  "-ndev", "8", "-pencils", "-p2p_pl", "-iters", "1"])
+    out = capsys.readouterr().out
+    assert "decomposition: pencil" in out
+    assert "algorithm: ppermute" in out
+
+
+def test_speed3d_staged(capsys):
+    speed3d.main(["c2c", "double", "16", "16", "16",
+                  "-ndev", "4", "-slabs", "-staged", "-iters", "1"])
+    out = capsys.readouterr().out
+    assert "t0_fft_yz" in out and "t2_all_to_all" in out and "t3_fft_x" in out
+
+
+def test_batch_bench_1d(capsys, tmp_path):
+    csv = str(tmp_path / "b.csv")
+    batch_bench.main(["1d", "-radix", "5", "-total", "1000",
+                      "-iters", "1", "-csv", csv])
+    out = capsys.readouterr().out
+    assert "1D n=" in out
+    rows = open(csv).read().splitlines()
+    assert rows[0].startswith("n0,")
+    assert len(rows) >= 3  # 5, 25, 125, 625
+
+
+def test_batch_bench_2d(capsys, tmp_path):
+    csv = str(tmp_path / "b2.csv")
+    batch_bench.main(["2d", "-sizes", "8", "16", "-batch", "2",
+                      "-iters", "1", "-csv", csv])
+    out = capsys.readouterr().out
+    assert "2D 8x8" in out and "2D 16x16" in out

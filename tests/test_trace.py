@@ -1,0 +1,59 @@
+"""Tracing smoke tests — the role of heFFTe's ``test_trace.cpp`` — plus
+plan-info dump and CSV recorder checks."""
+
+import os
+
+import numpy as np
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu import testing as tu
+from distributedfft_tpu.utils import trace as tr
+
+
+def test_trace_records_events(tmp_path):
+    root = str(tmp_path / "trace")
+    tr.init_tracing(root)
+    assert tr.tracing_enabled()
+    with tr.add_trace("outer"):
+        with tr.add_trace("inner"):
+            pass
+    plan = dfft.plan_dft_c2c_3d((8, 8, 8))
+    plan(tu.make_world_data((8, 8, 8)))  # execute() auto-instruments
+    path = tr.finalize_tracing()
+    assert not tr.tracing_enabled()
+    assert path == f"{root}_0.log"
+    text = open(path).read()
+    assert "inner" in text and "outer" in text
+    assert "execute_c2c_single" in text
+
+
+def test_trace_disabled_is_noop():
+    assert not tr.tracing_enabled()
+    with tr.add_trace("nothing"):  # must not record or fail
+        pass
+    assert tr.finalize_tracing() is None
+
+
+def test_csv_recorder(tmp_path):
+    path = str(tmp_path / "out" / "bench.csv")
+    rec = tr.CsvRecorder(path, ("n", "time", "gflops"))
+    rec.record(512, 0.028, 644.1)
+    rec.record(1024, 0.3, 500.0)
+    lines = open(path).read().splitlines()
+    assert lines[0] == "n,time,gflops"
+    assert len(lines) == 3
+    # reopening appends instead of truncating
+    rec2 = tr.CsvRecorder(path, ("n", "time", "gflops"))
+    rec2.record(2048, 1.0, 400.0)
+    assert len(open(path).read().splitlines()) == 4
+
+
+def test_plan_info_dump():
+    mesh = dfft.make_mesh(4)
+    plan = dfft.plan_dft_r2c_3d((16, 12, 10), mesh, algorithm="ppermute")
+    info = dfft.plan_info(plan)
+    assert "decomposition: slab" in info
+    assert "algorithm: ppermute" in info
+    assert "r2c" in info
+    assert "in box[3]" in info and "out box[3]" in info
+    assert "4 devices" in info
