@@ -32,7 +32,8 @@ import jax.numpy as jnp
 def parse_args(argv):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("mode", choices=["1d", "2d"])
-    p.add_argument("-radix", type=int, default=2, help="1d: sweep powers of this radix")
+    p.add_argument("-radix", type=int, default=2,
+                   help="1d: sweep powers of this radix (>= 2)")
     p.add_argument("-total", type=int, default=1 << 22,
                    help="1d: total elements per run (batch = total // n); "
                         "reference uses 64*32*2^15 (Test_1D.cpp:210)")
@@ -59,6 +60,8 @@ def run_one(plan, iplan, x, iters):
 
 def main(argv=None) -> None:
     args = parse_args(argv if argv is not None else sys.argv[1:])
+    if args.radix < 2:
+        raise SystemExit("-radix must be >= 2")
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
     if args.precision == "double":

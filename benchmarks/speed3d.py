@@ -156,9 +156,10 @@ def main(argv=None) -> None:
             stage_times, _ = time_staged(stages, x, iters=args.iters)
 
     seconds, _ = time_fn_amortized(lambda: fwd(x), iters=args.iters, repeats=2)
-    gf = gflops(shape, seconds)
+    is_real = args.kind == "r2c"
+    gf = gflops(shape, seconds, real=is_real)
 
-    print(result_block(shape, ndev, seconds, max_err, stage_times))
+    print(result_block(shape, ndev, seconds, max_err, stage_times, real=is_real))
 
     if args.csv:
         rec = tr.CsvRecorder(args.csv, (

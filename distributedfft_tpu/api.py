@@ -54,8 +54,9 @@ from .parallel.slab import (
     build_slab_stages,
 )
 
-FORWARD = -1   # FFTW sign convention (FFTW_FORWARD)
-BACKWARD = +1  # FFTW_BACKWARD
+# FFTW sign convention (FFTW_FORWARD = -1, FFTW_BACKWARD = +1); single
+# definition lives in .local, re-exported here as the public surface.
+from .local import BACKWARD, FORWARD  # noqa: E402
 
 
 @dataclass

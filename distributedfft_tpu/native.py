@@ -1,0 +1,231 @@
+"""ctypes bindings for the native runtime core (``native/dfft_native.cpp``).
+
+The reference's runtime around the device kernels is C++ (plan scheduler
+``templateFFT.cpp:3941-4100``, exchange tables ``fft_mpi_3d_api.cpp:84-133``,
+trace log ``heffte_trace.h``); this framework keeps the same split: JAX/XLA/
+Pallas own device compute, while plan-time scheduling, geometry search,
+exchange bookkeeping, and trace recording have a native C++ implementation.
+
+The library is built on demand with the in-tree Makefile (g++ only, no
+external deps). Every entry point has a pure-Python fallback so the package
+works without a toolchain; ``tests/test_native.py`` asserts the two agree.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import math
+import os
+import subprocess
+import threading
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libdfft_native.so")
+
+_lib = None
+_tried = False
+_lock = threading.Lock()
+
+
+def _build() -> bool:
+    src = os.path.join(_NATIVE_DIR, "dfft_native.cpp")
+    if not os.path.exists(src):
+        return False
+    if os.path.exists(_SO_PATH) and os.path.getmtime(_SO_PATH) >= os.path.getmtime(src):
+        return True
+    try:
+        subprocess.run(
+            ["make", "-s", "libdfft_native.so"],
+            cwd=_NATIVE_DIR, check=True, capture_output=True, timeout=120,
+        )
+        return os.path.exists(_SO_PATH)
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            return None
+        ll = ctypes.c_longlong
+        lp = ctypes.POINTER(ll)
+        lib.dfft_abi_version.restype = ctypes.c_int
+        lib.dfft_schedule_axis.restype = ctypes.c_int
+        lib.dfft_schedule_axis.argtypes = [ll, ll, ctypes.c_int, lp]
+        lib.dfft_procgrid2.argtypes = [ll, lp, lp]
+        lib.dfft_min_surface_grid.argtypes = [ll, ll, ll, ll, lp]
+        lib.dfft_exchange_table.argtypes = [ll] * 5 + [lp] * 4
+        lib.dfft_trace_begin.restype = ll
+        lib.dfft_trace_begin.argtypes = [ctypes.c_char_p]
+        lib.dfft_trace_end.argtypes = [ll]
+        lib.dfft_trace_count.restype = ll
+        lib.dfft_trace_dump.restype = ctypes.c_int
+        lib.dfft_trace_dump.argtypes = [ctypes.c_char_p, ll, ll]
+        if lib.dfft_abi_version() != 1:
+            return None
+        _lib = lib
+        return _lib
+
+
+def is_available() -> bool:
+    return _load() is not None
+
+
+# ------------------------------------------------------------- scheduler
+
+def schedule_axis(
+    n: int, max_factor: int = 256, max_passes: int = 4
+) -> list[int] | None:
+    """Split ``n`` into <= ``max_passes`` balanced factors each <=
+    ``max_factor`` (descending), or None when impossible (large prime ->
+    Bluestein; or too many passes). The FFTScheduler decision
+    (``templateFFT.cpp:3941-4100``) with VMEM/MXU bounds in place of shared
+    memory."""
+    lib = _load()
+    if lib is not None:
+        out = (ctypes.c_longlong * max_passes)()
+        r = lib.dfft_schedule_axis(n, max_factor, max_passes, out)
+        return [int(v) for v in out[:r]] if r > 0 else None
+    return _schedule_axis_py(n, max_factor, max_passes)
+
+
+def _prime_factors(n: int) -> list[int]:
+    out, p = [], 2
+    while p * p <= n:
+        while n % p == 0:
+            out.append(p)
+            n //= p
+        p += 1
+    if n > 1:
+        out.append(n)
+    return out
+
+
+def _schedule_axis_py(n: int, max_factor: int, max_passes: int) -> list[int] | None:
+    """Pure-Python mirror of ``dfft_schedule_axis`` (kept in lockstep —
+    see tests/test_native.py)."""
+    if n < 1 or max_factor < 2 or max_passes < 1:
+        return None
+    if n == 1:
+        return [1]
+    primes = _prime_factors(n)
+    if max(primes) > max_factor:
+        return None
+    for passes in range(1, max_passes + 1):
+        bins = [1] * passes
+        ok = True
+        for p in sorted(primes, reverse=True):
+            fits = [b for b in range(passes) if bins[b] * p <= max_factor]
+            if not fits:
+                ok = False
+                break
+            bins[max(fits, key=lambda b: bins[b])] *= p
+        if not ok:
+            continue
+        for _ in range(64):
+            bins.sort(reverse=True)
+            if bins[-1] == 1 and len(bins) > 1:
+                bins.pop()
+                continue
+            moved = False
+            for p in sorted(_prime_factors(bins[0])):
+                big, small = bins[0] // p, bins[-1] * p
+                if small <= max_factor and max(big, small) < bins[0]:
+                    bins[0], bins[-1] = big, small
+                    moved = True
+                    break
+            if not moved:
+                break
+        return sorted(bins, reverse=True)
+    return None
+
+
+# -------------------------------------------------------------- geometry
+
+def procgrid2(p: int) -> tuple[int, int]:
+    lib = _load()
+    if lib is not None:
+        a, b = ctypes.c_longlong(), ctypes.c_longlong()
+        lib.dfft_procgrid2(p, ctypes.byref(a), ctypes.byref(b))
+        return int(a.value), int(b.value)
+    from .geometry import make_procgrid
+
+    return make_procgrid(p)
+
+
+def min_surface_grid(shape, p: int) -> tuple[int, int, int]:
+    lib = _load()
+    if lib is not None:
+        out = (ctypes.c_longlong * 3)()
+        lib.dfft_min_surface_grid(shape[0], shape[1], shape[2], p, out)
+        return int(out[0]), int(out[1]), int(out[2])
+    from .geometry import Box3, proc_setup_min_surface
+
+    return proc_setup_min_surface(Box3((0, 0, 0), tuple(s - 1 for s in shape)), p)
+
+
+# -------------------------------------------------------- exchange tables
+
+def exchange_table(n0: int, n1: int, n2: int, p: int, rank: int):
+    """Per-peer (send_counts, send_offsets, recv_counts, recv_offsets) for
+    the uneven X-slab -> Y-slab redistribution (``fft_mpi_3d_api.cpp:84-133``
+    TransInfo semantics; element counts, not bytes)."""
+    lib = _load()
+    if lib is not None:
+        arrs = [(ctypes.c_longlong * p)() for _ in range(4)]
+        lib.dfft_exchange_table(n0, n1, n2, p, rank, *arrs)
+        return tuple([int(v) for v in a] for a in arrs)
+    return _exchange_table_py(n0, n1, n2, p, rank)
+
+
+def _exchange_table_py(n0: int, n1: int, n2: int, p: int, rank: int):
+    c0, c1 = -(-n0 // p), -(-n1 // p)
+    owned = lambda n, c, r: max(0, min(n, (r + 1) * c) - min(n, r * c))
+    my_rows, my_cols = owned(n0, c0, rank), owned(n1, c1, rank)
+    sc = [my_rows * owned(n1, c1, j) * n2 for j in range(p)]
+    rc = [owned(n0, c0, j) * my_cols * n2 for j in range(p)]
+    off = lambda cs: [sum(cs[:j]) for j in range(p)]
+    return sc, off(sc), rc, off(rc)
+
+
+# ----------------------------------------------------------------- trace
+
+class NativeTrace:
+    """Native trace recorder handle; no-ops when the library is missing so
+    callers can use it unconditionally."""
+
+    def __init__(self) -> None:
+        self._lib = _load()
+
+    @property
+    def available(self) -> bool:
+        return self._lib is not None
+
+    def init(self) -> None:
+        if self._lib is not None:
+            self._lib.dfft_trace_init()
+
+    def begin(self, name: str) -> int:
+        if self._lib is None:
+            return -1
+        return int(self._lib.dfft_trace_begin(name.encode()))
+
+    def end(self, event_id: int) -> None:
+        if self._lib is not None:
+            self._lib.dfft_trace_end(event_id)
+
+    def count(self) -> int:
+        return 0 if self._lib is None else int(self._lib.dfft_trace_count())
+
+    def dump(self, path: str, process: int = 0, nprocs: int = 1) -> bool:
+        if self._lib is None:
+            return False
+        return self._lib.dfft_trace_dump(path.encode(), process, nprocs) == 0

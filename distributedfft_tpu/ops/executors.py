@@ -15,6 +15,10 @@ any of them can be dropped into the distributed pipeline under ``jit`` /
 - ``"matmul"`` — mixed-radix DFT-by-matrix-multiply on the MXU
   (:mod:`distributedfft_tpu.ops.dft_matmul`), the TPU-idiomatic analog of
   templateFFT's runtime-generated Stockham kernels.
+- ``"pallas"`` — the fused four-step Pallas kernel
+  (:mod:`distributedfft_tpu.ops.pallas_fft`): whole-axis transform staged
+  through VMEM in one kernel, one HBM read/write per axis; falls back to
+  ``"matmul"`` for ineligible lengths/dtypes.
 """
 
 from __future__ import annotations
@@ -149,6 +153,42 @@ def _matmul_c2r(y: Array, n: int, axis: int) -> Array:
 
 
 register_real_executor("matmul", _matmul_r2c, _matmul_c2r)
+
+
+def _pallas_executor(x: Array, axes: Sequence[int], forward: bool = True) -> Array:
+    from . import pallas_fft
+
+    for ax in tuple(axes):
+        x = pallas_fft.fft_along_axis(x, ax, forward=forward)
+    return x
+
+
+register_executor("pallas", _pallas_executor)
+
+
+def _pallas_r2c(x: Array, axis: int) -> Array:
+    import jax.lax as lax
+
+    from . import pallas_fft
+
+    n = x.shape[axis]
+    y = pallas_fft.fft_along_axis(x, axis, forward=True)
+    return lax.slice_in_dim(y, 0, n // 2 + 1, axis=axis)
+
+
+def _pallas_c2r(y: Array, n: int, axis: int) -> Array:
+    import jax.lax as lax
+
+    from . import pallas_fft
+
+    h = y.shape[axis]
+    mirror = lax.slice_in_dim(y, 1, n - h + 1, axis=axis)
+    mirror = jnp.conj(jnp.flip(mirror, axis=axis))
+    full = jnp.concatenate([y, mirror], axis=axis)
+    return jnp.real(pallas_fft.fft_along_axis(full, axis, forward=False))
+
+
+register_real_executor("pallas", _pallas_r2c, _pallas_c2r)
 
 
 def get_r2c(name: str) -> Callable:

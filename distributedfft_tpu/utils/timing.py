@@ -79,9 +79,13 @@ def time_fn_amortized(
     return best, out
 
 
-def gflops(shape, seconds: float) -> float:
+def gflops(shape, seconds: float, real: bool = False) -> float:
+    """5 N log2 N / t for complex transforms; a real transform does half the
+    work (heFFTe applies the same 0.5 factor for r2c in its benchmark flop
+    count), so ``real=True`` halves the model."""
     n = math.prod(shape)
-    return 5.0 * n * math.log2(n) / seconds / 1e9
+    f = 2.5 if real else 5.0
+    return f * n * math.log2(n) / seconds / 1e9
 
 
 @jax.jit
@@ -135,18 +139,18 @@ def time_staged(stages, x, iters: int = 3) -> tuple[StageTimes, object]:
 
 
 def result_block(
-    shape, ranks: int, seconds: float, max_err: float, stage_times: StageTimes | None = None
+    shape, ranks: int, seconds: float, max_err: float,
+    stage_times: StageTimes | None = None, real: bool = False,
 ) -> str:
     """Human-readable result in the spirit of the reference's sample output
     (``README.md:44-58``)."""
-    n = math.prod(shape)
     lines = []
     if stage_times is not None:
         lines.append(stage_times.report())
     lines += [
         f"size: {shape[0]} {shape[1]} {shape[2]}, ranks: {ranks}",
         f"time: {seconds:.6f} s",
-        f"gflops: {gflops(shape, seconds):.1f}",
+        f"gflops: {gflops(shape, seconds, real=real):.1f}",
         f"max error: {max_err:.3e}",
     ]
     return "\n".join(lines)

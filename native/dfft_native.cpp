@@ -1,0 +1,250 @@
+// dfft_native — native runtime core for distributedfft_tpu.
+//
+// TPU-native re-design of the reference's C++ runtime layer: the plan-time
+// scheduler that splits one FFT axis into bounded passes (the FFTScheduler
+// role, templateFFT/src/templateFFT.cpp:3941-4100 — there bounded by GPU
+// shared memory, here by VMEM/MXU factor limits), the processor-grid
+// geometry searches (make_procgrid / proc_setup_min_surface,
+// heffte_geometry.h:303,589), the uneven-slab exchange count/offset tables
+// (TransInfo construction, 3dmpifft_opt/include/fft_mpi_3d_api.cpp:84-133),
+// and a low-overhead thread-safe trace-event recorder (the heffte_trace.h
+// RAII event log, :48-127).
+//
+// Pure planning/observability code: no device API calls — device compute
+// belongs to XLA/Pallas. Exposed as a C API for ctypes binding
+// (distributedfft_tpu/native.py); the Python layer keeps equivalent
+// fallbacks, and tests assert bit-identical results between the two.
+
+#include <algorithm>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- version
+
+int dfft_abi_version() { return 1; }
+
+// ------------------------------------------------------------- scheduler
+//
+// Factor n into at most max_passes factors, each <= max_factor, balanced so
+// the largest factor is as small as possible (matmul stages closest to
+// square use the MXU best). Returns the number of passes and writes the
+// factors (descending) into splits_out, or returns:
+//   -1  if n has a prime factor > max_factor (caller switches to Bluestein)
+//   -2  if n needs more than max_passes factors of size <= max_factor
+
+static void prime_factors(long long n, std::vector<long long>& out) {
+  for (long long p = 2; p * p <= n; ++p) {
+    while (n % p == 0) {
+      out.push_back(p);
+      n /= p;
+    }
+  }
+  if (n > 1) out.push_back(n);
+}
+
+int dfft_schedule_axis(long long n, long long max_factor, int max_passes,
+                       long long* splits_out) {
+  if (n < 1 || max_factor < 2 || max_passes < 1) return -3;
+  if (n == 1) {
+    splits_out[0] = 1;
+    return 1;
+  }
+  std::vector<long long> primes;
+  prime_factors(n, primes);
+  for (long long p : primes)
+    if (p > max_factor) return -1;
+
+  // Find the smallest pass count that can work at all.
+  for (int passes = 1; passes <= max_passes; ++passes) {
+    // Feasibility: product must fit in passes factors of <= max_factor.
+    // Greedy first-fit-decreasing into `passes` bins (product-balanced).
+    std::sort(primes.begin(), primes.end(), std::greater<long long>());
+    std::vector<long long> bins(passes, 1);
+    bool ok = true;
+    for (long long p : primes) {
+      // Place into the fullest bin that still fits (keeps factors large and
+      // count small), else the emptiest.
+      int best = -1;
+      for (int b = 0; b < passes; ++b)
+        if (bins[b] * p <= max_factor && (best < 0 || bins[b] > bins[best]))
+          best = b;
+      if (best < 0) {
+        ok = false;
+        break;
+      }
+      bins[best] *= p;
+    }
+    if (!ok) continue;
+    // Rebalance pass: repeatedly move a prime from the largest bin to the
+    // smallest when that reduces the max factor (keeps stages square-ish).
+    for (int iter = 0; iter < 64; ++iter) {
+      std::sort(bins.begin(), bins.end(), std::greater<long long>());
+      if (bins.back() == 1 && bins.size() > 1) {
+        bins.pop_back();  // unused pass
+        continue;
+      }
+      std::vector<long long> f;
+      prime_factors(bins.front(), f);
+      std::sort(f.begin(), f.end());
+      bool moved = false;
+      for (long long p : f) {
+        long long big = bins.front() / p, small = bins.back() * p;
+        if (small <= max_factor &&
+            std::max(big, small) < bins.front()) {
+          bins.front() = big;
+          bins.back() = small;
+          moved = true;
+          break;
+        }
+      }
+      if (!moved) break;
+    }
+    std::sort(bins.begin(), bins.end(), std::greater<long long>());
+    for (size_t i = 0; i < bins.size(); ++i) splits_out[i] = bins[i];
+    return static_cast<int>(bins.size());
+  }
+  return -2;
+}
+
+// -------------------------------------------------------------- geometry
+
+void dfft_procgrid2(long long p, long long* a, long long* b) {
+  long long ba = 1, bb = p;
+  for (long long x = 1; x * x <= p; ++x)
+    if (p % x == 0) {
+      ba = x;
+      bb = p / x;
+    }
+  *a = ba;
+  *b = bb;
+}
+
+void dfft_min_surface_grid(long long nx, long long ny, long long nz,
+                           long long p, long long* out3) {
+  double best = -1.0;
+  for (long long a = 1; a <= p; ++a) {
+    if (p % a) continue;
+    long long q = p / a;
+    for (long long b = 1; b <= q; ++b) {
+      if (q % b) continue;
+      long long c = q / b;
+      double sx = double(nx) / a, sy = double(ny) / b, sz = double(nz) / c;
+      double cost = sx * sy + sy * sz + sx * sz;
+      if (best < 0.0 || cost < best) {
+        best = cost;
+        out3[0] = a;
+        out3[1] = b;
+        out3[2] = c;
+      }
+    }
+  }
+}
+
+// -------------------------------------------------------- exchange tables
+//
+// Uneven-slab redistribution bookkeeping: device r holds X-rows
+// [r*c0, min(n0,(r+1)*c0)) with c0 = ceil(n0/p) and after the global
+// transpose holds Y-columns [r*c1, min(n1,(r+1)*c1)). The element counts
+// each peer pair exchanges are the count tables the reference builds per
+// plan (sendCounts/recvCounts/offsets incl. the asymmetric last device,
+// fft_mpi_3d_api.cpp:84-133). On TPU the collective itself is a padded
+// all_to_all; these tables size the true payloads for plan_info, cost
+// models, and the alltoallv-style masked path.
+
+static inline long long owned(long long n, long long chunk, long long r) {
+  long long lo = r * chunk;
+  if (lo >= n) return 0;
+  return std::min(n, lo + chunk) - lo;
+}
+
+void dfft_exchange_table(long long n0, long long n1, long long n2,
+                         long long p, long long rank,
+                         long long* send_counts, long long* send_offsets,
+                         long long* recv_counts, long long* recv_offsets) {
+  long long c0 = (n0 + p - 1) / p, c1 = (n1 + p - 1) / p;
+  long long my_rows = owned(n0, c0, rank);
+  long long my_cols = owned(n1, c1, rank);
+  long long soff = 0, roff = 0;
+  for (long long j = 0; j < p; ++j) {
+    long long sc = my_rows * owned(n1, c1, j) * n2;
+    long long rc = owned(n0, c0, j) * my_cols * n2;
+    send_counts[j] = sc;
+    send_offsets[j] = soff;
+    recv_counts[j] = rc;
+    recv_offsets[j] = roff;
+    soff += sc;
+    roff += rc;
+  }
+}
+
+// ----------------------------------------------------------------- trace
+//
+// Steady-clock event recorder: begin/end pairs by id, dump to a per-process
+// log in the same "start  duration  name" shape as the Python tracer (which
+// mirrors heffte_trace.h's finalize format).
+
+namespace {
+struct TraceEvent {
+  std::string name;
+  double start;
+  double stop;  // < 0 while open
+};
+std::vector<TraceEvent> g_events;
+std::mutex g_mu;
+bool g_on = false;
+
+double now_s() {
+  using clk = std::chrono::steady_clock;
+  return std::chrono::duration<double>(clk::now().time_since_epoch()).count();
+}
+}  // namespace
+
+void dfft_trace_init() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_events.clear();
+  g_on = true;
+}
+
+long long dfft_trace_begin(const char* name) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_on) return -1;
+  g_events.push_back({name ? name : "", now_s(), -1.0});
+  return static_cast<long long>(g_events.size()) - 1;
+}
+
+void dfft_trace_end(long long id) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_on || id < 0 || id >= (long long)g_events.size()) return;
+  g_events[id].stop = now_s();
+}
+
+long long dfft_trace_count() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  return static_cast<long long>(g_events.size());
+}
+
+int dfft_trace_dump(const char* path, long long process, long long nprocs) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  std::FILE* f = std::fopen(path, "w");
+  if (!f) return -1;
+  std::fprintf(f, "process %lld of %lld\n", process, nprocs);
+  double t0 = g_events.empty() ? 0.0 : g_events.front().start;
+  for (const auto& e : g_events) {
+    double dur = e.stop < 0 ? 0.0 : e.stop - e.start;
+    std::fprintf(f, "%14.6f  %12.6f  %s\n", e.start - t0, dur,
+                 e.name.c_str());
+  }
+  std::fclose(f);
+  g_events.clear();
+  g_on = false;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,114 @@
+"""Native runtime core: build, bind, and Python-parity tests.
+
+The native library re-implements plan-time logic that also exists in Python
+(the reference's split between C++ runtime and device code, SURVEY.md §2);
+these tests pin the two implementations together.
+"""
+
+import os
+
+import pytest
+
+from distributedfft_tpu import geometry as geo
+from distributedfft_tpu import native
+
+
+requires_native = pytest.mark.skipif(
+    not native.is_available(), reason="native toolchain unavailable"
+)
+
+
+def test_schedule_axis_python_fallback():
+    # Smooth sizes factor into balanced bounded passes.
+    assert native._schedule_axis_py(512, 256, 4) == [32, 16]
+    assert native._schedule_axis_py(65536, 256, 4) == [256, 256]
+    assert native._schedule_axis_py(128, 256, 4) == [128]
+    assert native._schedule_axis_py(1, 256, 4) == [1]
+    # Large prime -> None (Bluestein territory).
+    assert native._schedule_axis_py(8191, 256, 4) is None
+    # Too many passes required -> None.
+    assert native._schedule_axis_py(2**40, 256, 4) is None
+
+
+@requires_native
+def test_native_builds_and_loads():
+    assert os.path.exists(os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                                       "native", "libdfft_native.so"))
+
+
+@requires_native
+@pytest.mark.parametrize("n", [1, 2, 12, 128, 512, 4096, 48828125, 2**22,
+                               3**8, 5 * 7 * 11 * 13, 8191])
+def test_schedule_axis_native_matches_python(n):
+    for max_factor, max_passes in [(256, 4), (128, 2), (16, 4)]:
+        got = native.schedule_axis(n, max_factor, max_passes)
+        want = native._schedule_axis_py(n, max_factor, max_passes)
+        assert got == want, (n, max_factor, max_passes)
+        if got is not None:
+            prod = 1
+            for f in got:
+                prod *= f
+                assert f <= max_factor
+            assert prod == n
+
+
+@requires_native
+@pytest.mark.parametrize("p", [1, 2, 3, 4, 6, 8, 12, 16, 30])
+def test_procgrid_native_matches_python(p):
+    assert native.procgrid2(p) == geo.make_procgrid(p)
+
+
+@requires_native
+@pytest.mark.parametrize("shape,p", [((512, 512, 512), 8), ((1536, 1024, 768), 16),
+                                     ((100, 200, 300), 12), ((8, 8, 8), 1)])
+def test_min_surface_native_matches_python(shape, p):
+    world = geo.world_box(shape)
+    assert tuple(native.min_surface_grid(shape, p)) == tuple(
+        geo.proc_setup_min_surface(world, p)
+    )
+
+
+@pytest.mark.parametrize("n0,n1,p", [(512, 512, 4), (100, 70, 8), (7, 5, 4),
+                                     (16, 16, 16)])
+def test_exchange_table_conservation(n0, n1, p):
+    """Totals conserve: every element owned before the exchange is sent, and
+    the global send volume equals the global recv volume (the invariant
+    behind the reference's count tables, fft_mpi_3d_api.cpp:84-133)."""
+    n2 = 3
+    tables = [native.exchange_table(n0, n1, n2, p, r) for r in range(p)]
+    c0 = -(-n0 // p)
+    for r, (sc, soff, rc, roff) in enumerate(tables):
+        rows = max(0, min(n0, (r + 1) * c0) - min(n0, r * c0))
+        assert sum(sc) == rows * n1 * n2
+        assert soff == [sum(sc[:j]) for j in range(p)]
+        assert roff == [sum(rc[:j]) for j in range(p)]
+    # Pairwise symmetry: what r sends to j is what j receives from r.
+    for r in range(p):
+        for j in range(p):
+            assert tables[r][0][j] == tables[j][2][r]
+    assert sum(sum(t[0]) for t in tables) == n0 * n1 * n2
+
+
+@requires_native
+@pytest.mark.parametrize("n0,n1,p,rank", [(512, 512, 4, 0), (100, 70, 8, 7),
+                                          (7, 5, 4, 2)])
+def test_exchange_table_native_matches_python(n0, n1, p, rank):
+    assert native.exchange_table(n0, n1, 4, p, rank) == native._exchange_table_py(
+        n0, n1, 4, p, rank
+    )
+
+
+@requires_native
+def test_native_trace_roundtrip(tmp_path):
+    tr = native.NativeTrace()
+    tr.init()
+    i = tr.begin("stage_a")
+    tr.end(i)
+    j = tr.begin("stage_b")
+    tr.end(j)
+    assert tr.count() == 2
+    path = str(tmp_path / "trace_0.log")
+    assert tr.dump(path, process=0, nprocs=1)
+    text = open(path).read()
+    assert "process 0 of 1" in text
+    assert "stage_a" in text and "stage_b" in text
