@@ -33,7 +33,18 @@ from .local import (  # noqa: F401
     plan_dft_c2c_2d,
 )
 from .ops.executors import Scale, available_executors  # noqa: F401
+from .parallel.fft1d import (  # noqa: F401
+    DistPlan1D,
+    build_dist_fft1d,
+    choose_split_1d,
+    plan_dft_c2c_1d_dist,
+)
 from .parallel.mesh import make_mesh  # noqa: F401
+from .parallel.multihost import (  # noqa: F401
+    fft_mesh_for,
+    init_multihost,
+    make_hybrid_mesh,
+)
 from .parallel.reshape import make_reshape3d, reshape3d  # noqa: F401
 from .plan_logic import (  # noqa: F401
     LogicPlan,

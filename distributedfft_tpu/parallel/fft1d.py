@@ -1,0 +1,298 @@
+"""Distributed 1D FFT of one long sequence over a device mesh.
+
+The reference scales long 1D sequences *within* one device via templateFFT's
+four-step axis split (``FFTScheduler``, ``templateFFT.cpp:3975-4100``, sizes
+up to 5^11 = 48,828,125, ``runTest1D_opt.sh:14-20``) — its cross-device story
+exists only for 3D grids. This module is the missing cross-device analog,
+TPU-native: the same four-step identity, but with the two DFT stages running
+on different mesh shards and the inter-stage reorder riding ICI as
+all-to-alls — sequence parallelism for a single transform far larger than
+one chip's HBM.
+
+Math (j = j1*B + j2, k = k1 + A*k2, n = A*B):
+
+    X[k1 + A*k2] = sum_j2 w_B^{j2 k2} * w_n^{j2 k1}
+                   * (sum_j1 w_A^{j1 k1} x[j1*B + j2])
+
+Pipeline over a 1D mesh of P devices (input [A, B] row-major view of x,
+sharded by rows):
+
+    s0  all_to_all:  rows -> columns            ([A, B/P] per device)
+    s1  executor FFT over axis 0 (length A)
+    s2  twiddle w_n^{k1 * j2}                   (exact integer mulmod phase)
+    s3  all_to_all:  columns -> rows            ([A/P, B] per device)
+    s4  executor FFT over axis 1 (length B)
+
+The result is the spectrum in **transposed order**: element [k1, k2] of the
+output's [A, B] view is X[k1 + A*k2] — the FFTW-MPI ``TRANSPOSED_OUT``
+convention. ``order="natural"`` appends one more global transpose (a third
+all-to-all) to return X in index order.
+
+Twiddle exactness: w_n^{k1*j2} phases are reduced with integer
+multiply-mod (binary doubling, intermediates < 2n), never by forming the
+float product k1*j2 — exact for n < 2^30 in int32 (larger n switches to
+int64, which requires x64 mode). The per-device factor w_n^{k1*(dev*Bl)}
+is computed on device; the device-independent factor w_n^{k1*c}, c < B/P,
+is a host-precomputed LUT (plan-time table discipline as everywhere else).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.6
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from ..ops.executors import get_executor
+from .exchange import exchange
+
+
+def _find_split(n: int, p: int) -> tuple[int, int] | None:
+    best = None
+    for a in range(int(math.isqrt(n)), 0, -1):
+        if n % a:
+            continue
+        b = n // a
+        for big, small in ((a, b), (b, a)):
+            if big % p == 0 and small % p == 0:
+                if best is None or abs(big - small) < abs(best[0] - best[1]):
+                    best = (big, small)
+        if best is not None and best[0] == a:
+            break
+    return best
+
+
+def choose_split_1d(n: int, p: int) -> tuple[int, int]:
+    """Balanced divisor pair (A, B) of n with both divisible by ``p`` (both
+    exchange axes must split evenly across the mesh). Raises when no such
+    pair exists — pad the sequence to a friendlier length."""
+    best = _find_split(n, p)
+    if best is None:
+        raise ValueError(
+            f"length {n} has no factor pair with both factors divisible by "
+            f"{p}; pad the sequence (e.g. to {_suggest_length(n, p)})"
+        )
+    return best
+
+
+def _suggest_length(n: int, p: int) -> int:
+    m = n
+    while _find_split(m, p) is None:
+        m += 1
+    return m
+
+
+def _mulmod(a, b: int, n: int, idt):
+    """(a * b) % n elementwise with intermediates < 2n (binary doubling over
+    the static multiplier ``b``); exact where a float product would not be."""
+    a = (a % n).astype(idt)
+    acc = jnp.zeros_like(a)
+    cur = a
+    for s in range(max(1, b.bit_length())):
+        if (b >> s) & 1:
+            acc = (acc + cur) % n
+        cur = (cur * 2) % n
+    return acc
+
+
+def _mulmod_traced(a, b, n: int, idt):
+    """Same, but for a traced multiplier ``b`` (static bit budget)."""
+    a = (a % n).astype(idt)
+    b = b.astype(idt)
+    acc = jnp.zeros_like(a)
+    cur = a
+    for s in range(max(1, (n - 1).bit_length())):
+        bit = (b >> s) & 1
+        acc = jnp.where(bit == 1, (acc + cur) % n, acc)
+        cur = (cur * 2) % n
+    return acc
+
+
+@functools.lru_cache(maxsize=None)
+def _local_twiddle_np(n: int, a: int, bl: int, forward: bool) -> np.ndarray:
+    """Device-independent twiddle factor w_n^{k1*c} for local columns
+    c < bl, exact host f64 (complex128; cast to working dtype on use)."""
+    sign = -2j if forward else 2j
+    kc = np.outer(np.arange(a, dtype=np.int64), np.arange(bl, dtype=np.int64))
+    return np.exp(sign * np.pi * (kc % n) / n)
+
+
+@dataclass
+class Dist1DSpec:
+    """Static geometry of a distributed 1D plan."""
+
+    n: int
+    a: int  # rows    (first-stage DFT length)
+    b: int  # columns (second-stage DFT length)
+    parts: int
+    axis_name: str
+    order: str  # "transposed" | "natural"
+
+
+def build_dist_fft1d(
+    mesh: Mesh,
+    n: int,
+    *,
+    axis_name: str = "slab",
+    forward: bool = True,
+    executor: str | Callable = "xla",
+    order: str = "transposed",
+    algorithm: str = "alltoall",
+    donate: bool = False,
+) -> tuple[Callable, Dist1DSpec]:
+    """Build the jitted distributed 1D C2C transform of length ``n``.
+
+    Forward maps a length-``n`` vector (sharded in contiguous blocks) to its
+    spectrum in transposed order ([A, B]-view element [k1, k2] = X[k1+A*k2])
+    or natural order. Backward inverts exactly that layout back to the
+    natural-order sequence (1/n scaling, numpy convention).
+    """
+    if order not in ("transposed", "natural"):
+        raise ValueError("order must be 'transposed' or 'natural'")
+    p = mesh.shape[axis_name]
+    a, b = choose_split_1d(n, p)
+    bl = b // p
+    ex = get_executor(executor) if isinstance(executor, str) else executor
+    spec = Dist1DSpec(n, a, b, p, axis_name, order)
+    idt = jnp.int32 if n < (1 << 30) else jnp.int64
+
+    w_local_np = _local_twiddle_np(n, a, bl, forward)
+
+    def twiddle(g):  # g: [a, bl] complex, full k1 range, local j2 columns
+        dev = lax.axis_index(axis_name)
+        # per-device phase w_n^{k1 * dev*bl}: exact integer phase reduction
+        ps = _mulmod(jnp.full((1,), dev, idt), bl, n, idt)[0]
+        rows = _mulmod_traced(jnp.arange(a, dtype=idt), ps, n, idt)
+        rdt = g.real.dtype
+        sign = -2.0 if forward else 2.0
+        ang = (sign * np.pi / n) * rows.astype(rdt)
+        rot = lax.complex(jnp.cos(ang), jnp.sin(ang))
+        w = jnp.asarray(w_local_np, dtype=g.dtype)
+        vma = getattr(jax.typeof(g), "vma", None)
+        if vma:
+            w = lax.pvary(w, tuple(vma))
+        return g * rot[:, None] * w
+
+    if forward:
+
+        def local_fn(x2):  # [a/p, b] per device
+            g = exchange(x2, axis_name, split_axis=1, concat_axis=0,
+                         axis_size=p, algorithm=algorithm)   # s0: [a, bl]
+            g = ex(g, (0,), True)                            # s1: DFT_A
+            g = twiddle(g)                                   # s2
+            h = exchange(g, axis_name, split_axis=0, concat_axis=1,
+                         axis_size=p, algorithm=algorithm)   # s3: [a/p, b]
+            return ex(h, (1,), True)                         # s4: DFT_B
+
+    else:
+
+        def local_fn(r2):  # transposed-order spectrum [a/p, b] per device
+            h = ex(r2, (1,), False)                          # inverse DFT_B
+            g = exchange(h, axis_name, split_axis=1, concat_axis=0,
+                         axis_size=p, algorithm=algorithm)   # [a, bl]
+            g = twiddle(g)                                   # conj twiddle
+            g = ex(g, (0,), False)                           # inverse DFT_A
+            return exchange(g, axis_name, split_axis=0, concat_axis=1,
+                            axis_size=p, algorithm=algorithm)  # [a/p, b]
+
+    rows_spec = P(axis_name, None)
+    mapped = _shard_map(local_fn, mesh=mesh,
+                        in_specs=(rows_spec,), out_specs=rows_spec)
+    vec_sh = NamedSharding(mesh, P(axis_name))
+    rows_sh = NamedSharding(mesh, rows_spec)
+    jit_kw: dict[str, Any] = {"donate_argnums": 0} if donate else {}
+    jit_kw |= {"in_shardings": vec_sh, "out_shardings": vec_sh}
+
+    if forward:
+
+        @functools.partial(jax.jit, **jit_kw)
+        def fn(x):
+            x2 = lax.with_sharding_constraint(x.reshape(a, b), rows_sh)
+            r = mapped(x2)
+            if order == "natural":
+                # one more global transpose: [a, b] rows-sharded ->
+                # [b, a] rows-sharded; flat index becomes k2*a + k1 = k.
+                r = lax.with_sharding_constraint(
+                    r.T, NamedSharding(mesh, P(axis_name, None))
+                )
+            return r.reshape(n)
+
+    else:
+
+        @functools.partial(jax.jit, **jit_kw)
+        def fn(r):
+            if order == "natural":
+                r2 = lax.with_sharding_constraint(
+                    r.reshape(b, a).T, rows_sh
+                )
+            else:
+                r2 = r.reshape(a, b)
+            r2 = lax.with_sharding_constraint(r2, rows_sh)
+            x2 = mapped(r2)
+            return x2.reshape(n)
+
+    return fn, spec
+
+
+@dataclass
+class DistPlan1D:
+    """Callable distributed 1D plan (cf. the local :class:`~..local.LocalPlan`
+    surface; this is its cross-device sibling)."""
+
+    spec: Dist1DSpec
+    direction: int
+    dtype: Any
+    executor: str
+    fn: Callable
+
+    def __call__(self, x):
+        x = jnp.asarray(x, dtype=self.dtype)
+        if x.shape != (self.spec.n,):
+            raise ValueError(f"plan input shape is ({self.spec.n},), got {x.shape}")
+        return self.fn(x)
+
+    def flops(self) -> float:
+        return 5.0 * self.spec.n * math.log2(self.spec.n)
+
+
+def plan_dft_c2c_1d_dist(
+    n: int,
+    mesh: Mesh | None,
+    *,
+    direction: int = -1,
+    executor: str = "xla",
+    order: str = "transposed",
+    algorithm: str = "alltoall",
+    dtype: Any = None,
+    donate: bool = False,
+) -> DistPlan1D:
+    """Plan a distributed 1D C2C FFT of one length-``n`` sequence.
+
+    With ``mesh=None`` (or one device) the plan is a plain local transform;
+    ``order`` then has no effect (output is always natural)."""
+    if dtype is None:
+        dtype = jnp.complex128 if jax.config.jax_enable_x64 else jnp.complex64
+    forward = direction == -1
+    if mesh is None or math.prod(mesh.devices.shape) == 1:
+        ex = get_executor(executor)
+        fn = jax.jit(lambda x: ex(x, (0,), forward),
+                     donate_argnums=(0,) if donate else ())
+        spec = Dist1DSpec(n, n, 1, 1, "", "natural")
+        return DistPlan1D(spec, direction, jnp.dtype(dtype), executor, fn)
+    axis_name = mesh.axis_names[0]
+    fn, spec = build_dist_fft1d(
+        mesh, n, axis_name=axis_name, forward=forward, executor=executor,
+        order=order, algorithm=algorithm, donate=donate,
+    )
+    return DistPlan1D(spec, direction, jnp.dtype(dtype), executor, fn)

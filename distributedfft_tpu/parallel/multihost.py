@@ -1,0 +1,136 @@
+"""Multi-host (DCN) tier: process bootstrap, hybrid meshes, host/global data.
+
+The reference's multi-node story is MPI over UCX/InfiniBand: one rank per
+node, ``MPI_Init_thread`` + hostfile (``3dmpifft_opt/fftSpeed3d_c2c.cpp:18``,
+``speedTest.sh``, ``nodelist``), GPU-aware Isend/Irecv between nodes and
+peer-DMA inside a node (``fft_mpi_3d_api.cpp:610-699``). The TPU-native
+equivalent keeps the same two-tier shape with XLA collectives:
+
+- process bootstrap  = ``jax.distributed.initialize``  (replaces MPI_Init;
+  coordinator address plays the role of the hostfile),
+- intra-node XGMI    = ICI mesh axes (devices within a slice),
+- inter-node UCX/IB  = DCN mesh axes (across processes/slices),
+
+and one jitted mesh program spans both tiers — XLA routes each collective
+over ICI or DCN according to which mesh axis it runs on, replacing the
+reference's hand-split hipMemcpyPeerAsync / MPI_Isend code paths.
+
+Everything here is single-process-safe: with one process the DCN axis has
+extent 1 and every helper degenerates to the local behavior, so the same
+driver script runs on a laptop, one TPU host, or a multi-host pod (the
+"multi-node without a cluster" property of the reference's test strategy,
+SURVEY.md §4.2).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_initialized = False
+
+
+def init_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    **kw,
+) -> bool:
+    """Initialize the cross-host runtime (``jax.distributed.initialize``).
+
+    Arguments default to the standard environment (JAX_COORDINATOR_ADDRESS
+    etc. / cloud auto-detection). Returns True when a multi-process runtime
+    was initialized, False when running single-process (no coordinator
+    configured) — in which case everything degrades gracefully to one
+    process. Safe to call twice.
+    """
+    global _initialized
+    if _initialized or jax.process_count() > 1:
+        _initialized = True
+        return True
+    configured = (
+        coordinator_address is not None
+        or os.environ.get("JAX_COORDINATOR_ADDRESS")
+        or os.environ.get("COORDINATOR_ADDRESS")
+    )
+    if not configured:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kw,
+    )
+    _initialized = True
+    return True
+
+
+def make_hybrid_mesh(
+    axis_names: tuple[str, str] = ("dcn", "slab"),
+    *,
+    devices: Sequence | None = None,
+) -> Mesh:
+    """2D (processes x per-process-devices) mesh: axis 0 spans DCN (one row
+    per process), axis 1 spans the ICI-connected devices of each process.
+
+    For the FFT engines this is the pencil mesh with the *column* axis on
+    ICI — lay the heavy exchange on ``axis_names[1]`` so it rides ICI and
+    only the coarse exchange crosses DCN (the ICI/DCN layering rule; the
+    reference's analogous split is peer-DMA within a node vs MPI across,
+    ``fft_mpi_3d_api.cpp:627-672``).
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    nproc = max(1, jax.process_count())
+    per = len(devs) // nproc
+    if per * nproc != len(devs):
+        raise ValueError(
+            f"{len(devs)} devices do not divide over {nproc} processes"
+        )
+    # jax.devices() orders by process; rows = processes -> row-major grid.
+    grid = np.array(devs).reshape(nproc, per)
+    return Mesh(grid, axis_names)
+
+
+def fft_mesh_for(ndev_total: int | None = None) -> Mesh:
+    """The default distributed-FFT mesh for this runtime: hybrid 2D when
+    multi-process, flat 1D slab mesh when single-process."""
+    from .mesh import make_mesh
+
+    if jax.process_count() > 1:
+        return make_hybrid_mesh()
+    return make_mesh(ndev_total or len(jax.devices()))
+
+
+def host_local_to_global(mesh: Mesh, spec: P, local: np.ndarray):
+    """Assemble a global (sharded) array from each process's host-local
+    block — the data-ingest direction of the reference's per-rank init
+    (``fftSpeed3d_c2c.cpp:59-72`` fills each rank's slab then plans over the
+    world). Single-process this is just device_put with a sharding."""
+    if jax.process_count() == 1:
+        return jax.device_put(local, NamedSharding(mesh, spec))
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.host_local_array_to_global_array(local, mesh, spec)
+
+
+def global_to_host_local(x) -> np.ndarray:
+    """Fetch the full value of a (possibly sharded) global array to every
+    host (cross-process allgather when needed) — the validation direction."""
+    if jax.process_count() == 1:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
+def sync_global_devices(tag: str = "dfft") -> None:
+    """Cross-process barrier (the MPI_Barrier analog used between timing
+    sections, ``test_common.h`` banner sync)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)

@@ -1,0 +1,118 @@
+"""Distributed long-1D FFT: four-step over the mesh vs numpy, both orders,
+both directions, exchange algorithms, and the exact-twiddle helpers."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu.parallel import fft1d
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the virtual 8-device mesh"
+)
+
+
+def _data(n, seed=3):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal(n) + 1j * rng.standard_normal(n)
+
+
+def _transposed_to_natural(y, a, b):
+    return np.asarray(y).reshape(a, b).T.reshape(-1)
+
+
+def test_choose_split_balanced():
+    assert fft1d.choose_split_1d(64 * 64, 8) == (64, 64)
+    a, b = fft1d.choose_split_1d(8 * 8 * 3, 8)
+    assert a * b == 192 and a % 8 == 0 and b % 8 == 0
+    with pytest.raises(ValueError):
+        fft1d.choose_split_1d(17 * 8, 8)  # 17 prime: no second factor % 8
+
+
+def test_mulmod_exact():
+    n = (1 << 29) + 3
+    a = jnp.arange(0, 1 << 13, 97, dtype=jnp.int32)
+    got = np.asarray(fft1d._mulmod(a, 123457, n, jnp.int32))
+    want = (np.asarray(a).astype(object) * 123457) % n
+    assert (got == want.astype(np.int64)).all()
+    ps = jnp.asarray(54321, jnp.int32)
+    got2 = np.asarray(fft1d._mulmod_traced(a, ps, n, jnp.int32))
+    want2 = (np.asarray(a).astype(object) * 54321) % n
+    assert (got2 == want2.astype(np.int64)).all()
+
+
+@pytest.mark.parametrize("algorithm", ["alltoall", "ppermute"])
+def test_forward_transposed_order(algorithm):
+    n = 64 * 64
+    mesh = dfft.make_mesh(8)
+    x = _data(n)
+    plan = fft1d.plan_dft_c2c_1d_dist(n, mesh, algorithm=algorithm)
+    y = plan(x)
+    a, b = plan.spec.a, plan.spec.b
+    got = _transposed_to_natural(y, a, b)
+    ref = np.fft.fft(x)
+    assert np.max(np.abs(got - ref)) / np.max(np.abs(ref)) < 1e-11
+
+
+def test_forward_natural_order():
+    n = 128 * 72
+    mesh = dfft.make_mesh(8)
+    x = _data(n, seed=5)
+    plan = fft1d.plan_dft_c2c_1d_dist(n, mesh, order="natural")
+    got = np.asarray(plan(x))
+    ref = np.fft.fft(x)
+    assert np.max(np.abs(got - ref)) / np.max(np.abs(ref)) < 1e-11
+
+
+@pytest.mark.parametrize("order", ["transposed", "natural"])
+def test_roundtrip(order):
+    n = 64 * 64
+    mesh = dfft.make_mesh(8)
+    x = _data(n, seed=7)
+    fwd = fft1d.plan_dft_c2c_1d_dist(n, mesh, order=order)
+    bwd = fft1d.plan_dft_c2c_1d_dist(n, mesh, order=order, direction=+1)
+    r = np.asarray(bwd(fwd(x)))
+    assert np.max(np.abs(r - x)) / np.max(np.abs(x)) < 1e-11
+
+
+def test_matmul_executor_distributed_1d():
+    n = 64 * 64
+    mesh = dfft.make_mesh(8)
+    x = _data(n, seed=9)
+    plan = fft1d.plan_dft_c2c_1d_dist(n, mesh, executor="matmul",
+                                      order="natural")
+    got = np.asarray(plan(x))
+    ref = np.fft.fft(x)
+    assert np.max(np.abs(got - ref)) / np.max(np.abs(ref)) < 1e-9
+
+
+def test_single_device_fallback():
+    n = 1000
+    x = _data(n, seed=11)
+    plan = fft1d.plan_dft_c2c_1d_dist(n, None)
+    got = np.asarray(plan(x))
+    ref = np.fft.fft(x)
+    assert np.max(np.abs(got - ref)) / np.max(np.abs(ref)) < 1e-11
+
+
+def test_wrong_shape_rejected():
+    mesh = dfft.make_mesh(8)
+    plan = fft1d.plan_dft_c2c_1d_dist(64 * 64, mesh)
+    with pytest.raises(ValueError):
+        plan(np.zeros(17, np.complex128))
+
+
+def test_pencil_of_long_sequence_beats_memory_bound():
+    """The sharded input is never materialized unsharded: per-device shard
+    shapes stay [a/p, b] / [a, b/p] through the pipeline (checked via the
+    jitted lowering's output sharding)."""
+    n = 64 * 64
+    mesh = dfft.make_mesh(8)
+    plan = fft1d.plan_dft_c2c_1d_dist(n, mesh)
+    y = plan(_data(n))
+    assert y.sharding.is_equivalent_to(
+        jax.NamedSharding(mesh, jax.sharding.PartitionSpec("slab")), y.ndim
+    )
