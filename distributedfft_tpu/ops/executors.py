@@ -172,6 +172,11 @@ def _pallas_r2c(x: Array, axis: int) -> Array:
     from . import pallas_fft
 
     n = x.shape[axis]
+    # Promote real input up front: the kernel's dtype gate only admits
+    # complex64, so a float32 operand would silently take the fallback.
+    if not jnp.issubdtype(x.dtype, jnp.complexfloating):
+        wide = jnp.dtype(x.dtype).itemsize >= 8
+        x = x.astype(jnp.complex128 if wide else jnp.complex64)
     y = pallas_fft.fft_along_axis(x, axis, forward=True)
     return lax.slice_in_dim(y, 0, n // 2 + 1, axis=axis)
 

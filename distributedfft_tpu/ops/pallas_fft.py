@@ -111,10 +111,6 @@ def _mm(a, b):
 
 
 def _make_kernel(n1: int, n2: int):
-    n = n1 * n2
-
-    del n
-
     def kernel(w1r, w1i, tr, ti, w2r, w2i, xr, xi, yr, yi):
         # Mosaic note: every reshape below merges/splits *leading* dims only
         # (the lane dim never changes inside a reshape); layout moves between
@@ -211,7 +207,7 @@ def fft_along_axis(x: jnp.ndarray, axis: int, forward: bool = True) -> jnp.ndarr
     from . import dft_matmul
 
     n = x.shape[axis]
-    if jnp.dtype(x.dtype) != jnp.complex64 or not eligible(n):
+    if jnp.dtype(x.dtype) != jnp.complex64 or not eligible(n) or x.size == 0:
         return dft_matmul.fft_along_axis(x, axis, forward=forward)
 
     shape = x.shape

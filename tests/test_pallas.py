@@ -99,6 +99,23 @@ def test_distributed_plan_with_pallas_executor():
     assert _rel_err(np.asarray(bwd(fwd(x))), np.asarray(x)) < 5e-4
 
 
+def test_zero_batch_falls_back_cleanly():
+    x = jnp.zeros((0, 256), jnp.complex64)
+    y = pallas_fft.fft_along_axis(x, 1, True)
+    assert y.shape == (0, 256)
+
+
+def test_r2c_real_input_promoted_to_kernel_dtype():
+    rng = np.random.default_rng(12)
+    from distributedfft_tpu.ops.executors import get_c2r, get_r2c
+
+    x = rng.standard_normal((4, 256)).astype(np.float32)
+    y = np.asarray(get_r2c("pallas")(jnp.asarray(x), 1))
+    assert _rel_err(y, np.fft.rfft(x, axis=1)) < RTOL
+    r = np.asarray(get_c2r("pallas")(jnp.asarray(y.astype(np.complex64)), 256, 1))
+    assert _rel_err(r, x) < RTOL
+
+
 def test_scheduler_feeds_kernel_splits():
     """The native scheduler and the kernel's split agree on bounds."""
     from distributedfft_tpu import native
