@@ -50,6 +50,7 @@ from .plan_logic import (  # noqa: F401
     LogicPlan,
     PlanOptions,
     choose_decomposition,
+    negotiate_device_count,
     default_options,
     logic_plan3d,
 )

@@ -186,6 +186,63 @@ def _fft_tiles(xr, xi, *, n: int, forward: bool, interpret: bool):
     return yr.reshape(batch, n), yi.reshape(batch, n)
 
 
+@functools.lru_cache(maxsize=None)
+def outer_split(n: int) -> tuple[int, int] | None:
+    """Balanced divisor pair with BOTH factors kernel-eligible — the
+    two-level plan for axes beyond one kernel's reach (the multi-upload
+    regime of the reference's scheduler, ``templateFFT.cpp:4007-4100``:
+    there >1 shared-memory passes, here >1 fused-kernel passes). Capped at
+    n < 2^31 so the int32 twiddle phase stays exact; longer axes take the
+    recursive matmul path."""
+    if n >= 1 << 31:
+        return None
+    for d in range(int(math.isqrt(n)), 63, -1):
+        if n % d == 0 and eligible(d) and eligible(n // d):
+            return d, n // d
+    return None
+
+
+def _fft_last_big(x2: jnp.ndarray, n: int, forward: bool) -> jnp.ndarray:
+    """Two-level four-step over [batch, n]: each DFT stage is a fused-kernel
+    batched transform, the inter-stage twiddle/transposes run at the XLA
+    level (exact int32 phase: i*j < n < 2^31)."""
+    m1, m2 = outer_split(n)
+    batch = x2.shape[0]
+    a = x2.reshape(batch, m1, m2)
+    # DFT over j1: move it last, kernel-transform, move back.
+    b = jnp.swapaxes(a, -1, -2).reshape(batch * m2, m1)
+    b = _fft_eligible(b, m1, forward)
+    b = jnp.swapaxes(b.reshape(batch, m2, m1), -1, -2)  # [batch, k1, j2]
+    i = jnp.arange(m1, dtype=jnp.int32)[:, None]
+    j = jnp.arange(m2, dtype=jnp.int32)[None, :]
+    phase = (i * j) % jnp.int32(n)
+    sign = -2.0 if forward else 2.0
+    ang = (sign * np.pi / n) * phase.astype(jnp.float32)
+    b = b * lax.complex(jnp.cos(ang), jnp.sin(ang))
+    c = _fft_eligible(b.reshape(batch * m1, m2), m2, forward)
+    c = c.reshape(batch, m1, m2)
+    # Output flat index k = k1 + m1*k2.
+    return jnp.swapaxes(c, -1, -2).reshape(batch, n)
+
+
+def _fft_eligible(x2: jnp.ndarray, n: int, forward: bool) -> jnp.ndarray:
+    """Kernel-path transform of [batch, n] complex64 rows (n eligible),
+    including the batch pad/crop discipline."""
+    batch = x2.shape[0]
+    bt = min(batch_tile(n), max(8, batch))
+    pad = (-batch) % bt
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    interpret = jax.default_backend() == "cpu"
+    if interpret and _vma(x2):
+        y = _four_step_ref(x2, n, forward)
+    else:
+        yr, yi = _fft_tiles(jnp.real(x2), jnp.imag(x2), n=n, forward=forward,
+                            interpret=interpret)
+        y = lax.complex(yr, yi)
+    return y[:batch] if pad else y
+
+
 def _four_step_ref(x2: jnp.ndarray, n: int, forward: bool) -> jnp.ndarray:
     """jnp mirror of the kernel math (same LUTs, same contraction order and
     precision) for [batch, n] complex input. Used on the CPU test backend
@@ -207,10 +264,14 @@ def fft_along_axis(x: jnp.ndarray, axis: int, forward: bool = True) -> jnp.ndarr
     from . import dft_matmul
 
     n = x.shape[axis]
-    if jnp.dtype(x.dtype) != jnp.complex64 or not eligible(n) or x.size == 0:
+    two_level = False
+    if jnp.dtype(x.dtype) != jnp.complex64 or x.size == 0:
         return dft_matmul.fft_along_axis(x, axis, forward=forward)
+    if not eligible(n):
+        if outer_split(n) is None:
+            return dft_matmul.fft_along_axis(x, axis, forward=forward)
+        two_level = True
 
-    shape = x.shape
     moved = axis not in (-1, x.ndim - 1)
     if moved:
         x = jnp.moveaxis(x, axis, -1)
@@ -218,19 +279,10 @@ def fft_along_axis(x: jnp.ndarray, axis: int, forward: bool = True) -> jnp.ndarr
     batch = math.prod(mshape[:-1]) if x.ndim > 1 else 1
     x = x.reshape(batch, n)
 
-    interpret = jax.default_backend() == "cpu"
-    if interpret and _vma(x):
-        y = _four_step_ref(x, n, forward)
+    if two_level:
+        y = _fft_last_big(x, n, forward)
     else:
-        bt = min(batch_tile(n), max(8, batch))
-        pad = (-batch) % bt
-        if pad:
-            x = jnp.pad(x, ((0, pad), (0, 0)))
-        yr, yi = _fft_tiles(jnp.real(x), jnp.imag(x), n=n, forward=forward,
-                            interpret=interpret)
-        y = lax.complex(yr, yi)
-        if pad:
-            y = y[:batch]
+        y = _fft_eligible(x, n, forward)
     if not forward:
         y = y * jnp.float32(1.0 / n)
     y = y.reshape(mshape)

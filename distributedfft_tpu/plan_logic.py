@@ -108,6 +108,38 @@ def choose_decomposition(shape: Sequence[int], ndev: int) -> str:
     return "pencil"
 
 
+def negotiate_device_count(
+    shape: Sequence[int], ndev: int, decomposition: str = "slab"
+) -> int:
+    """Largest device count <= ``ndev`` whose slabs/pencils divide the split
+    axes evenly — the reference's device-count renegotiation
+    (``getProperDeviceNum``, ``fft_mpi_3d_api.cpp:232-272``: when N0 %
+    devices != 0 it *shrinks* the device count until slabs divide).
+
+    On TPU the padded-exchange path makes uneven shapes correct anyway, so
+    this is an *optimization* choice, not a correctness one: a caller that
+    prefers zero padding waste over maximum parallelism can plan with the
+    negotiated count (idle devices simply hold empty shards).
+    """
+    n0, n1, n2 = (int(s) for s in shape)
+    start = min(ndev, n0, n1) if decomposition == "slab" else ndev
+    for p in range(start, 0, -1):
+        if decomposition == "slab":
+            if n0 % p == 0 and n1 % p == 0:
+                return p
+        else:
+            # pencil pads axis0/axis1 over mesh rows and axis1/axis2 over
+            # mesh cols (PencilSpec n0p/n1p_row/n1p_col/n2p); an even plan
+            # needs the planner's grid orientation (rows >= cols, as
+            # logic_plan3d builds it) to divide all four.
+            from .geometry import make_procgrid
+
+            r, c = sorted(make_procgrid(p), reverse=True)
+            if n0 % r == 0 and n1 % r == 0 and n1 % c == 0 and n2 % c == 0:
+                return p
+    return 1
+
+
 def logic_plan3d(
     shape: Sequence[int],
     mesh: Mesh | int | None,

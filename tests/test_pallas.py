@@ -99,6 +99,23 @@ def test_distributed_plan_with_pallas_executor():
     assert _rel_err(np.asarray(bwd(fwd(x))), np.asarray(x)) < 5e-4
 
 
+@pytest.mark.parametrize("n", [131072, 90000])
+def test_two_level_big_axis(n):
+    """Axes beyond one kernel's reach run the two-level four-step (both DFT
+    stages still fused kernels)."""
+    from distributedfft_tpu.ops.pallas_fft import eligible, outer_split
+
+    assert not eligible(n) and outer_split(n) is not None
+    rng = np.random.default_rng(13)
+    x = _rand_c64(rng, (2, n))
+    y = np.asarray(pallas_fft.fft_along_axis(jnp.asarray(x), 1, True))
+    ref = np.fft.fft(x, axis=1)
+    assert _rel_err(y, ref) < 2e-4
+    r = np.asarray(pallas_fft.fft_along_axis(jnp.asarray(ref.astype(np.complex64)),
+                                             1, False))
+    assert _rel_err(r, x) < 2e-4
+
+
 def test_zero_batch_falls_back_cleanly():
     x = jnp.zeros((0, 256), jnp.complex64)
     y = pallas_fft.fft_along_axis(x, 1, True)

@@ -150,3 +150,27 @@ def test_explicit_single_overrides_mesh():
 def test_r2c_rejects_real_dtype():
     with pytest.raises(ValueError):
         dfft.plan_dft_r2c_3d((8, 8, 8), dtype=np.float64)
+
+
+def test_negotiate_device_count():
+    """Device-count renegotiation (the getProperDeviceNum analog,
+    fft_mpi_3d_api.cpp:232-272): largest count whose decomposition divides
+    the split axes evenly."""
+    from distributedfft_tpu.plan_logic import negotiate_device_count
+
+    # 512^3 divides by 8 -> keep all devices.
+    assert negotiate_device_count((512, 512, 512), 8) == 8
+    # 100 % 8 != 0 -> shrink to 5 (divides 100 on both split axes), not 8.
+    assert negotiate_device_count((100, 100, 100), 8) == 5
+    # Prime extent: only 1 divides.
+    assert negotiate_device_count((7, 7, 7), 4) == 1
+    # Never exceeds the plane count.
+    assert negotiate_device_count((4, 4, 64), 16) == 4
+    # Pencil: the planner's grid orientation (rows >= cols) must divide all
+    # four padded extents (n0/n1 over rows, n1/n2 over cols).
+    assert negotiate_device_count((8, 8, 8), 4, "pencil") == 4
+    assert negotiate_device_count((8, 6, 9), 4, "pencil") == 2
+    assert negotiate_device_count((10, 8, 8), 8, "pencil") == 4
+    # Pencil is not capped by the slab plane-count rule: 16 = (4, 4) works
+    # even though n0 = 4.
+    assert negotiate_device_count((4, 16, 16), 16, "pencil") == 16
