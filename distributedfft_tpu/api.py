@@ -28,6 +28,7 @@ Transform convention is numpy's: forward unnormalized, inverse scaled by
 
 from __future__ import annotations
 
+import functools
 import math
 from dataclasses import dataclass, field
 from typing import Any, Sequence, Callable
@@ -177,6 +178,114 @@ def _boxes(lp: LogicPlan, world_in: Box3, world_out: Box3):
     return io_boxes(lp.decomposition, lp.mesh, world_in, world_out)
 
 
+def _check_spec_rank(spec: P, ndim: int) -> tuple:
+    entries = tuple(spec)
+    if len(entries) > ndim:
+        raise ValueError(
+            f"PartitionSpec {spec} has more entries than the {ndim} array dims"
+        )
+    return entries + (None,) * (ndim - len(entries))
+
+
+def _layout_boxes(mesh: Mesh, spec: P, world: Box3) -> list[Box3]:
+    """Per-device boxes of a mesh-expressible layout, ordered to match
+    ``mesh.devices.flat`` (the same device order as the canonical
+    ``io_boxes``) — the ``ioboxes`` view of a PartitionSpec."""
+    import itertools
+
+    entries = _check_spec_rank(spec, 3)
+    names_order = mesh.axis_names
+    boxes = []
+    for combo in itertools.product(*(range(mesh.shape[n]) for n in names_order)):
+        idx = dict(zip(names_order, combo))
+        low, high = [], []
+        for d, entry in enumerate(entries):
+            extent = world.high[d] - world.low[d]
+            if entry is None:
+                start, stop = 0, extent
+            else:
+                names = entry if isinstance(entry, tuple) else (entry,)
+                block, nblocks = 0, 1
+                for nm in names:  # major-to-minor, NamedSharding semantics
+                    block = block * mesh.shape[nm] + idx[nm]
+                    nblocks *= mesh.shape[nm]
+                start, stop = geo.ceil_splits(extent, nblocks)[block]
+            low.append(world.low[d] + start)
+            high.append(world.low[d] + stop)
+        boxes.append(Box3(tuple(low), tuple(high)))
+    return boxes
+
+
+def _spec_divides(mesh: Mesh, spec: P, shape) -> bool:
+    """True when every sharded dim of ``shape`` divides by its mesh-axis
+    product (the equal-shard requirement of jit-level shardings)."""
+    for d, entry in enumerate(_check_spec_rank(spec, len(shape))):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        k = math.prod(mesh.shape[nm] for nm in names)
+        if shape[d] % k:
+            return False
+    return True
+
+
+def _wrap_user_layout(
+    fn: Callable,
+    mesh: Mesh,
+    canonical_in: NamedSharding,
+    canonical_out: NamedSharding,
+    in_spec: P | None,
+    out_spec: P | None,
+    donate: bool,
+    in_shape,
+    out_shape,
+) -> tuple[Callable, NamedSharding, NamedSharding]:
+    """Compose user brick layouts around a canonical-layout transform — the
+    heFFTe brick-in/brick-out capability (arbitrary ``box3d`` per rank,
+    ``heffte_fft3d.h:105-115``) restricted to mesh-expressible bricks: the
+    reshard into the canonical layout is the plan's first (and last)
+    reshape, exactly how heFFTe's planner prepends/appends reshapes for
+    non-pencil input (``heffte_plan_logic.cpp:162-245``). XLA emits the
+    collectives for both reshards and fuses them into the program.
+
+    User bricks require evenly-divisible extents (TPU equal-shard rule);
+    uneven *canonical* layouts are fine — the inner plan pads/crops itself,
+    so sharding hints are simply omitted where they would not divide.
+    """
+    for label, spec, shp in (("in_spec", in_spec, in_shape),
+                             ("out_spec", out_spec, out_shape)):
+        if spec is not None and not _spec_divides(mesh, spec, shp):
+            raise ValueError(
+                f"{label}={spec} does not evenly divide extents {tuple(shp)} "
+                f"over the mesh; brick layouts need divisible shards"
+            )
+    user_in = NamedSharding(mesh, in_spec) if in_spec is not None else canonical_in
+    user_out = NamedSharding(mesh, out_spec) if out_spec is not None else canonical_out
+
+    # User specs were just validated; only the canonical fallbacks (uneven
+    # extents the inner plan pads/crops itself) can fail to divide here.
+    jit_kw: dict = {"donate_argnums": 0} if donate else {}
+    if in_spec is not None or _spec_divides(mesh, canonical_in.spec, in_shape):
+        jit_kw["in_shardings"] = user_in
+    out_fits = out_spec is not None or _spec_divides(
+        mesh, canonical_out.spec, out_shape
+    )
+    if out_fits:
+        jit_kw["out_shardings"] = user_out
+    canon_in_fits = _spec_divides(mesh, canonical_in.spec, in_shape)
+
+    @functools.partial(jax.jit, **jit_kw)
+    def wrapped(x):
+        if canon_in_fits:
+            x = jax.lax.with_sharding_constraint(x, canonical_in)
+        y = fn(x)
+        if out_fits:
+            y = jax.lax.with_sharding_constraint(y, user_out)
+        return y
+
+    return wrapped, user_in, user_out
+
+
 def plan_dft_c2c_3d(
     shape: Sequence[int],
     mesh: Mesh | int | None = None,
@@ -188,6 +297,8 @@ def plan_dft_c2c_3d(
     donate: bool = False,
     algorithm: str = "alltoall",
     options: PlanOptions | None = None,
+    in_spec: P | None = None,
+    out_spec: P | None = None,
 ) -> Plan3D:
     """Create a distributed 3D complex-to-complex FFT plan.
 
@@ -199,6 +310,11 @@ def plan_dft_c2c_3d(
     cf. ``fft_mpi_plan_dft_c2c_3d`` (``fft_mpi_3d_api.cpp:41``), which also
     fixes direction at plan time and builds one plan per direction.
 
+    ``in_spec`` / ``out_spec`` accept any mesh-expressible brick layout for
+    the plan's input/output (heFFTe's brick-in/brick-out, see
+    :func:`_wrap_user_layout`); None keeps the decomposition's canonical
+    layout (X-slabs <-> Y-slabs, z-pencils <-> x-pencils).
+
     ``donate=True`` makes execution consume its input buffer (the analog of
     the reference's bufferDev ping-pong, halving HBM footprint for big
     grids) at the cost of repeat-execution on the same array; the default
@@ -209,6 +325,8 @@ def plan_dft_c2c_3d(
     dtype = _default_cdtype(dtype)
     lp = logic_plan3d(shape, mesh, opts)
     world = world_box(shape)
+    if (in_spec is not None or out_spec is not None) and lp.mesh is None:
+        raise ValueError("in_spec/out_spec require a mesh")
 
     if lp.decomposition == "single":
         ex = get_executor(opts.executor)
@@ -231,6 +349,15 @@ def plan_dft_c2c_3d(
     in_sh, out_sh = _shardings(lp, forward)
     fb, bb = _boxes(lp, world, world)
     in_boxes, out_boxes = (fb, bb) if forward else (bb, fb)
+    if in_spec is not None or out_spec is not None:
+        fn, in_sh, out_sh = _wrap_user_layout(
+            fn, lp.mesh, in_sh, out_sh, in_spec, out_spec, opts.donate,
+            shape, shape,
+        )
+        if in_spec is not None:
+            in_boxes = _layout_boxes(lp.mesh, in_spec, world)
+        if out_spec is not None:
+            out_boxes = _layout_boxes(lp.mesh, out_spec, world)
     return Plan3D(
         shape=shape, direction=direction, dtype=dtype,
         decomposition=lp.decomposition, executor=opts.executor, mesh=lp.mesh,
@@ -250,6 +377,8 @@ def plan_dft_r2c_3d(
     donate: bool = False,
     algorithm: str = "alltoall",
     options: PlanOptions | None = None,
+    in_spec: P | None = None,
+    out_spec: P | None = None,
 ) -> Plan3D:
     """Create a distributed real-to-complex (forward) / complex-to-real
     (backward) 3D FFT plan — heFFTe ``fft3d_r2c`` parity
@@ -295,9 +424,22 @@ def plan_dft_r2c_3d(
             algorithm=opts.algorithm,
         )
 
+    if (in_spec is not None or out_spec is not None) and lp.mesh is None:
+        raise ValueError("in_spec/out_spec require a mesh")
     in_sh, out_sh = _shardings(lp, forward)
     fb, bb = _boxes(lp, world, cworld)
     in_boxes, out_boxes = (fb, bb) if forward else (bb, fb)
+    if in_spec is not None or out_spec is not None:
+        fn, in_sh, out_sh = _wrap_user_layout(
+            fn, lp.mesh, in_sh, out_sh, in_spec, out_spec, opts.donate,
+            shape if forward else cshape, cshape if forward else shape,
+        )
+        in_world = world if forward else cworld
+        out_world = cworld if forward else world
+        if in_spec is not None:
+            in_boxes = _layout_boxes(lp.mesh, in_spec, in_world)
+        if out_spec is not None:
+            out_boxes = _layout_boxes(lp.mesh, out_spec, out_world)
     return Plan3D(
         shape=shape, direction=direction, dtype=dtype,
         decomposition=lp.decomposition, executor=opts.executor, mesh=lp.mesh,

@@ -132,9 +132,7 @@ def negotiate_device_count(
             # mesh cols (PencilSpec n0p/n1p_row/n1p_col/n2p); an even plan
             # needs the planner's grid orientation (rows >= cols, as
             # logic_plan3d builds it) to divide all four.
-            from .geometry import make_procgrid
-
-            r, c = sorted(make_procgrid(p), reverse=True)
+            r, c = sorted(geo.make_procgrid(p), reverse=True)
             if n0 % r == 0 and n1 % r == 0 and n1 % c == 0 and n2 % c == 0:
                 return p
     return 1
