@@ -58,6 +58,9 @@ def parse_args(argv):
                    help="run on (virtual) CPU devices instead of TPU")
     p.add_argument("-csv", default=None, help="append a result row to this CSV")
     p.add_argument("-trace", action="store_true", help="write a dfft trace log")
+    p.add_argument("-profile", default=None, metavar="DIR",
+                   help="capture an XLA profiler trace of the timed section "
+                        "into DIR (view with tensorboard/xprof)")
     p.add_argument("-no-verify", action="store_true",
                    help="skip the roundtrip error check")
     return p.parse_args(argv)
@@ -155,7 +158,12 @@ def main(argv=None) -> None:
             )
             stage_times, _ = time_staged(stages, x, iters=args.iters)
 
-    seconds, _ = time_fn_amortized(lambda: fwd(x), iters=args.iters, repeats=2)
+    import contextlib
+
+    prof = jax.profiler.trace(args.profile) if args.profile else contextlib.nullcontext()
+    with prof:
+        seconds, _ = time_fn_amortized(lambda: fwd(x), iters=args.iters,
+                                       repeats=2)
     is_real = args.kind == "r2c"
     gf = gflops(shape, seconds, real=is_real)
 

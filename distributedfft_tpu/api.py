@@ -178,48 +178,51 @@ def _boxes(lp: LogicPlan, world_in: Box3, world_out: Box3):
     return io_boxes(lp.decomposition, lp.mesh, world_in, world_out)
 
 
-def _check_spec_rank(spec: P, ndim: int) -> tuple:
+def _spec_entries(mesh: Mesh, spec: P, ndim: int) -> tuple:
+    """Validate a user PartitionSpec (rank, axis names) and return it padded
+    to ``ndim`` entries."""
     entries = tuple(spec)
     if len(entries) > ndim:
         raise ValueError(
             f"PartitionSpec {spec} has more entries than the {ndim} array dims"
         )
+    for entry in entries:
+        if entry is None:
+            continue
+        for nm in entry if isinstance(entry, tuple) else (entry,):
+            if nm not in mesh.shape:
+                raise ValueError(
+                    f"spec {spec} names unknown mesh axis {nm!r}; mesh axes: "
+                    f"{tuple(mesh.shape)}"
+                )
     return entries + (None,) * (ndim - len(entries))
 
 
 def _layout_boxes(mesh: Mesh, spec: P, world: Box3) -> list[Box3]:
     """Per-device boxes of a mesh-expressible layout, ordered to match
     ``mesh.devices.flat`` (the same device order as the canonical
-    ``io_boxes``) — the ``ioboxes`` view of a PartitionSpec."""
-    import itertools
-
-    entries = _check_spec_rank(spec, 3)
-    names_order = mesh.axis_names
+    ``io_boxes``) — the ``ioboxes`` view of a PartitionSpec, derived from
+    the sharding's own index map so box metadata can never diverge from
+    what XLA actually places on each device."""
+    _spec_entries(mesh, spec, 3)
+    shape = tuple(h - lo for lo, h in zip(world.low, world.high))
+    index_map = NamedSharding(mesh, spec).devices_indices_map(shape)
     boxes = []
-    for combo in itertools.product(*(range(mesh.shape[n]) for n in names_order)):
-        idx = dict(zip(names_order, combo))
-        low, high = [], []
-        for d, entry in enumerate(entries):
-            extent = world.high[d] - world.low[d]
-            if entry is None:
-                start, stop = 0, extent
-            else:
-                names = entry if isinstance(entry, tuple) else (entry,)
-                block, nblocks = 0, 1
-                for nm in names:  # major-to-minor, NamedSharding semantics
-                    block = block * mesh.shape[nm] + idx[nm]
-                    nblocks *= mesh.shape[nm]
-                start, stop = geo.ceil_splits(extent, nblocks)[block]
-            low.append(world.low[d] + start)
-            high.append(world.low[d] + stop)
-        boxes.append(Box3(tuple(low), tuple(high)))
+    for dev in mesh.devices.flat:
+        idxs = index_map[dev]
+        low = tuple(world.low[d] + (ix.start or 0) for d, ix in enumerate(idxs))
+        high = tuple(
+            world.low[d] + (ix.stop if ix.stop is not None else shape[d])
+            for d, ix in enumerate(idxs)
+        )
+        boxes.append(Box3(low, high))
     return boxes
 
 
 def _spec_divides(mesh: Mesh, spec: P, shape) -> bool:
     """True when every sharded dim of ``shape`` divides by its mesh-axis
     product (the equal-shard requirement of jit-level shardings)."""
-    for d, entry in enumerate(_check_spec_rank(spec, len(shape))):
+    for d, entry in enumerate(_spec_entries(mesh, spec, len(shape))):
         if entry is None:
             continue
         names = entry if isinstance(entry, tuple) else (entry,)
@@ -264,15 +267,15 @@ def _wrap_user_layout(
 
     # User specs were just validated; only the canonical fallbacks (uneven
     # extents the inner plan pads/crops itself) can fail to divide here.
+    canon_in_fits = _spec_divides(mesh, canonical_in.spec, in_shape)
     jit_kw: dict = {"donate_argnums": 0} if donate else {}
-    if in_spec is not None or _spec_divides(mesh, canonical_in.spec, in_shape):
+    if in_spec is not None or canon_in_fits:
         jit_kw["in_shardings"] = user_in
     out_fits = out_spec is not None or _spec_divides(
         mesh, canonical_out.spec, out_shape
     )
     if out_fits:
         jit_kw["out_shardings"] = user_out
-    canon_in_fits = _spec_divides(mesh, canonical_in.spec, in_shape)
 
     @functools.partial(jax.jit, **jit_kw)
     def wrapped(x):

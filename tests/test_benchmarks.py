@@ -56,3 +56,27 @@ def test_batch_bench_2d(capsys, tmp_path):
                       "-iters", "1", "-csv", csv])
     out = capsys.readouterr().out
     assert "2D 8x8" in out and "2D 16x16" in out
+
+
+def test_bench_executor_menu(tmp_path):
+    """bench.py's candidate runner: plans, verifies, and times one executor
+    (tiny shape); a broken executor name raises instead of silently passing."""
+    sys.path.insert(0, REPO)
+    import bench
+    import jax.numpy as jnp
+
+    import distributedfft_tpu as dfft
+
+    mesh = dfft.make_mesh(4)
+    secs, err, decomp = bench.bench_executor((16, 16, 16), mesh,
+                                             jnp.complex64, "xla")
+    assert secs > 0 and err < 1e-3 and decomp == "slab"
+    with pytest.raises(ValueError):
+        bench.bench_executor((16, 16, 16), mesh, jnp.complex64, "nope")
+
+
+def test_speed3d_profile_flag(tmp_path):
+    d = str(tmp_path / "prof")
+    speed3d.main(["c2c", "double", "16", "16", "16",
+                  "-ndev", "4", "-slabs", "-iters", "1", "-profile", d])
+    assert os.path.isdir(d) and os.listdir(d)

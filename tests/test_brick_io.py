@@ -115,3 +115,9 @@ def test_overlong_spec_rejected():
 def test_spec_without_mesh_rejected():
     with pytest.raises(ValueError):
         dfft.plan_dft_c2c_3d(SHAPE, None, in_spec=P(None, None, None))
+
+
+def test_misspelled_axis_rejected_clearly():
+    mesh = dfft.make_mesh((2, 4))
+    with pytest.raises(ValueError, match="unknown mesh axis"):
+        dfft.plan_dft_c2c_3d(SHAPE, mesh, in_spec=P("rwo", None, None))

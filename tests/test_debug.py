@@ -1,0 +1,63 @@
+"""Debug aids: ramp dumps, coordinate decode, layout validation, plan info
+files (the debugLocalData / outputPlanInfo analogs, SURVEY.md §4.1)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu.utils import debug as dbg
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the virtual 8-device mesh"
+)
+
+SHAPE = (8, 8, 8)
+
+
+def test_ramp_decode_inverts():
+    w = dbg.ramp_world(SHAPE)
+    assert dbg.decode_ramp(w[3, 5, 7].real, SHAPE) == (3, 5, 7)
+    assert dbg.decode_ramp(0.0, SHAPE) == (0, 0, 0)
+
+
+def test_check_layout_accepts_plan_sharding_and_rejects_wrong():
+    mesh = dfft.make_mesh(8)
+    plan = dfft.plan_dft_c2c_3d(SHAPE, mesh)
+    x = dfft.alloc_local(plan, dbg.ramp_world(SHAPE))
+    dbg.check_layout(x, plan.in_boxes)  # must not raise
+    with pytest.raises(AssertionError):
+        dbg.check_layout(x, plan.out_boxes)  # Y-slab boxes != X-slab shards
+
+
+def test_dump_local_data(tmp_path):
+    mesh = dfft.make_mesh(8)
+    plan = dfft.plan_dft_c2c_3d(SHAPE, mesh)
+    x = dfft.alloc_local(plan, dbg.ramp_world(SHAPE))
+    paths = dbg.dump_local_data(x, prefix=str(tmp_path / "dump"))
+    assert len(paths) == 8
+    first = open(paths[0]).read().splitlines()
+    assert first[0].startswith("# device=")
+    assert first[1] == "local_index,value"
+    # First shard of the X-slab layout holds flat indices 0..63.
+    v = complex(first[2].split(",", 1)[1]).real
+    assert dbg.decode_ramp(v, SHAPE) == (0, 0, 0)
+
+
+def test_write_plan_info(tmp_path):
+    mesh = dfft.make_mesh(8)
+    plan = dfft.plan_dft_c2c_3d(SHAPE, mesh)
+    path = dbg.write_plan_info(plan, prefix=str(tmp_path / "plan"))
+    text = open(path).read()
+    assert "decomposition: slab" in text
+    assert "in box[7]" in text
+
+
+def test_ramp_roundtrip_check():
+    mesh = dfft.make_mesh(8)
+    fwd = dfft.plan_dft_c2c_3d(SHAPE, mesh)
+    bwd = dfft.plan_dft_c2c_3d(SHAPE, mesh, direction=dfft.BACKWARD)
+    err = dbg.ramp_roundtrip_check(fwd, bwd, tol=1e-11)
+    assert err < 1e-11
