@@ -1,41 +1,64 @@
 #!/usr/bin/env python
 """Headline benchmark: distributed 3D C2C forward FFT, reference taxonomy.
 
-Runs the flagship problem (512^3, cf. ``/root/reference/README.md:44-58``) on
-the available TPU device(s) and prints ONE JSON line with the headline
-GFlops/s (5 N log2 N / t, ``fftSpeed3d_c2c.cpp:128``) versus the reference's
-heFFTe baseline (324.4 GFlops/s at 512^3 on 4 GPUs, ``README.md:65-77``).
+Prints exactly ONE JSON line on stdout and always exits 0 — the contract the
+round driver records into ``BENCH_r{N}.json``. The measured metric is the
+flagship problem (512^3, cf. ``/root/reference/README.md:44-58``) timed on
+the available TPU device(s): GFlops/s = 5 N log2 N / t
+(``fftSpeed3d_c2c.cpp:128``) versus the reference's heFFTe baseline
+(324.4 GFlops/s at 512^3 on 4 GPUs, ``README.md:65-77``).
+
+Robustness (the round-1 failure mode was an axon TPU tunnel whose backend
+init hangs indefinitely, producing rc=1 and zero perf evidence): this file
+is an *orchestrator* that runs the actual measurement in worker
+subprocesses, because a wedged PJRT client cannot be cancelled in-process.
+
+  - bounded retries with backoff around backend init/measurement;
+  - a hard timeout per attempt and an overall deadline;
+  - problem-size fallback 512^3 -> 256^3 on repeated failure/OOM;
+  - a last-resort CPU-backend measurement (clearly labelled) so the driver
+    still gets a parseable line when the TPU transport is down;
+  - on truly unrecoverable failure, a JSON line with an "error" field —
+    never a bare traceback, never a nonzero exit.
 
 Executor selection mirrors the reference keeping several backends side by
 side and picking one (``setFFTPlans``, ``fft_mpi_3d_api.cpp:318-429``): every
-candidate in DFFT_BENCH_EXECUTORS (default "xla,pallas") is planned, verified
-by roundtrip, and timed; the fastest correct one is reported. A candidate
-that fails to compile or verify is skipped, never fatal.
+candidate in DFFT_BENCH_EXECUTORS (default "xla,pallas,matmul") is planned,
+verified by roundtrip, and timed; the fastest correct one is reported. A
+candidate that fails to compile or verify is skipped, never fatal.
 
 TPU note: TPUs have no complex128 (C128 unsupported), so the on-chip bench
 runs complex64; double-precision correctness at the 1e-11 tier is validated
 by the CPU-backend test suite (tests/test_fft3d.py).
 """
 
-import functools
+from __future__ import annotations
+
 import json
 import os
+import subprocess
 import sys
-import traceback
-
-import jax
-import jax.numpy as jnp
-
-import distributedfft_tpu as dfft
-from distributedfft_tpu.utils.timing import gflops, max_rel_err, sync, time_fn_amortized
+import time
 
 HEFFTE_BASELINE_GFLOPS = 324.4  # README.md:65-77, 512^3 / 4 ranks / rocfft
 ERR_GATE = 1e-3  # complex64 tier; double tier is gated in the test suite
 
 
+# --------------------------------------------------------------- worker
+
 def bench_executor(shape, mesh, dtype, executor: str):
     """Plan, verify (roundtrip), and time one executor. Returns
     (seconds, max_err, decomposition) or raises."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    import distributedfft_tpu as dfft
+    from distributedfft_tpu.utils.timing import (
+        max_rel_err, sync, time_fn_amortized,
+    )
+
     plan = dfft.plan_dft_c2c_3d(
         shape, mesh, direction=dfft.FORWARD, dtype=dtype, donate=False,
         executor=executor,
@@ -45,11 +68,12 @@ def bench_executor(shape, mesh, dtype, executor: str):
         executor=executor,
     )
 
-    # Deterministic on-device init (host->device of 1 GiB through the tunnel
-    # is avoided; the reference also inits on device, fftSpeed3d_c2c.cpp:61-72).
+    # Deterministic on-device init (host->device of 1 GiB through the
+    # tunnel is avoided; the reference also inits on device,
+    # fftSpeed3d_c2c.cpp:61-72).
     mk_kw = {}
     if plan.in_sharding is not None:
-        mk_kw["out_shardings"] = plan.in_sharding  # generate each shard in place
+        mk_kw["out_shardings"] = plan.in_sharding
 
     @functools.partial(jax.jit, **mk_kw)
     def make_input():
@@ -71,15 +95,27 @@ def bench_executor(shape, mesh, dtype, executor: str):
     return seconds, max_err, plan.decomposition
 
 
-def main() -> None:
-    shape = (512, 512, 512)
-    n_dev = len(jax.devices())
+def _worker(shape_n: int) -> None:
+    """Measure and print the result JSON line (runs in a subprocess)."""
+    import traceback
+
+    import jax
+    import jax.numpy as jnp
+
+    import distributedfft_tpu as dfft
+    from distributedfft_tpu.utils.timing import gflops, time_staged
+
+    shape = (shape_n,) * 3
+    devs = jax.devices()  # orchestrator enforces the timeout around this
+    n_dev = len(devs)
     mesh = dfft.make_mesh(n_dev) if n_dev > 1 else None
     dtype = jnp.complex64  # TPU: no C128
 
     candidates = [
         e.strip()
-        for e in os.environ.get("DFFT_BENCH_EXECUTORS", "xla,pallas").split(",")
+        for e in os.environ.get(
+            "DFFT_BENCH_EXECUTORS", "xla,pallas,matmul"
+        ).split(",")
         if e.strip()
     ]
     results = {}
@@ -88,32 +124,178 @@ def main() -> None:
             results[ex] = bench_executor(shape, mesh, dtype, ex)
         except Exception:  # noqa: BLE001 — a failed candidate is skipped
             print(f"executor {ex!r} failed:", file=sys.stderr)
-            traceback.print_exc(limit=3)
+            traceback.print_exc(limit=3, file=sys.stderr)
 
     if not results:
         raise SystemExit("no benchmark executor succeeded")
     best = min(results, key=lambda e: results[e][0])
     seconds, max_err, decomposition = results[best]
+
+    # Per-stage t0..t3 breakdown (fft_mpi_3d_api.cpp:184-201) — only
+    # meaningful when there is an exchange, i.e. n_dev > 1.
+    stages = None
+    if mesh is not None and decomposition == "slab":
+        try:
+            from distributedfft_tpu.parallel.slab import build_slab_stages
+
+            stage_fns, _ = build_slab_stages(
+                mesh, shape, axis_name=mesh.axis_names[0], executor=best,
+                forward=True,
+            )
+            plan = dfft.plan_dft_c2c_3d(
+                shape, mesh, direction=dfft.FORWARD, dtype=dtype,
+                executor=best,
+            )
+            x = dfft.alloc_local(plan, fill=None)
+            st, _ = time_staged(stage_fns, x, iters=3)
+            stages = {k: round(v, 6) for k, v in st.times.items()}
+        except Exception:  # noqa: BLE001 — breakdown is best-effort extra
+            traceback.print_exc(limit=3, file=sys.stderr)
+
     gf = gflops(shape, seconds)
+    out = {
+        "metric": f"fft3d_c2c_{shape_n}_forward_gflops",
+        "value": round(gf, 1),
+        "unit": "GFlops/s",
+        "vs_baseline": round(gf / HEFFTE_BASELINE_GFLOPS, 3),
+        "seconds": round(seconds, 6),
+        "max_roundtrip_err": max_err,
+        "dtype": "complex64",
+        "backend": jax.default_backend(),
+        "devices": n_dev,
+        "decomposition": decomposition,
+        "executor": best,
+        "all": {e: round(r[0], 6) for e, r in results.items()},
+    }
+    if stages:
+        out["stages"] = stages
+    print(json.dumps(out), flush=True)
+
+
+# ----------------------------------------------------------- orchestrator
+
+def _parse_json_line(text: str) -> dict | None:
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict) and "metric" in obj:
+                return obj
+    return None
+
+
+def _run_attempt(shape_n: int, timeout: float, extra_env: dict | None = None):
+    """Run one worker subprocess. Returns (result_dict|None, note)."""
+    env = dict(os.environ)
+    if extra_env:
+        env.update(extra_env)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-u", os.path.abspath(__file__),
+             "--worker", str(shape_n)],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired as e:
+        # Keep the child's partial output — it is the only evidence of where
+        # the worker wedged (the exact failure mode this orchestrator exists
+        # to survive).
+        partial = ""
+        for stream in (e.stderr, e.stdout):
+            if stream:
+                text = stream if isinstance(stream, str) else stream.decode(
+                    "utf-8", "replace")
+                sys.stderr.write(text[-2000:])
+                partial = partial or "; ".join(
+                    text.strip().splitlines()[-2:])[-300:]
+        note = f"attempt timed out after {int(timeout)}s"
+        return None, f"{note}: {partial}" if partial else note
+    except OSError as e:
+        return None, f"spawn failed: {e}"
+    sys.stderr.write(proc.stderr[-2000:])
+    result = _parse_json_line(proc.stdout)
+    if result is not None:
+        return result, "ok"
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+    note = "; ".join(tail[-3:])[-500:] if tail else f"rc={proc.returncode}"
+    return None, f"rc={proc.returncode}: {note}"
+
+
+def main() -> None:
+    deadline = time.time() + float(os.environ.get("DFFT_BENCH_DEADLINE", 2100))
+    errors: list[str] = []
+
+    # (shape, per-attempt timeout, backoff before the attempt)
+    schedule = [(512, 780, 0), (512, 780, 15), (256, 600, 30), (256, 600, 60)]
+    for shape_n, timeout, backoff in schedule:
+        remaining = deadline - time.time()
+        if remaining < 120:
+            errors.append("deadline reached before attempt")
+            break
+        if backoff:
+            time.sleep(min(backoff, max(0.0, remaining - 120)))
+        timeout = min(timeout, max(120.0, deadline - time.time() - 60))
+        result, note = _run_attempt(shape_n, timeout)
+        if result is not None:
+            print(json.dumps(result), flush=True)
+            return
+        errors.append(f"tpu@{shape_n}: {note}")
+
+    # Last resort: a clearly-labelled CPU-backend measurement so the driver
+    # records a parseable line even with the TPU transport down.
+    remaining = deadline - time.time()
+    if remaining > 180:
+        result, note = _run_attempt(
+            256, min(600.0, remaining - 60),
+            # Clearing PALLAS_AXON_POOL_IPS skips the axon PJRT
+            # registration in sitecustomize entirely — with it set, even a
+            # JAX_PLATFORMS=cpu process attempts (and can hang in) axon
+            # backend init through the sick tunnel.
+            extra_env={"JAX_PLATFORMS": "cpu",
+                       "PALLAS_AXON_POOL_IPS": "",
+                       "DFFT_BENCH_EXECUTORS": "xla"},
+        )
+        if result is not None:
+            result["error"] = "tpu unavailable: " + " | ".join(errors)[-700:]
+            result["vs_baseline"] = 0.0  # CPU number; not comparable
+            print(json.dumps(result), flush=True)
+            return
+        errors.append(f"cpu-fallback: {note}")
 
     print(
         json.dumps(
             {
                 "metric": "fft3d_c2c_512_forward_gflops",
-                "value": round(gf, 1),
+                "value": 0.0,
                 "unit": "GFlops/s",
-                "vs_baseline": round(gf / HEFFTE_BASELINE_GFLOPS, 3),
-                "seconds": round(seconds, 6),
-                "max_roundtrip_err": max_err,
-                "dtype": "complex64",
-                "devices": n_dev,
-                "decomposition": decomposition,
-                "executor": best,
-                "all": {e: round(r[0], 6) for e, r in results.items()},
+                "vs_baseline": 0.0,
+                "error": " | ".join(errors)[-1500:],
             }
-        )
+        ),
+        flush=True,
     )
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        _worker(int(sys.argv[2]))
+    else:
+        try:
+            main()
+        except Exception as e:  # noqa: BLE001 — the contract is JSON + rc 0
+            print(
+                json.dumps(
+                    {
+                        "metric": "fft3d_c2c_512_forward_gflops",
+                        "value": 0.0,
+                        "unit": "GFlops/s",
+                        "vs_baseline": 0.0,
+                        "error": f"orchestrator: {type(e).__name__}: {e}",
+                    }
+                ),
+                flush=True,
+            )
+        sys.exit(0)

@@ -167,9 +167,9 @@ def min_surface_grid(shape, p: int) -> tuple[int, int, int]:
         out = (ctypes.c_longlong * 3)()
         lib.dfft_min_surface_grid(shape[0], shape[1], shape[2], p, out)
         return int(out[0]), int(out[1]), int(out[2])
-    from .geometry import Box3, proc_setup_min_surface
+    from .geometry import proc_setup_min_surface, world_box
 
-    return proc_setup_min_surface(Box3((0, 0, 0), tuple(s - 1 for s in shape)), p)
+    return proc_setup_min_surface(world_box(tuple(shape)), p)
 
 
 # -------------------------------------------------------- exchange tables

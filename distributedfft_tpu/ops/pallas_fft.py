@@ -42,6 +42,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..utils.compat import pvary
 from .dft_matmul import _best_split, _dft_matrix_np
 
 # Largest per-stage DFT factor the kernel accepts; 256 keeps every LUT and
@@ -154,7 +155,7 @@ def _fft_tiles(xr, xi, *, n: int, forward: bool, interpret: bool):
     if vma:
         # Under shard_map every kernel operand must carry the data's
         # varying-axes set; the replicated LUTs are marked explicitly.
-        consts = [lax.pvary(c, tuple(vma)) for c in consts]
+        consts = [pvary(c, tuple(vma)) for c in consts]
 
     lut_specs = [
         pl.BlockSpec(m.shape, lambda i: (0, 0), memory_space=pltpu.VMEM)

@@ -55,6 +55,7 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from ..ops.executors import get_executor
+from ..utils.compat import pvary
 from .exchange import exchange
 
 
@@ -181,7 +182,7 @@ def build_dist_fft1d(
         w = jnp.asarray(w_local_np, dtype=g.dtype)
         vma = getattr(jax.typeof(g), "vma", None)
         if vma:
-            w = lax.pvary(w, tuple(vma))
+            w = pvary(w, tuple(vma))
         return g * rot[:, None] * w
 
     if forward:

@@ -1,0 +1,18 @@
+"""Version shims for JAX APIs still in motion.
+
+The shard_map varying-axes discipline (every operand of a collective or a
+pallas_call must carry the right varying-across-mesh-axes set) is spelled
+``lax.pcast(..., to="varying")`` from JAX 0.9; older releases spell it
+``lax.pvary``. One shim here so call sites stay warning-free on both.
+"""
+
+from __future__ import annotations
+
+from jax import lax
+
+
+def pvary(x, axes: tuple):
+    """Mark replicated ``x`` as varying over mesh ``axes``."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, tuple(axes), to="varying")
+    return lax.pvary(x, tuple(axes))  # pragma: no cover — jax < 0.9

@@ -68,6 +68,18 @@ def test_min_surface_native_matches_python(shape, p):
     )
 
 
+@pytest.mark.parametrize("shape,p", [((512, 512, 512), 8), ((1536, 1024, 768), 16),
+                                     ((100, 200, 300), 12)])
+def test_min_surface_python_fallback_matches(shape, p, monkeypatch):
+    """The ctypes-less fallback must agree with the native path (and with
+    geometry.proc_setup_min_surface on the true half-open world box) — the
+    round-1 fallback built a Box3 with inclusive-style highs, shrinking every
+    extent by one."""
+    want = geo.proc_setup_min_surface(geo.world_box(shape), p)
+    monkeypatch.setattr(native, "_load", lambda: None)
+    assert tuple(native.min_surface_grid(shape, p)) == tuple(want)
+
+
 @pytest.mark.parametrize("n0,n1,p", [(512, 512, 4), (100, 70, 8), (7, 5, 4),
                                      (16, 16, 16)])
 def test_exchange_table_conservation(n0, n1, p):
