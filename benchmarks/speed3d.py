@@ -102,9 +102,11 @@ def main(argv=None) -> None:
         mesh = dfft.make_mesh(tuple(args.grid))
         decomposition = None
     elif args.pencils:
-        from distributedfft_tpu.geometry import make_procgrid
+        # Same min-surface grid the planner's int-mesh path would choose, so
+        # -pencils benchmarks what plan_dft_c2c_3d(shape, ndev) plans.
+        from distributedfft_tpu import native
 
-        r, c = sorted(make_procgrid(ndev), reverse=True)
+        r, c = native.pencil_grid(shape, ndev)
         mesh = dfft.make_mesh((r, c)) if ndev > 1 else None
         decomposition = None
     elif args.slabs:

@@ -46,6 +46,7 @@ from .plan_logic import (
     PlanOptions,
     io_boxes,
     logic_plan3d,
+    spec_entries as _spec_entries_impl,
 )
 from .parallel.pencil import PencilSpec, build_pencil_fft3d, build_pencil_rfft3d
 from .parallel.slab import (
@@ -89,6 +90,9 @@ class Plan3D:
     out_dtype: Any = None
     real: bool = False
     options: PlanOptions = DEFAULT_OPTIONS
+    # The resolved plan skeleton (axis assignment, stage chain, device-count
+    # negotiation record) — surfaced by plan_info.
+    logic: LogicPlan | None = None
 
     def __post_init__(self) -> None:
         if self.in_shape is None:
@@ -154,48 +158,30 @@ def _default_cdtype(dtype):
     return jnp.dtype(dtype)
 
 
-def _shardings(lp: LogicPlan, forward: bool):
-    """Input/output NamedShardings for the resolved decomposition: slabs go
-    X-slabs <-> Y-slabs, pencils z-pencils <-> x-pencils."""
-    mesh = lp.mesh
-    if mesh is None:
+def _shardings(lp: LogicPlan, spec):
+    """Input/output NamedShardings of the built chain — taken from the
+    builder's own spec object (direction-true), so they reflect generalized
+    axis assignments."""
+    if lp.mesh is None or spec is None:
         return None, None
-    if lp.decomposition == "slab":
-        a = mesh.axis_names[0]
-        x_sh = NamedSharding(mesh, P(a, None, None))
-        y_sh = NamedSharding(mesh, P(None, a, None))
-        return (x_sh, y_sh) if forward else (y_sh, x_sh)
-    row, col = mesh.axis_names[:2]
-    z_sh = NamedSharding(mesh, P(row, col, None))
-    x_sh = NamedSharding(mesh, P(None, row, col))
-    return (z_sh, x_sh) if forward else (x_sh, z_sh)
+    if hasattr(spec, "in_pspec"):  # SlabSpec
+        return (NamedSharding(lp.mesh, spec.in_pspec),
+                NamedSharding(lp.mesh, spec.out_pspec))
+    return (NamedSharding(lp.mesh, spec.in_spec),
+            NamedSharding(lp.mesh, spec.out_spec))
 
 
 def _boxes(lp: LogicPlan, world_in: Box3, world_out: Box3):
-    """Per-device input/output boxes for the *forward* orientation of the
-    decomposition; r2c plans pass a shrunk complex-side world. Delegates to
+    """Per-device input/output boxes of this plan's own orientation; r2c
+    plans pass a shrunk complex-side world. Delegates to
     :func:`.plan_logic.io_boxes` (one source of truth with ``lp.stages``)."""
-    return io_boxes(lp.decomposition, lp.mesh, world_in, world_out)
+    return io_boxes(lp, world_in, world_out)
 
 
 def _spec_entries(mesh: Mesh, spec: P, ndim: int) -> tuple:
     """Validate a user PartitionSpec (rank, axis names) and return it padded
-    to ``ndim`` entries."""
-    entries = tuple(spec)
-    if len(entries) > ndim:
-        raise ValueError(
-            f"PartitionSpec {spec} has more entries than the {ndim} array dims"
-        )
-    for entry in entries:
-        if entry is None:
-            continue
-        for nm in entry if isinstance(entry, tuple) else (entry,):
-            if nm not in mesh.shape:
-                raise ValueError(
-                    f"spec {spec} names unknown mesh axis {nm!r}; mesh axes: "
-                    f"{tuple(mesh.shape)}"
-                )
-    return entries + (None,) * (ndim - len(entries))
+    to ``ndim`` entries (shared with the planner's layout classifier)."""
+    return _spec_entries_impl(mesh, spec, ndim)
 
 
 def _layout_boxes(mesh: Mesh, spec: P, world: Box3) -> list[Box3]:
@@ -314,9 +300,14 @@ def plan_dft_c2c_3d(
     fixes direction at plan time and builds one plan per direction.
 
     ``in_spec`` / ``out_spec`` accept any mesh-expressible brick layout for
-    the plan's input/output (heFFTe's brick-in/brick-out, see
-    :func:`_wrap_user_layout`); None keeps the decomposition's canonical
-    layout (X-slabs <-> Y-slabs, z-pencils <-> x-pencils).
+    the plan's input/output. Slab/pencil-shaped layouts are *absorbed* into
+    the stage chain itself (heFFTe's reshape minimization,
+    ``heffte_plan_logic.cpp:162-245,265-408``); other layouts get an edge
+    reshard (:func:`_wrap_user_layout`). With both None the canonical chain
+    runs (X-slabs <-> Y-slabs, z-pencils <-> x-pencils). NOTE: when only
+    ``in_spec`` is given, the output layout follows the re-axed chain's
+    natural endpoint, which may differ from canonical — read
+    ``plan.out_sharding`` (pass ``out_spec`` to pin a specific layout).
 
     ``donate=True`` makes execution consume its input buffer (the analog of
     the reference's bufferDev ping-pong, halving HBM footprint for big
@@ -326,7 +317,9 @@ def plan_dft_c2c_3d(
     shape, forward = _check_direction(shape, direction)
     opts = _resolve_options(decomposition, executor, donate, algorithm, options)
     dtype = _default_cdtype(dtype)
-    lp = logic_plan3d(shape, mesh, opts)
+    lp = logic_plan3d(
+        shape, mesh, opts, forward=forward, in_spec=in_spec, out_spec=out_spec
+    )
     world = world_box(shape)
     if (in_spec is not None or out_spec is not None) and lp.mesh is None:
         raise ValueError("in_spec/out_spec require a mesh")
@@ -340,6 +333,7 @@ def plan_dft_c2c_3d(
             lp.mesh, shape, axis_name=lp.mesh.axis_names[0],
             executor=opts.executor, forward=forward, donate=opts.donate,
             algorithm=opts.algorithm,
+            in_axis=lp.slab_axes[0], out_axis=lp.slab_axes[1],
         )
     else:
         row, col = lp.mesh.axis_names[:2]
@@ -347,25 +341,33 @@ def plan_dft_c2c_3d(
             lp.mesh, shape, row_axis=row, col_axis=col,
             executor=opts.executor, forward=forward, donate=opts.donate,
             algorithm=opts.algorithm,
+            perm=lp.pencil_perm, order=lp.pencil_order,
         )
 
-    in_sh, out_sh = _shardings(lp, forward)
-    fb, bb = _boxes(lp, world, world)
-    in_boxes, out_boxes = (fb, bb) if forward else (bb, fb)
-    if in_spec is not None or out_spec is not None:
+    in_sh, out_sh = _shardings(lp, spec)
+    in_boxes, out_boxes = _boxes(lp, world, world)
+    # Edge reshards only for layouts the chain could not absorb — absorbed
+    # layouts ARE the chain's own endpoints (heFFTe's reshape minimization,
+    # heffte_plan_logic.cpp:162-245,265-408).
+    wrap_in = in_spec if (in_spec is not None and not lp.in_absorbed) else None
+    wrap_out = out_spec if (out_spec is not None and not lp.out_absorbed) else None
+    if wrap_in is not None or wrap_out is not None:
         fn, in_sh, out_sh = _wrap_user_layout(
-            fn, lp.mesh, in_sh, out_sh, in_spec, out_spec, opts.donate,
+            fn, lp.mesh, in_sh, out_sh, wrap_in, wrap_out, opts.donate,
             shape, shape,
         )
-        if in_spec is not None:
-            in_boxes = _layout_boxes(lp.mesh, in_spec, world)
-        if out_spec is not None:
-            out_boxes = _layout_boxes(lp.mesh, out_spec, world)
+    # Absorbed layouts ARE the chain endpoints, so the chain's own (ceil-
+    # split, possibly uneven) boxes already describe them; _layout_boxes is
+    # only for wrapped layouts (validated divisible by _wrap_user_layout).
+    if wrap_in is not None:
+        in_boxes = _layout_boxes(lp.mesh, in_spec, world)
+    if wrap_out is not None:
+        out_boxes = _layout_boxes(lp.mesh, out_spec, world)
     return Plan3D(
         shape=shape, direction=direction, dtype=dtype,
         decomposition=lp.decomposition, executor=opts.executor, mesh=lp.mesh,
         fn=fn, spec=spec, in_sharding=in_sh, out_sharding=out_sh,
-        in_boxes=in_boxes, out_boxes=out_boxes, options=lp.options,
+        in_boxes=in_boxes, out_boxes=out_boxes, options=lp.options, logic=lp,
     )
 
 
@@ -402,7 +404,10 @@ def plan_dft_r2c_3d(
     rdtype = jnp.float64 if dtype == jnp.complex128 else jnp.float32
     n0, n1, n2 = shape
     cshape = (n0, n1, n2 // 2 + 1)
-    lp = logic_plan3d(shape, mesh, opts)
+    # r2c chains keep the canonical axis assignment (the real axis must be
+    # axis 2, device-local on the real side); user layouts go through edge
+    # reshards below rather than chain re-axing.
+    lp = logic_plan3d(shape, mesh, opts, forward=forward)
     world, cworld = world_box(shape), world_box(cshape)
 
     if lp.decomposition == "single":
@@ -429,16 +434,15 @@ def plan_dft_r2c_3d(
 
     if (in_spec is not None or out_spec is not None) and lp.mesh is None:
         raise ValueError("in_spec/out_spec require a mesh")
-    in_sh, out_sh = _shardings(lp, forward)
-    fb, bb = _boxes(lp, world, cworld)
-    in_boxes, out_boxes = (fb, bb) if forward else (bb, fb)
+    in_sh, out_sh = _shardings(lp, spec)
+    in_world = world if forward else cworld
+    out_world = cworld if forward else world
+    in_boxes, out_boxes = _boxes(lp, in_world, out_world)
     if in_spec is not None or out_spec is not None:
         fn, in_sh, out_sh = _wrap_user_layout(
             fn, lp.mesh, in_sh, out_sh, in_spec, out_spec, opts.donate,
             shape if forward else cshape, cshape if forward else shape,
         )
-        in_world = world if forward else cworld
-        out_world = cworld if forward else world
         if in_spec is not None:
             in_boxes = _layout_boxes(lp.mesh, in_spec, in_world)
         if out_spec is not None:
@@ -452,7 +456,7 @@ def plan_dft_r2c_3d(
         out_shape=cshape if forward else shape,
         in_dtype=rdtype if forward else dtype,
         out_dtype=dtype if forward else rdtype,
-        real=True, options=lp.options,
+        real=True, options=lp.options, logic=lp,
     )
 
 

@@ -192,6 +192,25 @@ def make_procgrid(p: int) -> tuple[int, int]:
     return best
 
 
+def pencil_grid_min_surface(shape: Sequence[int], p: int) -> tuple[int, int]:
+    """2D processor grid (rows over axis 0, cols over axis 1) minimizing the
+    surface area of the input z-pencil boxes — the pencil-planner analog of
+    ``proc_setup_min_surface`` (``heffte_geometry.h:589-626``). Ties prefer
+    more rows (the most-square heritage orientation of ``make_procgrid``).
+
+    Kept in float lockstep with the native ``dfft_pencil_grid``
+    (``native/dfft_native.cpp``); tests pin the two together.
+    """
+    n0, n1, n2 = (int(s) for s in shape)
+    best = None  # (cost, r, c)
+    for r, c in factorizations2(int(p)):
+        sx, sy = n0 / r, n1 / c
+        cost = sx * sy + sy * n2 + sx * n2
+        if best is None or cost < best[0] or (cost == best[0] and r > best[1]):
+            best = (cost, r, c)
+    return best[1], best[2]
+
+
 def proc_setup_min_surface(world: Box3, p: int) -> tuple[int, int, int]:
     """3D processor grid minimizing total box surface area — the reference's
     default-grid search (``proc_setup_min_surface``, ``heffte_geometry.h:589``).

@@ -62,6 +62,9 @@ def _load():
         lib.dfft_schedule_axis.argtypes = [ll, ll, ctypes.c_int, lp]
         lib.dfft_procgrid2.argtypes = [ll, lp, lp]
         lib.dfft_min_surface_grid.argtypes = [ll, ll, ll, ll, lp]
+        lib.dfft_pencil_grid.argtypes = [ll, ll, ll, ll, lp]
+        lib.dfft_balanced_split.restype = ctypes.c_int
+        lib.dfft_balanced_split.argtypes = [ll, ll, lp]
         lib.dfft_exchange_table.argtypes = [ll] * 5 + [lp] * 4
         lib.dfft_trace_begin.restype = ll
         lib.dfft_trace_begin.argtypes = [ctypes.c_char_p]
@@ -69,7 +72,7 @@ def _load():
         lib.dfft_trace_count.restype = ll
         lib.dfft_trace_dump.restype = ctypes.c_int
         lib.dfft_trace_dump.argtypes = [ctypes.c_char_p, ll, ll]
-        if lib.dfft_abi_version() != 1:
+        if lib.dfft_abi_version() != 2:
             return None
         _lib = lib
         return _lib
@@ -170,6 +173,41 @@ def min_surface_grid(shape, p: int) -> tuple[int, int, int]:
     from .geometry import proc_setup_min_surface, world_box
 
     return proc_setup_min_surface(world_box(tuple(shape)), p)
+
+
+def pencil_grid(shape, p: int) -> tuple[int, int]:
+    """Min-surface 2D pencil grid (rows over axis 0, cols over axis 1) — the
+    planner's default grid for pencil decompositions (the
+    ``proc_setup_min_surface`` role, ``heffte_geometry.h:589-626``)."""
+    lib = _load()
+    if lib is not None:
+        out = (ctypes.c_longlong * 2)()
+        lib.dfft_pencil_grid(shape[0], shape[1], shape[2], p, out)
+        return int(out[0]), int(out[1])
+    from .geometry import pencil_grid_min_surface
+
+    return pencil_grid_min_surface(shape, p)
+
+
+def balanced_split(n: int, max_factor: int) -> tuple[int, int] | None:
+    """Balanced divisor pair (n1, n2), n1 <= n2 <= max_factor, n1 maximal —
+    the per-axis split rule of the matmul/Pallas executors (the FFTScheduler
+    decision, ``templateFFT.cpp:3941-4100``). None when impossible."""
+    lib = _load()
+    if lib is not None:
+        out = (ctypes.c_longlong * 2)()
+        r = lib.dfft_balanced_split(n, max_factor, out)
+        return (int(out[0]), int(out[1])) if r == 0 else None
+    return _balanced_split_py(n, max_factor)
+
+
+def _balanced_split_py(n: int, max_factor: int) -> tuple[int, int] | None:
+    """Pure-Python mirror of ``dfft_balanced_split`` (kept in lockstep)."""
+    for d in range(math.isqrt(n), 1, -1):
+        if n % d == 0:
+            n1, n2 = d, n // d
+            return (n1, n2) if n2 <= max_factor else None
+    return None
 
 
 # -------------------------------------------------------- exchange tables

@@ -61,14 +61,15 @@ def _twiddle_np(n: int, n1: int, n2: int, forward: bool) -> np.ndarray:
 
 def _best_split(n: int) -> tuple[int, int] | None:
     """Divisor pair (n1, n2), n1 <= n2, with n1 as close to sqrt(n) as
-    possible while preferring both factors composite-small. Returns None for
-    primes (no nontrivial divisor)."""
-    best = None
-    for d in range(int(math.isqrt(n)), 1, -1):
-        if n % d == 0:
-            best = (d, n // d)
-            break
-    return best
+    possible. Returns None for primes (no nontrivial divisor).
+
+    Delegates to the native runtime core (``dfft_balanced_split``,
+    ``native/dfft_native.cpp`` — the per-axis split decision of the
+    reference's FFTScheduler, ``templateFFT.cpp:3941-4100``), with its
+    Python mirror as the toolchain-less fallback."""
+    from .. import native
+
+    return native.balanced_split(n, n)
 
 
 def _direct(x: jnp.ndarray, forward: bool) -> jnp.ndarray:

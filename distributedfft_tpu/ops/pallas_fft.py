@@ -43,7 +43,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..utils.compat import pvary
-from .dft_matmul import _best_split, _dft_matrix_np
+from .dft_matmul import _dft_matrix_np
 
 # Largest per-stage DFT factor the kernel accepts; 256 keeps every LUT and
 # matmul comfortably MXU/VMEM-sized and covers n <= 65536 in one kernel.
@@ -55,14 +55,15 @@ _VMEM_BUDGET = 6 * 1024 * 1024
 
 
 def split_for(n: int) -> tuple[int, int] | None:
-    """Balanced (n1, n2) factor pair the kernel can run, or None."""
-    s = _best_split(n)
-    if s is None:
-        return None
-    n1, n2 = s
-    if n1 < 2 or n2 > MAX_FACTOR:
-        return None
-    return s
+    """Balanced (n1, n2) factor pair the kernel can run, or None.
+
+    The bounded-split decision comes from the native scheduler
+    (``dfft_balanced_split`` with the kernel's MAX_FACTOR bound — the
+    VMEM-bounded analog of the reference's shared-memory-bounded axis split,
+    ``templateFFT.cpp:3941-4100``)."""
+    from .. import native
+
+    return native.balanced_split(n, MAX_FACTOR)
 
 
 def eligible(n: int) -> bool:

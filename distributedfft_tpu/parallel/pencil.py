@@ -45,24 +45,41 @@ from .slab import _crop_axis, _pad_axis
 
 @dataclass(frozen=True)
 class PencilSpec:
-    """Static geometry of a pencil plan on a (rows x cols) mesh."""
+    """Static geometry of a pencil plan on a (rows x cols) mesh.
+
+    ``perm = (a, b, c)`` is the input layout: axis ``a`` sharded over mesh
+    rows, axis ``b`` over mesh cols, axis ``c`` local (full pencils along
+    ``c``). ``order`` picks which mesh axis exchanges first; the two orders
+    reach two different output pencil orientations, which is the pencil
+    planner's reshape-minimization lever (``heffte_plan_logic.cpp:162-245``):
+
+    - ``"col_first"``: fft c | exch col (c<->b) | fft b | exch row (b<->a)
+      | fft a -> output axis ``b`` on rows, ``c`` on cols, ``a`` local.
+    - ``"row_first"``: fft c | exch row (c<->a) | fft a | exch col (a<->b)
+      | fft b -> output axis ``c`` on rows, ``a`` on cols, ``b`` local.
+
+    The canonical forward plan is perm (0, 1, 2) col_first (z-pencils in,
+    x-pencils out); canonical backward is perm (1, 2, 0) row_first.
+    """
 
     shape: tuple[int, int, int]
     rows: int
     cols: int
     row_axis: str = "row"
     col_axis: str = "col"
+    perm: tuple[int, int, int] = (0, 1, 2)
+    order: str = "col_first"
 
     @property
-    def n0p(self) -> int:  # axis0 split over rows on input
+    def n0p(self) -> int:  # axis0 split over rows on canonical input
         return pad_to(self.shape[0], self.rows)
 
     @property
-    def n1p_col(self) -> int:  # axis1 split over cols on input
+    def n1p_col(self) -> int:  # axis1 split over cols on canonical input
         return pad_to(self.shape[1], self.cols)
 
     @property
-    def n1p_row(self) -> int:  # axis1 split over rows on output
+    def n1p_row(self) -> int:  # axis1 split over rows on canonical output
         return pad_to(self.shape[1], self.rows)
 
     @property
@@ -70,12 +87,109 @@ class PencilSpec:
         return pad_to(self.shape[2], self.cols)
 
     @property
+    def out_placement(self) -> tuple[int, int]:
+        """(row_dim, col_dim) of the output layout."""
+        a, b, c = self.perm
+        return (b, c) if self.order == "col_first" else (c, a)
+
+    def _pspec(self, row_dim: int, col_dim: int) -> P:
+        return P(*[
+            self.row_axis if d == row_dim
+            else self.col_axis if d == col_dim
+            else None
+            for d in range(3)
+        ])
+
+    @property
     def in_spec(self) -> P:
-        return P(self.row_axis, self.col_axis, None)
+        return self._pspec(self.perm[0], self.perm[1])
 
     @property
     def out_spec(self) -> P:
-        return P(None, self.row_axis, self.col_axis)
+        return self._pspec(*self.out_placement)
+
+
+def build_pencil_general(
+    mesh: Mesh,
+    shape: tuple[int, int, int],
+    *,
+    perm: tuple[int, int, int],
+    order: str,
+    row_axis: str = "row",
+    col_axis: str = "col",
+    executor: str | Callable = "xla",
+    forward: bool = True,
+    donate: bool = False,
+    algorithm: str = "alltoall",
+) -> tuple[Callable, PencilSpec]:
+    """Build the jitted end-to-end pencil transform for ANY input layout
+    permutation and exchange order (see :class:`PencilSpec` for the chain
+    taxonomy). Uneven extents use the ceil-pad/crop scheme of :mod:`.slab`
+    (pads only ever touch an axis while it is *not* being transformed at its
+    true length).
+    """
+    if sorted(perm) != [0, 1, 2]:
+        raise ValueError(f"perm must be a permutation of (0, 1, 2), got {perm}")
+    if order not in ("col_first", "row_first"):
+        raise ValueError(f"order must be col_first|row_first, got {order!r}")
+    rows, cols = mesh.shape[row_axis], mesh.shape[col_axis]
+    spec = PencilSpec(tuple(int(s) for s in shape), rows, cols,
+                      row_axis, col_axis, tuple(perm), order)
+    ex = get_executor(executor) if isinstance(executor, str) else executor
+    n = spec.shape
+    a, b, c = perm
+    if order == "col_first":
+        # (mesh_axis, parts, split_axis, concat_axis) per exchange; the fft
+        # before each exchange runs on its split axis.
+        seq = [(col_axis, cols, c, b), (row_axis, rows, b, a)]
+        last_fft = a
+    else:
+        seq = [(row_axis, rows, c, a), (col_axis, cols, a, b)]
+        last_fft = b
+
+    def local_fn(x):
+        for mesh_ax, parts, split, concat in seq:
+            x = ex(x, (split,), forward)
+            x = _pad_axis(x, split, pad_to(n[split], parts))
+            x = exchange(x, mesh_ax, split_axis=split, concat_axis=concat,
+                         axis_size=parts, algorithm=algorithm)
+            x = _crop_axis(x, concat, n[concat])
+        return ex(x, (last_fft,), forward)
+
+    in_spec, out_spec = spec.in_spec, spec.out_spec
+    in_pads = ((a, pad_to(n[a], rows)), (b, pad_to(n[b], cols)))
+    # Each exchange's split axis keeps its pad on the global output.
+    out_crops = tuple((split, n[split]) for _, _, split, _ in seq)
+
+    def pre(x):
+        for ax, to in in_pads:
+            x = _pad_axis(x, ax, to)
+        return x
+
+    def post(y):
+        for ax, to in out_crops:
+            y = _crop_axis(y, ax, to)
+        return y
+
+    mapped = _shard_map(local_fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec)
+
+    in_sh = NamedSharding(mesh, in_spec)
+    out_sh = NamedSharding(mesh, out_spec)
+    # Even iff every pad in the chain is a no-op: the two input-side pads
+    # and each exchange's split-axis pad.
+    even = all(to == n[ax] for ax, to in in_pads) and all(
+        pad_to(n[split], parts) == n[split] for _, parts, split, _ in seq
+    )
+    jit_kw: dict = {"donate_argnums": 0} if donate else {}
+    if even:
+        jit_kw |= {"in_shardings": in_sh, "out_shardings": out_sh}
+
+    @functools.partial(jax.jit, **jit_kw)
+    def fn(x):
+        x = lax.with_sharding_constraint(pre(x), in_sh)
+        return post(mapped(x))
+
+    return fn, spec
 
 
 def build_pencil_fft3d(
@@ -88,72 +202,23 @@ def build_pencil_fft3d(
     forward: bool = True,
     donate: bool = False,
     algorithm: str = "alltoall",
+    perm: tuple[int, int, int] | None = None,
+    order: str | None = None,
 ) -> tuple[Callable, PencilSpec]:
-    """Build the jitted end-to-end pencil transform.
-
-    Forward maps z-pencils (global array sharded ``P(row, col, None)``) to
-    x-pencils (``P(None, row, col)``); backward is the exact mirror.
+    """Canonical-orientation wrapper over :func:`build_pencil_general`:
+    forward maps z-pencils (``P(row, col, None)``) to x-pencils
+    (``P(None, row, col)``); backward is the exact mirror — unless the
+    planner supplies a different permutation/order.
     """
-    rows, cols = mesh.shape[row_axis], mesh.shape[col_axis]
-    spec = PencilSpec(tuple(int(s) for s in shape), rows, cols, row_axis, col_axis)
-    ex = get_executor(executor) if isinstance(executor, str) else executor
-    n0, n1, n2 = spec.shape
-    n0p, n1pc, n1pr, n2p = spec.n0p, spec.n1p_col, spec.n1p_row, spec.n2p
-
-    if forward:
-
-        def local_fn(x):  # [n0p/rows, n1pc/cols, N2]
-            y = ex(x, (2,), True)                       # t0: Z lines
-            y = _pad_axis(y, 2, n2p)
-            # z-pencils -> y-pencils: exchange along cols
-            y = exchange(y, col_axis, split_axis=2, concat_axis=1, axis_size=cols,
-                         algorithm=algorithm)
-            y = _crop_axis(y, 1, n1)                    # true Y extent
-            y = ex(y, (1,), True)                       # Y lines
-            y = _pad_axis(y, 1, n1pr)
-            # y-pencils -> x-pencils: exchange along rows
-            y = exchange(y, row_axis, split_axis=1, concat_axis=0, axis_size=rows,
-                         algorithm=algorithm)
-            y = _crop_axis(y, 0, n0)                    # true X extent
-            return ex(y, (0,), True)                    # t3: X lines
-
-        in_spec, out_spec = spec.in_spec, spec.out_spec
-        pre = lambda x: _pad_axis(_pad_axis(x, 0, n0p), 1, n1pc)
-        post = lambda y: _crop_axis(_crop_axis(y, 1, n1), 2, n2)
-    else:
-
-        def local_fn(y):  # [N0, n1pr/rows, n2p/cols]
-            x = ex(y, (0,), False)                      # inverse X lines
-            x = _pad_axis(x, 0, n0p)
-            x = exchange(x, row_axis, split_axis=0, concat_axis=1, axis_size=rows,
-                         algorithm=algorithm)
-            x = _crop_axis(x, 1, n1)
-            x = ex(x, (1,), False)                      # inverse Y lines
-            x = _pad_axis(x, 1, n1pc)
-            x = exchange(x, col_axis, split_axis=1, concat_axis=2, axis_size=cols,
-                         algorithm=algorithm)
-            x = _crop_axis(x, 2, n2)
-            return ex(x, (2,), False)                   # inverse Z lines
-
-        in_spec, out_spec = spec.out_spec, spec.in_spec
-        pre = lambda y: _pad_axis(_pad_axis(y, 1, n1pr), 2, n2p)
-        post = lambda x: _crop_axis(_crop_axis(x, 0, n0), 1, n1)
-
-    mapped = _shard_map(local_fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec)
-
-    in_sh = NamedSharding(mesh, in_spec)
-    out_sh = NamedSharding(mesh, out_spec)
-    even = n0p == n0 and n1pc == n1 and n1pr == n1 and n2p == n2
-    jit_kw: dict = {"donate_argnums": 0} if donate else {}
-    if even:
-        jit_kw |= {"in_shardings": in_sh, "out_shardings": out_sh}
-
-    @functools.partial(jax.jit, **jit_kw)
-    def fn(x):
-        x = lax.with_sharding_constraint(pre(x), in_sh)
-        return post(mapped(x))
-
-    return fn, spec
+    if perm is None:
+        perm = (0, 1, 2) if forward else (1, 2, 0)
+    if order is None:
+        order = "col_first" if forward else "row_first"
+    return build_pencil_general(
+        mesh, shape, perm=perm, order=order, row_axis=row_axis,
+        col_axis=col_axis, executor=executor, forward=forward, donate=donate,
+        algorithm=algorithm,
+    )
 
 
 def build_pencil_rfft3d(
@@ -178,7 +243,15 @@ def build_pencil_rfft3d(
     if not isinstance(executor, str):
         raise TypeError("r2c builders take a registered executor name")
     rows, cols = mesh.shape[row_axis], mesh.shape[col_axis]
-    spec = PencilSpec(tuple(int(s) for s in shape), rows, cols, row_axis, col_axis)
+    # Direction-true spec: the canonical r2c chain is perm (0,1,2) col_first
+    # forward (z->x pencils) and perm (1,2,0) row_first backward — the same
+    # taxonomy as the generalized c2c builder, so plan-level shardings can be
+    # read straight off the spec.
+    spec = PencilSpec(
+        tuple(int(s) for s in shape), rows, cols, row_axis, col_axis,
+        perm=(0, 1, 2) if forward else (1, 2, 0),
+        order="col_first" if forward else "row_first",
+    )
     ex = get_executor(executor)
     r2c, c2r = get_r2c(executor), get_c2r(executor)
     n0, n1, n2 = spec.shape
@@ -219,7 +292,9 @@ def build_pencil_rfft3d(
             x = _crop_axis(x, 2, n2h)
             return c2r(x, n2, 2)                        # real Z lines
 
-        in_spec, out_spec = spec.out_spec, spec.in_spec
+        # Direction-true spec: perm (1,2,0) row_first makes spec.in_spec the
+        # complex x-pencils and spec.out_spec the real z-pencils.
+        in_spec, out_spec = spec.in_spec, spec.out_spec
         pre = lambda y: _pad_axis(_pad_axis(y, 1, n1pr), 2, n2hp)
         post = lambda x: _crop_axis(_crop_axis(x, 0, n0), 1, n1)
 

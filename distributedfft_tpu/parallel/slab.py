@@ -66,11 +66,20 @@ def _crop_axis(x: jnp.ndarray, axis: int, to: int) -> jnp.ndarray:
 
 @dataclass(frozen=True)
 class SlabSpec:
-    """Static geometry of a slab plan: true and padded extents."""
+    """Static geometry of a slab plan: true and padded extents.
+
+    ``in_axis``/``out_axis`` are the sharded array axes of this plan's input
+    and output — the generalized axis assignment that lets the planner start
+    a chain directly on a caller's slab layout (reshape minimization,
+    ``heffte_plan_logic.cpp:265-408``). The canonical forward plan is
+    (0, 1): X-slabs in, Y-slabs out.
+    """
 
     shape: tuple[int, int, int]
     parts: int
     axis_name: str
+    in_axis: int = 0
+    out_axis: int = 1
 
     @property
     def n0p(self) -> int:
@@ -81,12 +90,96 @@ class SlabSpec:
         return pad_to(self.shape[1], self.parts)
 
     @property
+    def in_padded_extent(self) -> int:
+        return pad_to(self.shape[self.in_axis], self.parts)
+
+    @property
+    def out_padded_extent(self) -> int:
+        return pad_to(self.shape[self.out_axis], self.parts)
+
+    @property
+    def in_pspec(self) -> P:
+        return P(*[self.axis_name if d == self.in_axis else None
+                   for d in range(3)])
+
+    @property
+    def out_pspec(self) -> P:
+        return P(*[self.axis_name if d == self.out_axis else None
+                   for d in range(3)])
+
+    @property
     def in_padded(self) -> tuple[int, int, int]:
-        return (self.n0p, self.shape[1], self.shape[2])
+        s = list(self.shape)
+        s[self.in_axis] = self.in_padded_extent
+        return tuple(s)
 
     @property
     def out_padded(self) -> tuple[int, int, int]:
-        return (self.shape[0], self.n1p, self.shape[2])
+        s = list(self.shape)
+        s[self.out_axis] = self.out_padded_extent
+        return tuple(s)
+
+
+def build_slab_general(
+    mesh: Mesh,
+    shape: tuple[int, int, int],
+    *,
+    in_axis: int,
+    out_axis: int,
+    axis_name: str = "slab",
+    executor: str | Callable = "xla",
+    forward: bool = True,
+    donate: bool = False,
+    algorithm: str = "alltoall",
+) -> tuple[Callable, SlabSpec]:
+    """Build the jitted end-to-end slab transform for ANY ordered axis pair.
+
+    Input is the global ``[N0, N1, N2]`` array sharded along ``in_axis``;
+    the two other axes are transformed locally, one exchange reshards
+    ``in_axis <-> out_axis``, and ``in_axis`` is transformed last — so the
+    chain works started from any slab layout (reshape minimization,
+    ``heffte_plan_logic.cpp:265-408``). The canonical forward plan is
+    ``(in_axis, out_axis) = (0, 1)`` (the reference engine's only mode,
+    ``fft_mpi_3d_api.cpp:181-214``), backward is (1, 0).
+    """
+    if in_axis == out_axis or not (0 <= in_axis < 3 and 0 <= out_axis < 3):
+        raise ValueError(f"need distinct 3D axes, got {in_axis}, {out_axis}")
+    p = mesh.shape[axis_name]
+    spec = SlabSpec(tuple(int(s) for s in shape), p, axis_name,
+                    in_axis, out_axis)
+    ex = get_executor(executor) if isinstance(executor, str) else executor
+    n_in, n_out = spec.shape[in_axis], spec.shape[out_axis]
+    n_inp, n_outp = spec.in_padded_extent, spec.out_padded_extent
+    local_axes = tuple(a for a in range(3) if a != in_axis)
+
+    def local_fn(x):  # in_axis extent n_inp/p per device, others full
+        y = ex(x, local_axes, forward)                   # t0: local planes
+        y = _pad_axis(y, out_axis, n_outp)               # t1: exchange prep
+        y = exchange(y, axis_name, split_axis=out_axis, concat_axis=in_axis,
+                     axis_size=p, algorithm=algorithm)   # t2: global transpose
+        y = _crop_axis(y, in_axis, n_in)                 # drop in-axis padding
+        return ex(y, (in_axis,), forward)                # t3: final lines
+
+    in_spec, out_spec = spec.in_pspec, spec.out_pspec
+    mapped = _shard_map(local_fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec)
+
+    in_sh = NamedSharding(mesh, in_spec)
+    out_sh = NamedSharding(mesh, out_spec)
+    # jit-level shardings require divisible extents; when the plan pads, the
+    # constraint moves inside (after the pad / before the crop) instead.
+    even = n_inp == n_in and n_outp == n_out
+    jit_kw: dict = {"donate_argnums": 0} if donate else {}
+    if even:
+        jit_kw |= {"in_shardings": in_sh, "out_shardings": out_sh}
+
+    @functools.partial(jax.jit, **jit_kw)
+    def fn(x):
+        x = _pad_axis(x, in_axis, n_inp)
+        x = lax.with_sharding_constraint(x, in_sh)
+        y = mapped(x)
+        return _crop_axis(y, out_axis, n_out)
+
+    return fn, spec
 
 
 def build_slab_fft3d(
@@ -98,67 +191,22 @@ def build_slab_fft3d(
     forward: bool = True,
     donate: bool = False,
     algorithm: str = "alltoall",
+    in_axis: int | None = None,
+    out_axis: int | None = None,
 ) -> tuple[Callable, SlabSpec]:
-    """Build the jitted end-to-end slab transform.
-
-    Returns ``(fn, spec)`` where ``fn`` maps a global ``[N0, N1, N2]`` array
-    sharded along axis 0 (forward) / axis 1 (backward) to the transformed
-    array sharded along the other axis. The function is donated-in-place, the
-    TPU analog of the reference's bufferDev1/bufferDev2 ping-pong
-    (``fft_mpi_3d_api.cpp:66-81``).
+    """Canonical-orientation wrapper over :func:`build_slab_general`:
+    X-slabs -> Y-slabs forward, Y-slabs -> X-slabs backward (the reference
+    pipeline, ``fft_mpi_3d_api.cpp:181-214``), unless the planner supplies a
+    different axis pair.
     """
-    p = mesh.shape[axis_name]
-    spec = SlabSpec(tuple(int(s) for s in shape), p, axis_name)
-    ex = get_executor(executor) if isinstance(executor, str) else executor
-    n0, n1, n2 = spec.shape
-    n0p, n1p = spec.n0p, spec.n1p
-
-    if forward:
-
-        def local_fn(x):  # [n0p/p, N1, N2] per device
-            y = ex(x, (1, 2), True)                      # t0: YZ planes
-            y = _pad_axis(y, 1, n1p)                     # t1: exchange prep
-            y = exchange(y, axis_name, split_axis=1, concat_axis=0, axis_size=p,
-                         algorithm=algorithm)
-            y = _crop_axis(y, 0, n0)                     # drop axis-0 padding
-            return ex(y, (0,), True)                     # t3: X lines
-
-        in_spec, out_spec = P(axis_name, None, None), P(None, axis_name, None)
-        pad_axis, pad_to = 0, n0p
-        crop_axis_, crop_to = 1, n1
-    else:
-
-        def local_fn(y):  # [N0, N1p/p, N2] per device
-            x = ex(y, (0,), False)                       # inverse X lines
-            x = _pad_axis(x, 0, n0p)
-            x = exchange(x, axis_name, split_axis=0, concat_axis=1, axis_size=p,
-                         algorithm=algorithm)
-            x = _crop_axis(x, 1, n1)
-            return ex(x, (1, 2), False)                  # inverse YZ planes
-
-        in_spec, out_spec = P(None, axis_name, None), P(axis_name, None, None)
-        pad_axis, pad_to = 1, n1p
-        crop_axis_, crop_to = 0, n0
-
-    mapped = _shard_map(local_fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec)
-
-    in_sh = NamedSharding(mesh, in_spec)
-    out_sh = NamedSharding(mesh, out_spec)
-    # jit-level shardings require divisible extents; when the plan pads, the
-    # constraint moves inside (after the pad / before the crop) instead.
-    even = spec.n0p == n0 and spec.n1p == n1
-    jit_kw: dict = {"donate_argnums": 0} if donate else {}
-    if even:
-        jit_kw |= {"in_shardings": in_sh, "out_shardings": out_sh}
-
-    @functools.partial(jax.jit, **jit_kw)
-    def fn(x):
-        x = _pad_axis(x, pad_axis, pad_to)
-        x = lax.with_sharding_constraint(x, in_sh)
-        y = mapped(x)
-        return _crop_axis(y, crop_axis_, crop_to)
-
-    return fn, spec
+    d_in, d_out = (0, 1) if forward else (1, 0)
+    return build_slab_general(
+        mesh, shape,
+        in_axis=d_in if in_axis is None else in_axis,
+        out_axis=d_out if out_axis is None else out_axis,
+        axis_name=axis_name, executor=executor, forward=forward,
+        donate=donate, algorithm=algorithm,
+    )
 
 
 def build_slab_rfft3d(
@@ -184,11 +232,18 @@ def build_slab_rfft3d(
     if not isinstance(executor, str):
         raise TypeError("r2c builders take a registered executor name")
     p = mesh.shape[axis_name]
-    spec = SlabSpec(tuple(int(s) for s in shape), p, axis_name)
+    # Direction-true spec (like build_slab_general): forward maps X-slabs to
+    # Y-slabs, backward the mirror — so plan-level shardings read straight
+    # off the spec.
+    spec = SlabSpec(
+        tuple(int(s) for s in shape), p, axis_name,
+        in_axis=0 if forward else 1, out_axis=1 if forward else 0,
+    )
     ex = get_executor(executor)
     r2c, c2r = get_r2c(executor), get_c2r(executor)
     n0, n1, n2 = spec.shape
     n0p, n1p = spec.n0p, spec.n1p
+    in_spec, out_spec = spec.in_pspec, spec.out_pspec
 
     if forward:
 
@@ -201,7 +256,6 @@ def build_slab_rfft3d(
             y = _crop_axis(y, 0, n0)
             return ex(y, (0,), True)                     # t3: X lines
 
-        in_spec, out_spec = P(axis_name, None, None), P(None, axis_name, None)
         pre = lambda x: _pad_axis(x, 0, n0p)
         post = lambda y: _crop_axis(y, 1, n1)
     else:
@@ -215,7 +269,6 @@ def build_slab_rfft3d(
             x = ex(x, (1,), False)                       # inverse Y lines
             return c2r(x, n2, 2)                         # real Z lines
 
-        in_spec, out_spec = P(None, axis_name, None), P(axis_name, None, None)
         pre = lambda y: _pad_axis(y, 1, n1p)
         post = lambda x: _crop_axis(x, 0, n0)
 

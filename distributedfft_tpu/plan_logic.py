@@ -1,10 +1,12 @@
-"""Plan logic: option handling and decomposition selection.
+"""Plan logic: option handling, decomposition selection, reshape minimization.
 
 The heFFTe analog layer (``heffte/heffteBenchmark/include/heffte_plan_logic.h``,
 ``src/heffte_plan_logic.cpp``): ``plan_options`` {algorithm, use_reorder,
 use_pencils, use_gpu_aware} (``heffte_plan_logic.h:69-89``) and
 ``plan_operations`` (``heffte_plan_logic.cpp:410-432``), which inspects the
-in/out geometry and picks the cheapest reshape pipeline.
+in/out geometry and picks the cheapest reshape pipeline — the pencil planner
+(``:162-245``) and slab planner (``:265-408``) both detect when the caller's
+layouts already *are* pencils/slabs on useful axes and emit fewer reshapes.
 
 On TPU the decision space is smaller and different: the transport is always
 XLA collectives over the mesh (no gpu-aware/host-staged split — there is no
@@ -13,13 +15,23 @@ assignment, and the real knobs are
 
 - **decomposition**: slab (one exchange) vs pencil (two exchanges, but each
   on a smaller mesh axis and with more parallel lines per FFT stage);
+- **axis assignment** (the reshape-minimization lever): which array axis the
+  input/output sharding lives on. The slab chain works for ANY ordered axis
+  pair (in_axis != out_axis) and the pencil chain for any axis permutation
+  in either exchange order, so a plan can *start from the caller's layout*
+  instead of resharding to a fixed canonical one — the TPU translation of
+  heFFTe's "already pencils on the right axes -> skip the reshape";
 - **exchange algorithm**: one fused ``all_to_all`` vs a pipelined
   ``ppermute`` ring (:mod:`.parallel.exchange`);
 - **mesh geometry**: how to factor the device count into a 2D grid
-  (``make_procgrid``, min-surface heuristics — :mod:`.geometry`).
+  (min-surface search, :func:`distributedfft_tpu.native.pencil_grid`);
+- **device count**: shrink to an evenly-dividing count when that removes
+  padding at no per-device compute cost (``getProperDeviceNum``,
+  ``fft_mpi_3d_api.cpp:232-272``).
 
-:func:`logic_plan3d` resolves (shape, mesh/device-count, options) to a
-concrete decomposition + mesh, the role of ``plan_operations``.
+:func:`logic_plan3d` resolves (shape, mesh/device-count, options, layouts)
+to a concrete decomposition + mesh + stage chain, the role of
+``plan_operations``.
 """
 
 from __future__ import annotations
@@ -28,9 +40,10 @@ import math
 from dataclasses import dataclass, field, replace
 from typing import Sequence
 
-from jax.sharding import Mesh
+from jax.sharding import Mesh, PartitionSpec as P
 
 from . import geometry as geo
+from . import native
 from .parallel.exchange import ALGORITHMS
 from .parallel.mesh import make_mesh
 
@@ -47,12 +60,18 @@ class PlanOptions:
     ``heffte_common.h:275``).
     ``donate``: consume the input buffer (bufferDev ping-pong analog,
     ``fft_mpi_3d_api.cpp:66-81``).
+    ``renegotiate``: device-count renegotiation when the mesh is built from
+    an int device count (``getProperDeviceNum``, ``fft_mpi_3d_api.cpp:232-272``):
+    "auto" shrinks only when the negotiated count removes padding at equal
+    per-device compute (a strict win); "force" always shrinks to the largest
+    evenly-dividing count (the reference's rule); "never" keeps the request.
     """
 
     decomposition: str = "auto"
     algorithm: str = "alltoall"
     executor: str = "xla"
     donate: bool = False
+    renegotiate: str = "auto"
 
     def __post_init__(self) -> None:
         if self.algorithm not in ALGORITHMS:
@@ -61,6 +80,10 @@ class PlanOptions:
             )
         if self.decomposition not in ("auto", "single", "slab", "pencil"):
             raise ValueError(f"unknown decomposition {self.decomposition!r}")
+        if self.renegotiate not in ("auto", "force", "never"):
+            raise ValueError(
+                f"renegotiate must be auto|force|never, got {self.renegotiate!r}"
+            )
 
 
 DEFAULT_OPTIONS = PlanOptions()
@@ -75,18 +98,80 @@ def default_options(decomposition: str = "auto", **kw) -> PlanOptions:
 class LogicPlan:
     """Resolved plan skeleton (the ``logic_plan3d`` analog,
     ``heffte_plan_logic.h:152-164``): the decomposition, the mesh to run on,
-    and the intermediate layout chain as per-stage box lists."""
+    the axis assignment of the stage chain, and the intermediate layout
+    chain as per-stage box lists. Orientation follows the plan's own
+    direction: ``stages[0]`` is this plan's input side."""
 
     shape: tuple[int, int, int]
     decomposition: str            # "single" | "slab" | "pencil"
     mesh: Mesh | None
     options: PlanOptions
+    forward: bool = True
+    # Slab chain: input sharded on slab_axes[0], output on slab_axes[1].
+    slab_axes: tuple[int, int] | None = None
+    # Pencil chain: input layout (row->perm[0], col->perm[1], perm[2] local)
+    # and exchange order "col_first" | "row_first".
+    pencil_perm: tuple[int, int, int] | None = None
+    pencil_order: str | None = None
+    # Whether the caller's in/out layouts are realized by the chain itself
+    # (True) or still need an edge reshard (False).
+    in_absorbed: bool = True
+    out_absorbed: bool = True
+    # Device-count renegotiation record: (requested, used, reason).
+    negotiated: tuple | None = None
     # Stage layouts: list of (fft_axes, boxes) pairs, input side first.
     stages: tuple = ()
 
     @property
     def num_exchanges(self) -> int:
         return {"single": 0, "slab": 1, "pencil": 2}[self.decomposition]
+
+
+def spec_entries(mesh: Mesh, spec: P, ndim: int) -> tuple:
+    """Validate a user PartitionSpec (rank, axis names) and return it padded
+    to ``ndim`` entries."""
+    entries = tuple(spec)
+    if len(entries) > ndim:
+        raise ValueError(
+            f"PartitionSpec {spec} has more entries than the {ndim} array dims"
+        )
+    for entry in entries:
+        if entry is None:
+            continue
+        for nm in entry if isinstance(entry, tuple) else (entry,):
+            if nm not in mesh.shape:
+                raise ValueError(
+                    f"spec {spec} names unknown mesh axis {nm!r}; mesh axes: "
+                    f"{tuple(mesh.shape)}"
+                )
+    return entries + (None,) * (ndim - len(entries))
+
+
+def classify_layout(mesh: Mesh, spec: P) -> tuple[str, tuple]:
+    """Classify a mesh-expressible 3D layout against the chain shapes.
+
+    Returns ``("slab", (axis,))`` when a 1D mesh's axis shards exactly one
+    array dim, ``("pencil", (row_dim, col_dim))`` when a 2D mesh's axes each
+    shard exactly one distinct dim, and ``("other", ())`` for everything
+    else (replicated dims, tupled axes, partial placements) — the layout
+    detection step of heFFTe's planners (``heffte_plan_logic.cpp:162-245``
+    checks ``is_pencils``; ``:265-408`` checks slabs).
+    """
+    entries = spec_entries(mesh, spec, 3)
+    placement: dict = {}
+    for d, e in enumerate(entries):
+        if e is None:
+            continue
+        names = e if isinstance(e, tuple) else (e,)
+        if len(names) != 1:
+            return ("other", ())
+        placement[names[0]] = d
+    names = list(mesh.axis_names)
+    if len(names) == 1 and set(placement) == set(names):
+        return ("slab", (placement[names[0]],))
+    if len(names) == 2 and set(placement) == set(names):
+        return ("pencil", (placement[names[0]], placement[names[1]]))
+    return ("other", ())
 
 
 def choose_decomposition(shape: Sequence[int], ndev: int) -> str:
@@ -109,7 +194,10 @@ def choose_decomposition(shape: Sequence[int], ndev: int) -> str:
 
 
 def negotiate_device_count(
-    shape: Sequence[int], ndev: int, decomposition: str = "slab"
+    shape: Sequence[int], ndev: int, decomposition: str = "slab", *,
+    slab_axes: tuple[int, int] | None = None,
+    perm: tuple[int, int, int] | None = None,
+    order: str | None = None,
 ) -> int:
     """Largest device count <= ``ndev`` whose slabs/pencils divide the split
     axes evenly — the reference's device-count renegotiation
@@ -117,47 +205,119 @@ def negotiate_device_count(
     devices != 0 it *shrinks* the device count until slabs divide).
 
     On TPU the padded-exchange path makes uneven shapes correct anyway, so
-    this is an *optimization* choice, not a correctness one: a caller that
-    prefers zero padding waste over maximum parallelism can plan with the
-    negotiated count (idle devices simply hold empty shards).
+    this is an *optimization* choice, not a correctness one; see
+    ``PlanOptions.renegotiate`` for how :func:`logic_plan3d` applies it.
     """
-    n0, n1, n2 = (int(s) for s in shape)
-    start = min(ndev, n0, n1) if decomposition == "slab" else ndev
+    shape = tuple(int(s) for s in shape)
+    if decomposition == "slab":
+        a0, a1 = slab_axes if slab_axes is not None else (0, 1)
+        start = min(ndev, shape[a0], shape[a1])
+    else:
+        start = ndev
     for p in range(start, 0, -1):
-        if decomposition == "slab":
-            if n0 % p == 0 and n1 % p == 0:
-                return p
-        else:
-            # pencil pads axis0/axis1 over mesh rows and axis1/axis2 over
-            # mesh cols (PencilSpec n0p/n1p_row/n1p_col/n2p); an even plan
-            # needs the planner's grid orientation (rows >= cols, as
-            # logic_plan3d builds it) to divide all four.
-            r, c = sorted(geo.make_procgrid(p), reverse=True)
-            if n0 % r == 0 and n1 % r == 0 and n1 % c == 0 and n2 % c == 0:
-                return p
+        if all(shape[a] % parts == 0
+               for a, parts in _chain_pad_axes(shape, decomposition, p,
+                                               slab_axes=slab_axes,
+                                               perm=perm, order=order)):
+            return p
     return 1
+
+
+def _chain_pad_axes(
+    shape, decomposition: str, p: int, *,
+    slab_axes: tuple[int, int] | None = None,
+    perm: tuple[int, int, int] | None = None,
+    order: str | None = None,
+) -> list[tuple[int, int]]:
+    """(array_axis, parts) pairs the chain ceil-pads at device count ``p`` —
+    the padding surface the renegotiation decision must judge. Uses the
+    ACTUAL chain axes (post layout absorption), not the canonical ones."""
+    if decomposition == "slab":
+        in_axis, out_axis = slab_axes if slab_axes is not None else (0, 1)
+        return [(in_axis, p), (out_axis, p)]
+    rows, cols = native.pencil_grid(shape, p)
+    a, b, c = perm if perm is not None else (0, 1, 2)
+    pairs = [(a, rows), (b, cols)]  # input-side shard pads
+    if (order or "col_first") == "col_first":
+        pairs += [(c, cols), (b, rows)]  # exchange split-axis pads
+    else:
+        pairs += [(c, rows), (a, cols)]
+    return pairs
+
+
+def _apply_renegotiation(
+    shape: tuple[int, int, int], ndev: int, decomp: str, mode: str, *,
+    slab_axes: tuple[int, int] | None = None,
+    perm: tuple[int, int, int] | None = None,
+    order: str | None = None,
+) -> tuple[int, tuple | None]:
+    """Resolve the device count to actually use, judged on the actual chain
+    axes (after layout absorption). Returns (count, record) where record =
+    (requested, used, reason) for ``plan_info``."""
+    if mode == "never" or ndev <= 1 or decomp == "single":
+        return ndev, None
+    neg = negotiate_device_count(shape, ndev, decomp,
+                                 slab_axes=slab_axes, perm=perm, order=order)
+    if neg == ndev:
+        return ndev, None
+    if mode == "force":
+        return neg, (ndev, neg, "forced: largest evenly-dividing count")
+    # "auto": shrink only when per-device padded compute does not grow —
+    # i.e. the ceil-shard extents stay the same on every chain axis, so
+    # dropping devices only removes padding (a strict win: same compute per
+    # device, less padded exchange payload, fewer participants).
+    old = _chain_pad_axes(shape, decomp, ndev,
+                          slab_axes=slab_axes, perm=perm, order=order)
+    new = _chain_pad_axes(shape, decomp, neg,
+                          slab_axes=slab_axes, perm=perm, order=order)
+    free = all(
+        geo.ceil_shards(shape[a0], p1) == geo.ceil_shards(shape[a0], p0)
+        for (a0, p0), (_, p1) in zip(old, new)
+    )
+    if free:
+        return neg, (ndev, neg, "auto: even shards at equal per-device compute")
+    return ndev, (
+        ndev, ndev,
+        f"kept: shrinking to {neg} evenly-dividing devices would raise "
+        "per-device compute more than the padding it removes",
+    )
 
 
 def logic_plan3d(
     shape: Sequence[int],
     mesh: Mesh | int | None,
     options: PlanOptions = DEFAULT_OPTIONS,
+    *,
+    forward: bool = True,
+    in_spec: P | None = None,
+    out_spec: P | None = None,
 ) -> LogicPlan:
-    """Resolve (shape, mesh-or-device-count, options) to a concrete plan
-    skeleton. The role of ``plan_operations``
+    """Resolve (shape, mesh-or-device-count, options, layouts) to a concrete
+    plan skeleton. The role of ``plan_operations``
     (``heffte_plan_logic.cpp:410-432``): all geometry decisions happen here,
     and the builders in :mod:`.parallel` only execute them.
 
     ``mesh`` may be ``None`` (single device), an int device count (the mesh
-    is built here, shaped by the chosen decomposition), or an existing
-    :class:`Mesh` (1D -> slab, 2D -> pencil; the mesh wins over
-    ``options.decomposition == "auto"``).
+    is built here, shaped by the chosen decomposition — pencil grids come
+    from the min-surface search, and the device count may be renegotiated
+    per ``options.renegotiate``), or an existing :class:`Mesh` (1D -> slab,
+    2D -> pencil; the mesh wins over ``options.decomposition == "auto"``).
+
+    ``in_spec`` / ``out_spec`` are the caller's layouts (this plan's own
+    orientation). When one classifies as a slab/pencil layout of the mesh,
+    the stage chain is re-axed to *start (or end) right there*, eliminating
+    the edge reshard — heFFTe's reshape minimization
+    (``heffte_plan_logic.cpp:162-245,265-408``). Unabsorbable layouts are
+    reported via ``in_absorbed``/``out_absorbed`` and handled by the caller
+    with an edge reshard.
     """
     shape = tuple(int(s) for s in shape)
     decomp = options.decomposition
+    negotiated = None
+    requested = None  # device count requested as an int (renegotiable)
 
     if isinstance(mesh, int):
-        ndev = mesh
+        requested = ndev = mesh
         if decomp == "auto":
             decomp = choose_decomposition(shape, ndev)
         if decomp == "single" or ndev == 1:
@@ -165,8 +325,8 @@ def logic_plan3d(
             decomp = "single"
         elif decomp == "slab":
             mesh = make_mesh(ndev)
-        else:  # pencil: most-square grid, larger factor on rows
-            r, c = sorted(geo.make_procgrid(ndev), reverse=True)
+        else:  # pencil: min-surface grid (rows over axis 0, cols over axis 1)
+            r, c = native.pencil_grid(shape, ndev)
             mesh = make_mesh((r, c))
 
     if decomp == "single":  # explicit request wins over any provided mesh
@@ -182,38 +342,168 @@ def logic_plan3d(
     if decomp == "pencil" and mesh is not None and len(mesh.axis_names) != 2:
         raise ValueError("pencil decomposition requires a 2D mesh")
 
-    stages = stage_layouts(decomp, mesh, geo.world_box(shape))
+    # ---- axis assignment (reshape minimization) ----
+    kin = classify_layout(mesh, in_spec) if (
+        mesh is not None and in_spec is not None) else None
+    kout = classify_layout(mesh, out_spec) if (
+        mesh is not None and out_spec is not None) else None
+    slab_axes = None
+    perm = order = None
+    in_absorbed = in_spec is None or mesh is None
+    out_absorbed = out_spec is None or mesh is None
+
+    if decomp == "slab" and mesh is not None:
+        default_in, default_out = (0, 1) if forward else (1, 0)
+        if kin is not None and kin[0] == "slab":
+            in_axis = kin[1][0]
+            in_absorbed = True
+        else:
+            in_axis = default_in
+        if kout is not None and kout[0] == "slab" and kout[1][0] != in_axis:
+            out_axis = kout[1][0]
+            out_absorbed = True
+        else:
+            out_axis = default_out if default_out != in_axis else default_in
+        slab_axes = (in_axis, out_axis)
+    elif decomp == "pencil" and mesh is not None:
+        default_perm = (0, 1, 2) if forward else (1, 2, 0)
+        default_order = "col_first" if forward else "row_first"
+        if kin is not None and kin[0] == "pencil":
+            a, b = kin[1]
+            perm = (a, b, 3 - a - b)
+            in_absorbed = True
+        else:
+            perm = default_perm
+        # The two exchange orders reach two different output layouts; pick
+        # the one matching the caller's out_spec when possible.
+        col_first_out = (perm[1], perm[2])  # (row_dim, col_dim)
+        row_first_out = (perm[2], perm[0])
+        if kout is not None and kout[0] == "pencil":
+            if kout[1] == col_first_out:
+                order, out_absorbed = "col_first", True
+            elif kout[1] == row_first_out:
+                order, out_absorbed = "row_first", True
+            else:
+                order = default_order
+        else:
+            order = default_order
+
+    # ---- device-count renegotiation (int-mesh requests only), judged on
+    # the ACTUAL chain axes chosen above ----
+    if requested is not None and mesh is not None:
+        used, negotiated = _apply_renegotiation(
+            shape, requested, decomp, options.renegotiate,
+            slab_axes=slab_axes, perm=perm, order=order,
+        )
+        if used != requested:
+            if used == 1 and (in_spec is not None or out_spec is not None):
+                # Layout-carrying plans need a mesh; keep the request.
+                negotiated = (requested, requested,
+                              "kept: in_spec/out_spec require a mesh")
+            elif used == 1:
+                mesh = None
+                decomp = "single"
+                slab_axes = perm = order = None
+            elif decomp == "slab":
+                mesh = make_mesh(used)
+            else:
+                r, c = native.pencil_grid(shape, used)
+                mesh = make_mesh((r, c))
+
+    stages = stage_layouts(
+        decomp, mesh, geo.world_box(shape),
+        slab_axes=slab_axes, pencil_perm=perm, pencil_order=order,
+    )
     return LogicPlan(
         shape=shape, decomposition=decomp, mesh=mesh,
-        options=replace(options, decomposition=decomp), stages=stages,
+        options=replace(options, decomposition=decomp), forward=forward,
+        slab_axes=slab_axes, pencil_perm=perm, pencil_order=order,
+        in_absorbed=in_absorbed, out_absorbed=out_absorbed,
+        negotiated=negotiated, stages=stages,
     )
 
 
-def stage_layouts(decomposition: str, mesh: Mesh | None, world: geo.Box3) -> tuple:
+def _grid_boxes(
+    world: geo.Box3, placements: dict[int, int], *, rule=geo.ceil_splits,
+    major_dim: int | None = None,
+) -> tuple:
+    """Boxes of a layout sharding ``placements`` = {array_dim: parts},
+    ordered with ``major_dim``'s chunk index slowest (mesh row-major device
+    order). With one entry this is a slab split; with two, a pencil grid."""
+    dims = sorted(placements)
+    if major_dim is not None and dims[0] != major_dim:
+        dims = [major_dim] + [d for d in dims if d != major_dim]
+    chunks = {
+        d: [
+            (world.low[d] + a, world.low[d] + b)
+            for a, b in rule(world.shape[d], placements[d])
+        ]
+        for d in dims
+    }
+    import itertools
+
+    boxes = []
+    for combo in itertools.product(*(range(placements[d]) for d in dims)):
+        low = list(world.low)
+        high = list(world.high)
+        for d, ci in zip(dims, combo):
+            low[d], high[d] = chunks[d][ci]
+        boxes.append(geo.Box3(tuple(low), tuple(high)))
+    return tuple(boxes)
+
+
+def stage_layouts(
+    decomposition: str,
+    mesh: Mesh | None,
+    world: geo.Box3,
+    *,
+    slab_axes: tuple[int, int] | None = None,
+    pencil_perm: tuple[int, int, int] | None = None,
+    pencil_order: str | None = None,
+) -> tuple:
     """The per-stage (fft_axes, boxes) layout chain of a decomposition over
     ``world`` — the single source of truth for box geometry (the 4-shape
-    lists of ``logic_plan3d``, ``heffte_plan_logic.h:152-164``)."""
+    lists of ``logic_plan3d``, ``heffte_plan_logic.h:152-164``). Input side
+    of the chain first, in the chain's own orientation."""
     if decomposition == "single" or mesh is None:
         return (((0, 1, 2), (world,)),)
     if decomposition == "slab":
+        in_axis, out_axis = slab_axes if slab_axes is not None else (0, 1)
         p = mesh.shape[mesh.axis_names[0]]
+        local_axes = tuple(a for a in range(3) if a != in_axis)
         return (
-            ((1, 2), tuple(geo.make_slabs(world, p, axis=0, rule=geo.ceil_splits))),
-            ((0,), tuple(geo.make_slabs(world, p, axis=1, rule=geo.ceil_splits))),
+            (local_axes, _grid_boxes(world, {in_axis: p})),
+            ((in_axis,), _grid_boxes(world, {out_axis: p})),
         )
-    r, c = (mesh.shape[a] for a in mesh.axis_names[:2])
+    rows, cols = (mesh.shape[a] for a in mesh.axis_names[:2])
+    a, b, c = pencil_perm if pencil_perm is not None else (0, 1, 2)
+    order = pencil_order or "col_first"
+    if order == "col_first":
+        # fft c | exch col (c<->b) | fft b | exch row (b<->a) | fft a
+        return (
+            ((c,), _grid_boxes(world, {a: rows, b: cols}, major_dim=a)),
+            ((b,), _grid_boxes(world, {a: rows, c: cols}, major_dim=a)),
+            ((a,), _grid_boxes(world, {b: rows, c: cols}, major_dim=b)),
+        )
+    # row_first: fft c | exch row (c<->a) | fft a | exch col (a<->b) | fft b
     return (
-        ((2,), tuple(geo.make_pencils(world, (r, c), 2, rule=geo.ceil_splits))),
-        ((1,), tuple(geo.make_pencils(world, (r, c), 1, rule=geo.ceil_splits))),
-        ((0,), tuple(geo.make_pencils(world, (r, c), 0, rule=geo.ceil_splits))),
+        ((c,), _grid_boxes(world, {a: rows, b: cols}, major_dim=a)),
+        ((a,), _grid_boxes(world, {c: rows, b: cols}, major_dim=c)),
+        ((b,), _grid_boxes(world, {c: rows, a: cols}, major_dim=c)),
     )
 
 
-def io_boxes(
-    decomposition: str, mesh: Mesh | None, world_in: geo.Box3, world_out: geo.Box3
-) -> tuple[list[geo.Box3], list[geo.Box3]]:
-    """Per-device input/output boxes for the forward orientation; r2c plans
-    pass a shrunk complex-side ``world_out``."""
-    first = stage_layouts(decomposition, mesh, world_in)[0][1]
-    last = stage_layouts(decomposition, mesh, world_out)[-1][1]
+def io_boxes(lp: LogicPlan, world_in: geo.Box3, world_out: geo.Box3) -> tuple:
+    """Per-device input/output boxes of the plan's own orientation; r2c
+    plans pass a shrunk complex-side world."""
+    first = stage_layouts(
+        lp.decomposition, lp.mesh, world_in,
+        slab_axes=lp.slab_axes, pencil_perm=lp.pencil_perm,
+        pencil_order=lp.pencil_order,
+    )[0][1]
+    last = stage_layouts(
+        lp.decomposition, lp.mesh, world_out,
+        slab_axes=lp.slab_axes, pencil_perm=lp.pencil_perm,
+        pencil_order=lp.pencil_order,
+    )[-1][1]
     return list(first), list(last)

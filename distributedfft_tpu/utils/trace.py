@@ -124,6 +124,23 @@ def plan_info(plan) -> str:
         )
         lines.append(f"in sharding:  {plan.in_sharding.spec}")
         lines.append(f"out sharding: {plan.out_sharding.spec}")
+    lp = getattr(plan, "logic", None)
+    if lp is not None:
+        if lp.slab_axes is not None:
+            lines.append(f"slab chain: in axis {lp.slab_axes[0]} -> out axis "
+                         f"{lp.slab_axes[1]}")
+        if lp.pencil_perm is not None:
+            lines.append(f"pencil chain: perm {lp.pencil_perm} "
+                         f"({lp.pencil_order})")
+        if not (lp.in_absorbed and lp.out_absorbed):
+            edges = [s for s, ok in (("in", lp.in_absorbed),
+                                     ("out", lp.out_absorbed)) if not ok]
+            lines.append(f"edge reshards: {', '.join(edges)}")
+        if lp.negotiated is not None:
+            req, used, reason = lp.negotiated
+            lines.append(
+                f"device negotiation: requested {req} -> using {used} ({reason})"
+            )
     if plan.spec is not None:
         lines.append(f"padded extents: {plan.spec}")
     for label, boxes in (("in", plan.in_boxes), ("out", plan.out_boxes)):

@@ -17,6 +17,7 @@
 
 #include <algorithm>
 #include <chrono>
+#include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -28,7 +29,7 @@ extern "C" {
 
 // ---------------------------------------------------------------- version
 
-int dfft_abi_version() { return 1; }
+int dfft_abi_version() { return 2; }
 
 // ------------------------------------------------------------- scheduler
 //
@@ -145,6 +146,51 @@ void dfft_min_surface_grid(long long nx, long long ny, long long nz,
       }
     }
   }
+}
+
+// 2D pencil grid (rows over axis 0, cols over axis 1) minimizing the input
+// z-pencil box surface — the pencil-planner analog of the min-surface
+// search above; consulted by logic_plan3d when building a mesh from a
+// device count. Ties prefer more rows (the most-square heritage
+// orientation). Kept in float lockstep with
+// geometry.pencil_grid_min_surface.
+void dfft_pencil_grid(long long n0, long long n1, long long n2, long long p,
+                      long long* out2) {
+  double best = -1.0;
+  long long br = 1, bc = p;
+  for (long long r = 1; r <= p; ++r) {
+    if (p % r) continue;
+    long long c = p / r;
+    double sx = double(n0) / r, sy = double(n1) / c;
+    double cost = sx * sy + sy * double(n2) + sx * double(n2);
+    if (best < 0.0 || cost < best || (cost == best && r > br)) {
+      best = cost;
+      br = r;
+      bc = c;
+    }
+  }
+  out2[0] = br;
+  out2[1] = bc;
+}
+
+// Balanced bounded divisor pair: (n1, n2) with n1 <= n2 <= max_factor and
+// n1 maximal (closest to sqrt(n)) — the split rule shared by the MXU-matmul
+// four-step recursion and the fused Pallas kernel (the per-axis split
+// decision of the reference's FFTScheduler, templateFFT.cpp:3941-4100).
+// Returns 0 on success; -1 when no such pair exists (prime n, or n too
+// large for the bound).
+int dfft_balanced_split(long long n, long long max_factor, long long* out2) {
+  for (long long d = (long long)std::sqrt((double)n) + 1; d >= 2; --d) {
+    if (d > n) continue;
+    if (n % d) continue;
+    long long other = n / d;
+    if (d > other) continue;  // keep n1 <= n2
+    if (other > max_factor) return -1;  // even the most balanced n2 too big
+    out2[0] = d;
+    out2[1] = other;
+    return 0;
+  }
+  return -1;
 }
 
 // -------------------------------------------------------- exchange tables

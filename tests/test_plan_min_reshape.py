@@ -1,0 +1,274 @@
+"""Reshape-minimizing plan logic: layout-aware chains, collective counts,
+device-count renegotiation, and the min-surface pencil grid.
+
+The heFFTe planners detect when the caller's layouts already are
+pencils/slabs on useful axes and emit fewer reshapes
+(``heffte_plan_logic.cpp:162-245`` pencil, ``:265-408`` slab, ``:410-432``
+dispatcher); the TPU translation re-axes the slab/pencil chain to start or
+end exactly on the caller's layout, and these tests pin the resulting
+collective counts in the *compiled HLO*.
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu import geometry as geo
+from distributedfft_tpu import native
+from distributedfft_tpu.plan_logic import (
+    PlanOptions,
+    classify_layout,
+    logic_plan3d,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the virtual 8-device mesh"
+)
+
+SHAPE = (16, 16, 16)
+CDT = jnp.complex128
+
+_COLLECTIVE = re.compile(
+    r"\b(all-to-all|all-gather|all-reduce|collective-permute)(?:-start)?\("
+)
+
+
+def _collectives(plan) -> list[str]:
+    """Collective ops in the plan's compiled HLO."""
+    txt = plan.fn.lower(
+        jax.ShapeDtypeStruct(plan.in_shape, plan.in_dtype)
+    ).compile().as_text()
+    return _COLLECTIVE.findall(txt)
+
+
+def _world(shape=SHAPE, seed=7):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal(shape) + 1j * rng.standard_normal(shape)
+
+
+def _check(plan, x, ref, tol=1e-11):
+    y = np.asarray(plan(jnp.asarray(x)))
+    assert np.max(np.abs(y - ref)) / np.max(np.abs(ref)) < tol
+
+
+# ------------------------------------------------------------- slab chains
+
+def test_canonical_slab_has_one_collective():
+    mesh = dfft.make_mesh(8)
+    plan = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT)
+    assert len(_collectives(plan)) == 1
+    _check(plan, x := _world(), np.fft.fftn(x))
+
+
+def test_slab_in_yslabs_absorbed_one_collective():
+    """in_spec already Y-slabs: the chain starts there (fft X,Z locally,
+    exchange once, fft Y) instead of resharding to X-slabs first — one fewer
+    collective than the round-1 wrap-around behavior."""
+    mesh = dfft.make_mesh(8)
+    plan = dfft.plan_dft_c2c_3d(
+        SHAPE, mesh, dtype=CDT, in_spec=P(None, "slab", None)
+    )
+    assert plan.logic.slab_axes == (1, 0)
+    assert plan.logic.in_absorbed and plan.logic.out_absorbed
+    assert plan.in_sharding.spec == P(None, "slab", None)
+    assert plan.out_sharding.spec == P("slab", None, None)
+    assert len(_collectives(plan)) == 1
+    _check(plan, x := _world(), np.fft.fftn(x))
+
+
+def test_slab_out_zslabs_absorbed_one_collective():
+    mesh = dfft.make_mesh(8)
+    plan = dfft.plan_dft_c2c_3d(
+        SHAPE, mesh, dtype=CDT, out_spec=P(None, None, "slab")
+    )
+    assert plan.logic.slab_axes == (0, 2)
+    assert len(_collectives(plan)) == 1
+    assert plan.out_sharding.spec == P(None, None, "slab")
+    _check(plan, x := _world(), np.fft.fftn(x))
+
+
+def test_slab_same_in_out_axis_needs_two_collectives():
+    """in == out slab axis cannot be done with one exchange (the transformed
+    axis must move away and back): chain + one edge reshard."""
+    mesh = dfft.make_mesh(8)
+    plan = dfft.plan_dft_c2c_3d(
+        SHAPE, mesh, dtype=CDT,
+        in_spec=P("slab", None, None), out_spec=P("slab", None, None),
+    )
+    assert not plan.logic.out_absorbed
+    assert plan.out_sharding.spec == P("slab", None, None)
+    assert len(_collectives(plan)) == 2
+    _check(plan, x := _world(), np.fft.fftn(x))
+
+
+def test_slab_backward_absorbed_roundtrip():
+    mesh = dfft.make_mesh(8)
+    fwd = dfft.plan_dft_c2c_3d(
+        SHAPE, mesh, dtype=CDT, in_spec=P(None, "slab", None)
+    )
+    bwd = dfft.plan_dft_c2c_3d(
+        SHAPE, mesh, dtype=CDT, direction=dfft.BACKWARD,
+        in_spec=P("slab", None, None), out_spec=P(None, "slab", None),
+    )
+    assert bwd.logic.slab_axes == (0, 1)
+    assert len(_collectives(bwd)) == 1
+    x = _world()
+    r = np.asarray(bwd(fwd(jnp.asarray(x))))
+    assert np.max(np.abs(r - x)) / np.max(np.abs(x)) < 1e-11
+
+
+def test_slab_uneven_absorbed_layout():
+    """Absorbed layouts keep the pad/crop discipline for uneven extents."""
+    shape = (10, 9, 7)
+    mesh = dfft.make_mesh(8)
+    plan = dfft.plan_dft_c2c_3d(
+        shape, mesh, dtype=CDT, in_spec=P(None, "slab", None)
+    )
+    _check(plan, x := _world(shape), np.fft.fftn(x))
+
+
+# ----------------------------------------------------------- pencil chains
+
+def test_canonical_pencil_has_two_collectives():
+    mesh = dfft.make_mesh((2, 4))
+    plan = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT)
+    assert len(_collectives(plan)) == 2
+    _check(plan, x := _world(), np.fft.fftn(x))
+
+
+def test_pencil_in_perm_absorbed():
+    """Input y-pencils (row on axis 0, col on axis 2): the chain starts
+    there; still exactly two collectives, no edge reshard."""
+    mesh = dfft.make_mesh((2, 4))
+    plan = dfft.plan_dft_c2c_3d(
+        SHAPE, mesh, dtype=CDT, in_spec=P("row", None, "col")
+    )
+    assert plan.logic.pencil_perm == (0, 2, 1)
+    assert plan.logic.in_absorbed
+    assert plan.in_sharding.spec == P("row", None, "col")
+    assert len(_collectives(plan)) == 2
+    _check(plan, x := _world(), np.fft.fftn(x))
+
+
+def test_pencil_out_spec_selects_row_first_order():
+    """An out_spec reachable by the row-first exchange order flips the chain
+    instead of appending a reshard: still two collectives."""
+    mesh = dfft.make_mesh((2, 4))
+    # default perm (0,1,2); row_first output = (row->2, col->0).
+    plan = dfft.plan_dft_c2c_3d(
+        SHAPE, mesh, dtype=CDT, out_spec=P("col", None, "row")
+    )
+    assert plan.logic.pencil_order == "row_first"
+    assert plan.logic.out_absorbed
+    assert plan.out_sharding.spec == P("col", None, "row")
+    assert len(_collectives(plan)) == 2
+    _check(plan, x := _world(), np.fft.fftn(x))
+
+
+def test_pencil_unreachable_out_spec_adds_reshard():
+    mesh = dfft.make_mesh((2, 4))
+    # Neither chain order ends row->0, col->1 from perm (0,1,2).
+    plan = dfft.plan_dft_c2c_3d(
+        SHAPE, mesh, dtype=CDT, out_spec=P("row", "col", None)
+    )
+    assert not plan.logic.out_absorbed
+    assert len(_collectives(plan)) >= 3
+    _check(plan, x := _world(), np.fft.fftn(x))
+
+
+# ------------------------------------------------------------- classifier
+
+def test_classify_layouts():
+    m1 = dfft.make_mesh(8)
+    assert classify_layout(m1, P("slab", None, None)) == ("slab", (0,))
+    assert classify_layout(m1, P(None, None, "slab")) == ("slab", (2,))
+    assert classify_layout(m1, P(None, None, None)) == ("other", ())
+    m2 = dfft.make_mesh((2, 4))
+    assert classify_layout(m2, P("row", "col", None)) == ("pencil", (0, 1))
+    assert classify_layout(m2, P("col", None, "row")) == ("pencil", (2, 0))
+    assert classify_layout(m2, P("row", None, None)) == ("other", ())
+    assert classify_layout(m2, P(("row", "col"), None, None)) == ("other", ())
+    with pytest.raises(ValueError):
+        classify_layout(m1, P("nope", None, None))
+
+
+# ----------------------------------------------------- device negotiation
+
+def test_renegotiation_free_shrink():
+    """8x8 planes on 7 devices: shrinking to 4 keeps ceil-shards identical
+    (2 planes/device) while removing all padding — auto shrinks (the
+    getProperDeviceNum analog, fft_mpi_3d_api.cpp:232-272)."""
+    lp = logic_plan3d((8, 8, 32), 7)
+    assert lp.mesh.devices.size == 4
+    assert lp.negotiated == (7, 4, "auto: even shards at equal per-device compute")
+
+
+def test_renegotiation_keeps_when_costly():
+    """Prime extents: the only evenly-dividing count is 1; auto keeps all
+    devices and records the justification."""
+    lp = logic_plan3d((13, 13, 13), 7)
+    assert lp.mesh.devices.size == 7
+    assert lp.negotiated is not None and lp.negotiated[1] == 7
+    assert "kept" in lp.negotiated[2]
+
+
+def test_renegotiation_force_and_never():
+    lp = logic_plan3d((13, 13, 13), 6, PlanOptions(renegotiate="force"))
+    assert lp.decomposition == "single"  # shrunk to 1
+    lp = logic_plan3d((8, 8, 32), 7, PlanOptions(renegotiate="never"))
+    assert lp.mesh.devices.size == 7 and lp.negotiated is None
+
+
+def test_renegotiation_judged_on_absorbed_axes():
+    """The shrink decision must look at the ACTUAL chain axes after layout
+    absorption: with input slabs on axis 2 (extent 6), shrinking 7 -> 4
+    would be 'free' on the canonical axes (0, 1) but grows the axis-2
+    shard from ceil(6/7)=1 to 2 — so the planner must keep 7."""
+    plan = dfft.plan_dft_c2c_3d(
+        (8, 8, 6), 7, dtype=CDT, in_spec=P(None, None, "slab")
+    )
+    assert plan.logic.slab_axes[0] == 2
+    assert plan.mesh.devices.size == 7
+    assert plan.logic.negotiated is not None and "kept" in plan.logic.negotiated[2]
+    _check(plan, x := _world((8, 8, 6)), np.fft.fftn(x))
+
+
+def test_renegotiated_plan_correct_and_documented():
+    plan = dfft.plan_dft_c2c_3d((8, 8, 32), 7, dtype=CDT)
+    assert plan.mesh.devices.size == 4
+    assert "device negotiation" in dfft.plan_info(plan)
+    _check(plan, x := _world((8, 8, 32)), np.fft.fftn(x))
+
+
+# ------------------------------------------------- min-surface pencil grid
+
+def test_pencil_grid_min_surface_noncubic():
+    """Non-cubic worlds get a surface-minimizing grid, not the blind
+    most-square factorization (proc_setup_min_surface role,
+    heffte_geometry.h:589-626)."""
+    assert native.pencil_grid((256, 2048, 256), 8) == (1, 8)
+    assert native.pencil_grid((64, 64, 64), 8) == (4, 2)  # cube: most-square
+    # Parity with the pure-Python fallback.
+    for shape in [(256, 2048, 256), (64, 64, 64), (100, 70, 33)]:
+        for p in [1, 2, 4, 8, 16]:
+            assert native.pencil_grid(shape, p) == geo.pencil_grid_min_surface(
+                shape, p
+            )
+
+
+def test_planner_uses_min_surface_grid():
+    lp = logic_plan3d(
+        (4, 64, 4), 8, PlanOptions(decomposition="pencil")
+    )
+    r, c = (lp.mesh.shape[a] for a in lp.mesh.axis_names[:2])
+    assert (r, c) == native.pencil_grid((4, 64, 4), 8)
+    plan = dfft.plan_dft_c2c_3d(
+        (4, 64, 4), 8, dtype=CDT, decomposition="pencil"
+    )
+    _check(plan, x := _world((4, 64, 4)), np.fft.fftn(x))
