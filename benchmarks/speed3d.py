@@ -47,6 +47,10 @@ def parse_args(argv):
     a.add_argument("-a2a", action="store_true", help="fused all_to_all exchange (default)")
     a.add_argument("-p2p_pl", action="store_true",
                    help="pipelined ppermute ring exchange (p2p_plined analog)")
+    a.add_argument("-a2av", action="store_true",
+                   help="masked ragged all-to-all shipping true slices "
+                        "(MPI_Alltoallv analog; TPU backend only, the CPU "
+                        "test backend mirrors the dense path)")
     p.add_argument("-executor", default="xla", help="local FFT backend (xla|matmul|...)")
     p.add_argument("-ndev", type=int, default=None, help="device count (default: all)")
     p.add_argument("-grid", type=int, nargs=2, metavar=("R", "C"),
@@ -64,6 +68,15 @@ def parse_args(argv):
     p.add_argument("-no-verify", action="store_true",
                    help="skip the roundtrip error check")
     return p.parse_args(argv)
+
+
+def mesh_prod(mesh, entry) -> int:
+    """Product of mesh-axis sizes named by one PartitionSpec entry."""
+    names = entry if isinstance(entry, tuple) else (entry,)
+    p = 1
+    for nm in names:
+        p *= mesh.shape[nm]
+    return p
 
 
 def main(argv=None) -> None:
@@ -96,7 +109,8 @@ def main(argv=None) -> None:
     shape = (args.nx, args.ny, args.nz)
     dtype = jnp.complex128 if args.precision == "double" else jnp.complex64
     ndev = args.ndev or len(jax.devices())
-    algorithm = "ppermute" if args.p2p_pl else "alltoall"
+    algorithm = ("ppermute" if args.p2p_pl
+                 else "alltoallv" if args.a2av else "alltoall")
 
     if args.grid:
         mesh = dfft.make_mesh(tuple(args.grid))
@@ -124,10 +138,18 @@ def main(argv=None) -> None:
     print(dfft.plan_info(fwd))
 
     # On-device deterministic init (the reference inits on device too,
-    # fftSpeed3d_c2c.cpp:61-72).
+    # fftSpeed3d_c2c.cpp:61-72). Sharding hints need divisible extents;
+    # uneven plans place the (padded) sharding themselves.
     mk_kw = {}
     if fwd.in_sharding is not None:
-        mk_kw["out_shardings"] = fwd.in_sharding
+        from distributedfft_tpu.plan_logic import spec_entries
+
+        divides = all(
+            e is None or shape[d] % mesh_prod(fwd.mesh, e) == 0
+            for d, e in enumerate(spec_entries(fwd.mesh, fwd.in_sharding.spec, 3))
+        )
+        if divides:
+            mk_kw["out_shardings"] = fwd.in_sharding
 
     @functools.partial(jax.jit, **mk_kw)
     def make_input():
@@ -148,16 +170,43 @@ def main(argv=None) -> None:
 
     stage_times = None
     if args.staged:
-        if fwd.decomposition != "slab" or args.kind != "c2c":
-            print("note: -staged supports the slab c2c pipeline; ignoring",
+        stages = None
+        if fwd.mesh is None:
+            print("note: -staged needs a multi-device plan; ignoring",
                   file=sys.stderr)
-        else:
+        elif fwd.decomposition == "slab" and args.kind == "c2c":
             from distributedfft_tpu.parallel.slab import build_slab_stages
 
             stages, _ = build_slab_stages(
                 fwd.mesh, shape, axis_name=fwd.mesh.axis_names[0],
                 executor=args.executor, algorithm=algorithm,
             )
+        elif fwd.decomposition == "slab":
+            from distributedfft_tpu.parallel.staged import build_slab_rfft_stages
+
+            stages, _ = build_slab_rfft_stages(
+                fwd.mesh, shape, axis_name=fwd.mesh.axis_names[0],
+                executor=args.executor, algorithm=algorithm,
+            )
+        elif args.kind == "c2c":
+            from distributedfft_tpu.parallel.staged import build_pencil_stages
+
+            stages, _ = build_pencil_stages(
+                fwd.mesh, shape, row_axis=fwd.mesh.axis_names[0],
+                col_axis=fwd.mesh.axis_names[1], executor=args.executor,
+                algorithm=algorithm,
+            )
+        else:
+            from distributedfft_tpu.parallel.staged import (
+                build_pencil_rfft_stages,
+            )
+
+            stages, _ = build_pencil_rfft_stages(
+                fwd.mesh, shape, row_axis=fwd.mesh.axis_names[0],
+                col_axis=fwd.mesh.axis_names[1], executor=args.executor,
+                algorithm=algorithm,
+            )
+        if stages is not None:
             stage_times, _ = time_staged(stages, x, iters=args.iters)
 
     import contextlib

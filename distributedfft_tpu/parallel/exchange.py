@@ -7,11 +7,21 @@ implementations ``src/heffte_reshape3d.cpp:268,375,497-625``) and the
 first-party engine's hand-rolled peer DMA + MPI_Isend/Irecv tables
 (``3dmpifft_opt/include/fft_mpi_3d_api.cpp:610-699``).
 
-The TPU-native menu has two entries, selected per plan:
+The TPU-native menu has three entries, selected per plan:
 
 - ``"alltoall"`` — one ``jax.lax.all_to_all`` on the mesh axis. XLA lowers
   this to the platform all-to-all riding ICI; the analog of
-  ``MPI_Alltoall`` with equal (ceil-padded) counts.
+  ``MPI_Alltoall`` with equal (ceil-padded) counts
+  (``reshape3d_alltoall``, ``src/heffte_reshape3d.cpp:268`` pads to equal
+  counts the same way).
+- ``"alltoallv"`` — one ``jax.lax.ragged_all_to_all`` shipping each peer's
+  TRUE slice of the split axis (no split-axis padding on the wire) — the
+  analog of ``MPI_Alltoallv`` with the exact per-peer count tables
+  (``reshape3d_alltoallv``, ``src/heffte_reshape3d.cpp:375``;
+  count/offset semantics = ``dfft_exchange_table``,
+  ``native/dfft_native.cpp``). Concat-axis padding (each sender's equal
+  ceil-chunk block, zero rows on the tail device) is inherent to the SPMD
+  equal-shard layout and still travels.
 - ``"ppermute"`` — an explicit (P-1)-step ring of ``jax.lax.ppermute``
   neighbor shifts, each step moving one peer's chunk. The analog of the
   pipelined point-to-point path (``reshape3d_pointtopoint``,
@@ -19,16 +29,38 @@ The TPU-native menu has two entries, selected per plan:
   nearest-neighbor permutes that map 1:1 onto ICI ring links, and XLA can
   overlap each step's transfer with the next step's slice/update work.
 
-Both require equal chunk sizes — the ceil-pad/crop scheme of
-:mod:`.slab` / :mod:`.pencil` guarantees that.
+``alltoall``/``ppermute`` require equal chunk sizes — the ceil-pad/crop
+scheme of :mod:`.slab` / :mod:`.pencil` (via :func:`exchange_uneven`)
+guarantees that; ``alltoallv`` takes the unpadded split axis directly.
 """
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax.numpy as jnp
 from jax import lax
 
-ALGORITHMS = ("alltoall", "ppermute")
+from ..geometry import pad_to
+
+ALGORITHMS = ("alltoall", "alltoallv", "ppermute")
+
+
+def _pad_axis(x: jnp.ndarray, axis: int, to: int) -> jnp.ndarray:
+    """Zero-pad ``axis`` up to extent ``to`` (no-op when already there).
+    Single definition shared by every chain builder and exchange path — the
+    dense and ragged paths depend on bit-identical padding."""
+    if x.shape[axis] == to:
+        return x
+    pads = [(0, 0)] * x.ndim
+    pads[axis] = (0, to - x.shape[axis])
+    return jnp.pad(x, pads)
+
+
+def _crop_axis(x: jnp.ndarray, axis: int, to: int) -> jnp.ndarray:
+    if x.shape[axis] == to:
+        return x
+    return lax.slice_in_dim(x, 0, to, axis=axis)
 
 
 def exchange(
@@ -50,11 +82,122 @@ def exchange(
         return lax.all_to_all(
             x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=True
         )
+    if algorithm == "alltoallv":
+        return ragged_all_to_all_exchange(
+            x, axis_name, split_axis=split_axis, concat_axis=concat_axis,
+            p=axis_size,
+        )
     if algorithm == "ppermute":
         return ring_all_to_all(
             x, axis_name, split_axis=split_axis, concat_axis=concat_axis, p=axis_size
         )
     raise ValueError(f"unknown exchange algorithm {algorithm!r}; use {ALGORITHMS}")
+
+
+def exchange_uneven(
+    x: jnp.ndarray,
+    axis_name: str,
+    *,
+    split_axis: int,
+    concat_axis: int,
+    axis_size: int,
+    algorithm: str = "alltoall",
+    platform: str | None = None,
+) -> jnp.ndarray:
+    """Exchange whose split-axis extent need not divide ``axis_size``.
+
+    The dense algorithms ceil-pad the split axis first (the reference's
+    padded-equal-counts strategy, ``src/heffte_reshape3d.cpp:268``);
+    ``alltoallv`` ships the true slices unpadded. Either way the result's
+    split axis holds the local ceil-chunk (padded at the tail) and the
+    concat axis holds ``axis_size`` ceil-chunks per sender — callers crop
+    the concat axis to its true extent exactly as before. ``platform`` is
+    the mesh devices' platform (used by ``alltoallv`` to pick the real
+    ragged collective vs its CPU mirror).
+    """
+    if algorithm == "alltoallv":
+        return ragged_all_to_all_exchange(
+            x, axis_name, split_axis=split_axis, concat_axis=concat_axis,
+            p=axis_size, platform=platform,
+        )
+    x = _pad_axis(x, split_axis, pad_to(x.shape[split_axis], axis_size))
+    return exchange(x, axis_name, split_axis=split_axis,
+                    concat_axis=concat_axis, axis_size=axis_size,
+                    algorithm=algorithm)
+
+
+def ragged_all_to_all_exchange(
+    x: jnp.ndarray, axis_name: str, *, split_axis: int, concat_axis: int,
+    p: int, platform: str | None = None,
+) -> jnp.ndarray:
+    """All-to-all transpose shipping each peer's TRUE split-axis slice.
+
+    The MPI_Alltoallv analog (``reshape3d_alltoallv``,
+    ``src/heffte_reshape3d.cpp:375``): where the dense path pads the split
+    axis to ``p * ceil(S/p)`` and ships the padding, this sends peer ``j``
+    exactly its ``sizes[j]`` true elements via ``lax.ragged_all_to_all``.
+    The per-peer counts/offsets follow the ceil-split ownership convention —
+    the same tables ``dfft_exchange_table`` computes (elements =
+    ``rows * sizes[j] * inner``).
+
+    Takes the UNPADDED split axis (extent S = the true global extent of the
+    post-exchange sharded axis); returns the same shape the padded path
+    would: split axis -> local ceil chunk ``c``, concat axis ->
+    ``p * local_chunk`` (each sender's equal-size block, tail padding
+    included — that padding is the SPMD equal-shard layout itself and is
+    cropped by the caller, never transformed).
+    """
+    import jax
+
+    S = x.shape[split_axis]
+    c = -(-S // p)
+    if platform is None:
+        platform = jax.default_backend()
+    if platform == "cpu":
+        # XLA:CPU has no ragged-all-to-all lowering; the ceil-padded dense
+        # exchange produces the bit-identical result (the padding positions
+        # the ragged path never writes stay zero either way), so the CPU
+        # test backend mirrors through it — the same discipline as the
+        # Pallas kernel's interpreter-mode mirror.
+        x = _pad_axis(x, split_axis, p * c)
+        return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+    i = lax.axis_index(axis_name)
+    # Static per-peer ownership of the split axis (ceil splits, short/empty
+    # tail) — the dfft_exchange_table geometry.
+    bounds = np.minimum(np.arange(p + 1) * c, S)
+    starts, sizes = bounds[:-1], np.diff(bounds)
+
+    xt = jnp.moveaxis(x, split_axis, 0)
+    rest = xt.shape[1:]
+    out = jnp.zeros((p * c,) + rest, x.dtype)
+    my_size = jnp.minimum((i + 1) * c, S) - jnp.minimum(i * c, S)
+    y = lax.ragged_all_to_all(
+        xt, out,
+        jnp.asarray(starts, jnp.int32),
+        jnp.asarray(sizes, jnp.int32),
+        # Sender i's slice lands at leading offset i*c on every receiver.
+        jnp.full((p,), i * c, jnp.int32),
+        jnp.full((p,), my_size, jnp.int32),
+        axis_name=axis_name,
+    )
+    # y: [p, c, *rest] with the sender dim to be merged into the concat
+    # axis (sender-major) and the local split chunk moved back into place.
+    y = y.reshape((p, c) + rest)
+    cpos = 1 + (concat_axis if concat_axis < split_axis else concat_axis - 1)
+    perm = [1]
+    for k in range(len(rest)):
+        ax = 2 + k
+        if k == cpos - 1:
+            perm.extend([0, ax])
+        else:
+            perm.append(ax)
+    y = y.transpose(perm)
+    j = perm.index(0)
+    shp = list(y.shape)
+    shp[j:j + 2] = [p * shp[j + 1]]
+    y = y.reshape(shp)
+    return jnp.moveaxis(y, 0, split_axis)
 
 
 def ring_all_to_all(

@@ -39,7 +39,7 @@ except ImportError:  # pragma: no cover
 
 from ..geometry import pad_to
 from ..ops.executors import get_c2r, get_executor, get_r2c
-from .exchange import exchange
+from .exchange import exchange, exchange_uneven
 from .slab import _crop_axis, _pad_axis
 
 
@@ -150,9 +150,9 @@ def build_pencil_general(
     def local_fn(x):
         for mesh_ax, parts, split, concat in seq:
             x = ex(x, (split,), forward)
-            x = _pad_axis(x, split, pad_to(n[split], parts))
-            x = exchange(x, mesh_ax, split_axis=split, concat_axis=concat,
-                         axis_size=parts, algorithm=algorithm)
+            x = exchange_uneven(x, mesh_ax, split_axis=split,
+                                concat_axis=concat, axis_size=parts,
+                                algorithm=algorithm)
             x = _crop_axis(x, concat, n[concat])
         return ex(x, (last_fft,), forward)
 
@@ -263,14 +263,12 @@ def build_pencil_rfft3d(
 
         def local_fn(x):  # real [n0p/rows, n1pc/cols, N2]
             y = r2c(x, 2)                               # t0: real Z lines
-            y = _pad_axis(y, 2, n2hp)
-            y = exchange(y, col_axis, split_axis=2, concat_axis=1, axis_size=cols,
-                         algorithm=algorithm)
+            y = exchange_uneven(y, col_axis, split_axis=2, concat_axis=1,
+                                axis_size=cols, algorithm=algorithm)
             y = _crop_axis(y, 1, n1)
             y = ex(y, (1,), True)                       # Y lines
-            y = _pad_axis(y, 1, n1pr)
-            y = exchange(y, row_axis, split_axis=1, concat_axis=0, axis_size=rows,
-                         algorithm=algorithm)
+            y = exchange_uneven(y, row_axis, split_axis=1, concat_axis=0,
+                                axis_size=rows, algorithm=algorithm)
             y = _crop_axis(y, 0, n0)
             return ex(y, (0,), True)                    # t3: X lines
 
@@ -281,14 +279,12 @@ def build_pencil_rfft3d(
 
         def local_fn(y):  # complex [N0, n1pr/rows, n2hp/cols]
             x = ex(y, (0,), False)                      # inverse X lines
-            x = _pad_axis(x, 0, n0p)
-            x = exchange(x, row_axis, split_axis=0, concat_axis=1, axis_size=rows,
-                         algorithm=algorithm)
+            x = exchange_uneven(x, row_axis, split_axis=0, concat_axis=1,
+                                axis_size=rows, algorithm=algorithm)
             x = _crop_axis(x, 1, n1)
             x = ex(x, (1,), False)                      # inverse Y lines
-            x = _pad_axis(x, 1, n1pc)
-            x = exchange(x, col_axis, split_axis=1, concat_axis=2, axis_size=cols,
-                         algorithm=algorithm)
+            x = exchange_uneven(x, col_axis, split_axis=1, concat_axis=2,
+                                axis_size=cols, algorithm=algorithm)
             x = _crop_axis(x, 2, n2h)
             return c2r(x, n2, 2)                        # real Z lines
 

@@ -47,21 +47,9 @@ except ImportError:  # pragma: no cover
 
 from ..geometry import pad_to
 from ..ops.executors import get_c2r, get_executor, get_r2c
-from .exchange import exchange
-
-
-def _pad_axis(x: jnp.ndarray, axis: int, to: int) -> jnp.ndarray:
-    if x.shape[axis] == to:
-        return x
-    pads = [(0, 0)] * x.ndim
-    pads[axis] = (0, to - x.shape[axis])
-    return jnp.pad(x, pads)
-
-
-def _crop_axis(x: jnp.ndarray, axis: int, to: int) -> jnp.ndarray:
-    if x.shape[axis] == to:
-        return x
-    return lax.slice_in_dim(x, 0, to, axis=axis)
+# _pad_axis/_crop_axis live in exchange.py (single definition shared with
+# the ragged path) and are re-exported here for the other chain builders.
+from .exchange import _crop_axis, _pad_axis, exchange, exchange_uneven
 
 
 @dataclass(frozen=True)
@@ -151,12 +139,15 @@ def build_slab_general(
     n_in, n_out = spec.shape[in_axis], spec.shape[out_axis]
     n_inp, n_outp = spec.in_padded_extent, spec.out_padded_extent
     local_axes = tuple(a for a in range(3) if a != in_axis)
+    platform = mesh.devices.flat[0].platform
 
     def local_fn(x):  # in_axis extent n_inp/p per device, others full
         y = ex(x, local_axes, forward)                   # t0: local planes
-        y = _pad_axis(y, out_axis, n_outp)               # t1: exchange prep
-        y = exchange(y, axis_name, split_axis=out_axis, concat_axis=in_axis,
-                     axis_size=p, algorithm=algorithm)   # t2: global transpose
+        # t1 (exchange prep: dense algorithms ceil-pad the split axis;
+        # alltoallv ships the true slices) + t2 (global transpose).
+        y = exchange_uneven(y, axis_name, split_axis=out_axis,
+                            concat_axis=in_axis, axis_size=p,
+                            algorithm=algorithm, platform=platform)
         y = _crop_axis(y, in_axis, n_in)                 # drop in-axis padding
         return ex(y, (in_axis,), forward)                # t3: final lines
 
@@ -250,9 +241,8 @@ def build_slab_rfft3d(
         def local_fn(x):  # real [n0p/p, N1, N2] per device
             y = r2c(x, 2)                                # t0a: real Z lines
             y = ex(y, (1,), True)                        # t0b: Y lines
-            y = _pad_axis(y, 1, n1p)
-            y = exchange(y, axis_name, split_axis=1, concat_axis=0, axis_size=p,
-                         algorithm=algorithm)
+            y = exchange_uneven(y, axis_name, split_axis=1, concat_axis=0,
+                                axis_size=p, algorithm=algorithm)
             y = _crop_axis(y, 0, n0)
             return ex(y, (0,), True)                     # t3: X lines
 
@@ -262,9 +252,8 @@ def build_slab_rfft3d(
 
         def local_fn(y):  # complex [N0, n1p/p, n2h] per device
             x = ex(y, (0,), False)                       # inverse X lines
-            x = _pad_axis(x, 0, n0p)
-            x = exchange(x, axis_name, split_axis=0, concat_axis=1, axis_size=p,
-                         algorithm=algorithm)
+            x = exchange_uneven(x, axis_name, split_axis=0, concat_axis=1,
+                                axis_size=p, algorithm=algorithm)
             x = _crop_axis(x, 1, n1)
             x = ex(x, (1,), False)                       # inverse Y lines
             return c2r(x, n2, 2)                         # real Z lines

@@ -1,0 +1,339 @@
+"""Separately-jitted stage pipelines for per-stage timing — all plan kinds.
+
+The reference prints a per-stage wall-time breakdown on every distributed
+execute (t0 fftZY / t1 transpose / t2 all-to-all / t3 fftX,
+``fft_mpi_3d_api.cpp:184-201``, ``README.md:44-58``) for every benchmarkable
+config. Fusing the whole transform under one jit hides the ICI cost
+(SURVEY.md §7), so benchmarking keeps a staged mode: each stage is its own
+jit, synchronized and timed by :func:`..utils.timing.time_staged`.
+
+:mod:`.slab` provides ``build_slab_stages`` for the slab c2c plan; this
+module adds the pencil c2c pipeline (two exchanges -> t2a/t2b lines) and the
+r2c/c2r pipelines for both decompositions. Stage boundaries carry
+ceil-padded global arrays; shardings are established with
+``with_sharding_constraint`` inside each stage (not pinned on the jits), so
+uneven extents — e.g. the r2c half-spectrum n2//2+1, which almost never
+divides the mesh — work in staged mode too.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from ..geometry import pad_to
+from ..ops.executors import get_c2r, get_executor, get_r2c
+from .exchange import exchange
+from .pencil import PencilSpec
+from .slab import SlabSpec, _crop_axis, _pad_axis
+
+__all__ = [
+    "build_pencil_stages",
+    "build_slab_rfft_stages",
+    "build_pencil_rfft_stages",
+]
+
+_AXIS_LETTER = "xyz"
+
+
+def _pspec(mapping: dict[int, str]) -> P:
+    return P(*[mapping.get(d) for d in range(3)])
+
+
+def build_pencil_stages(
+    mesh: Mesh,
+    shape: tuple[int, int, int],
+    *,
+    row_axis: str = "row",
+    col_axis: str = "col",
+    executor: str | Callable = "xla",
+    forward: bool = True,
+    algorithm: str = "alltoall",
+    perm: tuple[int, int, int] | None = None,
+    order: str | None = None,
+) -> tuple[list[tuple[str, Callable]], PencilSpec]:
+    """Pencil c2c transform as five timed stages:
+    t0 (first fft) | t2a (first exchange) | t1 (mid fft) | t2b (second
+    exchange) | t3 (last fft) — the reference's taxonomy with the two
+    pencil exchanges split out as t2a/t2b."""
+    if perm is None:
+        perm = (0, 1, 2) if forward else (1, 2, 0)
+    if order is None:
+        order = "col_first" if forward else "row_first"
+    rows, cols = mesh.shape[row_axis], mesh.shape[col_axis]
+    spec = PencilSpec(tuple(int(s) for s in shape), rows, cols,
+                      row_axis, col_axis, tuple(perm), order)
+    ex = get_executor(executor) if isinstance(executor, str) else executor
+    n = spec.shape
+    a, b, c = perm
+    if order == "col_first":
+        seq = [(col_axis, cols, c, b), (row_axis, rows, b, a)]
+        mid_fft, last_fft = b, a
+    else:
+        seq = [(row_axis, rows, c, a), (col_axis, cols, a, b)]
+        mid_fft, last_fft = a, b
+
+    in_lay = {a: row_axis, b: col_axis}
+    mid_lay = ({a: row_axis, c: col_axis} if order == "col_first"
+               else {c: row_axis, b: col_axis})
+    op = spec.out_placement
+    out_lay = {op[0]: row_axis, op[1]: col_axis}
+
+    sh = lambda lay: NamedSharding(mesh, _pspec(lay))
+    in_sh, mid_sh, out_sh = sh(in_lay), sh(mid_lay), sh(out_lay)
+    pads = {a: pad_to(n[a], rows), b: pad_to(n[b], cols)}
+    # each exchange's split axis is padded to its part count before it runs
+    pads[seq[0][2]] = pad_to(n[seq[0][2]], seq[0][1])
+    mid_pad = pad_to(n[seq[1][2]], seq[1][1])
+
+    def smap(f, lay_in, lay_out):
+        return _shard_map(f, mesh=mesh, in_specs=(_pspec(lay_in),),
+                          out_specs=_pspec(lay_out))
+
+    def t0(x):
+        x = _pad_axis(_pad_axis(x, a, pads[a]), b, pads[b])
+        x = lax.with_sharding_constraint(x, in_sh)
+        y = smap(lambda v: ex(v, (c,), forward), in_lay, in_lay)(x)
+        y = _pad_axis(y, seq[0][2], pads[seq[0][2]])
+        return lax.with_sharding_constraint(y, in_sh)
+
+    def t2a(x):
+        x = lax.with_sharding_constraint(x, in_sh)
+        mesh_ax, parts, split, concat = seq[0]
+        y = smap(lambda v: exchange(v, mesh_ax, split_axis=split,
+                                    concat_axis=concat, axis_size=parts,
+                                    algorithm=algorithm), in_lay, mid_lay)(x)
+        return lax.with_sharding_constraint(y, mid_sh)
+
+    def t1(x):
+        x = lax.with_sharding_constraint(x, mid_sh)
+        concat0 = seq[0][3]
+        y = smap(lambda v: _pad_axis(
+            ex(_crop_axis(v, concat0, n[concat0]), (mid_fft,), forward),
+            seq[1][2], mid_pad), mid_lay, mid_lay)(x)
+        return lax.with_sharding_constraint(y, mid_sh)
+
+    def t2b(x):
+        x = lax.with_sharding_constraint(x, mid_sh)
+        mesh_ax, parts, split, concat = seq[1]
+        y = smap(lambda v: exchange(v, mesh_ax, split_axis=split,
+                                    concat_axis=concat, axis_size=parts,
+                                    algorithm=algorithm), mid_lay, out_lay)(x)
+        return lax.with_sharding_constraint(y, out_sh)
+
+    def t3(x):
+        x = lax.with_sharding_constraint(x, out_sh)
+        concat1 = seq[1][3]
+        y = smap(lambda v: ex(_crop_axis(v, concat1, n[concat1]),
+                              (last_fft,), forward), out_lay, out_lay)(x)
+        for ax in op:
+            y = _crop_axis(y, ax, n[ax])
+        return y
+
+    L = _AXIS_LETTER
+    stages = [
+        (f"t0_fft_{L[c]}", jax.jit(t0)),
+        (f"t2a_exchange_{seq[0][0]}", jax.jit(t2a)),
+        (f"t1_fft_{L[mid_fft]}", jax.jit(t1)),
+        (f"t2b_exchange_{seq[1][0]}", jax.jit(t2b)),
+        (f"t3_fft_{L[last_fft]}", jax.jit(t3)),
+    ]
+    return stages, spec
+
+
+def build_slab_rfft_stages(
+    mesh: Mesh,
+    shape: tuple[int, int, int],
+    *,
+    axis_name: str = "slab",
+    executor: str = "xla",
+    forward: bool = True,
+    algorithm: str = "alltoall",
+) -> tuple[list[tuple[str, Callable]], SlabSpec]:
+    """Slab r2c (forward) / c2r (backward) as three timed stages — the
+    per-stage breakdown for every benchmarkable r2c config
+    (``fft_mpi_3d_api.cpp:184-201`` prints it for every run)."""
+    p = mesh.shape[axis_name]
+    spec = SlabSpec(tuple(int(s) for s in shape), p, axis_name,
+                    in_axis=0 if forward else 1, out_axis=1 if forward else 0)
+    ex = get_executor(executor)
+    r2c, c2r = get_r2c(executor), get_c2r(executor)
+    n0, n1, n2 = spec.shape
+    n0p, n1p = spec.n0p, spec.n1p
+    xs, ys = P(axis_name, None, None), P(None, axis_name, None)
+    x_sh, y_sh = NamedSharding(mesh, xs), NamedSharding(mesh, ys)
+
+    def smap(f, i, o):
+        return _shard_map(f, mesh=mesh, in_specs=(i,), out_specs=o)
+
+    if forward:
+
+        def t0(x):  # real [n0, n1, n2] -> complex [n0p, n1p, n2h]
+            x = lax.with_sharding_constraint(_pad_axis(x, 0, n0p), x_sh)
+            y = smap(lambda v: _pad_axis(
+                ex(r2c(v, 2), (1,), True), 1, n1p), xs, xs)(x)
+            return lax.with_sharding_constraint(y, x_sh)
+
+        def t2(y):
+            y = lax.with_sharding_constraint(y, x_sh)
+            z = smap(lambda v: exchange(v, axis_name, split_axis=1,
+                                        concat_axis=0, axis_size=p,
+                                        algorithm=algorithm), xs, ys)(y)
+            return lax.with_sharding_constraint(z, y_sh)
+
+        def t3(z):
+            z = lax.with_sharding_constraint(z, y_sh)
+            w = smap(lambda v: ex(_crop_axis(v, 0, n0), (0,), True),
+                     ys, ys)(z)
+            return _crop_axis(w, 1, n1)
+
+        stages = [("t0_r2c_zy", jax.jit(t0)),
+                  ("t2_exchange", jax.jit(t2)),
+                  ("t3_fft_x", jax.jit(t3))]
+    else:
+
+        def t3i(z):  # complex [n0, n1, n2h] y-slabs
+            z = lax.with_sharding_constraint(_pad_axis(z, 1, n1p), y_sh)
+            w = smap(lambda v: _pad_axis(ex(v, (0,), False), 0, n0p),
+                     ys, ys)(z)
+            return lax.with_sharding_constraint(w, y_sh)
+
+        def t2(w):
+            w = lax.with_sharding_constraint(w, y_sh)
+            u = smap(lambda v: exchange(v, axis_name, split_axis=0,
+                                        concat_axis=1, axis_size=p,
+                                        algorithm=algorithm), ys, xs)(w)
+            return lax.with_sharding_constraint(u, x_sh)
+
+        def t0i(u):
+            u = lax.with_sharding_constraint(u, x_sh)
+            w = smap(lambda v: c2r(ex(_crop_axis(v, 1, n1), (1,), False),
+                                   n2, 2), xs, xs)(u)
+            return _crop_axis(w, 0, n0)
+
+        stages = [("t3_ifft_x", jax.jit(t3i)),
+                  ("t2_exchange", jax.jit(t2)),
+                  ("t0_ifft_y_c2r", jax.jit(t0i))]
+    return stages, spec
+
+
+def build_pencil_rfft_stages(
+    mesh: Mesh,
+    shape: tuple[int, int, int],
+    *,
+    row_axis: str = "row",
+    col_axis: str = "col",
+    executor: str = "xla",
+    forward: bool = True,
+    algorithm: str = "alltoall",
+) -> tuple[list[tuple[str, Callable]], PencilSpec]:
+    """Pencil r2c/c2r as five timed stages with t2a/t2b exchange lines.
+    Canonical chains only (the real axis must be device-local axis 2 on the
+    real side), matching :func:`.pencil.build_pencil_rfft3d`."""
+    rows, cols = mesh.shape[row_axis], mesh.shape[col_axis]
+    spec = PencilSpec(
+        tuple(int(s) for s in shape), rows, cols, row_axis, col_axis,
+        perm=(0, 1, 2) if forward else (1, 2, 0),
+        order="col_first" if forward else "row_first",
+    )
+    ex = get_executor(executor)
+    r2c, c2r = get_r2c(executor), get_c2r(executor)
+    n0, n1, n2 = spec.shape
+    n0p, n1pc, n1pr = spec.n0p, spec.n1p_col, spec.n1p_row
+    n2h = n2 // 2 + 1
+    n2hp = pad_to(n2h, cols)
+    zs, ysp, xs = (P(row_axis, col_axis, None),
+                   P(row_axis, None, col_axis),
+                   P(None, row_axis, col_axis))
+    z_sh, y_sh, x_sh = (NamedSharding(mesh, s) for s in (zs, ysp, xs))
+
+    def smap(f, i, o):
+        return _shard_map(f, mesh=mesh, in_specs=(i,), out_specs=o)
+
+    if forward:
+
+        def t0(x):  # real z-pencils -> half-spectrum, padded for exch
+            x = _pad_axis(_pad_axis(x, 0, n0p), 1, n1pc)
+            x = lax.with_sharding_constraint(x, z_sh)
+            y = smap(lambda v: _pad_axis(r2c(v, 2), 2, n2hp), zs, zs)(x)
+            return lax.with_sharding_constraint(y, z_sh)
+
+        def t2a(y):
+            y = lax.with_sharding_constraint(y, z_sh)
+            z = smap(lambda v: exchange(v, col_axis, split_axis=2,
+                                        concat_axis=1, axis_size=cols,
+                                        algorithm=algorithm), zs, ysp)(y)
+            return lax.with_sharding_constraint(z, y_sh)
+
+        def t1(z):
+            z = lax.with_sharding_constraint(z, y_sh)
+            w = smap(lambda v: _pad_axis(
+                ex(_crop_axis(v, 1, n1), (1,), True), 1, n1pr), ysp, ysp)(z)
+            return lax.with_sharding_constraint(w, y_sh)
+
+        def t2b(w):
+            w = lax.with_sharding_constraint(w, y_sh)
+            u = smap(lambda v: exchange(v, row_axis, split_axis=1,
+                                        concat_axis=0, axis_size=rows,
+                                        algorithm=algorithm), ysp, xs)(w)
+            return lax.with_sharding_constraint(u, x_sh)
+
+        def t3(u):
+            u = lax.with_sharding_constraint(u, x_sh)
+            w = smap(lambda v: ex(_crop_axis(v, 0, n0), (0,), True),
+                     xs, xs)(u)
+            return _crop_axis(_crop_axis(w, 1, n1), 2, n2h)
+
+        stages = [("t0_r2c_z", jax.jit(t0)),
+                  ("t2a_exchange_col", jax.jit(t2a)),
+                  ("t1_fft_y", jax.jit(t1)),
+                  ("t2b_exchange_row", jax.jit(t2b)),
+                  ("t3_fft_x", jax.jit(t3))]
+    else:
+
+        def t3i(u):  # complex x-pencils [n0, n1, n2h]
+            u = _pad_axis(_pad_axis(u, 1, n1pr), 2, n2hp)
+            u = lax.with_sharding_constraint(u, x_sh)
+            w = smap(lambda v: _pad_axis(ex(v, (0,), False), 0, n0p),
+                     xs, xs)(u)
+            return lax.with_sharding_constraint(w, x_sh)
+
+        def t2b(w):
+            w = lax.with_sharding_constraint(w, x_sh)
+            z = smap(lambda v: exchange(v, row_axis, split_axis=0,
+                                        concat_axis=1, axis_size=rows,
+                                        algorithm=algorithm), xs, ysp)(w)
+            return lax.with_sharding_constraint(z, y_sh)
+
+        def t1i(z):
+            z = lax.with_sharding_constraint(z, y_sh)
+            w = smap(lambda v: _pad_axis(
+                ex(_crop_axis(v, 1, n1), (1,), False), 1, n1pc), ysp, ysp)(z)
+            return lax.with_sharding_constraint(w, y_sh)
+
+        def t2a(w):
+            w = lax.with_sharding_constraint(w, y_sh)
+            z = smap(lambda v: exchange(v, col_axis, split_axis=1,
+                                        concat_axis=2, axis_size=cols,
+                                        algorithm=algorithm), ysp, zs)(w)
+            return lax.with_sharding_constraint(z, z_sh)
+
+        def t0i(z):
+            z = lax.with_sharding_constraint(z, z_sh)
+            w = smap(lambda v: c2r(_crop_axis(v, 2, n2h), n2, 2), zs, zs)(z)
+            return _crop_axis(_crop_axis(w, 0, n0), 1, n1)
+
+        stages = [("t3_ifft_x", jax.jit(t3i)),
+                  ("t2b_exchange_row", jax.jit(t2b)),
+                  ("t1_ifft_y", jax.jit(t1i)),
+                  ("t2a_exchange_col", jax.jit(t2a)),
+                  ("t0_c2r_z", jax.jit(t0i))]
+    return stages, spec

@@ -493,6 +493,65 @@ def stage_layouts(
     )
 
 
+def exchange_payloads(lp: LogicPlan, shape, itemsize: int) -> list[dict]:
+    """Per-exchange payload accounting: the TRUE information moved versus
+    the bytes each algorithm ships on the wire.
+
+    The reference sizes true payloads with exact per-peer count tables
+    (``TransInfo``, ``fft_mpi_3d_api.cpp:84-133``; ``dfft_exchange_table``);
+    on TPU the dense ``alltoall`` ships both split- and concat-axis ceil
+    padding, ``alltoallv`` (ragged) strips the split-axis padding, and the
+    concat-axis padding (the SPMD equal-shard layout itself) always
+    travels. Entries: {stage, mesh_axis, parts, true_bytes,
+    alltoall_bytes, alltoallv_bytes}.
+    """
+    if lp.mesh is None:
+        return []
+    shape = tuple(int(s) for s in shape)
+    pad = lambda n, k: k * (-(-n // k))
+    out = []
+    if lp.decomposition == "slab":
+        p = lp.mesh.shape[lp.mesh.axis_names[0]]
+        a_in, a_out = lp.slab_axes if lp.slab_axes else (0, 1)
+        oth = 3 - a_in - a_out
+        n_in, n_out, n_oth = shape[a_in], shape[a_out], shape[oth]
+        f = (p - 1) / p
+        out.append({
+            "stage": "t2", "mesh_axis": lp.mesh.axis_names[0], "parts": p,
+            "true_bytes": int(n_in * n_out * n_oth * f * itemsize),
+            "alltoall_bytes": int(pad(n_in, p) * pad(n_out, p) * n_oth * f
+                                  * itemsize),
+            "alltoallv_bytes": int(pad(n_in, p) * n_out * n_oth * f
+                                   * itemsize),
+        })
+        return out
+    rows, cols = (lp.mesh.shape[ax] for ax in lp.mesh.axis_names[:2])
+    a, b, c = lp.pencil_perm if lp.pencil_perm else (0, 1, 2)
+    order = lp.pencil_order or "col_first"
+    # (stage, mesh_axis_idx, parts, split_axis, padded extents of the two
+    # non-split axes at that stage)
+    pa, pb = pad(shape[a], rows), pad(shape[b], cols)
+    if order == "col_first":
+        pc = pad(shape[c], cols)
+        seq = [("t2a", 1, cols, c, pa * pb), ("t2b", 0, rows, b, pa * pc)]
+    else:
+        pc = pad(shape[c], rows)
+        seq = [("t2a", 0, rows, c, pa * pb), ("t2b", 1, cols, a, pc * pb)]
+    true_vol = shape[0] * shape[1] * shape[2]
+    for stage, ax_i, parts, split, bystander_padded in seq:
+        f = (parts - 1) / parts
+        out.append({
+            "stage": stage, "mesh_axis": lp.mesh.axis_names[ax_i],
+            "parts": parts,
+            "true_bytes": int(true_vol * f * itemsize),
+            "alltoall_bytes": int(bystander_padded * pad(shape[split], parts)
+                                  * f * itemsize),
+            "alltoallv_bytes": int(bystander_padded * shape[split] * f
+                                   * itemsize),
+        })
+    return out
+
+
 def io_boxes(lp: LogicPlan, world_in: geo.Box3, world_out: geo.Box3) -> tuple:
     """Per-device input/output boxes of the plan's own orientation; r2c
     plans pass a shrunk complex-side world."""

@@ -141,6 +141,40 @@ def plan_info(plan) -> str:
             lines.append(
                 f"device negotiation: requested {req} -> using {used} ({reason})"
             )
+        # Exchange payload accounting: true information moved vs bytes on
+        # the wire per algorithm (the count-table role of TransInfo /
+        # outputPlanInfo, fft_mpi_3d_api.cpp:84-133,433-464).
+        if lp.mesh is not None:
+            import numpy as _np
+
+            from ..plan_logic import exchange_payloads
+
+            shape_eff = plan.out_shape if (plan.real and plan.forward) else (
+                plan.in_shape if plan.real else plan.shape
+            )
+            itemsize = _np.dtype(plan.dtype).itemsize
+            mb = 1.0 / (1024 * 1024)
+            for e in exchange_payloads(lp, shape_eff, itemsize):
+                t, d, v = e["true_bytes"], e["alltoall_bytes"], e["alltoallv_bytes"]
+                ov = lambda x: f"+{(x / t - 1) * 100:.1f}%" if t else "n/a"
+                lines.append(
+                    f"exchange {e['stage']} ({e['mesh_axis']}, {e['parts']}-way): "
+                    f"true {t * mb:.2f} MB | alltoall {d * mb:.2f} MB ({ov(d)}) | "
+                    f"alltoallv {v * mb:.2f} MB ({ov(v)})"
+                )
+        if (lp.decomposition == "slab" and lp.mesh is not None
+                and not plan.real):
+            # Rank-0 row of the exact per-peer count tables (TransInfo
+            # semantics; full tables via native.exchange_table).
+            from .. import native
+
+            p = lp.mesh.devices.size
+            a_in, a_out = lp.slab_axes or (0, 1)
+            oth = 3 - a_in - a_out
+            sc, _, rc, _ = native.exchange_table(
+                plan.shape[a_in], plan.shape[a_out], plan.shape[oth], p, 0
+            )
+            lines.append(f"exchange counts[rank0]: send {sc} recv {rc}")
     if plan.spec is not None:
         lines.append(f"padded extents: {plan.spec}")
     for label, boxes in (("in", plan.in_boxes), ("out", plan.out_boxes)):

@@ -1,0 +1,71 @@
+"""Worker process for the two-process DCN smoke test (test_multihost.py).
+
+Each process owns 4 virtual CPU devices; two processes form the hybrid
+(dcn=2) x (slab=4) mesh — the "multiple ranks on one box" strategy of the
+reference's test suite (``heffte_add_mpi_test`` -> ``mpiexec -np N``,
+``test/CMakeLists.txt:1-7``), with ``jax.distributed.initialize`` playing
+MPI_Init (``fftSpeed3d_c2c.cpp:18``).
+
+Usage: python tests/_dcn_worker.py <coordinator_port> <process_id>
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+
+def main() -> None:
+    port, pid = int(sys.argv[1]), int(sys.argv[2])
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{port}", num_processes=2,
+        process_id=pid,
+    )
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8, len(jax.devices())
+
+    import distributedfft_tpu as dfft
+    from distributedfft_tpu.parallel import multihost as mh
+
+    mesh = mh.fft_mesh_for()
+    assert dict(mesh.shape) == {"dcn": 2, "slab": 4}, dict(mesh.shape)
+
+    shape = (8, 12, 16)
+    fwd = dfft.plan_dft_c2c_3d(shape, mesh, dtype=np.complex128)
+    bwd = dfft.plan_dft_c2c_3d(shape, mesh, dtype=np.complex128,
+                               direction=dfft.BACKWARD)
+    assert fwd.decomposition == "pencil"
+
+    # Deterministic world; every process holds the full reference copy and
+    # feeds only its own host-local block (fftSpeed3d_c2c.cpp:59-72 fills
+    # each rank's slab the same way).
+    rng = np.random.default_rng(4242)
+    world = (rng.standard_normal(shape)
+             + 1j * rng.standard_normal(shape)).astype(np.complex128)
+    # in sharding P('dcn','slab',None): the dcn axis shards axis 0 across
+    # processes -> this process's host-local block is its axis-0 slice.
+    rows = shape[0] // 2
+    local = world[pid * rows:(pid + 1) * rows]
+    x = mh.host_local_to_global(mesh, P("dcn", "slab", None), local)
+
+    y = fwd(x)
+    got = mh.global_to_host_local(y)
+    ref = np.fft.fftn(world)
+    err = np.max(np.abs(got - ref)) / np.max(np.abs(ref))
+    assert err < 1e-11, f"forward err {err}"
+
+    r = mh.global_to_host_local(bwd(y))
+    rerr = np.max(np.abs(r - world))
+    assert rerr < 1e-11, f"roundtrip err {rerr}"
+
+    mh.sync_global_devices("dcn-smoke-done")
+    print(f"DCN_WORKER_OK pid={pid} err={err:.3e} rerr={rerr:.3e}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,135 @@
+"""Masked/uneven exchange (the MPI_Alltoallv analog) and payload accounting.
+
+The reference moves uneven payloads with exact per-peer count tables
+(``TransInfo``, ``fft_mpi_3d_api.cpp:84-133``; heFFTe
+``reshape3d_alltoallv``, ``src/heffte_reshape3d.cpp:375``). The TPU path
+ships true split-axis slices via ``lax.ragged_all_to_all`` ("alltoallv");
+on the CPU test backend the op is unimplemented and the exchange mirrors
+through the bit-identical ceil-padded dense path, so these tests pin
+plan-level correctness and the payload arithmetic.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu import native
+from distributedfft_tpu.plan_logic import exchange_payloads
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the virtual 8-device mesh"
+)
+
+CDT = jnp.complex128
+
+
+def _world(shape, seed=5):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal(shape) + 1j * rng.standard_normal(shape)
+
+
+@pytest.mark.parametrize("shape", [(16, 16, 16), (10, 9, 7), (8, 15, 5)])
+def test_alltoallv_slab_matches_reference(shape):
+    mesh = dfft.make_mesh(8)
+    plan = dfft.plan_dft_c2c_3d(shape, mesh, dtype=CDT, algorithm="alltoallv")
+    x = _world(shape)
+    ref = np.fft.fftn(x)
+    y = np.asarray(plan(jnp.asarray(x)))
+    assert np.max(np.abs(y - ref)) / np.max(np.abs(ref)) < 1e-11
+
+
+@pytest.mark.parametrize("shape", [(16, 12, 20), (10, 9, 7)])
+def test_alltoallv_pencil_roundtrip(shape):
+    mesh = dfft.make_mesh((2, 4))
+    fwd = dfft.plan_dft_c2c_3d(shape, mesh, dtype=CDT, algorithm="alltoallv")
+    bwd = dfft.plan_dft_c2c_3d(shape, mesh, dtype=CDT, algorithm="alltoallv",
+                               direction=dfft.BACKWARD)
+    x = _world(shape)
+    ref = np.fft.fftn(x)
+    y = fwd(jnp.asarray(x))
+    assert np.max(np.abs(np.asarray(y) - ref)) / np.max(np.abs(ref)) < 1e-11
+    r = np.asarray(bwd(y))
+    assert np.max(np.abs(r - x)) / np.max(np.abs(x)) < 1e-11
+
+
+def test_alltoallv_r2c_uneven():
+    shape = (10, 9, 12)
+    mesh = dfft.make_mesh(8)
+    fwd = dfft.plan_dft_r2c_3d(shape, mesh, dtype=CDT, algorithm="alltoallv")
+    x = np.random.default_rng(8).standard_normal(shape)
+    y = np.asarray(fwd(jnp.asarray(x)))
+    ref = np.fft.rfftn(x)
+    assert np.max(np.abs(y - ref)) / np.max(np.abs(ref)) < 1e-11
+
+
+def test_alltoallv_absorbed_layout():
+    """The masked exchange composes with reshape-minimized chains."""
+    shape = (10, 9, 7)
+    mesh = dfft.make_mesh(8)
+    plan = dfft.plan_dft_c2c_3d(
+        shape, mesh, dtype=CDT, algorithm="alltoallv",
+        in_spec=P(None, "slab", None),
+    )
+    assert plan.logic.slab_axes == (1, 0)
+    x = _world(shape)
+    ref = np.fft.fftn(x)
+    y = np.asarray(plan(jnp.asarray(x)))
+    assert np.max(np.abs(y - ref)) / np.max(np.abs(ref)) < 1e-11
+
+
+# ------------------------------------------------------ payload accounting
+
+def test_payload_accounting_slab_uneven():
+    """512^3-style arithmetic at test scale: the 7-way uneven case the
+    VERDICT asked to quantify. true <= alltoallv <= alltoall, with
+    alltoallv exactly stripping the split-axis padding."""
+    shape = (10, 9, 7)
+    plan = dfft.plan_dft_c2c_3d(shape, 7, dtype=CDT,
+                                options=None, decomposition="slab")
+    lp = plan.logic
+    p = lp.mesh.devices.size
+    [e] = exchange_payloads(lp, shape, 16)
+    assert e["true_bytes"] <= e["alltoallv_bytes"] <= e["alltoall_bytes"]
+    a_in, a_out = lp.slab_axes
+    pad = lambda n: p * (-(-n // p))
+    f = (p - 1) / p
+    assert e["alltoallv_bytes"] == int(
+        pad(shape[a_in]) * shape[a_out] * shape[3 - a_in - a_out] * f * 16
+    )
+    assert e["alltoall_bytes"] == int(
+        pad(shape[a_in]) * pad(shape[a_out]) * shape[3 - a_in - a_out] * f * 16
+    )
+    # Consistency with the exact native count tables: total true elements
+    # sent by all ranks == world volume (minus nothing; factor applies to
+    # off-diagonal only, so compare the full-table sum).
+    total = sum(
+        sum(native.exchange_table(shape[a_in], shape[a_out],
+                                  shape[3 - a_in - a_out], p, r)[0])
+        for r in range(p)
+    )
+    assert total == shape[0] * shape[1] * shape[2]
+
+
+def test_payload_accounting_in_plan_info():
+    shape = (10, 9, 7)
+    mesh = dfft.make_mesh(8)
+    plan = dfft.plan_dft_c2c_3d(shape, mesh, dtype=CDT, algorithm="alltoallv")
+    info = dfft.plan_info(plan)
+    assert "alltoallv" in info and "true" in info
+    assert "exchange counts[rank0]" in info
+    # Pencil plans report both exchanges.
+    pp = dfft.plan_dft_c2c_3d(shape, dfft.make_mesh((2, 4)), dtype=CDT)
+    pinfo = dfft.plan_info(pp)
+    assert "exchange t2a" in pinfo and "exchange t2b" in pinfo
+
+
+def test_payload_accounting_even_no_overhead():
+    shape = (16, 16, 16)
+    mesh = dfft.make_mesh(8)
+    plan = dfft.plan_dft_c2c_3d(shape, mesh, dtype=CDT)
+    [e] = exchange_payloads(plan.logic, shape, 16)
+    assert e["true_bytes"] == e["alltoallv_bytes"] == e["alltoall_bytes"]

@@ -39,6 +39,27 @@ def test_speed3d_staged(capsys):
     assert "t0_fft_yz" in out and "t2_all_to_all" in out and "t3_fft_x" in out
 
 
+def test_speed3d_staged_pencil(capsys):
+    speed3d.main(["c2c", "double", "16", "16", "16",
+                  "-ndev", "8", "-pencils", "-staged", "-iters", "1"])
+    out = capsys.readouterr().out
+    assert "t2a_exchange_col" in out and "t2b_exchange_row" in out
+
+
+def test_speed3d_staged_r2c(capsys):
+    speed3d.main(["r2c", "double", "16", "16", "16",
+                  "-ndev", "8", "-slabs", "-staged", "-iters", "1"])
+    out = capsys.readouterr().out
+    assert "t0_r2c_zy" in out and "t2_exchange" in out and "t3_fft_x" in out
+
+
+def test_speed3d_a2av(capsys):
+    speed3d.main(["c2c", "double", "10", "9", "7",
+                  "-ndev", "8", "-slabs", "-a2av", "-iters", "1"])
+    out = capsys.readouterr().out
+    assert "algorithm: alltoallv" in out
+
+
 def test_batch_bench_1d(capsys, tmp_path):
     csv = str(tmp_path / "b.csv")
     batch_bench.main(["1d", "-radix", "5", "-total", "1000",

@@ -1,0 +1,102 @@
+"""Staged t0..t3 pipelines for pencil and r2c plans.
+
+Every benchmarkable config must produce the reference's per-stage breakdown
+(``fft_mpi_3d_api.cpp:184-201`` prints t0..t3 on every run; the pencil
+pipeline splits t2 into the two exchanges t2a/t2b). Correctness here: the
+composition of the timed stages equals the fused plan / numpy reference.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu.parallel.staged import (
+    build_pencil_rfft_stages,
+    build_pencil_stages,
+    build_slab_rfft_stages,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the virtual 8-device mesh"
+)
+
+
+def _run(stages, x):
+    for _, fn in stages:
+        x = fn(x)
+    return np.asarray(x)
+
+
+def _cw(shape, seed=21):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(shape)
+            + 1j * rng.standard_normal(shape)).astype(np.complex128)
+
+
+@pytest.mark.parametrize("shape", [(16, 16, 16), (10, 9, 7)])
+def test_pencil_stages_forward(shape):
+    mesh = dfft.make_mesh((2, 4))
+    stages, _ = build_pencil_stages(mesh, shape)
+    names = [n for n, _ in stages]
+    assert names == ["t0_fft_z", "t2a_exchange_col", "t1_fft_y",
+                     "t2b_exchange_row", "t3_fft_x"]
+    x = _cw(shape)
+    y = _run(stages, jnp.asarray(x))
+    ref = np.fft.fftn(x)
+    assert np.max(np.abs(y - ref)) / np.max(np.abs(ref)) < 1e-11
+
+
+def test_pencil_stages_backward():
+    shape = (16, 12, 20)
+    mesh = dfft.make_mesh((2, 4))
+    stages, _ = build_pencil_stages(mesh, shape, forward=False)
+    x = _cw(shape)
+    y = _run(stages, jnp.asarray(x))  # inverse stages apply 1/N
+    ref = np.fft.ifftn(x)
+    assert np.max(np.abs(y - ref)) / np.max(np.abs(ref)) < 1e-11
+
+
+@pytest.mark.parametrize("shape", [(16, 16, 16), (10, 9, 12)])
+def test_slab_rfft_stages_roundtrip(shape):
+    mesh = dfft.make_mesh(8)
+    fwd, _ = build_slab_rfft_stages(mesh, shape)
+    bwd, _ = build_slab_rfft_stages(mesh, shape, forward=False)
+    names = [n for n, _ in fwd]
+    assert names == ["t0_r2c_zy", "t2_exchange", "t3_fft_x"]
+    x = np.random.default_rng(3).standard_normal(shape)
+    y = _run(fwd, jnp.asarray(x))
+    ref = np.fft.rfftn(x)
+    assert np.max(np.abs(y - ref)) / np.max(np.abs(ref)) < 1e-11
+    r = _run(bwd, jnp.asarray(y))  # inverse stages apply 1/N
+    assert np.max(np.abs(r - x)) < 1e-11
+
+
+@pytest.mark.parametrize("shape", [(16, 16, 16), (10, 9, 12)])
+def test_pencil_rfft_stages_roundtrip(shape):
+    mesh = dfft.make_mesh((2, 4))
+    fwd, _ = build_pencil_rfft_stages(mesh, shape)
+    bwd, _ = build_pencil_rfft_stages(mesh, shape, forward=False)
+    assert [n for n, _ in fwd] == ["t0_r2c_z", "t2a_exchange_col", "t1_fft_y",
+                                   "t2b_exchange_row", "t3_fft_x"]
+    x = np.random.default_rng(5).standard_normal(shape)
+    y = _run(fwd, jnp.asarray(x))
+    ref = np.fft.rfftn(x)
+    assert np.max(np.abs(y - ref)) / np.max(np.abs(ref)) < 1e-11
+    r = _run(bwd, jnp.asarray(y))  # inverse stages apply 1/N
+    assert np.max(np.abs(r - x)) < 1e-11
+
+
+def test_pencil_stages_timed():
+    """time_staged produces a t0..t3 table over the staged pencil pipeline
+    (the -pencils -staged benchmark path)."""
+    from distributedfft_tpu.utils.timing import time_staged
+
+    mesh = dfft.make_mesh((2, 4))
+    stages, _ = build_pencil_stages(mesh, (16, 16, 16))
+    st, out = time_staged(stages, jnp.asarray(_cw((16, 16, 16))), iters=1)
+    assert set(st.times) == {n for n, _ in stages}
+    assert all(v >= 0 for v in st.times.values())
+    assert out.shape == (16, 16, 16)
