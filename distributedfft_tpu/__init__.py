@@ -21,6 +21,7 @@ from .api import (  # noqa: F401
     alloc_local,
     destroy_plan,
     execute,
+    plan_brick_dft_c2c_3d,
     plan_dft_c2c_3d,
     plan_dft_c2r_3d,
     plan_dft_r2c_3d,

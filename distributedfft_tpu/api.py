@@ -371,6 +371,116 @@ def plan_dft_c2c_3d(
     )
 
 
+def _even_fallback_spec(mesh: Mesh, pref: P, shape) -> P:
+    """``pref`` if it divides ``shape`` evenly over the mesh, else the first
+    mesh-expressible layout (using every mesh axis) that does."""
+    import itertools
+
+    if _spec_divides(mesh, pref, shape):
+        return pref
+    names = list(mesh.axis_names)
+    cands = []
+    if len(names) == 1:
+        for d in range(3):
+            e: list = [None, None, None]
+            e[d] = names[0]
+            cands.append(P(*e))
+    else:
+        for da, db in itertools.permutations(range(3), 2):
+            e = [None, None, None]
+            e[da], e[db] = names[0], names[1]
+            cands.append(P(*e))
+        for d in range(3):  # both axes merged onto one dim
+            e = [None, None, None]
+            e[d] = tuple(names)
+            cands.append(P(*e))
+    for c in cands:
+        if _spec_divides(mesh, c, shape):
+            return c
+    raise ValueError(
+        f"no mesh-expressible layout of {tuple(shape)} divides evenly over "
+        f"mesh axes {dict(mesh.shape)}; brick plans need at least one even "
+        f"intermediate layout"
+    )
+
+
+def plan_brick_dft_c2c_3d(
+    shape: Sequence[int],
+    mesh: Mesh | int,
+    in_boxes: Sequence[Box3],
+    out_boxes: Sequence[Box3],
+    *,
+    direction: int = FORWARD,
+    decomposition: str | None = None,
+    executor: str = "xla",
+    dtype: Any = None,
+    donate: bool = False,
+    algorithm: str = "alltoall",
+    options: PlanOptions | None = None,
+) -> Plan3D:
+    """3D C2C plan with *arbitrary* per-device input/output boxes.
+
+    The full heFFTe brick capability (``fft3d(inbox, outbox, comm)``,
+    ``heffte_fft3d.h:105-115``): ``in_boxes``/``out_boxes`` are any
+    non-overlapping decompositions of the world — uneven, non-grid,
+    axis-swapped — one ``Box3`` per device in ``mesh.devices.flat`` order.
+    The plan brackets the canonical stage chain with the overlap-map ring
+    reshapes of :mod:`.parallel.bricks` (the ``reshape3d_alltoallv``
+    analog, ``src/heffte_reshape3d.cpp:375``).
+
+    I/O travels as *brick stacks*: ``[P, *pad]`` arrays sharded one brick
+    per device (see :func:`~.parallel.bricks.scatter_bricks` /
+    ``gather_bricks``); ``plan.in_shape``/``plan.out_shape`` give the stack
+    shapes. The canonical chain endpoints must divide the world evenly over
+    the mesh (pick a mesh whose axis sizes divide the extents); the user
+    boxes themselves carry no such restriction.
+    """
+    from .parallel.bricks import (
+        pad_shape_for, plan_bricks_to_spec, plan_spec_to_bricks,
+    )
+
+    shape, _ = _check_direction(shape, direction)
+    dtype = _default_cdtype(dtype)
+    inner = plan_dft_c2c_3d(
+        shape, mesh, direction=direction, decomposition=decomposition,
+        executor=executor, dtype=dtype, donate=donate, algorithm=algorithm,
+        options=options,
+    )
+    if inner.mesh is None or inner.in_sharding is None:
+        raise ValueError("brick plans require a multi-device mesh")
+    m = inner.mesh
+    # The ring lands an *even* mesh layout; when the chain endpoint itself
+    # is uneven (ceil-split), target the nearest even layout and let the
+    # chain's own sharding constraints move data the rest of the way (one
+    # extra XLA reshard — the same prepend/append reshape heFFTe's planner
+    # emits for non-matching layouts, heffte_plan_logic.cpp:162-245).
+    in_target = _even_fallback_spec(m, inner.in_sharding.spec, shape)
+    out_target = _even_fallback_spec(m, inner.out_sharding.spec, shape)
+    to_canon, in_bspec = plan_bricks_to_spec(m, in_boxes, in_target)
+    from_canon, out_bspec = plan_spec_to_bricks(m, out_target, out_boxes)
+    inner_fn = inner.fn
+
+    jit_kw: dict = {"donate_argnums": 0} if (donate or (
+        options is not None and options.donate)) else {}
+
+    @functools.partial(jax.jit, **jit_kw)
+    def fn(stack):
+        return from_canon(inner_fn(to_canon(stack)))
+
+    p = len(in_boxes)
+    names = tuple(m.axis_names)
+    stack_sh = NamedSharding(m, P(names, None, None, None))
+    return Plan3D(
+        shape=shape, direction=direction, dtype=dtype,
+        decomposition=inner.decomposition, executor=inner.executor, mesh=m,
+        fn=fn, spec=inner.spec, in_sharding=stack_sh, out_sharding=stack_sh,
+        in_boxes=list(in_boxes), out_boxes=list(out_boxes),
+        in_shape=(p,) + pad_shape_for(in_boxes),
+        out_shape=(p,) + pad_shape_for(out_boxes),
+        options=inner.options, logic=inner.logic,
+    )
+
+
 def plan_dft_r2c_3d(
     shape: Sequence[int],
     mesh: Mesh | int | None = None,

@@ -49,9 +49,21 @@ from .dft_matmul import _dft_matrix_np
 # matmul comfortably MXU/VMEM-sized and covers n <= 65536 in one kernel.
 MAX_FACTOR = 256
 
-# VMEM working-set budget per batch tile (bytes). The kernel keeps roughly
-# four [tile, n] float32 planes live (re/im in, re/im staged), plus LUTs.
-_VMEM_BUDGET = 6 * 1024 * 1024
+# VMEM working-set budget per batch tile (bytes). Hardware-measured (v5e):
+# Mosaic's scoped stack holds ~12 [tile, n] float32 planes live (re/im at
+# each staged intermediate plus the transpose copies), and the grid
+# pipeline double-buffers the in/out tiles on top — ~1.5 MiB of budget per
+# 16 n-rows. The budget is sized so the whole footprint stays inside
+# _VMEM_LIMIT with headroom (a 512-row tile at n=512 measured 48 MiB of
+# scoped stack).
+_VMEM_BUDGET = 2 * 1024 * 1024
+
+# Mosaic scoped-VMEM ceiling requested via CompilerParams. The default
+# scoped limit (16 MiB on v5e) rejects any usefully-sized tile; the chip
+# has 128 MiB of VMEM and granting the kernel most of it is the same
+# decision the reference makes sizing shared memory per workgroup
+# (templateFFT.cpp:3941-4100 maxSharedMemSize).
+_VMEM_LIMIT = 100 * 1024 * 1024
 
 
 def split_for(n: int) -> tuple[int, int] | None:
@@ -72,7 +84,14 @@ def eligible(n: int) -> bool:
 
 
 def batch_tile(n: int) -> int:
-    """Batch rows per grid step: power of two, >= 8, VMEM-budgeted."""
+    """Batch rows per grid step: power of two, >= 8, VMEM-budgeted.
+
+    ``DFFT_PALLAS_TILE`` overrides for hardware tuning sweeps."""
+    import os
+
+    env = os.environ.get("DFFT_PALLAS_TILE")
+    if env:
+        return int(env)
     rows = max(8, _VMEM_BUDGET // (4 * 4 * n))
     return 1 << min(10, int(math.log2(rows)))
 
@@ -182,6 +201,10 @@ def _fft_tiles(xr, xi, *, n: int, forward: bool, interpret: bool):
             flops=8 * batch * n * (n1 + n2),
             bytes_accessed=4 * batch * n * 4,
             transcendentals=0,
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",),
+            vmem_limit_bytes=_VMEM_LIMIT,
         ),
         interpret=interpret,
     )(*consts, xr.reshape(batch, n1, n2), xi.reshape(batch, n1, n2))
