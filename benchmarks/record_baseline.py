@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Record the BASELINE.json config sweep to ``benchmarks/csv/``.
+
+The committed-CSV parity artifact: the reference ships its manuscript
+benchmark data as CSVs (``templateFFT/csv/batch_result{1D,2D}.csv``,
+``README.md:32``); this driver produces the same kind of recorded evidence
+for the TPU framework — size/time/GFlops/error rows per (shape, dtype,
+executor, decomposition) config, written via
+:class:`distributedfft_tpu.utils.trace.CsvRecorder`.
+
+Run on whatever backend is available; every row records the backend and
+device count so a CPU smoke row can never masquerade as a TPU result.
+Configs that fail (OOM, unsupported dtype, sick transport) record an
+``error`` row rather than aborting the sweep — one bad config must not
+cost the evidence for the rest.
+
+Usage:
+  python benchmarks/record_baseline.py              # full sweep
+  python benchmarks/record_baseline.py --quick      # tiny shapes (CI smoke)
+  python benchmarks/record_baseline.py --sizes 256 512
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_config(shape, dtype_name, executor, mesh, *, real=False):
+    """Plan, verify, and time one config. Returns a result dict; raises on
+    failure (caller records the error row)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    import distributedfft_tpu as dfft
+    from distributedfft_tpu.utils.timing import (
+        gflops, max_rel_err, sync, time_fn_amortized,
+    )
+
+    dtype = jnp.dtype(dtype_name)
+    if real:
+        # r2c/c2r plans take the complex working dtype; the real side is
+        # derived from it.
+        cdt = jnp.dtype("complex128" if dtype == jnp.float64 else "complex64")
+        plan = dfft.plan_dft_r2c_3d(shape, mesh, dtype=cdt,
+                                    executor=executor)
+        iplan = dfft.plan_dft_c2r_3d(shape, mesh, dtype=cdt,
+                                     executor=executor)
+    else:
+        plan = dfft.plan_dft_c2c_3d(shape, mesh, dtype=dtype,
+                                    executor=executor)
+        iplan = dfft.plan_dft_c2c_3d(shape, mesh, direction=dfft.BACKWARD,
+                                     dtype=dtype, executor=executor)
+
+    mk_kw = {}
+    if plan.in_sharding is not None:
+        mk_kw["out_shardings"] = plan.in_sharding
+
+    @functools.partial(jax.jit, **mk_kw)
+    def make_input():
+        k1, k2 = jax.random.split(jax.random.PRNGKey(4242))
+        if real:
+            return jax.random.normal(k1, shape, plan.in_dtype)
+        re = jax.random.normal(k1, shape, jnp.float32)
+        im = jax.random.normal(k2, shape, jnp.float32)
+        return (re + 1j * im).astype(dtype)
+
+    x = make_input()
+    sync(x)
+    err = max_rel_err(iplan(plan(x)), x)
+    seconds, _ = time_fn_amortized(lambda: plan(x), iters=10, repeats=3)
+    return {
+        "seconds": seconds,
+        "gflops": gflops(shape, seconds, real=real),
+        "max_err": err,
+        "decomposition": plan.decomposition,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes", type=int, nargs="*", default=None)
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny shapes for CI smoke")
+    ap.add_argument("--out", default=None, help="CSV path override")
+    ap.add_argument("--executors", default="xla,pallas,matmul")
+    args = ap.parse_args()
+
+    import jax
+
+    from distributedfft_tpu.utils.trace import CsvRecorder
+
+    backend = jax.default_backend()
+    n_dev = len(jax.devices())
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = args.out or os.path.join(
+        here, "csv", f"speed3d_{backend}{n_dev}.csv")
+    rec = CsvRecorder(out, (
+        "nx", "ny", "nz", "kind", "dtype", "decomposition", "executor",
+        "backend", "devices", "seconds", "gflops", "max_err", "status",
+    ))
+
+    if args.quick:
+        sizes = args.sizes or [32]
+    else:
+        sizes = args.sizes or [256, 512]
+    executors = [e for e in args.executors.split(",") if e]
+
+    import jax.numpy as jnp
+
+    mesh = None
+    if n_dev > 1:
+        import distributedfft_tpu as dfft
+
+        mesh = dfft.make_mesh(n_dev)
+    # TPU has no complex128; double-precision rows only run where supported.
+    cdtypes = ["complex64"]
+    rdtypes = ["float32"]
+    if jax.config.jax_enable_x64 and backend == "cpu":
+        cdtypes.append("complex128")
+        rdtypes.append("float64")
+
+    failures = 0
+    for n in sizes:
+        shape = (n, n, n)
+        jobs = [(dt, ex, False) for dt in cdtypes for ex in executors]
+        jobs += [(dt, ex, True) for dt in rdtypes for ex in executors]
+        for dt, ex, real in jobs:
+            kind = "r2c" if real else "c2c"
+            try:
+                r = run_config(shape, dt, ex, mesh, real=real)
+                rec.record(n, n, n, kind, dt, r["decomposition"], ex,
+                           backend, n_dev, f"{r['seconds']:.6f}",
+                           f"{r['gflops']:.1f}", f"{r['max_err']:.3e}", "ok")
+                print(f"{shape} {kind} {dt} {ex}: {r['gflops']:.1f} GFlops "
+                      f"err={r['max_err']:.2e}", flush=True)
+            except Exception as e:  # noqa: BLE001 — record and continue
+                failures += 1
+                msg = f"{type(e).__name__}: {e}".replace(",", ";")
+                msg = " ".join(msg.split())[:160]
+                rec.record(n, n, n, kind, dt, "-", ex, backend, n_dev,
+                           "-", "-", "-", f"error {msg}")
+                print(f"{shape} {kind} {dt} {ex}: FAILED {msg}",
+                      file=sys.stderr, flush=True)
+    print(f"wrote {out}", flush=True)
+    return 0 if failures == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -130,8 +130,15 @@ register_real_executor("xla", _xla_r2c, _xla_c2r)
 
 def _matmul_r2c(x: Array, axis: int) -> Array:
     from . import dft_matmul
+    from .realfft import r2c_via_half_complex
 
     n = x.shape[axis]
+    if n % 2 == 0 and n > 2 and not jnp.issubdtype(
+            jnp.dtype(x.dtype), jnp.complexfloating):
+        # Half-length packed transform: half the flops of the promote-and-
+        # slice path (the native-r2c discipline of rocfft_executor_r2c,
+        # heffte_backend_rocm.h:567).
+        return r2c_via_half_complex(x, axis, dft_matmul.fft_along_axis)
     y = dft_matmul.fft_along_axis(x, axis, forward=True)
     import jax.lax as lax
 
@@ -142,8 +149,12 @@ def _matmul_c2r(y: Array, n: int, axis: int) -> Array:
     from . import dft_matmul
     import jax.lax as lax
 
-    # Rebuild the full hermitian spectrum from the non-redundant half, then a
-    # plain complex inverse; imaginary residue is dropped.
+    from .realfft import c2r_via_half_complex
+
+    if n % 2 == 0 and n > 2:
+        return c2r_via_half_complex(y, n, axis, dft_matmul.fft_along_axis)
+    # Odd n: rebuild the full hermitian spectrum from the non-redundant
+    # half, then a plain complex inverse; imaginary residue is dropped.
     h = y.shape[axis]
     mirror = lax.slice_in_dim(y, 1, n - h + 1, axis=axis)
     mirror = jnp.conj(jnp.flip(mirror, axis=axis))
@@ -170,10 +181,17 @@ def _pallas_r2c(x: Array, axis: int) -> Array:
     import jax.lax as lax
 
     from . import pallas_fft
+    from .realfft import r2c_via_half_complex
 
     n = x.shape[axis]
-    # Promote real input up front: the kernel's dtype gate only admits
-    # complex64, so a float32 operand would silently take the fallback.
+    if n % 2 == 0 and n > 2 and not jnp.issubdtype(
+            jnp.dtype(x.dtype), jnp.complexfloating):
+        # Half-length packed kernel transform (see _matmul_r2c); the
+        # packing promotes to the kernel's complex64 itself.
+        return r2c_via_half_complex(x, axis, pallas_fft.fft_along_axis)
+    # Odd n: promote real input up front — the kernel's dtype gate only
+    # admits complex64, so a float32 operand would silently take the
+    # fallback.
     if not jnp.issubdtype(x.dtype, jnp.complexfloating):
         wide = jnp.dtype(x.dtype).itemsize >= 8
         x = x.astype(jnp.complex128 if wide else jnp.complex64)
@@ -185,7 +203,10 @@ def _pallas_c2r(y: Array, n: int, axis: int) -> Array:
     import jax.lax as lax
 
     from . import pallas_fft
+    from .realfft import c2r_via_half_complex
 
+    if n % 2 == 0 and n > 2:
+        return c2r_via_half_complex(y, n, axis, pallas_fft.fft_along_axis)
     h = y.shape[axis]
     mirror = lax.slice_in_dim(y, 1, n - h + 1, axis=axis)
     mirror = jnp.conj(jnp.flip(mirror, axis=axis))

@@ -101,3 +101,25 @@ def test_speed3d_profile_flag(tmp_path):
     speed3d.main(["c2c", "double", "16", "16", "16",
                   "-ndev", "4", "-slabs", "-iters", "1", "-profile", d])
     assert os.path.isdir(d) and os.listdir(d)
+
+
+def test_record_baseline_quick(tmp_path):
+    """The BASELINE.json sweep recorder (manuscript-CSV parity artifact,
+    templateFFT/csv/*.csv role) runs end-to-end and records ok rows."""
+    import subprocess
+    import sys
+
+    out = tmp_path / "sweep.csv"
+    proc = subprocess.run(
+        [sys.executable, "benchmarks/record_baseline.py", "--quick",
+         "--sizes", "16", "--out", str(out), "--executors", "xla"],
+        capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PALLAS_AXON_POOL_IPS": ""},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = out.read_text().strip().splitlines()
+    assert rows[0].startswith("nx,ny,nz,kind")
+    assert len(rows) >= 3  # header + c2c + r2c
+    assert all(r.endswith(",ok") for r in rows[1:]), rows

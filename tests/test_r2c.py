@@ -5,6 +5,8 @@ heFFTe's r2c tier (``test/test_fft3d_r2c.cpp``): seeded real world data,
 import numpy as np
 import pytest
 
+import jax.numpy as jnp
+
 import distributedfft_tpu as dfft
 from distributedfft_tpu import testing as tu
 
@@ -110,3 +112,34 @@ def test_r2c_boxes_tile_worlds():
     plan = dfft.plan_dft_r2c_3d(shape, mesh)
     assert world_complete(plan.in_boxes, world_box(shape))
     assert world_complete(plan.out_boxes, world_box((10, 14, 4)))
+
+
+# -------------------------------------------- half-complex packed real path
+
+@pytest.mark.parametrize("executor", ["matmul", "pallas"])
+@pytest.mark.parametrize("n", [4, 12, 16, 64])
+def test_half_complex_r2c_matches_numpy(executor, n):
+    """Even-n r2c runs the packed half-length path and still matches
+    np.fft.rfft at the double tier."""
+    from distributedfft_tpu.ops.executors import get_c2r, get_r2c
+
+    rng = np.random.default_rng(21)
+    x = rng.standard_normal((5, n))
+    got = np.asarray(get_r2c(executor)(jnp.asarray(x), 1))
+    np.testing.assert_allclose(got, np.fft.rfft(x, axis=1), atol=1e-11)
+    back = np.asarray(get_c2r(executor)(jnp.asarray(np.fft.rfft(x, axis=1)),
+                                        n, 1))
+    np.testing.assert_allclose(back, x, atol=1e-11)
+
+
+@pytest.mark.parametrize("executor", ["matmul", "pallas"])
+def test_half_complex_odd_n_fallback(executor):
+    from distributedfft_tpu.ops.executors import get_c2r, get_r2c
+
+    rng = np.random.default_rng(22)
+    x = rng.standard_normal((4, 9))
+    got = np.asarray(get_r2c(executor)(jnp.asarray(x), 1))
+    np.testing.assert_allclose(got, np.fft.rfft(x, axis=1), atol=1e-11)
+    y = np.fft.rfft(x, axis=1)
+    back = np.asarray(get_c2r(executor)(jnp.asarray(y), 9, 1))
+    np.testing.assert_allclose(back, x, atol=1e-11)
