@@ -25,9 +25,33 @@ from __future__ import annotations
 import argparse
 import math
 import os
+import subprocess
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def reexec_with_watchdog(argv: list[str], timeout: float) -> int:
+    """Run this script's worker mode in a subprocess with a hard deadline.
+
+    A wedged PJRT backend init (the sick-axon-tunnel failure mode bench.py
+    was hardened against in round 1) hangs without raising, so in-process
+    try/except can never record the failure; only a subprocess with a
+    timeout can. CSV rows are appended incrementally by the worker, so
+    everything measured before a hang survives.
+    """
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-u", os.path.abspath(__file__), "--worker",
+             *argv],
+            timeout=timeout,
+        )
+        return proc.returncode
+    except subprocess.TimeoutExpired:
+        print(f"sweep worker exceeded {int(timeout)}s (wedged backend?); "
+              f"killed — rows recorded so far are kept", file=sys.stderr)
+        return 2
 
 
 def run_config(shape, dtype_name, executor, mesh, *, real=False):
@@ -90,7 +114,15 @@ def main() -> int:
                     help="tiny shapes for CI smoke")
     ap.add_argument("--out", default=None, help="CSV path override")
     ap.add_argument("--executors", default="xla,pallas,matmul")
+    ap.add_argument("--worker", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: run in-process
+    ap.add_argument("--timeout", type=float, default=float(
+        os.environ.get("DFFT_SWEEP_TIMEOUT", 2400)))
     args = ap.parse_args()
+
+    if not args.worker:
+        argv = [a for a in sys.argv[1:] if a != "--worker"]
+        return reexec_with_watchdog(argv, args.timeout)
 
     import jax
 
@@ -101,9 +133,13 @@ def main() -> int:
     here = os.path.dirname(os.path.abspath(__file__))
     out = args.out or os.path.join(
         here, "csv", f"speed3d_{backend}{n_dev}.csv")
+    # One stamp per sweep: re-runs append, so every row names the run it
+    # came from (stale rows from older code stay distinguishable).
+    run = time.strftime("%Y-%m-%dT%H:%M:%S")
     rec = CsvRecorder(out, (
-        "nx", "ny", "nz", "kind", "dtype", "decomposition", "executor",
-        "backend", "devices", "seconds", "gflops", "max_err", "status",
+        "run", "nx", "ny", "nz", "kind", "dtype", "decomposition",
+        "executor", "backend", "devices", "seconds", "gflops", "max_err",
+        "status",
     ))
 
     if args.quick:
@@ -135,7 +171,7 @@ def main() -> int:
             kind = "r2c" if real else "c2c"
             try:
                 r = run_config(shape, dt, ex, mesh, real=real)
-                rec.record(n, n, n, kind, dt, r["decomposition"], ex,
+                rec.record(run, n, n, n, kind, dt, r["decomposition"], ex,
                            backend, n_dev, f"{r['seconds']:.6f}",
                            f"{r['gflops']:.1f}", f"{r['max_err']:.3e}", "ok")
                 print(f"{shape} {kind} {dt} {ex}: {r['gflops']:.1f} GFlops "
@@ -144,8 +180,8 @@ def main() -> int:
                 failures += 1
                 msg = f"{type(e).__name__}: {e}".replace(",", ";")
                 msg = " ".join(msg.split())[:160]
-                rec.record(n, n, n, kind, dt, "-", ex, backend, n_dev,
-                           "-", "-", "-", f"error {msg}")
+                rec.record(run, n, n, n, kind, dt, "-", ex, backend,
+                           n_dev, "-", "-", "-", f"error {msg}")
                 print(f"{shape} {kind} {dt} {ex}: FAILED {msg}",
                       file=sys.stderr, flush=True)
     print(f"wrote {out}", flush=True)

@@ -93,6 +93,9 @@ class Plan3D:
     # The resolved plan skeleton (axis assignment, stage chain, device-count
     # negotiation record) — surfaced by plan_info.
     logic: LogicPlan | None = None
+    # Brick-I/O plans: the two overlap-map ring edges (in->chain, chain->out)
+    # with their payload/wire accounting (BrickSpec pair); None otherwise.
+    brick_edges: tuple | None = None
 
     def __post_init__(self) -> None:
         if self.in_shape is None:
@@ -478,6 +481,7 @@ def plan_brick_dft_c2c_3d(
         in_shape=(p,) + pad_shape_for(in_boxes),
         out_shape=(p,) + pad_shape_for(out_boxes),
         options=inner.options, logic=inner.logic,
+        brick_edges=(in_bspec, out_bspec),
     )
 
 

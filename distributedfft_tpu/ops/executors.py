@@ -186,8 +186,10 @@ def _pallas_r2c(x: Array, axis: int) -> Array:
     n = x.shape[axis]
     if n % 2 == 0 and n > 2 and not jnp.issubdtype(
             jnp.dtype(x.dtype), jnp.complexfloating):
-        # Half-length packed kernel transform (see _matmul_r2c); the
-        # packing promotes to the kernel's complex64 itself.
+        # Half-length packed transform (see _matmul_r2c). f32 input packs
+        # to complex64 and runs the fused kernel; f64 packs to complex128,
+        # which the kernel's dtype gate routes to the matmul fallback —
+        # still the packed half-length work, just not the fused engine.
         return r2c_via_half_complex(x, axis, pallas_fft.fft_along_axis)
     # Odd n: promote real input up front — the kernel's dtype gate only
     # admits complex64, so a float32 operand would silently take the

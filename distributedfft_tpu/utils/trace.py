@@ -175,6 +175,23 @@ def plan_info(plan) -> str:
                 plan.shape[a_in], plan.shape[a_out], plan.shape[oth], p, 0
             )
             lines.append(f"exchange counts[rank0]: send {sc} recv {rc}")
+    if getattr(plan, "brick_edges", None) is not None:
+        # Overlap-map ring accounting for the brick-I/O edges: true
+        # intersection payload vs what the padded ring ships (the
+        # send_size/recv_size table role of heffte_reshape3d's overlap
+        # maps).
+        import numpy as _np
+
+        itemsize = _np.dtype(plan.dtype).itemsize
+        mb = 1.0 / (1024 * 1024)
+        for label, bs in zip(("in->chain", "chain->out"), plan.brick_edges):
+            t = bs.payload_elems * itemsize
+            w = bs.wire_elems * itemsize
+            ov = f"+{(w / t - 1) * 100:.1f}%" if t else "n/a"
+            lines.append(
+                f"brick edge {label}: {len(bs.steps)} ring steps, "
+                f"payload {t * mb:.2f} MB | wire {w * mb:.2f} MB ({ov})"
+            )
     if plan.spec is not None:
         lines.append(f"padded extents: {plan.spec}")
     for label, boxes in (("in", plan.in_boxes), ("out", plan.out_boxes)):

@@ -120,6 +120,6 @@ def test_record_baseline_quick(tmp_path):
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     rows = out.read_text().strip().splitlines()
-    assert rows[0].startswith("nx,ny,nz,kind")
+    assert rows[0].startswith("run,nx,ny,nz,kind")
     assert len(rows) >= 3  # header + c2c + r2c
     assert all(r.endswith(",ok") for r in rows[1:]), rows

@@ -184,3 +184,20 @@ def test_brick_plan_backward_roundtrip():
     stack = scatter_bricks(x, ins, mesh=mesh)
     back = gather_bricks(bwd(fwd(stack)), ins)
     np.testing.assert_allclose(back, x, atol=1e-4)
+
+
+def test_brick_plan_info_accounting():
+    """plan_info surfaces the overlap-ring payload/wire accounting for both
+    brick edges (the outputPlanInfo/TransInfo table role)."""
+    from distributedfft_tpu.utils.trace import plan_info
+
+    shape = (16, 16, 16)
+    mesh = dfft.make_mesh(8)
+    w = world_box(shape)
+    plan = dfft.plan_brick_dft_c2c_3d(
+        shape, mesh, make_pencils(w, (4, 2), 2), make_slabs(w, 8, axis=1),
+        dtype=np.complex64)
+    info = plan_info(plan)
+    assert "brick edge in->chain" in info
+    assert "brick edge chain->out" in info
+    assert "payload" in info and "wire" in info
