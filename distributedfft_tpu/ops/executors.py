@@ -169,7 +169,18 @@ register_real_executor("matmul", _matmul_r2c, _matmul_c2r)
 def _pallas_executor(x: Array, axes: Sequence[int], forward: bool = True) -> Array:
     from . import pallas_fft
 
-    for ax in tuple(axes):
+    axes = tuple(axes)
+    # Fuse a trailing 2D plane into one kernel launch (the templateFFT
+    # 2D-app role for the t0 stage): both axes transform through VMEM with
+    # one HBM read/write instead of two of each.
+    if (len(axes) >= 2 and jnp.dtype(x.dtype) == jnp.complex64
+            and x.size > 0
+            and {axes[-2] % x.ndim, axes[-1] % x.ndim}
+            == {x.ndim - 2, x.ndim - 1}
+            and pallas_fft.eligible2d(x.shape[-2], x.shape[-1])):
+        x = pallas_fft.fft2_last(x, forward=forward)
+        axes = axes[:-2]
+    for ax in axes:
         x = pallas_fft.fft_along_axis(x, ax, forward=forward)
     return x
 

@@ -83,17 +83,22 @@ def eligible(n: int) -> bool:
     return n >= 64 and split_for(n) is not None
 
 
-def batch_tile(n: int) -> int:
-    """Batch rows per grid step: power of two, >= 8, VMEM-budgeted.
-
-    ``DFFT_PALLAS_TILE`` overrides for hardware tuning sweeps."""
+def _tile_rows(env_name: str, bytes_per_row: int, floor: int) -> int:
+    """Shared tile-size model: power of two, >= ``floor``, VMEM-budgeted;
+    ``env_name`` overrides for hardware tuning sweeps (single source for
+    the 1D and 2D kernels so budget changes cannot desynchronize them)."""
     import os
 
-    env = os.environ.get("DFFT_PALLAS_TILE")
+    env = os.environ.get(env_name)
     if env:
         return int(env)
-    rows = max(8, _VMEM_BUDGET // (4 * 4 * n))
+    rows = max(floor, _VMEM_BUDGET // bytes_per_row)
     return 1 << min(10, int(math.log2(rows)))
+
+
+def batch_tile(n: int) -> int:
+    """Batch rows per grid step for the 1D kernel."""
+    return _tile_rows("DFFT_PALLAS_TILE", 4 * 4 * n, 8)
 
 
 @functools.lru_cache(maxsize=None)
@@ -131,31 +136,86 @@ def _mm(a, b):
     )
 
 
+def _four_step_pass(a3r, a3i, w1r, w1i, tr, ti, w2r, w2i):
+    """One four-step DFT pass contracting the factor dims of [rows, n1, n2]
+    planes (the transform axis pre-split to (n1, n2) by the caller), shared
+    by the 1D and fused-2D kernels. Mosaic note: every reshape below
+    merges/splits *leading* dims only (the lane dim never changes inside a
+    reshape); layout moves between the two matmul groupings happen via
+    transposes. Returns [rows, n2, n1] planes — flat (k2, k1) IS the
+    transformed axis in natural order (k = k1 + n1*k2)."""
+    rows, n1, n2 = a3r.shape
+    # A[b, j1, j2] -> [b*j2, j1] so stage 1 contracts j1 on the MXU.
+    sr = a3r.transpose(0, 2, 1).reshape(rows * n2, n1)
+    si = a3i.transpose(0, 2, 1).reshape(rows * n2, n1)
+    gr = _mm(sr, w1r) - _mm(si, w1i)
+    gi = _mm(sr, w1i) + _mm(si, w1r)
+    # Twiddle on [b, j2, k1] (T broadcast over the batch).
+    gr = gr.reshape(rows, n2, n1)
+    gi = gi.reshape(rows, n2, n1)
+    hr = gr * tr - gi * ti
+    hi = gr * ti + gi * tr
+    # Stage 2 contracts j2: [b*k1, j2] @ W2 -> Z[b, k1, k2].
+    hr = hr.transpose(0, 2, 1).reshape(rows * n1, n2)
+    hi = hi.transpose(0, 2, 1).reshape(rows * n1, n2)
+    zr = _mm(hr, w2r) - _mm(hi, w2i)
+    zi = _mm(hr, w2i) + _mm(hi, w2r)
+    # Output flat index k = k1 + n1*k2: emit Z^T = [b, k2, k1].
+    zr = zr.reshape(rows, n1, n2).transpose(0, 2, 1)
+    zi = zi.reshape(rows, n1, n2).transpose(0, 2, 1)
+    return zr, zi
+
+
 def _make_kernel(n1: int, n2: int):
     def kernel(w1r, w1i, tr, ti, w2r, w2i, xr, xi, yr, yi):
-        # Mosaic note: every reshape below merges/splits *leading* dims only
-        # (the lane dim never changes inside a reshape); layout moves between
-        # the two matmul groupings happen via last-two-dim transposes.
+        zr, zi = _four_step_pass(
+            xr[:], xi[:],
+            w1r[:], w1i[:], tr[:], ti[:], w2r[:], w2i[:],
+        )
+        yr[:] = zr
+        yi[:] = zi
+
+    return kernel
+
+
+def _make_kernel2d(ny: int, nz: int):
+    """Fused 2D kernel: FFT along Z then Y of one plane tile, both passes
+    staged through VMEM in ONE launch — the templateFFT 2D-app role (one
+    ``FFT_main`` covering the whole YZ plane, ``kernel_512x512x1.h``; the
+    t0 stage of the slab pipeline, ``fft_mpi_3d_api.cpp:466-522``). Where
+    the per-axis path writes the full array to HBM between axes, this
+    kernel transposes in VMEM: one HBM read and one write for the plane.
+
+    Blocks are 5D ``[bt, y1, y2, z1, z2]`` (both axes pre-split by the
+    caller) so every in-kernel reshape merges/splits leading dims only;
+    the inter-axis data movement is done by transposes, which Mosaic
+    implements as real relayouts. Output blocks are ``[bt, ky2, ky1, kz2,
+    kz1]`` — flat (k2, k1) per axis is that axis's natural transformed
+    order, so the caller's view back to ``[batch, ny, nz]`` is free."""
+    y1, y2 = split_for(ny)
+    z1, z2 = split_for(nz)
+
+    def kernel(wy1r, wy1i, tyr, tyi, wy2r, wy2i,
+               wz1r, wz1i, tzr, tzi, wz2r, wz2i, xr, xi, yr, yi):
         bt = xr.shape[0]
-        # A[b, j1, j2] -> [b*j2, j1] so stage 1 contracts j1 on the MXU.
-        ar = xr[:].transpose(0, 2, 1).reshape(bt * n2, n1)
-        ai = xi[:].transpose(0, 2, 1).reshape(bt * n2, n1)
-        gr = _mm(ar, w1r[:]) - _mm(ai, w1i[:])
-        gi = _mm(ar, w1i[:]) + _mm(ai, w1r[:])
-        # Twiddle on [b, j2, k1] (T broadcast over the batch).
-        gr = gr.reshape(bt, n2, n1)
-        gi = gi.reshape(bt, n2, n1)
-        hr = gr * tr[:] - gi * ti[:]
-        hi = gr * ti[:] + gi * tr[:]
-        # Stage 2 contracts j2: [b*k1, j2] @ W2 -> Z[b, k1, k2].
-        hr = hr.transpose(0, 2, 1).reshape(bt * n1, n2)
-        hi = hi.transpose(0, 2, 1).reshape(bt * n1, n2)
-        zr = _mm(hr, w2r[:]) - _mm(hi, w2i[:])
-        zi = _mm(hr, w2i[:]) + _mm(hi, w2r[:])
-        # Output flat index k = k1 + n1*k2: emit Z^T = [b, k2, k1]; the
-        # caller views the [batch, n2, n1] result as [batch, n] for free.
-        yr[:] = zr.reshape(bt, n1, n2).transpose(0, 2, 1)
-        yi[:] = zi.reshape(bt, n1, n2).transpose(0, 2, 1)
+        # Pass 1 over Z: rows = bt*y1*y2 (leading merge).
+        ar = xr[:].reshape(bt * y1 * y2, z1, z2)
+        ai = xi[:].reshape(bt * y1 * y2, z1, z2)
+        br, bi = _four_step_pass(ar, ai, wz1r[:], wz1i[:], tzr[:],
+                                 tzi[:], wz2r[:], wz2i[:])
+        # [bt, y1, y2, kz2, kz1] -> [bt, kz2, kz1, y1, y2] (VMEM relayout).
+        br = br.reshape(bt, y1, y2, z2, z1).transpose(0, 3, 4, 1, 2)
+        bi = bi.reshape(bt, y1, y2, z2, z1).transpose(0, 3, 4, 1, 2)
+        # Pass 2 over Y: rows = bt*z2*z1.
+        br = br.reshape(bt * z2 * z1, y1, y2)
+        bi = bi.reshape(bt * z2 * z1, y1, y2)
+        cr, ci = _four_step_pass(br, bi, wy1r[:], wy1i[:], tyr[:],
+                                 tyi[:], wy2r[:], wy2i[:])
+        # [bt, kz2, kz1, ky2, ky1] -> [bt, ky2, ky1, kz2, kz1].
+        cr = cr.reshape(bt, z2, z1, y2, y1).transpose(0, 3, 4, 1, 2)
+        ci = ci.reshape(bt, z2, z1, y2, y1).transpose(0, 3, 4, 1, 2)
+        yr[:] = cr
+        yi[:] = ci
 
     return kernel
 
@@ -209,6 +269,121 @@ def _fft_tiles(xr, xi, *, n: int, forward: bool, interpret: bool):
         interpret=interpret,
     )(*consts, xr.reshape(batch, n1, n2), xi.reshape(batch, n1, n2))
     return yr.reshape(batch, n), yi.reshape(batch, n)
+
+
+def batch_tile_2d(ny: int, nz: int) -> int:
+    """Plane-batch rows per grid step for the fused 2D kernel (same budget
+    model as :func:`batch_tile` scaled by the full plane size)."""
+    return _tile_rows("DFFT_PALLAS_TILE2D", 4 * 4 * ny * nz, 1)
+
+
+# Largest ny*nz plane (float32 elements) the fused 2D kernel accepts: one
+# plane copy must fit the per-tile VMEM budget, since the kernel's working
+# set is ~a dozen live plane copies even at bt=1 (the measured stack model
+# behind _VMEM_BUDGET). 512x1024 planes pass; 1024^2 and beyond take the
+# per-axis path until hardware-proven.
+_MAX_PLANE_ELEMS = _VMEM_BUDGET // 4
+
+
+def eligible2d(ny: int, nz: int) -> bool:
+    """Plane shapes the fused 2D kernel handles: single-kernel factors on
+    BOTH axes *and* a VMEM-bounded plane footprint; larger planes take the
+    per-axis path."""
+    return (eligible(ny) and eligible(nz)
+            and ny * nz <= _MAX_PLANE_ELEMS)
+
+
+@functools.partial(jax.jit, static_argnames=("ny", "nz", "forward",
+                                             "interpret"))
+def _fft2_tiles(xr, xi, *, ny: int, nz: int, forward: bool, interpret: bool):
+    """Batched 2D DFT of [batch, ny, nz] float32 re/im planes; batch must
+    be a multiple of the tile size. Blocks travel pre-split as
+    [bt, y1, y2, z1, z2] (see ``_make_kernel2d``); outputs come back as
+    [batch, ky2, ky1, kz2, kz1] = [batch, ny, nz] flat."""
+    batch = xr.shape[0]
+    bt = min(batch_tile_2d(ny, nz), batch)
+    grid = batch // bt
+    y1, y2 = split_for(ny)
+    z1, z2 = split_for(nz)
+
+    tabs = []
+    for n in (ny, nz):
+        w1, t, w2 = _tables_np(n, forward)
+        tabs += [m for m in (w1, t, w2)]
+    consts = [jnp.asarray(p) for m in tabs for p in (m.real, m.imag)]
+    vma = _vma(xr)
+    if vma:
+        consts = [pvary(c, tuple(vma)) for c in consts]
+
+    lut_specs = [
+        pl.BlockSpec(m.shape, lambda i: (0, 0), memory_space=pltpu.VMEM)
+        for m in tabs for _ in (0, 1)
+    ]
+    x_spec = pl.BlockSpec((bt, y1, y2, z1, z2), lambda i: (i, 0, 0, 0, 0),
+                          memory_space=pltpu.VMEM)
+    y_spec = pl.BlockSpec((bt, y2, y1, z2, z1), lambda i: (i, 0, 0, 0, 0),
+                          memory_space=pltpu.VMEM)
+
+    yr, yi = pl.pallas_call(
+        _make_kernel2d(ny, nz),
+        grid=(grid,),
+        in_specs=lut_specs + [x_spec, x_spec],
+        out_specs=(y_spec, y_spec),
+        out_shape=(
+            jax.ShapeDtypeStruct((batch, y2, y1, z2, z1), jnp.float32,
+                                 vma=vma),
+            jax.ShapeDtypeStruct((batch, y2, y1, z2, z1), jnp.float32,
+                                 vma=vma),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=8 * batch * ny * nz * sum(sum(split_for(n))
+                                            for n in (ny, nz)),
+            bytes_accessed=4 * batch * ny * nz * 4,
+            transcendentals=0,
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",),
+            vmem_limit_bytes=_VMEM_LIMIT,
+        ),
+        interpret=interpret,
+    )(*consts,
+      xr.reshape(batch, y1, y2, z1, z2),
+      xi.reshape(batch, y1, y2, z1, z2))
+    return yr.reshape(batch, ny, nz), yi.reshape(batch, ny, nz)
+
+
+def fft2_last(x: jnp.ndarray, forward: bool = True) -> jnp.ndarray:
+    """Fused 2D C2C FFT over the LAST TWO axes of ``x`` (complex64, both
+    extents kernel-eligible — callers gate on :func:`eligible2d`). Forward
+    unnormalized, inverse scaled by 1/(ny*nz)."""
+    ny, nz = x.shape[-2:]
+    lead = x.shape[:-2]
+    batch = math.prod(lead) if lead else 1
+    x2 = x.reshape((batch, ny, nz))
+
+    bt = min(batch_tile_2d(ny, nz), max(1, batch))
+    pad = (-batch) % bt
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0), (0, 0)))
+    interpret = jax.default_backend() == "cpu"
+    if interpret and _vma(x2):
+        # CPU test backend under shard_map: the interpreter's grid loop
+        # cannot carry varying-axes types — per-axis jnp mirror, numerics
+        # identical to the kernel.
+        y = _four_step_ref(x2.reshape(-1, nz), nz, forward)
+        y = y.reshape(x2.shape)
+        y = jnp.swapaxes(y, -1, -2)
+        y = _four_step_ref(y.reshape(-1, ny), ny, forward)
+        y = jnp.swapaxes(y.reshape(x2.shape[0], nz, ny), -1, -2)
+    else:
+        yr, yi = _fft2_tiles(jnp.real(x2), jnp.imag(x2), ny=ny, nz=nz,
+                             forward=forward, interpret=interpret)
+        y = lax.complex(yr, yi)
+    if pad:
+        y = y[:batch]
+    if not forward:
+        y = y * jnp.float32(1.0 / (ny * nz))
+    return y.reshape(lead + (ny, nz))
 
 
 @functools.lru_cache(maxsize=None)
