@@ -118,6 +118,19 @@ class Plan3D:
     def __call__(self, x, *, scale: Scale = Scale.NONE):
         return execute(self, x, scale=scale)
 
+    def compile(self) -> "Plan3D":
+        """Eagerly compile (and warm every cache for) this plan's
+        transform, so later executes only replay — the reference's
+        plan-time discipline: all hipRTC compilation happens inside
+        ``setFFTPlans``/``initializeFFT`` and ``launchFFTKernel`` only
+        replays precomputed launches (``templateFFT.cpp:5621-5712,
+        6212-6260``). Runs one throwaway zero-filled execution; returns
+        ``self`` for chaining."""
+        from .utils.timing import sync
+
+        sync(self.fn(alloc_local(self)))
+        return self
+
     def flops(self) -> float:
         return geo.fft_flops(self.shape)
 
@@ -319,6 +332,12 @@ def plan_dft_c2c_3d(
     """
     shape, forward = _check_direction(shape, direction)
     opts = _resolve_options(decomposition, executor, donate, algorithm, options)
+    if opts.executor == "auto":
+        return _auto_plan(
+            functools.partial(plan_dft_c2c_3d, shape, mesh), opts,
+            direction=direction, dtype=dtype, in_spec=in_spec,
+            out_spec=out_spec,
+        )
     dtype = _default_cdtype(dtype)
     lp = logic_plan3d(
         shape, mesh, opts, forward=forward, in_spec=in_spec, out_spec=out_spec
@@ -372,6 +391,83 @@ def plan_dft_c2c_3d(
         fn=fn, spec=spec, in_sharding=in_sh, out_sharding=out_sh,
         in_boxes=in_boxes, out_boxes=out_boxes, options=lp.options, logic=lp,
     )
+
+
+#: Executor candidates tried by ``executor="auto"`` (override with the
+#: DFFT_AUTO_EXECUTORS env var, comma-separated).
+_AUTO_CANDIDATES = ("xla", "pallas", "matmul")
+
+
+def _autotune(make_plan: Callable[[str], Plan3D]) -> Plan3D:
+    """Plan every candidate executor, time one execution of each, keep the
+    fastest — the reference's plan-and-pick discipline (``setFFTPlans``
+    builds hipfft, rocfft, AND templateFFT plans side by side and selects
+    one, ``fft_mpi_3d_api.cpp:318-429``). Candidates that fail to compile
+    or execute are skipped, never fatal.
+
+    Timing uses a zero-filled input (FFT cost is data-independent) and
+    pays one compile per candidate at plan time — the same cost profile
+    as the reference's plan-time hipRTC compilation of every backend.
+
+    Multi-host: every process runs the tournament in lockstep (the timing
+    executions are themselves collective), but wall clocks differ per
+    process — the winner is therefore decided by process 0's times and
+    broadcast, so all processes build the same collective program.
+    """
+    import os
+
+    import numpy as np
+
+    from .utils.timing import time_fn
+
+    names = [e.strip() for e in os.environ.get(
+        "DFFT_AUTO_EXECUTORS", ",".join(_AUTO_CANDIDATES)).split(",")
+        if e.strip()]
+    plans: dict[str, Plan3D] = {}
+    times: dict[str, float] = {}
+    errors: list[str] = []
+    for ex in names:
+        try:
+            p = make_plan(ex)
+            x = alloc_local(p)
+            t, _ = time_fn(p.fn, x, iters=2, warmup=1)
+        except Exception as e:  # noqa: BLE001 — candidate skipped
+            errors.append(f"{ex}: {type(e).__name__}")
+            continue
+        plans[ex] = p
+        times[ex] = t
+    if not plans:
+        raise ValueError(
+            f"no auto executor candidate succeeded ({'; '.join(errors)})"
+        )
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        vec = np.array([times.get(nm, np.inf) for nm in names], np.float64)
+        vec = np.asarray(multihost_utils.broadcast_one_to_all(vec))
+        order = [nm for i, nm in enumerate(names)
+                 if np.isfinite(vec[i]) and nm in plans]
+        if order:
+            best = min(order, key=lambda nm: vec[names.index(nm)])
+            return plans[best]
+        # Process 0's finite set disagrees with ours — deterministic
+        # fallback to the first commonly-built candidate.
+        return plans[sorted(plans)[0]]
+    return plans[min(times, key=times.get)]
+
+
+def _auto_plan(plan_fn: Callable, opts: PlanOptions, **kw) -> Plan3D:
+    """Shared ``executor="auto"`` dispatch for every plan family: run the
+    tournament donation-free (a donated buffer cannot be re-executed for
+    timing), then rebuild the winner with the caller's donation flag."""
+    import dataclasses
+
+    def mk(ex: str, don: bool) -> Plan3D:
+        o = dataclasses.replace(opts, executor=ex, donate=don)
+        return plan_fn(options=o, **kw)
+
+    best = _autotune(lambda ex: mk(ex, False))
+    return mk(best.executor, opts.donate) if opts.donate else best
 
 
 def _even_fallback_spec(mesh: Mesh, pref: P, shape) -> P:
@@ -509,6 +605,12 @@ def plan_dft_r2c_3d(
     """
     shape, forward = _check_direction(shape, direction)
     opts = _resolve_options(decomposition, executor, donate, algorithm, options)
+    if opts.executor == "auto":
+        return _auto_plan(
+            functools.partial(plan_dft_r2c_3d, shape, mesh), opts,
+            direction=direction, dtype=dtype, in_spec=in_spec,
+            out_spec=out_spec,
+        )
     dtype = _default_cdtype(dtype)
     if not jnp.issubdtype(dtype, jnp.complexfloating):
         raise ValueError(

@@ -1,0 +1,68 @@
+"""executor="auto" plan-time autotuning — the setFFTPlans plan-and-pick
+discipline (the reference builds hipfft/rocfft/templateFFT plans side by
+side and selects one, ``fft_mpi_3d_api.cpp:318-429``)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu import testing as tu
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the virtual 8-device mesh"
+)
+
+
+def test_auto_picks_a_candidate_and_is_correct():
+    shape = (16, 12, 8)
+    mesh = dfft.make_mesh(8)
+    plan = dfft.plan_dft_c2c_3d(shape, mesh, executor="auto",
+                                dtype=np.complex64)
+    assert plan.executor in ("xla", "pallas", "matmul")
+    x = tu.make_world_data(shape, dtype=np.complex64)
+    got = np.asarray(plan(x))
+    want = np.fft.fftn(x)
+    assert np.max(np.abs(got - want)) / np.abs(want).max() < 5e-4
+
+
+def test_auto_respects_env_candidates(monkeypatch):
+    monkeypatch.setenv("DFFT_AUTO_EXECUTORS", "matmul")
+    plan = dfft.plan_dft_c2c_3d((8, 8, 8), dfft.make_mesh(8),
+                                executor="auto", dtype=np.complex64)
+    assert plan.executor == "matmul"
+
+
+def test_auto_r2c():
+    shape = (8, 8, 16)
+    mesh = dfft.make_mesh(8)
+    plan = dfft.plan_dft_r2c_3d(shape, mesh, executor="auto")
+    x = tu.make_world_data(shape, dtype=np.float64)
+    got = np.asarray(plan(x))
+    want = np.fft.rfftn(x)
+    assert np.max(np.abs(got - want)) / np.abs(want).max() < 1e-10
+
+
+def test_auto_with_donation_rebuilds_winner():
+    shape = (8, 8, 8)
+    mesh = dfft.make_mesh(8)
+    plan = dfft.plan_dft_c2c_3d(shape, mesh, executor="auto", donate=True,
+                                dtype=np.complex64)
+    assert plan.options.donate is True
+    x = dfft.alloc_local(plan, fill=tu.make_world_data(shape,
+                                                       dtype=np.complex64))
+    y = plan(x)  # consumes x
+    assert y.shape == shape
+
+
+def test_plan_compile_chains():
+    plan = dfft.plan_dft_c2c_3d((8, 8, 8), dfft.make_mesh(8),
+                                dtype=np.complex64)
+    assert plan.compile() is plan
+    x = tu.make_world_data((8, 8, 8), dtype=np.complex64)
+    got = np.asarray(plan(x))
+    want = np.fft.fftn(x)
+    assert np.max(np.abs(got - want)) / np.abs(want).max() < 5e-4
