@@ -82,20 +82,26 @@ def run_config(shape, dtype_name, executor, mesh, *, real=False):
         iplan = dfft.plan_dft_c2c_3d(shape, mesh, direction=dfft.BACKWARD,
                                      dtype=dtype, executor=executor)
 
-    mk_kw = {}
-    if plan.in_sharding is not None:
-        mk_kw["out_shardings"] = plan.in_sharding
+    def _make_input_fn(**jit_kw):
+        @functools.partial(jax.jit, **jit_kw)
+        def make_input():
+            k1, k2 = jax.random.split(jax.random.PRNGKey(4242))
+            if real:
+                return jax.random.normal(k1, shape, plan.in_dtype)
+            re = jax.random.normal(k1, shape, jnp.float32)
+            im = jax.random.normal(k2, shape, jnp.float32)
+            return (re + 1j * im).astype(dtype)
 
-    @functools.partial(jax.jit, **mk_kw)
-    def make_input():
-        k1, k2 = jax.random.split(jax.random.PRNGKey(4242))
-        if real:
-            return jax.random.normal(k1, shape, plan.in_dtype)
-        re = jax.random.normal(k1, shape, jnp.float32)
-        im = jax.random.normal(k2, shape, jnp.float32)
-        return (re + 1j * im).astype(dtype)
+        return make_input
 
-    x = make_input()
+    try:
+        # Pin the plan's input sharding when it can be pinned (jit output
+        # shardings need evenly-dividing extents; uneven plans pad/crop
+        # internally and take unpinned input).
+        x = _make_input_fn(out_shardings=plan.in_sharding)() \
+            if plan.in_sharding is not None else _make_input_fn()()
+    except ValueError:
+        x = _make_input_fn()()
     sync(x)
     err = max_rel_err(iplan(plan(x)), x)
     seconds, _ = time_fn_amortized(lambda: plan(x), iters=10, repeats=3)
@@ -110,6 +116,9 @@ def run_config(shape, dtype_name, executor, mesh, *, real=False):
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--sizes", type=int, nargs="*", default=None)
+    ap.add_argument("--shapes", nargs="*", default=None,
+                    help="extra non-cubic shapes, e.g. 1536x1024x768 "
+                         "(the BASELINE.json pencil config)")
     ap.add_argument("--quick", action="store_true",
                     help="tiny shapes for CI smoke")
     ap.add_argument("--out", default=None, help="CSV path override")
@@ -162,17 +171,27 @@ def main() -> int:
         cdtypes.append("complex128")
         rdtypes.append("float64")
 
+    shapes = [(n, n, n) for n in sizes]
+    for s in args.shapes or []:
+        try:
+            dims = tuple(int(v) for v in s.lower().split("x"))
+        except ValueError:
+            ap.error(f"--shapes value {s!r} is not NXxNYxNZ")
+        if len(dims) != 3:
+            ap.error(f"--shapes value {s!r} needs exactly 3 extents")
+        shapes.append(dims)
+
     failures = 0
-    for n in sizes:
-        shape = (n, n, n)
+    for shape in shapes:
+        n0, n1, n2 = shape
         jobs = [(dt, ex, False) for dt in cdtypes for ex in executors]
         jobs += [(dt, ex, True) for dt in rdtypes for ex in executors]
         for dt, ex, real in jobs:
             kind = "r2c" if real else "c2c"
             try:
                 r = run_config(shape, dt, ex, mesh, real=real)
-                rec.record(run, n, n, n, kind, dt, r["decomposition"], ex,
-                           backend, n_dev, f"{r['seconds']:.6f}",
+                rec.record(run, n0, n1, n2, kind, dt, r["decomposition"],
+                           ex, backend, n_dev, f"{r['seconds']:.6f}",
                            f"{r['gflops']:.1f}", f"{r['max_err']:.3e}", "ok")
                 print(f"{shape} {kind} {dt} {ex}: {r['gflops']:.1f} GFlops "
                       f"err={r['max_err']:.2e}", flush=True)
@@ -180,7 +199,7 @@ def main() -> int:
                 failures += 1
                 msg = f"{type(e).__name__}: {e}".replace(",", ";")
                 msg = " ".join(msg.split())[:160]
-                rec.record(run, n, n, n, kind, dt, "-", ex, backend,
+                rec.record(run, n0, n1, n2, kind, dt, "-", ex, backend,
                            n_dev, "-", "-", "-", f"error {msg}")
                 print(f"{shape} {kind} {dt} {ex}: FAILED {msg}",
                       file=sys.stderr, flush=True)

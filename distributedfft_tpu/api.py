@@ -422,37 +422,67 @@ def _autotune(make_plan: Callable[[str], Plan3D]) -> Plan3D:
 
     names = [e.strip() for e in os.environ.get(
         "DFFT_AUTO_EXECUTORS", ",".join(_AUTO_CANDIDATES)).split(",")
-        if e.strip()]
-    plans: dict[str, Plan3D] = {}
-    times: dict[str, float] = {}
+        if e.strip() and e.strip() != "auto"]  # 'auto' itself would recurse
     errors: list[str] = []
+
+    # Phase 1: build every candidate plan (no execution — jit is lazy, so
+    # building is host-local and never emits collectives).
+    plans: dict[str, Plan3D] = {}
     for ex in names:
         try:
-            p = make_plan(ex)
-            x = alloc_local(p)
-            t, _ = time_fn(p.fn, x, iters=2, warmup=1)
+            plans[ex] = make_plan(ex)
         except Exception as e:  # noqa: BLE001 — candidate skipped
             errors.append(f"{ex}: {type(e).__name__}")
-            continue
-        plans[ex] = p
-        times[ex] = t
     if not plans:
         raise ValueError(
             f"no auto executor candidate succeeded ({'; '.join(errors)})"
         )
-    if jax.process_count() > 1:
+
+    # Multi-host: agree on the candidate set BEFORE any timing execution —
+    # a candidate that built on only some processes must be timed on none,
+    # or the processes that have it enter collective executions the others
+    # never join (distributed hang).
+    candidates = [nm for nm in names if nm in plans]
+    multi = jax.process_count() > 1
+    if multi:
         from jax.experimental import multihost_utils
 
-        vec = np.array([times.get(nm, np.inf) for nm in names], np.float64)
-        vec = np.asarray(multihost_utils.broadcast_one_to_all(vec))
-        order = [nm for i, nm in enumerate(names)
-                 if np.isfinite(vec[i]) and nm in plans]
-        if order:
-            best = min(order, key=lambda nm: vec[names.index(nm)])
-            return plans[best]
-        # Process 0's finite set disagrees with ours — deterministic
-        # fallback to the first commonly-built candidate.
-        return plans[sorted(plans)[0]]
+        flags = np.array([1.0 if nm in plans else 0.0 for nm in names])
+        allf = np.asarray(multihost_utils.process_allgather(flags))
+        allf = allf.reshape(-1, len(names))
+        common = allf.min(axis=0) > 0
+        candidates = [nm for i, nm in enumerate(names) if common[i]]
+        if not candidates:
+            raise ValueError(
+                "no auto executor candidate built on every process "
+                f"(local: {sorted(plans)}; errors: {'; '.join(errors)})"
+            )
+
+    # Phase 2: time the agreed candidates in lockstep (identical order and
+    # execution count on every process).
+    times: dict[str, float] = {}
+    for ex in candidates:
+        try:
+            x = alloc_local(plans[ex])
+            t, _ = time_fn(plans[ex].fn, x, iters=2, warmup=1)
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"{ex}: {type(e).__name__}")
+            t = math.inf
+        times[ex] = t
+    if not any(math.isfinite(t) for t in times.values()):
+        raise ValueError(
+            f"every auto executor candidate failed ({'; '.join(errors)})"
+        )
+
+    # Wall clocks differ per process: the winner is process 0's choice,
+    # broadcast so every process builds the same collective program.
+    if multi:
+        from jax.experimental import multihost_utils
+
+        vec = np.array([times[nm] for nm in candidates], np.float64)
+        vec = np.asarray(multihost_utils.broadcast_one_to_all(vec)).ravel()
+        best = candidates[int(np.argmin(vec))]
+        return plans[best]
     return plans[min(times, key=times.get)]
 
 

@@ -72,11 +72,38 @@ def _best_split(n: int) -> tuple[int, int] | None:
     return native.balanced_split(n, n)
 
 
+def mm_precision() -> "lax.Precision":
+    """MXU precision for every DFT contraction (matmul + Pallas engines).
+
+    HIGHEST (f32-exact via multi-pass bf16) by default — the accuracy tier
+    the c64 roundtrip gates assume. ``DFFT_MM_PRECISION=default|high|
+    highest`` trades passes for throughput (up to ~3x MXU rate at reduced
+    accuracy) — a measurable knob for the hardware tuning sweeps, in the
+    spirit of the reference's per-backend accuracy/speed trade
+    (``csv/batch_rocResult1D.csv`` records rocFFT's faster-but-inaccurate
+    rows side by side). Read at trace time: set it before planning."""
+    import os
+
+    s = os.environ.get("DFFT_MM_PRECISION", "highest").strip().lower()
+    table = {
+        "default": lax.Precision.DEFAULT,
+        "high": lax.Precision.HIGH,
+        "highest": lax.Precision.HIGHEST,
+    }
+    try:
+        return table[s]
+    except KeyError:
+        raise ValueError(
+            f"DFFT_MM_PRECISION={s!r} is not a precision tier; "
+            f"use one of {sorted(table)}"
+        ) from None
+
+
 def _direct(x: jnp.ndarray, forward: bool) -> jnp.ndarray:
     """Dense DFT of the last axis: one batched matmul on the MXU."""
     n = x.shape[-1]
     w = jnp.asarray(_dft_matrix_np(n, forward), dtype=x.dtype)
-    return jnp.einsum("...j,jk->...k", x, w, precision=lax.Precision.HIGHEST)
+    return jnp.einsum("...j,jk->...k", x, w, precision=mm_precision())
 
 
 # Prime lengths above this use Bluestein's chirp-z algorithm instead of the

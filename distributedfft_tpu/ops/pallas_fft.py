@@ -129,9 +129,11 @@ def _vma(x) -> frozenset:
 
 
 def _mm(a, b):
+    from .dft_matmul import mm_precision
+
     return lax.dot_general(
         a, b, (((1,), (0,)), ((), ())),
-        precision=lax.Precision.HIGHEST,
+        precision=mm_precision(),
         preferred_element_type=jnp.float32,
     )
 
@@ -451,9 +453,11 @@ def _four_step_ref(x2: jnp.ndarray, n: int, forward: bool) -> jnp.ndarray:
     n1, n2 = split_for(n)
     w1, t, w2 = (jnp.asarray(m) for m in _tables_np(n, forward))
     a = x2.reshape(-1, n1, n2)
-    g = jnp.einsum("bij,ik->bjk", a, w1, precision=lax.Precision.HIGHEST)
+    from .dft_matmul import mm_precision
+
+    g = jnp.einsum("bij,ik->bjk", a, w1, precision=mm_precision())
     h = g * t
-    z = jnp.einsum("bjk,jl->bkl", h, w2, precision=lax.Precision.HIGHEST)
+    z = jnp.einsum("bjk,jl->bkl", h, w2, precision=mm_precision())
     return z.transpose(0, 2, 1).reshape(x2.shape)
 
 

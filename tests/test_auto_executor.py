@@ -66,3 +66,11 @@ def test_plan_compile_chains():
     got = np.asarray(plan(x))
     want = np.fft.fftn(x)
     assert np.max(np.abs(got - want)) / np.abs(want).max() < 5e-4
+
+
+def test_auto_rejects_recursive_candidate(monkeypatch):
+    """'auto' in the candidate list cannot recurse into nested tournaments."""
+    monkeypatch.setenv("DFFT_AUTO_EXECUTORS", "auto, xla")
+    plan = dfft.plan_dft_c2c_3d((8, 8, 8), dfft.make_mesh(8),
+                                executor="auto", dtype=np.complex64)
+    assert plan.executor == "xla"

@@ -89,3 +89,18 @@ def test_best_split_near_sqrt():
     assert dft_matmul._best_split(512) == (16, 32)
     assert dft_matmul._best_split(360) == (18, 20)
     assert dft_matmul._best_split(13) is None
+
+
+def test_mm_precision_env(monkeypatch):
+    """DFFT_MM_PRECISION parses the three tiers and defaults to HIGHEST."""
+    import jax.lax as lax
+
+    from distributedfft_tpu.ops.dft_matmul import mm_precision
+
+    monkeypatch.delenv("DFFT_MM_PRECISION", raising=False)
+    assert mm_precision() == lax.Precision.HIGHEST
+    for name, want in (("default", lax.Precision.DEFAULT),
+                       ("high", lax.Precision.HIGH),
+                       ("highest", lax.Precision.HIGHEST)):
+        monkeypatch.setenv("DFFT_MM_PRECISION", name)
+        assert mm_precision() == want
