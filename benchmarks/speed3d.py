@@ -43,6 +43,10 @@ def parse_args(argv):
     g = p.add_mutually_exclusive_group()
     g.add_argument("-slabs", action="store_true", help="force slab decomposition")
     g.add_argument("-pencils", action="store_true", help="force pencil decomposition")
+    g.add_argument("-bricks", action="store_true",
+                   help="arbitrary-brick I/O plan: uneven Z-slabs in, "
+                        "X-pencils out, over the overlap-map ring engine "
+                        "(c2c only)")
     a = p.add_mutually_exclusive_group()
     a.add_argument("-a2a", action="store_true", help="fused all_to_all exchange (default)")
     a.add_argument("-p2p_pl", action="store_true",
@@ -112,8 +116,13 @@ def main(argv=None) -> None:
     algorithm = ("ppermute" if args.p2p_pl
                  else "alltoallv" if args.a2av else "alltoall")
 
+    if args.bricks and args.kind != "c2c":
+        raise SystemExit("-bricks supports c2c only")
     if args.grid:
         mesh = dfft.make_mesh(tuple(args.grid))
+        decomposition = None
+    elif args.bricks:
+        mesh = dfft.make_mesh(ndev) if ndev > 1 else None
         decomposition = None
     elif args.pencils:
         # Same min-surface grid the planner's int-mesh path would choose, so
@@ -133,15 +142,35 @@ def main(argv=None) -> None:
     plan_fn = dfft.plan_dft_r2c_3d if args.kind == "r2c" else dfft.plan_dft_c2c_3d
     kw = dict(decomposition=decomposition, executor=args.executor,
               dtype=dtype, algorithm=algorithm)
-    fwd = plan_fn(shape, mesh, direction=dfft.FORWARD, **kw)
-    bwd = plan_fn(shape, mesh, direction=dfft.BACKWARD, **kw)
+    if args.bricks:
+        if mesh is None:
+            raise SystemExit("-bricks needs a multi-device mesh")
+        from distributedfft_tpu.geometry import (
+            ceil_splits, make_pencils, make_slabs, world_box,
+        )
+        from distributedfft_tpu import native
+
+        w = world_box(shape)
+        in_boxes = make_slabs(w, ndev, axis=2, rule=ceil_splits)
+        out_boxes = make_pencils(w, native.pencil_grid(shape, ndev), 0)
+        fwd = dfft.plan_brick_dft_c2c_3d(
+            shape, mesh, in_boxes, out_boxes, direction=dfft.FORWARD,
+            executor=args.executor, dtype=dtype, algorithm=algorithm)
+        bwd = dfft.plan_brick_dft_c2c_3d(
+            shape, mesh, out_boxes, in_boxes, direction=dfft.BACKWARD,
+            executor=args.executor, dtype=dtype, algorithm=algorithm)
+    else:
+        fwd = plan_fn(shape, mesh, direction=dfft.FORWARD, **kw)
+        bwd = plan_fn(shape, mesh, direction=dfft.BACKWARD, **kw)
     print(dfft.plan_info(fwd))
 
     # On-device deterministic init (the reference inits on device too,
     # fftSpeed3d_c2c.cpp:61-72). Sharding hints need divisible extents;
     # uneven plans place the (padded) sharding themselves.
     mk_kw = {}
-    if fwd.in_sharding is not None:
+    if args.bricks:
+        pass  # brick stacks always shard evenly (one brick per device)
+    elif fwd.in_sharding is not None:
         from distributedfft_tpu.plan_logic import spec_entries
 
         divides = all(
@@ -155,6 +184,26 @@ def main(argv=None) -> None:
     def make_input():
         k1, k2 = jax.random.split(jax.random.PRNGKey(4242))
         rdt = jnp.float64 if dtype == jnp.complex128 else jnp.float32
+        if args.bricks:
+            # On-device brick-stack init: random values, with the per-brick
+            # pad regions masked to zero (pads never travel the ring, but
+            # the stack-level roundtrip compare needs them zero on input).
+            import numpy as np
+            from jax import lax as jlax
+
+            stack_shape = fwd.in_shape
+            sizes = np.array([b.shape for b in fwd.in_boxes], np.int32)
+            re = jax.random.normal(k1, stack_shape, rdt)
+            im = jax.random.normal(k2, stack_shape, rdt)
+            mask = jnp.ones(stack_shape, bool)
+            for d in range(3):
+                idx = jlax.broadcasted_iota(jnp.int32, stack_shape, d + 1)
+                lim = jnp.asarray(sizes[:, d]).reshape(-1, 1, 1, 1)
+                mask &= idx < lim
+            z = (re + 1j * im).astype(dtype) * mask
+            if fwd.in_sharding is not None:
+                z = jlax.with_sharding_constraint(z, fwd.in_sharding)
+            return z
         re = jax.random.normal(k1, shape, rdt)
         if args.kind == "r2c":
             return re
@@ -169,6 +218,10 @@ def main(argv=None) -> None:
         max_err = max_rel_err(bwd(fwd(x)), x)
 
     stage_times = None
+    if args.staged and args.bricks:
+        print("note: -staged is not available for brick plans; ignoring",
+              file=sys.stderr)
+        args.staged = False
     if args.staged:
         stages = None
         if fwd.mesh is None:
@@ -225,7 +278,8 @@ def main(argv=None) -> None:
             "kind", "precision", "nx", "ny", "nz", "ndev", "decomposition",
             "algorithm", "executor", "seconds", "gflops", "max_err",
         ))
-        rec.record(args.kind, args.precision, *shape, ndev, fwd.decomposition,
+        deco = f"bricks-{fwd.decomposition}" if args.bricks else fwd.decomposition
+        rec.record(args.kind, args.precision, *shape, ndev, deco,
                    algorithm, args.executor, f"{seconds:.6f}", f"{gf:.1f}",
                    f"{max_err:.3e}")
     if args.trace:

@@ -433,7 +433,11 @@ def _autotune(make_plan: Callable[[str], Plan3D]) -> Plan3D:
             plans[ex] = make_plan(ex)
         except Exception as e:  # noqa: BLE001 — candidate skipped
             errors.append(f"{ex}: {type(e).__name__}")
-    if not plans:
+    multi = jax.process_count() > 1
+    if not plans and not multi:
+        # Multi-host must NOT raise here: every process has to reach the
+        # reconciliation collective below even with an empty local set, or
+        # the others block in it forever — the joint raise happens after.
         raise ValueError(
             f"no auto executor candidate succeeded ({'; '.join(errors)})"
         )
@@ -443,7 +447,6 @@ def _autotune(make_plan: Callable[[str], Plan3D]) -> Plan3D:
     # or the processes that have it enter collective executions the others
     # never join (distributed hang).
     candidates = [nm for nm in names if nm in plans]
-    multi = jax.process_count() > 1
     if multi:
         from jax.experimental import multihost_utils
 
@@ -469,20 +472,27 @@ def _autotune(make_plan: Callable[[str], Plan3D]) -> Plan3D:
             errors.append(f"{ex}: {type(e).__name__}")
             t = math.inf
         times[ex] = t
-    if not any(math.isfinite(t) for t in times.values()):
-        raise ValueError(
-            f"every auto executor candidate failed ({'; '.join(errors)})"
-        )
 
     # Wall clocks differ per process: the winner is process 0's choice,
-    # broadcast so every process builds the same collective program.
+    # broadcast so every process builds the same collective program. The
+    # all-failed decision is made from the broadcast vector too — a local
+    # raise before the collective would strand the other processes in it.
     if multi:
         from jax.experimental import multihost_utils
 
         vec = np.array([times[nm] for nm in candidates], np.float64)
         vec = np.asarray(multihost_utils.broadcast_one_to_all(vec)).ravel()
+        if not np.isfinite(vec).any():
+            raise ValueError(
+                f"every auto executor candidate failed on process 0 "
+                f"({'; '.join(errors)})"
+            )
         best = candidates[int(np.argmin(vec))]
         return plans[best]
+    if not any(math.isfinite(t) for t in times.values()):
+        raise ValueError(
+            f"every auto executor candidate failed ({'; '.join(errors)})"
+        )
     return plans[min(times, key=times.get)]
 
 
