@@ -61,6 +61,8 @@ def main() -> int:
                     default=[64, 128, 256, 512])
     ap.add_argument("--full3d", type=int, default=None,
                     help="also time full 3D c2c at this cube size per executor")
+    ap.add_argument("--strided", action="store_true",
+                    help="also sweep the strided axis-0 kernel at --n")
     ap.add_argument("--plane", type=int, default=None,
                     help="also sweep the fused 2D kernel at this plane size")
     ap.add_argument("--plane-batch", type=int, default=None)
@@ -154,6 +156,45 @@ def main() -> int:
                   flush=True)
     os.environ.pop("DFFT_PALLAS_TILE", None)
     pallas_fft._fft_tiles.clear_cache()
+
+    if args.strided:
+        xs = jax.jit(lambda a: jnp.swapaxes(a, 0, 1))(x)  # [n, batch]
+        sync(xs)
+        xla0 = jax.jit(lambda a: jnp.fft.fft(a, axis=0))
+        ys_ref = None
+        try:
+            t = time_fn(xla0, xs)
+            ys_ref = xla0(xs)
+            sync(ys_ref)
+            rec.record("s-xla", n, batch, "-", f"{t:.6f}",
+                       f"{model / t / 1e9:.1f}", "0", "ok")
+            print(f"xla fft axis0 [{n},{batch}]: {t*1e3:.3f} ms "
+                  f"({model/t/1e9:.1f} GFlops)", flush=True)
+        except Exception as e:  # noqa: BLE001
+            rec.record("s-xla", n, batch, "-", "-", "-", "-",
+                       f"error {type(e).__name__}")
+            print(f"xla axis0 failed: {e}", file=sys.stderr, flush=True)
+        for tile in args.tiles:
+            os.environ["DFFT_PALLAS_TILE_STRIDED"] = str(tile)
+            pallas_fft._fft_strided_tiles.clear_cache()
+            try:
+                pf0 = jax.jit(lambda a: pallas_fft.fft_axis0(a, forward=True))
+                t = time_fn(pf0, xs)
+                err = (max_rel_err(pf0(xs), ys_ref)
+                       if ys_ref is not None else float("nan"))
+                rec.record("s-pallas", n, batch, tile, f"{t:.6f}",
+                           f"{model / t / 1e9:.1f}", f"{err:.3e}", "ok")
+                print(f"pallas strided ct={tile} [{n},{batch}]: "
+                      f"{t*1e3:.3f} ms ({model/t/1e9:.1f} GFlops) "
+                      f"err={err:.2e}", flush=True)
+            except Exception as e:  # noqa: BLE001
+                msg = " ".join(str(e).split())[:140]
+                rec.record("s-pallas", n, batch, tile, "-", "-", "-",
+                           f"error {msg}")
+                print(f"pallas strided ct={tile} failed: {msg}",
+                      file=sys.stderr, flush=True)
+        os.environ.pop("DFFT_PALLAS_TILE_STRIDED", None)
+        pallas_fft._fft_strided_tiles.clear_cache()
 
     if args.plane:
         ny = nz = args.plane

@@ -484,8 +484,9 @@ def _autotune(make_plan: Callable[[str], Plan3D]) -> Plan3D:
         vec = np.asarray(multihost_utils.broadcast_one_to_all(vec)).ravel()
         if not np.isfinite(vec).any():
             raise ValueError(
-                f"every auto executor candidate failed on process 0 "
-                f"({'; '.join(errors)})"
+                "every auto executor candidate failed on process 0"
+                + (f" (local diagnostics: {'; '.join(errors)})"
+                   if errors else "")
             )
         best = candidates[int(np.argmin(vec))]
         return plans[best]

@@ -354,6 +354,118 @@ def _fft2_tiles(xr, xi, *, ny: int, nz: int, forward: bool, interpret: bool):
     return yr.reshape(batch, ny, nz), yi.reshape(batch, ny, nz)
 
 
+def _make_kernel_strided(n1: int, n2: int):
+    """Strided kernel: four-step DFT over the LEADING axis of [n1, n2, ct]
+    tiles (transform axis pre-split, a column chunk trailing) — the
+    ``radixStrided`` role of the reference's codegen
+    (``templateFFT.cpp:1760``): transform a strided axis without a global
+    transpose. The HBM layout never changes; the reorders run on the tile
+    in VMEM. Output tiles are [n2, n1, ct] (flat (k2, k1) = the transformed
+    axis in natural order)."""
+
+    def kernel(w1r, w1i, tr, ti, w2r, w2i, xr, xi, yr, yi):
+        ct = xr.shape[-1]
+        # Stage 1 contracts j1: [j1, j2, c] -> [j2, c, j1] -> [j2*c, j1].
+        ar = xr[:].transpose(1, 2, 0).reshape(n2 * ct, n1)
+        ai = xi[:].transpose(1, 2, 0).reshape(n2 * ct, n1)
+        gr = _mm(ar, w1r[:]) - _mm(ai, w1i[:])
+        gi = _mm(ar, w1i[:]) + _mm(ai, w1r[:])
+        # Twiddle T[j2, k1] broadcast over the column chunk.
+        gr = gr.reshape(n2, ct, n1)
+        gi = gi.reshape(n2, ct, n1)
+        hr = gr * tr[:][:, None, :] - gi * ti[:][:, None, :]
+        hi = gr * ti[:][:, None, :] + gi * tr[:][:, None, :]
+        # Stage 2 contracts j2: [j2, c, k1] -> [c, k1, j2] -> [c*k1, j2].
+        hr = hr.transpose(1, 2, 0).reshape(ct * n1, n2)
+        hi = hi.transpose(1, 2, 0).reshape(ct * n1, n2)
+        zr = _mm(hr, w2r[:]) - _mm(hi, w2i[:])
+        zi = _mm(hr, w2i[:]) + _mm(hi, w2r[:])
+        # [c, k1, k2] -> [k2, k1, c]: leading flat (k2, k1) = output order.
+        yr[:] = zr.reshape(ct, n1, n2).transpose(2, 1, 0)
+        yi[:] = zi.reshape(ct, n1, n2).transpose(2, 1, 0)
+
+    return kernel
+
+
+def col_tile(n: int) -> int:
+    """Column chunk per grid step for the strided kernel."""
+    return _tile_rows("DFFT_PALLAS_TILE_STRIDED", 4 * 4 * n, 8)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "forward", "interpret"))
+def _fft_strided_tiles(xr, xi, *, n: int, forward: bool, interpret: bool):
+    """Length-n DFT over the LEADING axis of [n, cols] float32 re/im
+    planes; cols must be a multiple of the tile size."""
+    n1, n2 = split_for(n)
+    cols = xr.shape[1]
+    ct = min(col_tile(n), cols)
+    grid = cols // ct
+
+    w1, t, w2 = _tables_np(n, forward)
+    consts = [jnp.asarray(p) for m in (w1, t, w2) for p in (m.real, m.imag)]
+    vma = _vma(xr)
+    if vma:
+        consts = [pvary(c, tuple(vma)) for c in consts]
+
+    lut_specs = [
+        pl.BlockSpec(m.shape, lambda i: (0, 0), memory_space=pltpu.VMEM)
+        for m in (w1, w1, t, t, w2, w2)
+    ]
+    x_spec = pl.BlockSpec((n1, n2, ct), lambda i: (0, 0, i),
+                          memory_space=pltpu.VMEM)
+    y_spec = pl.BlockSpec((n2, n1, ct), lambda i: (0, 0, i),
+                          memory_space=pltpu.VMEM)
+
+    yr, yi = pl.pallas_call(
+        _make_kernel_strided(n1, n2),
+        grid=(grid,),
+        in_specs=lut_specs + [x_spec, x_spec],
+        out_specs=(y_spec, y_spec),
+        out_shape=(
+            jax.ShapeDtypeStruct((n2, n1, cols), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((n2, n1, cols), jnp.float32, vma=vma),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=8 * cols * n * (n1 + n2),
+            bytes_accessed=4 * cols * n * 4,
+            transcendentals=0,
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",),
+            vmem_limit_bytes=_VMEM_LIMIT,
+        ),
+        interpret=interpret,
+    )(*consts, xr.reshape(n1, n2, cols), xi.reshape(n1, n2, cols))
+    return yr.reshape(n, cols), yi.reshape(n, cols)
+
+
+def fft_axis0(x: jnp.ndarray, forward: bool = True) -> jnp.ndarray:
+    """C2C FFT over axis 0 of ``x`` via the strided kernel — no HBM
+    transpose (callers gate on :func:`eligible` of ``x.shape[0]`` and
+    complex64). Forward unnormalized, inverse scaled by 1/n."""
+    n = x.shape[0]
+    rest = x.shape[1:]
+    cols = math.prod(rest) if rest else 1
+    x2 = x.reshape(n, cols)
+
+    ct = min(col_tile(n), max(8, cols))
+    pad = (-cols) % ct
+    if pad:
+        x2 = jnp.pad(x2, ((0, 0), (0, pad)))
+    interpret = jax.default_backend() == "cpu"
+    if interpret and _vma(x2):
+        y = _four_step_ref(x2.T, n, forward).T
+    else:
+        yr, yi = _fft_strided_tiles(jnp.real(x2), jnp.imag(x2), n=n,
+                                    forward=forward, interpret=interpret)
+        y = lax.complex(yr, yi)
+    if pad:
+        y = y[:, :cols]
+    if not forward:
+        y = y * jnp.float32(1.0 / n)
+    return y.reshape((n,) + rest)
+
+
 def fft2_last(x: jnp.ndarray, forward: bool = True) -> jnp.ndarray:
     """Fused 2D C2C FFT over the LAST TWO axes of ``x`` (complex64, both
     extents kernel-eligible — callers gate on :func:`eligible2d`). Forward
@@ -475,6 +587,11 @@ def fft_along_axis(x: jnp.ndarray, axis: int, forward: bool = True) -> jnp.ndarr
         if outer_split(n) is None:
             return dft_matmul.fft_along_axis(x, axis, forward=forward)
         two_level = True
+
+    if axis % x.ndim == 0 and x.ndim > 1 and not two_level:
+        # Leading-axis transform: the strided kernel reorders in VMEM,
+        # skipping the two HBM moveaxis passes entirely.
+        return fft_axis0(x, forward=forward)
 
     moved = axis not in (-1, x.ndim - 1)
     if moved:

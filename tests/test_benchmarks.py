@@ -127,7 +127,9 @@ def test_record_baseline_quick(tmp_path):
 
 def test_speed3d_bricks(capsys, tmp_path):
     csv = str(tmp_path / "b.csv")
-    speed3d.main(["c2c", "single", "24", "16", "16",
+    # nz=12 over 8 devices: uneven ceil-split bricks, so the pad-masking
+    # init and the uneven ring path are genuinely exercised.
+    speed3d.main(["c2c", "single", "24", "16", "12",
                   "-bricks", "-ndev", "8", "-iters", "1", "-csv", csv])
     out = capsys.readouterr().out
     assert "brick edge in->chain" in out
