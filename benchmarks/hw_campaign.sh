@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# One-shot hardware evidence campaign: run when a real TPU is attached.
+# Each step is independently deadline-bounded (the drivers run their
+# measurements in watchdogged subprocesses), so a mid-campaign backend
+# death costs only the remaining steps — rows already written survive.
+#
+#   bash benchmarks/hw_campaign.sh            # full (~20-30 min)
+#   bash benchmarks/hw_campaign.sh --short    # flagship-only (~5 min)
+
+set -u
+cd "$(dirname "$0")/.."
+
+SHORT=${1:-}
+note() { printf '\n=== %s (%s) ===\n' "$1" "$(date +%T)"; }
+
+note "flagship bench (512^3 c2c, all executors)"
+DFFT_BENCH_DEADLINE=1500 python bench.py | tee /tmp/hw_bench.json
+
+note "kernel tile sweep @512 (1D + strided)"
+DFFT_SWEEP_TIMEOUT=1200 python benchmarks/tune_pallas.py \
+    --n 512 --tiles 128 256 512 --strided --plane 512 --tiles2d 1 2 4 \
+    --full3d 512
+
+if [ "$SHORT" != "--short" ]; then
+  note "baseline sweep (256^3 + 512^3, c2c + r2c, all executors)"
+  DFFT_SWEEP_TIMEOUT=2400 python benchmarks/record_baseline.py \
+      --sizes 256 512
+
+  note "1024^3 donated-pair attempt (HBM-limit config)"
+  DFFT_SWEEP_TIMEOUT=1500 python benchmarks/record_baseline.py \
+      --sizes --big 1024 --executors xla,pallas
+
+  note "non-cubic pencil-config shape (single-chip local)"
+  DFFT_SWEEP_TIMEOUT=1200 python benchmarks/record_baseline.py \
+      --shapes 768x512x384 --sizes
+
+  note "1D batch sweeps (radix 2/3/5, matmul vs pallas vs xla)"
+  DFFT_SWEEP_TIMEOUT=900 timeout 900 python benchmarks/batch_bench.py 1d \
+      -radix 2 -csv benchmarks/csv/batch_tpu_1d.csv || true
+
+  note "precision-tier comparison @512^3 (HIGHEST vs HIGH vs DEFAULT)"
+  for prec in highest high default; do
+    DFFT_MM_PRECISION=$prec DFFT_SWEEP_TIMEOUT=900 \
+      python benchmarks/record_baseline.py --sizes 256 \
+      --executors matmul,pallas \
+      --out benchmarks/csv/precision_${prec}_tpu.csv
+  done
+fi
+
+note "campaign done — review benchmarks/csv/ and commit"
+git status --short benchmarks/

@@ -113,6 +113,70 @@ def run_config(shape, dtype_name, executor, mesh, *, real=False):
     }
 
 
+def run_config_big(shape, dtype_name, executor, mesh, iters=5):
+    """HBM-limit config: donated forward/backward pair timing.
+
+    At 1024^3 complex64 a non-donated plan needs input+output resident
+    (16 GiB) — over a single chip's HBM. Donated plans ping-pong one
+    buffer (the reference's bufferDev discipline), but a donated buffer
+    cannot be re-executed, so timing chains fwd->bwd pairs and reports
+    the per-transform average. The roundtrip error check regenerates the
+    deterministic input instead of keeping a copy."""
+    import functools
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    import distributedfft_tpu as dfft
+    from distributedfft_tpu.utils.timing import gflops, max_rel_err, sync
+
+    dtype = jnp.dtype(dtype_name)
+    plan = dfft.plan_dft_c2c_3d(shape, mesh, dtype=dtype, executor=executor,
+                                donate=True)
+    iplan = dfft.plan_dft_c2c_3d(shape, mesh, direction=dfft.BACKWARD,
+                                 dtype=dtype, executor=executor, donate=True)
+
+    def _expr():
+        k1, k2 = jax.random.split(jax.random.PRNGKey(4242))
+        re = jax.random.normal(k1, shape, jnp.float32)
+        im = jax.random.normal(k2, shape, jnp.float32)
+        return (re + 1j * im).astype(dtype)
+
+    def _make_input_fn(**jit_kw):
+        return jax.jit(_expr, **jit_kw)
+
+    try:
+        # Same pinned-then-unpinned discipline as run_config: jit output
+        # shardings need evenly-dividing extents.
+        x = _make_input_fn(out_shardings=plan.in_sharding)() \
+            if plan.in_sharding is not None else _make_input_fn()()
+    except ValueError:
+        x = _make_input_fn()()
+    sync(x)
+    x = iplan(plan(x))  # warm + compile both directions
+    # Probe-plane roundtrip check: regenerating the FULL input for
+    # comparison would hold two world-size arrays resident — exactly the
+    # HBM over-subscription donation exists to avoid. Slicing the
+    # regeneration expression lets XLA push the slice through the
+    # elementwise PRNG, so only one plane materializes; the full-array
+    # error tier is validated by the regular sweep sizes.
+    probe = jax.jit(lambda: _expr()[0])
+    err = max_rel_err(x[0], probe())
+    sync(x)
+    t0 = _time.perf_counter()
+    for _ in range(iters):
+        x = iplan(plan(x))
+    sync(x)
+    seconds = (_time.perf_counter() - t0) / (2 * iters)
+    return {
+        "seconds": seconds,
+        "gflops": gflops(shape, seconds),
+        "max_err": err,
+        "decomposition": plan.decomposition,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--sizes", type=int, nargs="*", default=None)
@@ -123,6 +187,9 @@ def main() -> int:
                     help="tiny shapes for CI smoke")
     ap.add_argument("--out", default=None, help="CSV path override")
     ap.add_argument("--executors", default="xla,pallas,matmul")
+    ap.add_argument("--big", type=int, nargs="*", default=None,
+                    help="HBM-limit cubic sizes timed as donated fwd/bwd "
+                         "pairs (e.g. --big 1024)")
     ap.add_argument("--worker", action="store_true",
                     help=argparse.SUPPRESS)  # internal: run in-process
     ap.add_argument("--timeout", type=float, default=float(
@@ -151,10 +218,12 @@ def main() -> int:
         "status",
     ))
 
-    if args.quick:
-        sizes = args.sizes or [32]
+    # `--sizes` with no values means "no cubic sweeps" (e.g. --shapes only);
+    # omitted entirely means the default sweep.
+    if args.sizes is not None:
+        sizes = args.sizes
     else:
-        sizes = args.sizes or [256, 512]
+        sizes = [32] if args.quick else [256, 512]
     executors = [e for e in args.executors.split(",") if e]
 
     import jax.numpy as jnp
@@ -202,6 +271,26 @@ def main() -> int:
                 rec.record(run, n0, n1, n2, kind, dt, "-", ex, backend,
                            n_dev, "-", "-", "-", f"error {msg}")
                 print(f"{shape} {kind} {dt} {ex}: FAILED {msg}",
+                      file=sys.stderr, flush=True)
+    for n in args.big or []:
+        shape = (n, n, n)
+        for ex in executors:
+            try:
+                r = run_config_big(shape, "complex64", ex, mesh)
+                rec.record(run, n, n, n, "c2c-pair", "complex64",
+                           r["decomposition"], ex, backend, n_dev,
+                           f"{r['seconds']:.6f}", f"{r['gflops']:.1f}",
+                           f"{r['max_err']:.3e}", "ok")
+                print(f"{shape} c2c-pair complex64 {ex}: "
+                      f"{r['gflops']:.1f} GFlops err={r['max_err']:.2e}",
+                      flush=True)
+            except Exception as e:  # noqa: BLE001 — record and continue
+                failures += 1
+                msg = f"{type(e).__name__}: {e}".replace(",", ";")
+                msg = " ".join(msg.split())[:160]
+                rec.record(run, n, n, n, "c2c-pair", "complex64", "-", ex,
+                           backend, n_dev, "-", "-", "-", f"error {msg}")
+                print(f"{shape} c2c-pair {ex}: FAILED {msg}",
                       file=sys.stderr, flush=True)
     print(f"wrote {out}", flush=True)
     return 0 if failures == 0 else 1
