@@ -38,7 +38,7 @@ if [ "$SHORT" != "--short" ]; then
   DFFT_SWEEP_TIMEOUT=900 timeout 900 python benchmarks/batch_bench.py 1d \
       -radix 2 -csv benchmarks/csv/batch_tpu_1d.csv || true
 
-  note "precision-tier comparison @512^3 (HIGHEST vs HIGH vs DEFAULT)"
+  note "precision-tier comparison @256^3 (HIGHEST vs HIGH vs DEFAULT)"
   for prec in highest high default; do
     DFFT_MM_PRECISION=$prec DFFT_SWEEP_TIMEOUT=900 \
       python benchmarks/record_baseline.py --sizes 256 \

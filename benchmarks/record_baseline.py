@@ -122,7 +122,6 @@ def run_config_big(shape, dtype_name, executor, mesh, iters=5):
     cannot be re-executed, so timing chains fwd->bwd pairs and reports
     the per-transform average. The roundtrip error check regenerates the
     deterministic input instead of keeping a copy."""
-    import functools
     import time as _time
 
     import jax
@@ -250,48 +249,45 @@ def main() -> int:
             ap.error(f"--shapes value {s!r} needs exactly 3 extents")
         shapes.append(dims)
 
+    def record_ok(shape, kind, dt, r):
+        rec.record(run, *shape, kind, dt, r["decomposition"],
+                   current_ex[0], backend, n_dev, f"{r['seconds']:.6f}",
+                   f"{r['gflops']:.1f}", f"{r['max_err']:.3e}", "ok")
+        print(f"{shape} {kind} {dt} {current_ex[0]}: "
+              f"{r['gflops']:.1f} GFlops err={r['max_err']:.2e}", flush=True)
+
+    def record_error(shape, kind, dt, e):
+        msg = f"{type(e).__name__}: {e}".replace(",", ";")
+        msg = " ".join(msg.split())[:160]
+        rec.record(run, *shape, kind, dt, "-", current_ex[0], backend,
+                   n_dev, "-", "-", "-", f"error {msg}")
+        print(f"{shape} {kind} {dt} {current_ex[0]}: FAILED {msg}",
+              file=sys.stderr, flush=True)
+
+    current_ex = [""]
     failures = 0
     for shape in shapes:
-        n0, n1, n2 = shape
         jobs = [(dt, ex, False) for dt in cdtypes for ex in executors]
         jobs += [(dt, ex, True) for dt in rdtypes for ex in executors]
         for dt, ex, real in jobs:
             kind = "r2c" if real else "c2c"
+            current_ex[0] = ex
             try:
-                r = run_config(shape, dt, ex, mesh, real=real)
-                rec.record(run, n0, n1, n2, kind, dt, r["decomposition"],
-                           ex, backend, n_dev, f"{r['seconds']:.6f}",
-                           f"{r['gflops']:.1f}", f"{r['max_err']:.3e}", "ok")
-                print(f"{shape} {kind} {dt} {ex}: {r['gflops']:.1f} GFlops "
-                      f"err={r['max_err']:.2e}", flush=True)
+                record_ok(shape, kind, dt,
+                          run_config(shape, dt, ex, mesh, real=real))
             except Exception as e:  # noqa: BLE001 — record and continue
                 failures += 1
-                msg = f"{type(e).__name__}: {e}".replace(",", ";")
-                msg = " ".join(msg.split())[:160]
-                rec.record(run, n0, n1, n2, kind, dt, "-", ex, backend,
-                           n_dev, "-", "-", "-", f"error {msg}")
-                print(f"{shape} {kind} {dt} {ex}: FAILED {msg}",
-                      file=sys.stderr, flush=True)
+                record_error(shape, kind, dt, e)
     for n in args.big or []:
         shape = (n, n, n)
         for ex in executors:
+            current_ex[0] = ex
             try:
-                r = run_config_big(shape, "complex64", ex, mesh)
-                rec.record(run, n, n, n, "c2c-pair", "complex64",
-                           r["decomposition"], ex, backend, n_dev,
-                           f"{r['seconds']:.6f}", f"{r['gflops']:.1f}",
-                           f"{r['max_err']:.3e}", "ok")
-                print(f"{shape} c2c-pair complex64 {ex}: "
-                      f"{r['gflops']:.1f} GFlops err={r['max_err']:.2e}",
-                      flush=True)
+                record_ok(shape, "c2c-pair", "complex64",
+                          run_config_big(shape, "complex64", ex, mesh))
             except Exception as e:  # noqa: BLE001 — record and continue
                 failures += 1
-                msg = f"{type(e).__name__}: {e}".replace(",", ";")
-                msg = " ".join(msg.split())[:160]
-                rec.record(run, n, n, n, "c2c-pair", "complex64", "-", ex,
-                           backend, n_dev, "-", "-", "-", f"error {msg}")
-                print(f"{shape} c2c-pair {ex}: FAILED {msg}",
-                      file=sys.stderr, flush=True)
+                record_error(shape, "c2c-pair", "complex64", e)
     print(f"wrote {out}", flush=True)
     return 0 if failures == 0 else 1
 

@@ -25,32 +25,63 @@ import jax
 
 _events: list[tuple[str, float, float]] | None = None
 _trace_root: str | None = None
+_native_rec = None  # native.NativeTrace when the C recorder is in use
 
 
 def tracing_enabled() -> bool:
-    return _events is not None
+    return _events is not None or _native_rec is not None
+
+
+def _try_native():
+    """The C trace recorder (``dfft_trace_*``, ``native/dfft_native.cpp``)
+    when the library is built — lower per-event overhead than the Python
+    list (the compile-gated-to-zero-cost discipline of
+    ``Heffte_ENABLE_TRACING``). ``DFFT_TRACE_NATIVE=0`` forces the Python
+    recorder."""
+    if os.environ.get("DFFT_TRACE_NATIVE", "1") == "0":
+        return None
+    try:
+        from .. import native
+
+        rec = native.NativeTrace()
+        if not rec.available:
+            return None
+        rec.init()
+        return rec
+    except Exception:  # noqa: BLE001 — recorder is best-effort
+        return None
 
 
 def init_tracing(root: str = "") -> None:
     """Start collecting events (``init_tracing``, ``heffte_trace.h:90``).
     ``root`` prefixes the log filename written by :func:`finalize_tracing`."""
-    global _events, _trace_root
-    _events = []
+    global _events, _trace_root, _native_rec
     _trace_root = root or "dfft_trace"
+    _native_rec = _try_native()
+    _events = None if _native_rec is not None else []
 
 
 def finalize_tracing() -> str | None:
     """Write ``<root>_<process>.log`` and stop tracing
     (``finalize_tracing``, ``heffte_trace.h:98-118``). Returns the path."""
-    global _events, _trace_root
-    if _events is None:
+    global _events, _trace_root, _native_rec
+    if not tracing_enabled():
         return None
     path = f"{_trace_root}_{jax.process_index()}.log"
-    t0 = _events[0][1] if _events else 0.0
-    with open(path, "w") as f:
-        f.write(f"process {jax.process_index()} of {jax.process_count()}\n")
-        for name, start, stop in _events:
-            f.write(f"{start - t0:14.6f}  {stop - start:12.6f}  {name}\n")
+    if _native_rec is not None:
+        ok = _native_rec.dump(path, jax.process_index(), jax.process_count())
+        if not ok:
+            # Same contract as the Python recorder's open() raising: a
+            # failed dump must not silently discard the events.
+            raise OSError(f"native trace dump to {path!r} failed")
+        _native_rec = None
+    else:
+        t0 = _events[0][1] if _events else 0.0
+        with open(path, "w") as f:
+            f.write(
+                f"process {jax.process_index()} of {jax.process_count()}\n")
+            for name, start, stop in _events:
+                f.write(f"{start - t0:14.6f}  {stop - start:12.6f}  {name}\n")
     _events, _trace_root = None, None
     return path
 
@@ -69,6 +100,14 @@ def add_trace(name: str):
     benchmark harness does) for true device timings.
     """
     with jax.profiler.TraceAnnotation(name):
+        rec = _native_rec  # bind: finalize/re-init inside the block must
+        if rec is not None:  # not retarget this event's end() call
+            eid = rec.begin(name)
+            try:
+                yield
+            finally:
+                rec.end(eid)
+            return
         if _events is None:
             yield
             return

@@ -57,3 +57,37 @@ def test_plan_info_dump():
     assert "r2c" in info
     assert "in box[3]" in info and "out box[3]" in info
     assert "4 devices" in info
+
+
+def test_native_recorder_engages(tmp_path):
+    """When the C library is built, init_tracing records through the native
+    dfft_trace_* recorder and its dump is a parseable per-process log."""
+    from distributedfft_tpu import native
+    from distributedfft_tpu.utils import trace as tr
+
+    if not native.is_available():
+        pytest.skip("native library not built")
+    tr.init_tracing(str(tmp_path / "nt"))
+    assert tr._native_rec is not None
+    with tr.add_trace("alpha"):
+        pass
+    with tr.add_trace("beta"):
+        pass
+    path = tr.finalize_tracing()
+    lines = open(path).read().splitlines()
+    assert lines[0].startswith("process 0 of")
+    assert any("alpha" in ln for ln in lines[1:])
+    assert any("beta" in ln for ln in lines[1:])
+
+
+def test_python_recorder_fallback(tmp_path, monkeypatch):
+    """DFFT_TRACE_NATIVE=0 forces the Python recorder."""
+    from distributedfft_tpu.utils import trace as tr
+
+    monkeypatch.setenv("DFFT_TRACE_NATIVE", "0")
+    tr.init_tracing(str(tmp_path / "pt"))
+    assert tr._native_rec is None and tr._events == []
+    with tr.add_trace("gamma"):
+        pass
+    path = tr.finalize_tracing()
+    assert "gamma" in open(path).read()
