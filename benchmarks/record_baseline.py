@@ -249,45 +249,42 @@ def main() -> int:
             ap.error(f"--shapes value {s!r} needs exactly 3 extents")
         shapes.append(dims)
 
-    def record_ok(shape, kind, dt, r):
-        rec.record(run, *shape, kind, dt, r["decomposition"],
-                   current_ex[0], backend, n_dev, f"{r['seconds']:.6f}",
-                   f"{r['gflops']:.1f}", f"{r['max_err']:.3e}", "ok")
-        print(f"{shape} {kind} {dt} {current_ex[0]}: "
+    def record_ok(shape, kind, dt, ex, r):
+        rec.record(run, *shape, kind, dt, r["decomposition"], ex, backend,
+                   n_dev, f"{r['seconds']:.6f}", f"{r['gflops']:.1f}",
+                   f"{r['max_err']:.3e}", "ok")
+        print(f"{shape} {kind} {dt} {ex}: "
               f"{r['gflops']:.1f} GFlops err={r['max_err']:.2e}", flush=True)
 
-    def record_error(shape, kind, dt, e):
+    def record_error(shape, kind, dt, ex, e):
         msg = f"{type(e).__name__}: {e}".replace(",", ";")
         msg = " ".join(msg.split())[:160]
-        rec.record(run, *shape, kind, dt, "-", current_ex[0], backend,
-                   n_dev, "-", "-", "-", f"error {msg}")
-        print(f"{shape} {kind} {dt} {current_ex[0]}: FAILED {msg}",
+        rec.record(run, *shape, kind, dt, "-", ex, backend, n_dev,
+                   "-", "-", "-", f"error {msg}")
+        print(f"{shape} {kind} {dt} {ex}: FAILED {msg}",
               file=sys.stderr, flush=True)
 
-    current_ex = [""]
     failures = 0
     for shape in shapes:
         jobs = [(dt, ex, False) for dt in cdtypes for ex in executors]
         jobs += [(dt, ex, True) for dt in rdtypes for ex in executors]
         for dt, ex, real in jobs:
             kind = "r2c" if real else "c2c"
-            current_ex[0] = ex
             try:
-                record_ok(shape, kind, dt,
+                record_ok(shape, kind, dt, ex,
                           run_config(shape, dt, ex, mesh, real=real))
             except Exception as e:  # noqa: BLE001 — record and continue
                 failures += 1
-                record_error(shape, kind, dt, e)
+                record_error(shape, kind, dt, ex, e)
     for n in args.big or []:
         shape = (n, n, n)
         for ex in executors:
-            current_ex[0] = ex
             try:
-                record_ok(shape, "c2c-pair", "complex64",
+                record_ok(shape, "c2c-pair", "complex64", ex,
                           run_config_big(shape, "complex64", ex, mesh))
             except Exception as e:  # noqa: BLE001 — record and continue
                 failures += 1
-                record_error(shape, "c2c-pair", "complex64", e)
+                record_error(shape, "c2c-pair", "complex64", ex, e)
     print(f"wrote {out}", flush=True)
     return 0 if failures == 0 else 1
 

@@ -592,6 +592,17 @@ def fft_along_axis(x: jnp.ndarray, axis: int, forward: bool = True) -> jnp.ndarr
         # Leading-axis transform: the strided kernel reorders in VMEM,
         # skipping the two HBM moveaxis passes entirely.
         return fft_axis0(x, forward=forward)
+    ax = axis % x.ndim
+    if 0 < ax < x.ndim - 1 and not two_level:
+        # Middle-axis transform: vmap the strided kernel over the leading
+        # dims (the batching rule adds a grid dimension) — still no HBM
+        # transpose.
+        lead = math.prod(x.shape[:ax])
+        shp = x.shape
+        x3 = x.reshape((lead,) + x.shape[ax:ax + 1]
+                       + (math.prod(x.shape[ax + 1:]),))
+        y = jax.vmap(lambda v: fft_axis0(v, forward=forward))(x3)
+        return y.reshape(shp)
 
     moved = axis not in (-1, x.ndim - 1)
     if moved:

@@ -26,6 +26,7 @@ import jax
 _events: list[tuple[str, float, float]] | None = None
 _trace_root: str | None = None
 _native_rec = None  # native.NativeTrace when the C recorder is in use
+_session = 0  # bumped by init/finalize: stale in-flight events are dropped
 
 
 def tracing_enabled() -> bool:
@@ -55,7 +56,8 @@ def _try_native():
 def init_tracing(root: str = "") -> None:
     """Start collecting events (``init_tracing``, ``heffte_trace.h:90``).
     ``root`` prefixes the log filename written by :func:`finalize_tracing`."""
-    global _events, _trace_root, _native_rec
+    global _events, _trace_root, _native_rec, _session
+    _session += 1
     _trace_root = root or "dfft_trace"
     _native_rec = _try_native()
     _events = None if _native_rec is not None else []
@@ -64,9 +66,10 @@ def init_tracing(root: str = "") -> None:
 def finalize_tracing() -> str | None:
     """Write ``<root>_<process>.log`` and stop tracing
     (``finalize_tracing``, ``heffte_trace.h:98-118``). Returns the path."""
-    global _events, _trace_root, _native_rec
+    global _events, _trace_root, _native_rec, _session
     if not tracing_enabled():
         return None
+    _session += 1
     path = f"{_trace_root}_{jax.process_index()}.log"
     if _native_rec is not None:
         ok = _native_rec.dump(path, jax.process_index(), jax.process_count())
@@ -100,22 +103,31 @@ def add_trace(name: str):
     benchmark harness does) for true device timings.
     """
     with jax.profiler.TraceAnnotation(name):
-        rec = _native_rec  # bind: finalize/re-init inside the block must
-        if rec is not None:  # not retarget this event's end() call
+        # The C recorder's event table is process-global, so binding the
+        # Python handle alone cannot isolate an in-flight event from a
+        # finalize/re-init happening inside the block: a stale event id
+        # would land in the NEW session's table. The session generation
+        # drops such events instead (for the Python recorder, binding the
+        # list suffices — a stale append goes to the discarded list).
+        sess = _session
+        rec = _native_rec
+        if rec is not None:
             eid = rec.begin(name)
             try:
                 yield
             finally:
-                rec.end(eid)
+                if _session == sess:
+                    rec.end(eid)
             return
-        if _events is None:
+        ev = _events
+        if ev is None:
             yield
             return
         start = time.perf_counter()
         try:
             yield
         finally:
-            _events.append((name, start, time.perf_counter()))
+            ev.append((name, start, time.perf_counter()))
 
 
 @dataclass

@@ -224,3 +224,18 @@ def test_fft_along_axis_leading_uses_strided():
     got = np.asarray(pallas_fft.fft_along_axis(jnp.asarray(x), 0))
     want = np.fft.fft(x, axis=0)
     assert np.max(np.abs(got - want)) / np.abs(want).max() < 5e-6
+
+
+def test_fft_along_axis_middle_uses_vmapped_strided():
+    from distributedfft_tpu.ops import pallas_fft
+
+    rng = np.random.default_rng(44)
+    x = (rng.standard_normal((5, 64, 6, 3))
+         + 1j * rng.standard_normal((5, 64, 6, 3))).astype(np.complex64)
+    got = np.asarray(pallas_fft.fft_along_axis(jnp.asarray(x), 1))
+    want = np.fft.fft(x, axis=1)
+    assert np.max(np.abs(got - want)) / np.abs(want).max() < 5e-6
+    # inverse through the same path
+    back = np.asarray(pallas_fft.fft_along_axis(
+        pallas_fft.fft_along_axis(jnp.asarray(x), 1), 1, forward=False))
+    assert np.max(np.abs(back - x)) < 1e-5

@@ -59,12 +59,13 @@ def test_plan_info_dump():
     assert "4 devices" in info
 
 
-def test_native_recorder_engages(tmp_path):
+def test_native_recorder_engages(tmp_path, monkeypatch):
     """When the C library is built, init_tracing records through the native
     dfft_trace_* recorder and its dump is a parseable per-process log."""
     from distributedfft_tpu import native
     from distributedfft_tpu.utils import trace as tr
 
+    monkeypatch.delenv("DFFT_TRACE_NATIVE", raising=False)
     if not native.is_available():
         pytest.skip("native library not built")
     tr.init_tracing(str(tmp_path / "nt"))
@@ -91,3 +92,22 @@ def test_python_recorder_fallback(tmp_path, monkeypatch):
         pass
     path = tr.finalize_tracing()
     assert "gamma" in open(path).read()
+
+
+def test_finalize_inside_open_block_is_safe(tmp_path, monkeypatch):
+    """finalize/re-init inside an open add_trace block neither crashes nor
+    corrupts the new session (both recorder backends)."""
+    from distributedfft_tpu.utils import trace as tr
+
+    for native_flag in ("1", "0"):
+        monkeypatch.setenv("DFFT_TRACE_NATIVE", native_flag)
+        tr.init_tracing(str(tmp_path / f"re{native_flag}"))
+        with tr.add_trace("outer"):
+            tr.finalize_tracing()
+            tr.init_tracing(str(tmp_path / f"re{native_flag}b"))
+            with tr.add_trace("inner"):
+                pass
+        # outer's stale end() must not have retargeted inner's event
+        path = tr.finalize_tracing()
+        text = open(path).read()
+        assert "inner" in text
