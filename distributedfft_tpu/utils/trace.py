@@ -18,10 +18,14 @@ from __future__ import annotations
 
 import os
 import time
+import math
 from contextlib import contextmanager
 from dataclasses import dataclass
 
 import jax
+import numpy as np
+
+_MB = 1.0 / (1024 * 1024)
 
 _events: list[tuple[str, float, float]] | None = None
 _trace_root: str | None = None
@@ -196,22 +200,19 @@ def plan_info(plan) -> str:
         # the wire per algorithm (the count-table role of TransInfo /
         # outputPlanInfo, fft_mpi_3d_api.cpp:84-133,433-464).
         if lp.mesh is not None:
-            import numpy as _np
-
             from ..plan_logic import exchange_payloads
 
             shape_eff = plan.out_shape if (plan.real and plan.forward) else (
                 plan.in_shape if plan.real else plan.shape
             )
-            itemsize = _np.dtype(plan.dtype).itemsize
-            mb = 1.0 / (1024 * 1024)
+            itemsize = np.dtype(plan.dtype).itemsize
             for e in exchange_payloads(lp, shape_eff, itemsize):
                 t, d, v = e["true_bytes"], e["alltoall_bytes"], e["alltoallv_bytes"]
                 ov = lambda x: f"+{(x / t - 1) * 100:.1f}%" if t else "n/a"
                 lines.append(
                     f"exchange {e['stage']} ({e['mesh_axis']}, {e['parts']}-way): "
-                    f"true {t * mb:.2f} MB | alltoall {d * mb:.2f} MB ({ov(d)}) | "
-                    f"alltoallv {v * mb:.2f} MB ({ov(v)})"
+                    f"true {t * _MB:.2f} MB | alltoall {d * _MB:.2f} MB ({ov(d)}) | "
+                    f"alltoallv {v * _MB:.2f} MB ({ov(v)})"
                 )
         if (lp.decomposition == "slab" and lp.mesh is not None
                 and not plan.real):
@@ -231,18 +232,38 @@ def plan_info(plan) -> str:
         # intersection payload vs what the padded ring ships (the
         # send_size/recv_size table role of heffte_reshape3d's overlap
         # maps).
-        import numpy as _np
-
-        itemsize = _np.dtype(plan.dtype).itemsize
-        mb = 1.0 / (1024 * 1024)
+        itemsize = np.dtype(plan.dtype).itemsize
         for label, bs in zip(("in->chain", "chain->out"), plan.brick_edges):
             t = bs.payload_elems * itemsize
             w = bs.wire_elems * itemsize
             ov = f"+{(w / t - 1) * 100:.1f}%" if t else "n/a"
             lines.append(
                 f"brick edge {label}: {len(bs.steps)} ring steps, "
-                f"payload {t * mb:.2f} MB | wire {w * mb:.2f} MB ({ov})"
+                f"payload {t * _MB:.2f} MB | wire {w * _MB:.2f} MB ({ov})"
             )
+    # Per-device memory footprint estimate — the heFFTe benchmark's
+    # "MB/rank" report (benchmarks/speed3d.h:156-181) and the reference's
+    # getMaxDataCount allocation sizing (fft_mpi_3d_api.cpp:289-316).
+    # Intermediates are sized at the plan's PADDED extents (ceil-split
+    # pad/crop discipline), which is what the chain actually allocates.
+    ndev = plan.mesh.devices.size if plan.mesh is not None else 1
+    in_b = math.prod(plan.in_shape) * np.dtype(plan.in_dtype).itemsize
+    out_b = math.prod(plan.out_shape) * np.dtype(plan.out_dtype).itemsize
+    work = max(in_b, out_b)  # one staged intermediate at a time under jit
+    spec = plan.spec
+    if spec is not None and hasattr(spec, "in_padded"):
+        isz = np.dtype(plan.in_dtype).itemsize
+        work = max(work, math.prod(spec.in_padded) * isz,
+                   math.prod(spec.out_padded) * isz)
+    total = (in_b + out_b + (0 if plan.options.donate else work)) / ndev
+    lines.append(
+        f"memory/device (est): in {in_b / ndev * _MB:.1f} MB + out "
+        f"{out_b / ndev * _MB:.1f} MB"
+        + ("" if plan.options.donate else
+           f" + work {work / ndev * _MB:.1f} MB")
+        + f" ~= {total * _MB:.1f} MB"
+        + (" (donating)" if plan.options.donate else "")
+    )
     if plan.spec is not None:
         lines.append(f"padded extents: {plan.spec}")
     for label, boxes in (("in", plan.in_boxes), ("out", plan.out_boxes)):

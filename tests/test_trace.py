@@ -99,9 +99,14 @@ def test_finalize_inside_open_block_is_safe(tmp_path, monkeypatch):
     corrupts the new session (both recorder backends)."""
     from distributedfft_tpu.utils import trace as tr
 
+    from distributedfft_tpu import native
+
     for native_flag in ("1", "0"):
         monkeypatch.setenv("DFFT_TRACE_NATIVE", native_flag)
         tr.init_tracing(str(tmp_path / f"re{native_flag}"))
+        if native_flag == "1" and native.is_available():
+            # the native guard is only exercised when the C recorder runs
+            assert tr._native_rec is not None
         with tr.add_trace("outer"):
             tr.finalize_tracing()
             tr.init_tracing(str(tmp_path / f"re{native_flag}b"))
