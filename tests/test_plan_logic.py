@@ -174,3 +174,29 @@ def test_negotiate_device_count():
     # Pencil is not capped by the slab plane-count rule: 16 = (4, 4) works
     # even though n0 = 4.
     assert negotiate_device_count((4, 16, 16), 16, "pencil") == 16
+
+
+def test_2048_cube_traces_without_memory():
+    """The BASELINE.json 2048^3 single-precision world traces and
+    shape-checks abstractly (jax.eval_shape allocates nothing) — the
+    scale-sanity gate for a shape no test machine can materialize. Planned
+    over this suite's 8-device mesh; the 32-way device count itself is
+    exercised by the driver's dryrun_multichip(32) path."""
+    import jax
+    import jax.numpy as jnp
+
+    import distributedfft_tpu as dfft
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the virtual 8-device mesh")
+    mesh = dfft.make_mesh(8)
+    plan = dfft.plan_dft_c2c_3d((2048, 2048, 2048), mesh,
+                                dtype=jnp.complex64, donate=True)
+    out = jax.eval_shape(
+        plan.fn,
+        jax.ShapeDtypeStruct((2048, 2048, 2048), jnp.complex64,
+                             sharding=plan.in_sharding),
+    )
+    assert out.shape == (2048, 2048, 2048)
+    assert out.dtype == jnp.complex64
