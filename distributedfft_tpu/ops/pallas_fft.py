@@ -439,10 +439,12 @@ def _fft_strided_tiles(xr, xi, *, n: int, forward: bool, interpret: bool):
     return yr.reshape(n, cols), yi.reshape(n, cols)
 
 
-def fft_axis0(x: jnp.ndarray, forward: bool = True) -> jnp.ndarray:
+def fft_axis0(x: jnp.ndarray, forward: bool = True,
+              normalize: bool = True) -> jnp.ndarray:
     """C2C FFT over axis 0 of ``x`` via the strided kernel — no HBM
     transpose (callers gate on :func:`eligible` of ``x.shape[0]`` and
-    complex64). Forward unnormalized, inverse scaled by 1/n."""
+    complex64). Forward unnormalized, inverse scaled by 1/n
+    (``normalize=False`` skips the inverse scale for composing stages)."""
     n = x.shape[0]
     rest = x.shape[1:]
     cols = math.prod(rest) if rest else 1
@@ -461,7 +463,7 @@ def fft_axis0(x: jnp.ndarray, forward: bool = True) -> jnp.ndarray:
         y = lax.complex(yr, yi)
     if pad:
         y = y[:, :cols]
-    if not forward:
+    if not forward and normalize:
         y = y * jnp.float32(1.0 / n)
     return y.reshape((n,) + rest)
 
@@ -523,10 +525,17 @@ def _fft_last_big(x2: jnp.ndarray, n: int, forward: bool) -> jnp.ndarray:
     m1, m2 = outer_split(n)
     batch = x2.shape[0]
     a = x2.reshape(batch, m1, m2)
-    # DFT over j1: move it last, kernel-transform, move back.
-    b = jnp.swapaxes(a, -1, -2).reshape(batch * m2, m1)
-    b = _fft_eligible(b, m1, forward)
-    b = jnp.swapaxes(b.reshape(batch, m2, m1), -1, -2)  # [batch, k1, j2]
+    # DFT over j1 via the vmapped strided kernel — in-VMEM reorders, no
+    # HBM swapaxes round trip (the mirror path under shard_map on CPU
+    # takes the explicit transposes instead).
+    if jax.default_backend() == "cpu" and _vma(a):
+        b = jnp.swapaxes(a, -1, -2).reshape(batch * m2, m1)
+        b = _fft_eligible(b, m1, forward)
+        b = jnp.swapaxes(b.reshape(batch, m2, m1), -1, -2)  # [batch, k1, j2]
+    else:
+        # Unnormalized stage: the caller applies the single 1/n at the end.
+        b = jax.vmap(
+            lambda v: fft_axis0(v, forward=forward, normalize=False))(a)
     i = jnp.arange(m1, dtype=jnp.int32)[:, None]
     j = jnp.arange(m2, dtype=jnp.int32)[None, :]
     phase = (i * j) % jnp.int32(n)

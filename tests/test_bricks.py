@@ -201,3 +201,33 @@ def test_brick_plan_info_accounting():
     assert "brick edge in->chain" in info
     assert "brick edge chain->out" in info
     assert "payload" in info and "wire" in info
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_random_partition_fuzz(seed):
+    """Property test: ANY pair of random non-grid box partitions round-trips
+    exactly through the overlap-map ring (heFFTe's shuffled-boxes testing
+    idea, test_fft3d.h:155-167, applied to the reshape engine)."""
+    rng = np.random.default_rng(100 + seed)
+
+    def random_partition(world, parts):
+        boxes = [world]
+        while len(boxes) < parts:
+            # split the largest-volume box on a random axis at a random cut
+            i = max(range(len(boxes)), key=lambda k: boxes[k].size)
+            b = boxes.pop(i)
+            axes = [d for d in range(3) if b.shape[d] >= 2]
+            ax = int(rng.choice(axes))
+            lo, hi = b.low[ax], b.high[ax]
+            cut = int(rng.integers(lo + 1, hi))
+            la, ha = list(b.low), list(b.high)
+            lb, hb = list(b.low), list(b.high)
+            ha[ax], lb[ax] = cut, cut
+            boxes += [Box3(tuple(la), tuple(ha)), Box3(tuple(lb), tuple(hb))]
+        return boxes
+
+    shape = tuple(int(v) for v in rng.integers(6, 14, size=3))
+    w = world_box(shape)
+    ins = random_partition(w, 8)
+    outs = random_partition(w, 8)
+    _roundtrip(shape, ins, outs)
