@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import argparse
 import functools
+import math
 import os
 import sys
 
@@ -58,7 +59,12 @@ def parse_args(argv):
     p.add_argument("-executor", default="xla", help="local FFT backend (xla|matmul|...)")
     p.add_argument("-ndev", type=int, default=None, help="device count (default: all)")
     p.add_argument("-grid", type=int, nargs=2, metavar=("R", "C"),
-                   help="explicit 2D pencil grid (heFFTe -ingrid analog)")
+                   help="explicit 2D pencil mesh")
+    p.add_argument("-ingrid", type=int, nargs=3, metavar=("PX", "PY", "PZ"),
+                   help="input processor grid (heFFTe -ingrid): per-axis "
+                        "device factors, at most two > 1")
+    p.add_argument("-outgrid", type=int, nargs=3, metavar=("PX", "PY", "PZ"),
+                   help="output processor grid (heFFTe -outgrid)")
     p.add_argument("-staged", action="store_true",
                    help="separately-jitted t0..t3 stage timing (slab c2c only)")
     p.add_argument("-iters", type=int, default=5)
@@ -86,14 +92,39 @@ def mesh_prod(mesh, entry) -> int:
 def main(argv=None) -> None:
     args = parse_args(argv if argv is not None else sys.argv[1:])
 
+    # -ingrid/-outgrid describe plan LAYOUTS; they are incompatible with
+    # the decomposition-forcing flags (which would silently discard them).
+    if (args.ingrid or args.outgrid) and (args.bricks or args.grid
+                                          or args.slabs or args.pencils):
+        raise SystemExit("-ingrid/-outgrid cannot combine with "
+                         "-bricks/-grid/-slabs/-pencils")
+
+    def reconcile_ndev(label, want):
+        """One device-count reconciliation rule for every grid-ish flag."""
+        if args.ndev is not None and args.ndev != want:
+            raise SystemExit(
+                f"{label} implies {want} devices, contradicting the "
+                f"earlier count {args.ndev}")
+        args.ndev = want
+
+    for label, g in (("-ingrid", args.ingrid), ("-outgrid", args.outgrid)):
+        if g:
+            if any(v < 1 for v in g):
+                raise SystemExit(f"{label} {g}: grid entries must be >= 1")
+            if sum(1 for v in g if v > 1) > 2:
+                raise SystemExit(f"{label} {g}: at most two axes may have "
+                                 f">1 factors (mesh-expressible layouts)")
+            reconcile_ndev(label, math.prod(g))
+    if args.ingrid and args.outgrid:
+        if sorted(v for v in args.ingrid if v > 1) != sorted(
+                v for v in args.outgrid if v > 1):
+            raise SystemExit("-ingrid and -outgrid must use the same "
+                             "device factors (one mesh)")
+
     # Reconcile the requested device count before any backend comes up: an
     # explicit -grid fixes it (and must agree with -ndev if both are given).
     if args.grid:
-        want = args.grid[0] * args.grid[1]
-        if args.ndev is not None and args.ndev != want:
-            raise SystemExit(f"-ndev {args.ndev} contradicts -grid {args.grid} "
-                             f"({want} devices)")
-        args.ndev = want
+        reconcile_ndev("-grid", args.grid[0] * args.grid[1])
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
         if args.ndev and args.ndev > 1:
@@ -116,9 +147,41 @@ def main(argv=None) -> None:
     algorithm = ("ppermute" if args.p2p_pl
                  else "alltoallv" if args.a2av else "alltoall")
 
+    in_spec = out_spec = None
+    if args.ingrid or args.outgrid:
+        from jax.sharding import PartitionSpec as P
+
+        base = args.ingrid or args.outgrid
+        factors = [v for v in base if v > 1]
+        mesh = dfft.make_mesh(tuple(factors) if len(factors) > 1
+                              else (factors[0] if factors else 1))
+        names = list(mesh.axis_names) if factors else []
+
+        def to_spec(g):
+            if g is None:
+                return None
+            entries, pool = [], list(names)
+            for v in g:
+                if v <= 1:
+                    entries.append(None)
+                    continue
+                for nm in pool:
+                    if mesh.shape[nm] == v:
+                        entries.append(nm)
+                        pool.remove(nm)
+                        break
+                else:
+                    raise SystemExit(f"grid {g} does not factor over the "
+                                     f"mesh {dict(mesh.shape)}")
+            return P(*entries)
+
+        in_spec, out_spec = to_spec(args.ingrid), to_spec(args.outgrid)
+        decomposition = None
     if args.bricks and args.kind != "c2c":
         raise SystemExit("-bricks supports c2c only")
-    if args.grid:
+    if args.ingrid or args.outgrid:
+        pass  # mesh built above
+    elif args.grid:
         mesh = dfft.make_mesh(tuple(args.grid))
         decomposition = None
     elif args.bricks:
@@ -160,8 +223,13 @@ def main(argv=None) -> None:
             shape, mesh, out_boxes, in_boxes, direction=dfft.BACKWARD,
             executor=args.executor, dtype=dtype, algorithm=algorithm)
     else:
+        if in_spec is not None or out_spec is not None:
+            kw = dict(kw, in_spec=in_spec, out_spec=out_spec)
         fwd = plan_fn(shape, mesh, direction=dfft.FORWARD, **kw)
-        bwd = plan_fn(shape, mesh, direction=dfft.BACKWARD, **kw)
+        # The inverse runs the opposite layout direction.
+        bkw = (dict(kw, in_spec=out_spec, out_spec=in_spec)
+               if (in_spec is not None or out_spec is not None) else kw)
+        bwd = plan_fn(shape, mesh, direction=dfft.BACKWARD, **bkw)
     print(dfft.plan_info(fwd))
 
     # On-device deterministic init (the reference inits on device too,
@@ -221,6 +289,13 @@ def main(argv=None) -> None:
     if args.staged and args.bricks:
         print("note: -staged is not available for brick plans; ignoring",
               file=sys.stderr)
+        args.staged = False
+    if args.staged and (args.ingrid or args.outgrid):
+        # The staged builders rebuild the CANONICAL chain; an absorbed
+        # user layout re-axes it, so the breakdown would describe a
+        # different execution than the timed plan.
+        print("note: -staged is not available with -ingrid/-outgrid; "
+              "ignoring", file=sys.stderr)
         args.staged = False
     if args.staged:
         stages = None

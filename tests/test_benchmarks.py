@@ -140,3 +140,17 @@ def test_speed3d_bricks(capsys, tmp_path):
     assert err < 1e-3
     row = open(csv).read().splitlines()[1]
     assert ",bricks-" in row
+
+
+def test_speed3d_ingrid_outgrid(capsys, tmp_path):
+    """heFFTe -ingrid/-outgrid parity: user processor grids become plan
+    in/out layouts and roundtrip correctly."""
+    speed3d.main(["c2c", "single", "16", "16", "16",
+                  "-ingrid", "1", "4", "2", "-outgrid", "4", "2", "1",
+                  "-iters", "1"])
+    out = capsys.readouterr().out
+    assert "in sharding:  PartitionSpec(None, 'row', 'col')" in out
+    assert "out sharding: PartitionSpec('row', 'col', None)" in out
+    err = float([ln for ln in out.splitlines()
+                 if ln.startswith("max error")][0].split(":")[1])
+    assert err < 1e-3
