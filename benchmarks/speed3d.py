@@ -153,29 +153,30 @@ def main(argv=None) -> None:
 
         base = args.ingrid or args.outgrid
         factors = [v for v in base if v > 1]
-        mesh = dfft.make_mesh(tuple(factors) if len(factors) > 1
-                              else (factors[0] if factors else 1))
-        names = list(mesh.axis_names) if factors else []
+        if not factors:
+            # All-ones grids: a single-device plan, no layout to pin
+            # (heFFTe accepts this on one rank).
+            mesh = None
+        else:
+            mesh = dfft.make_mesh(tuple(factors) if len(factors) > 1
+                                  else factors[0])
+            names = list(mesh.axis_names)
 
-        def to_spec(g):
-            if g is None:
-                return None
-            entries, pool = [], list(names)
-            for v in g:
-                if v <= 1:
-                    entries.append(None)
-                    continue
-                for nm in pool:
-                    if mesh.shape[nm] == v:
-                        entries.append(nm)
-                        pool.remove(nm)
-                        break
-                else:
-                    raise SystemExit(f"grid {g} does not factor over the "
-                                     f"mesh {dict(mesh.shape)}")
-            return P(*entries)
+            def to_spec(g):
+                if g is None:
+                    return None
+                entries, pool = [], list(names)
+                for v in g:
+                    if v <= 1:
+                        entries.append(None)
+                        continue
+                    # The factor-multiset checks above guarantee a match.
+                    nm = next(n for n in pool if mesh.shape[n] == v)
+                    entries.append(nm)
+                    pool.remove(nm)
+                return P(*entries)
 
-        in_spec, out_spec = to_spec(args.ingrid), to_spec(args.outgrid)
+            in_spec, out_spec = to_spec(args.ingrid), to_spec(args.outgrid)
         decomposition = None
     if args.bricks and args.kind != "c2c":
         raise SystemExit("-bricks supports c2c only")

@@ -142,7 +142,7 @@ def test_speed3d_bricks(capsys, tmp_path):
     assert ",bricks-" in row
 
 
-def test_speed3d_ingrid_outgrid(capsys, tmp_path):
+def test_speed3d_ingrid_outgrid(capsys):
     """heFFTe -ingrid/-outgrid parity: user processor grids become plan
     in/out layouts and roundtrip correctly."""
     speed3d.main(["c2c", "single", "16", "16", "16",
