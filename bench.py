@@ -131,17 +131,26 @@ def _worker(shape_n: int) -> None:
     best = min(results, key=lambda e: results[e][0])
     seconds, max_err, decomposition = results[best]
 
-    # Per-stage t0..t3 breakdown (fft_mpi_3d_api.cpp:184-201) — only
-    # meaningful when there is an exchange, i.e. n_dev > 1.
+    # Per-stage t0..t3 breakdown (fft_mpi_3d_api.cpp:184-201); the
+    # reference prints it even single-rank (t1/t2 zero without an
+    # exchange).
     stages = None
-    if mesh is not None and decomposition == "slab":
-        try:
+    try:
+        stage_fns = None
+        if mesh is not None and decomposition == "slab":
             from distributedfft_tpu.parallel.slab import build_slab_stages
 
             stage_fns, _ = build_slab_stages(
                 mesh, shape, axis_name=mesh.axis_names[0], executor=best,
                 forward=True,
             )
+        elif mesh is None:
+            from distributedfft_tpu.parallel.staged import (
+                build_single_stages,
+            )
+
+            stage_fns = build_single_stages(shape, executor=best)
+        if stage_fns is not None:
             plan = dfft.plan_dft_c2c_3d(
                 shape, mesh, direction=dfft.FORWARD, dtype=dtype,
                 executor=best,
@@ -149,8 +158,8 @@ def _worker(shape_n: int) -> None:
             x = dfft.alloc_local(plan, fill=None)
             st, _ = time_staged(stage_fns, x, iters=3)
             stages = {k: round(v, 6) for k, v in st.times.items()}
-        except Exception:  # noqa: BLE001 — breakdown is best-effort extra
-            traceback.print_exc(limit=3, file=sys.stderr)
+    except Exception:  # noqa: BLE001 — breakdown is best-effort extra
+        traceback.print_exc(limit=3, file=sys.stderr)
 
     gf = gflops(shape, seconds)
     out = {

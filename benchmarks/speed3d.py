@@ -291,7 +291,7 @@ def main(argv=None) -> None:
         print("note: -staged is not available for brick plans; ignoring",
               file=sys.stderr)
         args.staged = False
-    if args.staged and (args.ingrid or args.outgrid):
+    if args.staged and (in_spec is not None or out_spec is not None):
         # The staged builders rebuild the CANONICAL chain; an absorbed
         # user layout re-axes it, so the breakdown would describe a
         # different execution than the timed plan.
@@ -301,8 +301,15 @@ def main(argv=None) -> None:
     if args.staged:
         stages = None
         if fwd.mesh is None:
-            print("note: -staged needs a multi-device plan; ignoring",
-                  file=sys.stderr)
+            if args.kind == "c2c":
+                from distributedfft_tpu.parallel.staged import (
+                    build_single_stages,
+                )
+
+                stages = build_single_stages(shape, executor=args.executor)
+            else:
+                print("note: single-device -staged supports c2c only; "
+                      "ignoring", file=sys.stderr)
         elif fwd.decomposition == "slab" and args.kind == "c2c":
             from distributedfft_tpu.parallel.slab import build_slab_stages
 

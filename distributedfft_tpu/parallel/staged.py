@@ -39,7 +39,26 @@ __all__ = [
     "build_pencil_stages",
     "build_slab_rfft_stages",
     "build_pencil_rfft_stages",
+    "build_single_stages",
 ]
+
+
+def build_single_stages(
+    shape: tuple[int, int, int],
+    *,
+    executor: str | Callable = "xla",
+    forward: bool = True,
+) -> list:
+    """Single-device staged pipeline: t0 (YZ planes) and t3 (X lines) as
+    separate jits — the per-stage breakdown the reference prints even on
+    one rank (``fft_mpi_3d_api.cpp:184-201``; t1/t2 are identically zero
+    without a transpose/exchange). With the pallas executor, t0 is the
+    fused 2D plane kernel and t3 the strided axis-0 kernel."""
+    ex = get_executor(executor) if isinstance(executor, str) else executor
+    return [
+        ("t0_fft_yz", jax.jit(lambda x: ex(x, (1, 2), forward))),
+        ("t3_fft_x", jax.jit(lambda y: ex(y, (0,), forward))),
+    ]
 
 _AXIS_LETTER = "xyz"
 
