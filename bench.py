@@ -100,6 +100,10 @@ def _worker(shape_n: int) -> None:
     import traceback
 
     import jax
+
+    from distributedfft_tpu.utils.cache import enable_compile_cache
+
+    enable_compile_cache()
     import jax.numpy as jnp
 
     import distributedfft_tpu as dfft
@@ -131,6 +135,27 @@ def _worker(shape_n: int) -> None:
     best = min(results, key=lambda e: results[e][0])
     seconds, max_err, decomposition = results[best]
 
+    gf = gflops(shape, seconds)
+    out = {
+        "metric": f"fft3d_c2c_{shape_n}_forward_gflops",
+        "value": round(gf, 1),
+        "unit": "GFlops/s",
+        "vs_baseline": round(gf / HEFFTE_BASELINE_GFLOPS, 3),
+        "seconds": round(seconds, 6),
+        "max_roundtrip_err": max_err,
+        "dtype": "complex64",
+        "backend": jax.default_backend(),
+        "devices": n_dev,
+        "decomposition": decomposition,
+        "executor": best,
+        "all": {e: round(r[0], 6) for e, r in results.items()},
+    }
+    # The measurement is in hand: print it BEFORE the best-effort staged
+    # extras, which compile fresh programs and can wedge on a sick tunnel
+    # (a hang there must not cost the number; the orchestrator recovers
+    # the last parseable line from partial stdout on timeout).
+    print(json.dumps(out), flush=True)
+
     # Per-stage t0..t3 breakdown (fft_mpi_3d_api.cpp:184-201); the
     # reference prints it even single-rank (t1/t2 zero without an
     # exchange).
@@ -161,24 +186,11 @@ def _worker(shape_n: int) -> None:
     except Exception:  # noqa: BLE001 — breakdown is best-effort extra
         traceback.print_exc(limit=3, file=sys.stderr)
 
-    gf = gflops(shape, seconds)
-    out = {
-        "metric": f"fft3d_c2c_{shape_n}_forward_gflops",
-        "value": round(gf, 1),
-        "unit": "GFlops/s",
-        "vs_baseline": round(gf / HEFFTE_BASELINE_GFLOPS, 3),
-        "seconds": round(seconds, 6),
-        "max_roundtrip_err": max_err,
-        "dtype": "complex64",
-        "backend": jax.default_backend(),
-        "devices": n_dev,
-        "decomposition": decomposition,
-        "executor": best,
-        "all": {e: round(r[0], 6) for e, r in results.items()},
-    }
     if stages:
+        # Enriched line supersedes the base one (the orchestrator parses
+        # the LAST line carrying "metric").
         out["stages"] = stages
-    print(json.dumps(out), flush=True)
+        print(json.dumps(out), flush=True)
 
 
 # ----------------------------------------------------------- orchestrator
@@ -209,17 +221,25 @@ def _run_attempt(shape_n: int, timeout: float, extra_env: dict | None = None):
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
     except subprocess.TimeoutExpired as e:
-        # Keep the child's partial output — it is the only evidence of where
-        # the worker wedged (the exact failure mode this orchestrator exists
-        # to survive).
+        # Keep the child's partial output — a worker that printed its
+        # result line and then wedged in best-effort extras still counts
+        # (the measurement is recovered from partial stdout).
         partial = ""
-        for stream in (e.stderr, e.stdout):
+        texts = {}
+        for name, stream in (("stderr", e.stderr), ("stdout", e.stdout)):
             if stream:
                 text = stream if isinstance(stream, str) else stream.decode(
                     "utf-8", "replace")
+                texts[name] = text
                 sys.stderr.write(text[-2000:])
                 partial = partial or "; ".join(
                     text.strip().splitlines()[-2:])[-300:]
+        result = _parse_json_line(texts.get("stdout", ""))
+        if result is not None:
+            sys.stderr.write(
+                "\nworker timed out after printing its result; "
+                "recovered the measurement from partial stdout\n")
+            return result, "ok (recovered from timed-out worker)"
         note = f"attempt timed out after {int(timeout)}s"
         return None, f"{note}: {partial}" if partial else note
     except OSError as e:

@@ -84,6 +84,10 @@ def main() -> int:
     import jax.numpy as jnp
     import numpy as np
 
+    from record_baseline import enable_compile_cache
+
+    enable_compile_cache()
+
     from distributedfft_tpu.ops import pallas_fft
     from distributedfft_tpu.utils.timing import max_rel_err, sync
     from distributedfft_tpu.utils.trace import CsvRecorder

@@ -1,0 +1,25 @@
+"""Persistent XLA compilation cache setup, shared by every benchmark
+driver: on the flaky TPU tunnel a retry must not pay the 20-40s compile
+again. One definition so the knob names, default directory, and threshold
+cannot drift between drivers."""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compile_cache() -> None:
+    """Point JAX at a persistent compile cache (no-op when
+    ``DFFT_NO_COMPILE_CACHE=1``; directory override via
+    ``DFFT_COMPILE_CACHE``)."""
+    if os.environ.get("DFFT_NO_COMPILE_CACHE") == "1":
+        return
+    import jax
+
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("DFFT_COMPILE_CACHE", "/tmp/dfft_xla_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 — the cache is an optimization only
+        pass
