@@ -54,14 +54,6 @@ def reexec_with_watchdog(argv: list[str], timeout: float) -> int:
         return 2
 
 
-def enable_compile_cache() -> None:
-    """Shared persistent-compile-cache setup (single definition in
-    distributedfft_tpu.utils.cache; re-exported here for tune_pallas)."""
-    from distributedfft_tpu.utils.cache import enable_compile_cache as go
-
-    go()
-
-
 def run_config(shape, dtype_name, executor, mesh, *, real=False):
     """Plan, verify, and time one config. Returns a result dict; raises on
     failure (caller records the error row)."""
@@ -209,9 +201,10 @@ def main() -> int:
 
     import jax
 
-    enable_compile_cache()
-
+    from distributedfft_tpu.utils.cache import enable_compile_cache
     from distributedfft_tpu.utils.trace import CsvRecorder
+
+    enable_compile_cache()
 
     backend = jax.default_backend()
     n_dev = len(jax.devices())

@@ -84,7 +84,7 @@ def main() -> int:
     import jax.numpy as jnp
     import numpy as np
 
-    from record_baseline import enable_compile_cache
+    from distributedfft_tpu.utils.cache import enable_compile_cache
 
     enable_compile_cache()
 

@@ -575,10 +575,6 @@ def plan_brick_dft_c2c_3d(
     the mesh (pick a mesh whose axis sizes divide the extents); the user
     boxes themselves carry no such restriction.
     """
-    from .parallel.bricks import (
-        pad_shape_for, plan_bricks_to_spec, plan_spec_to_bricks,
-    )
-
     shape, _ = _check_direction(shape, direction)
     dtype = _default_cdtype(dtype)
     inner = plan_dft_c2c_3d(
@@ -586,22 +582,80 @@ def plan_brick_dft_c2c_3d(
         executor=executor, dtype=dtype, donate=donate, algorithm=algorithm,
         options=options,
     )
+    return _wrap_brick_io(inner, in_boxes, out_boxes)
+
+
+def plan_brick_dft_r2c_3d(
+    shape: Sequence[int],
+    mesh: Mesh | int,
+    in_boxes: Sequence[Box3],
+    out_boxes: Sequence[Box3],
+    *,
+    direction: int = FORWARD,
+    decomposition: str | None = None,
+    executor: str = "xla",
+    dtype: Any = None,
+    donate: bool = False,
+    algorithm: str = "alltoall",
+    options: PlanOptions | None = None,
+) -> Plan3D:
+    """Real<->complex 3D plan with arbitrary per-device boxes — the brick
+    tier of heFFTe's ``fft3d_r2c`` (``heffte_fft3d_r2c.h``; r2c box shrink
+    ``box3d::r2c``, ``heffte_geometry.h:94``).
+
+    Forward: ``in_boxes`` partition the real-space world ``shape``,
+    ``out_boxes`` the shrunk complex world ``(n0, n1, n2//2+1)``; backward
+    swaps the roles. See :func:`plan_brick_dft_c2c_3d` for the stack I/O
+    convention."""
+    shape, _ = _check_direction(shape, direction)
+    inner = plan_dft_r2c_3d(
+        shape, mesh, direction=direction, decomposition=decomposition,
+        executor=executor, dtype=dtype, donate=donate, algorithm=algorithm,
+        options=options,
+    )
+    return _wrap_brick_io(inner, in_boxes, out_boxes)
+
+
+def plan_brick_dft_c2r_3d(shape, mesh, in_boxes, out_boxes, **kw) -> Plan3D:
+    """Convenience alias: the inverse of :func:`plan_brick_dft_r2c_3d`."""
+    kw.setdefault("direction", BACKWARD)
+    return plan_brick_dft_r2c_3d(shape, mesh, in_boxes, out_boxes, **kw)
+
+
+def _wrap_brick_io(
+    inner: Plan3D, in_boxes: Sequence[Box3], out_boxes: Sequence[Box3]
+) -> Plan3D:
+    """Bracket a canonical-chain plan with the overlap-map ring reshapes
+    (shared by the c2c and r2c brick planners)."""
+    from .geometry import find_world
+    from .parallel.bricks import (
+        pad_shape_for, plan_bricks_to_spec, plan_spec_to_bricks,
+    )
+
     if inner.mesh is None or inner.in_sharding is None:
         raise ValueError("brick plans require a multi-device mesh")
     m = inner.mesh
+    for label, boxes, want in (("in_boxes", in_boxes, inner.in_shape),
+                               ("out_boxes", out_boxes, inner.out_shape)):
+        got = find_world(boxes).shape
+        if got != tuple(want):
+            raise ValueError(
+                f"{label} cover a {got} world; this plan's side is {want}"
+            )
     # The ring lands an *even* mesh layout; when the chain endpoint itself
     # is uneven (ceil-split), target the nearest even layout and let the
     # chain's own sharding constraints move data the rest of the way (one
     # extra XLA reshard — the same prepend/append reshape heFFTe's planner
     # emits for non-matching layouts, heffte_plan_logic.cpp:162-245).
-    in_target = _even_fallback_spec(m, inner.in_sharding.spec, shape)
-    out_target = _even_fallback_spec(m, inner.out_sharding.spec, shape)
+    in_target = _even_fallback_spec(m, inner.in_sharding.spec,
+                                    inner.in_shape)
+    out_target = _even_fallback_spec(m, inner.out_sharding.spec,
+                                     inner.out_shape)
     to_canon, in_bspec = plan_bricks_to_spec(m, in_boxes, in_target)
     from_canon, out_bspec = plan_spec_to_bricks(m, out_target, out_boxes)
     inner_fn = inner.fn
 
-    jit_kw: dict = {"donate_argnums": 0} if (donate or (
-        options is not None and options.donate)) else {}
+    jit_kw: dict = {"donate_argnums": 0} if inner.options.donate else {}
 
     @functools.partial(jax.jit, **jit_kw)
     def fn(stack):
@@ -611,13 +665,14 @@ def plan_brick_dft_c2c_3d(
     names = tuple(m.axis_names)
     stack_sh = NamedSharding(m, P(names, None, None, None))
     return Plan3D(
-        shape=shape, direction=direction, dtype=dtype,
+        shape=inner.shape, direction=inner.direction, dtype=inner.dtype,
         decomposition=inner.decomposition, executor=inner.executor, mesh=m,
         fn=fn, spec=inner.spec, in_sharding=stack_sh, out_sharding=stack_sh,
         in_boxes=list(in_boxes), out_boxes=list(out_boxes),
         in_shape=(p,) + pad_shape_for(in_boxes),
         out_shape=(p,) + pad_shape_for(out_boxes),
-        options=inner.options, logic=inner.logic,
+        in_dtype=inner.in_dtype, out_dtype=inner.out_dtype,
+        real=inner.real, options=inner.options, logic=inner.logic,
         brick_edges=(in_bspec, out_bspec),
     )
 
