@@ -265,3 +265,31 @@ def test_brick_r2c_world_mismatch_rejected():
     with pytest.raises(ValueError, match="world"):
         # out boxes must partition the SHRUNK complex world, not the real one
         dfft.plan_brick_dft_r2c_3d(shape, mesh, ins, make_slabs(w, 8, axis=0))
+
+
+def test_brick_plan_scale_and_donate():
+    """Scale enum applies to brick-stack outputs (pads stay zero), and
+    donated brick plans consume their input stack."""
+    from distributedfft_tpu.ops.executors import Scale
+
+    shape = (8, 8, 8)
+    mesh = dfft.make_mesh(8)
+    w = world_box(shape)
+    ins = make_slabs(w, 8, axis=0)
+    outs = make_slabs(w, 8, axis=2)
+    fwd = dfft.plan_brick_dft_c2c_3d(shape, mesh, ins, outs,
+                                     dtype=np.complex64)
+    rng = np.random.default_rng(23)
+    x = (rng.standard_normal(shape) + 1j * rng.standard_normal(shape)).astype(
+        np.complex64)
+    stack = scatter_bricks(x, ins, fwd.in_shape[1:], mesh=mesh)
+    y_full = gather_bricks(fwd(stack, scale=Scale.FULL), outs)
+    np.testing.assert_allclose(y_full, np.fft.fftn(x) / x.size, atol=1e-5)
+
+    dplan = dfft.plan_brick_dft_c2c_3d(shape, mesh, ins, outs,
+                                       dtype=np.complex64, donate=True)
+    stack2 = scatter_bricks(x, ins, dplan.in_shape[1:], mesh=mesh)
+    y = dplan(stack2)
+    np.testing.assert_allclose(gather_bricks(y, outs), np.fft.fftn(x),
+                               atol=1e-3)
+    assert stack2.is_deleted()  # donation consumed the input stack
