@@ -102,16 +102,22 @@ def batch_tile(n: int) -> int:
 
 
 @functools.lru_cache(maxsize=None)
-def _tables_np(n: int, forward: bool):
+def _tables_np(n: int, forward: bool, g1: int = 1, g2: int = 1):
     """(W1, T, W2) float32 LUT triple for n = n1*n2, host-exact float64.
 
     W1[j1, k1] is the n1-point DFT matrix, W2[j2, k2] the n2-point one, and
     T[j2, k1] = w_n^{j2*k1} the inter-stage twiddle laid out to match the
-    first stage's [j2, k1] output.
+    first stage's [j2, k1] output. ``g1``/``g2`` > 1 widen the stage
+    matrices to block-diagonal I_g (x) W — ``g`` independent DFTs as one
+    MXU-width matmul (identical sums; the off-block zeros are exact), the
+    packing that lifts a sub-128 factor's systolic-array utilization from
+    (n/128)^2 to ~full (see ``dft_matmul.pack_factor``).
     """
+    from .dft_matmul import _blockdiag_dft_np
+
     n1, n2 = split_for(n)
-    w1 = _dft_matrix_np(n1, forward)
-    w2 = _dft_matrix_np(n2, forward)
+    w1 = _blockdiag_dft_np(n1, g1, forward)
+    w2 = _blockdiag_dft_np(n2, g2, forward)
     sign = -2j if forward else 2j
     jk = np.outer(np.arange(n2), np.arange(n1))
     t = np.exp(sign * np.pi * (jk % n) / n)
@@ -138,18 +144,20 @@ def _mm(a, b):
     )
 
 
-def _four_step_pass(a3r, a3i, w1r, w1i, tr, ti, w2r, w2i):
+def _four_step_pass(a3r, a3i, w1r, w1i, tr, ti, w2r, w2i, g1=1, g2=1):
     """One four-step DFT pass contracting the factor dims of [rows, n1, n2]
     planes (the transform axis pre-split to (n1, n2) by the caller), shared
-    by the 1D and fused-2D kernels. Mosaic note: every reshape below
-    merges/splits *leading* dims only (the lane dim never changes inside a
-    reshape); layout moves between the two matmul groupings happen via
-    transposes. Returns [rows, n2, n1] planes — flat (k2, k1) IS the
+    by the 1D and fused-2D kernels. With ``g1``/``g2`` > 1 the stage
+    matrices arrive block-diagonal (I_g (x) W, see ``_tables_np``) and the
+    row dim is regrouped so each matmul contracts a full MXU-width g*n
+    lanes instead of a sub-128 factor — the reshapes change the lane dim,
+    which Mosaic implements as VMEM relayouts (cheap next to a 98%-idle
+    systolic array). Returns [rows, n2, n1] planes — flat (k2, k1) IS the
     transformed axis in natural order (k = k1 + n1*k2)."""
     rows, n1, n2 = a3r.shape
     # A[b, j1, j2] -> [b*j2, j1] so stage 1 contracts j1 on the MXU.
-    sr = a3r.transpose(0, 2, 1).reshape(rows * n2, n1)
-    si = a3i.transpose(0, 2, 1).reshape(rows * n2, n1)
+    sr = a3r.transpose(0, 2, 1).reshape(rows * n2 // g1, g1 * n1)
+    si = a3i.transpose(0, 2, 1).reshape(rows * n2 // g1, g1 * n1)
     gr = _mm(sr, w1r) - _mm(si, w1i)
     gi = _mm(sr, w1i) + _mm(si, w1r)
     # Twiddle on [b, j2, k1] (T broadcast over the batch).
@@ -158,8 +166,8 @@ def _four_step_pass(a3r, a3i, w1r, w1i, tr, ti, w2r, w2i):
     hr = gr * tr - gi * ti
     hi = gr * ti + gi * tr
     # Stage 2 contracts j2: [b*k1, j2] @ W2 -> Z[b, k1, k2].
-    hr = hr.transpose(0, 2, 1).reshape(rows * n1, n2)
-    hi = hi.transpose(0, 2, 1).reshape(rows * n1, n2)
+    hr = hr.transpose(0, 2, 1).reshape(rows * n1 // g2, g2 * n2)
+    hi = hi.transpose(0, 2, 1).reshape(rows * n1 // g2, g2 * n2)
     zr = _mm(hr, w2r) - _mm(hi, w2i)
     zi = _mm(hr, w2i) + _mm(hi, w2r)
     # Output flat index k = k1 + n1*k2: emit Z^T = [b, k2, k1].
@@ -168,11 +176,25 @@ def _four_step_pass(a3r, a3i, w1r, w1i, tr, ti, w2r, w2i):
     return zr, zi
 
 
-def _make_kernel(n1: int, n2: int):
+def _packs(n1: int, n2: int, rows: int) -> tuple[int, int]:
+    """(g1, g2) block-diagonal pack factors for one four-step pass over
+    [rows, n1, n2] tiles (``DFFT_PALLAS_PACK=0`` disables, the hardware
+    fallback if a Mosaic version rejects the lane-changing reshapes)."""
+    import os
+
+    from .dft_matmul import pack_factor
+
+    if os.environ.get("DFFT_PALLAS_PACK", "1") == "0":
+        return 1, 1
+    return pack_factor(n1, rows * n2), pack_factor(n2, rows * n1)
+
+
+def _make_kernel(n1: int, n2: int, g1: int, g2: int):
     def kernel(w1r, w1i, tr, ti, w2r, w2i, xr, xi, yr, yi):
         zr, zi = _four_step_pass(
             xr[:], xi[:],
             w1r[:], w1i[:], tr[:], ti[:], w2r[:], w2i[:],
+            g1=g1, g2=g2,
         )
         yr[:] = zr
         yi[:] = zi
@@ -180,18 +202,21 @@ def _make_kernel(n1: int, n2: int):
     return kernel
 
 
-def _make_kernel2d(ny: int, nz: int):
+def _make_kernel2d(ny: int, nz: int, gy: tuple[int, int],
+                   gz: tuple[int, int]):
     """Fused 2D kernel: FFT along Z then Y of one plane tile, both passes
     staged through VMEM in ONE launch — the templateFFT 2D-app role (one
     ``FFT_main`` covering the whole YZ plane, ``kernel_512x512x1.h``; the
     t0 stage of the slab pipeline, ``fft_mpi_3d_api.cpp:466-522``). Where
     the per-axis path writes the full array to HBM between axes, this
     kernel transposes in VMEM: one HBM read and one write for the plane.
+    ``gy``/``gz`` are the per-axis block-diagonal pack factors (see
+    ``_packs``).
 
     Blocks are 5D ``[bt, y1, y2, z1, z2]`` (both axes pre-split by the
-    caller) so every in-kernel reshape merges/splits leading dims only;
-    the inter-axis data movement is done by transposes, which Mosaic
-    implements as real relayouts. Output blocks are ``[bt, ky2, ky1, kz2,
+    caller); inter-axis data movement is done by transposes, and the
+    packed stage matmuls inside ``_four_step_pass`` regroup rows with
+    lane-changing reshapes — both are VMEM relayouts under Mosaic. Output blocks are ``[bt, ky2, ky1, kz2,
     kz1]`` — flat (k2, k1) per axis is that axis's natural transformed
     order, so the caller's view back to ``[batch, ny, nz]`` is free."""
     y1, y2 = split_for(ny)
@@ -204,7 +229,8 @@ def _make_kernel2d(ny: int, nz: int):
         ar = xr[:].reshape(bt * y1 * y2, z1, z2)
         ai = xi[:].reshape(bt * y1 * y2, z1, z2)
         br, bi = _four_step_pass(ar, ai, wz1r[:], wz1i[:], tzr[:],
-                                 tzi[:], wz2r[:], wz2i[:])
+                                 tzi[:], wz2r[:], wz2i[:],
+                                 g1=gz[0], g2=gz[1])
         # [bt, y1, y2, kz2, kz1] -> [bt, kz2, kz1, y1, y2] (VMEM relayout).
         br = br.reshape(bt, y1, y2, z2, z1).transpose(0, 3, 4, 1, 2)
         bi = bi.reshape(bt, y1, y2, z2, z1).transpose(0, 3, 4, 1, 2)
@@ -212,7 +238,8 @@ def _make_kernel2d(ny: int, nz: int):
         br = br.reshape(bt * z2 * z1, y1, y2)
         bi = bi.reshape(bt * z2 * z1, y1, y2)
         cr, ci = _four_step_pass(br, bi, wy1r[:], wy1i[:], tyr[:],
-                                 tyi[:], wy2r[:], wy2i[:])
+                                 tyi[:], wy2r[:], wy2i[:],
+                                 g1=gy[0], g2=gy[1])
         # [bt, kz2, kz1, ky2, ky1] -> [bt, ky2, ky1, kz2, kz1].
         cr = cr.reshape(bt, z2, z1, y2, y1).transpose(0, 3, 4, 1, 2)
         ci = ci.reshape(bt, z2, z1, y2, y1).transpose(0, 3, 4, 1, 2)
@@ -230,8 +257,9 @@ def _fft_tiles(xr, xi, *, n: int, forward: bool, interpret: bool):
     batch = xr.shape[0]
     bt = min(batch_tile(n), batch)
     grid = batch // bt
+    g1, g2 = _packs(n1, n2, bt)
 
-    w1, t, w2 = _tables_np(n, forward)
+    w1, t, w2 = _tables_np(n, forward, g1, g2)
     consts = [jnp.asarray(p) for m in (w1, t, w2) for p in (m.real, m.imag)]
     vma = _vma(xr)
     if vma:
@@ -249,7 +277,7 @@ def _fft_tiles(xr, xi, *, n: int, forward: bool, interpret: bool):
                           memory_space=pltpu.VMEM)
 
     yr, yi = pl.pallas_call(
-        _make_kernel(n1, n2),
+        _make_kernel(n1, n2, g1, g2),
         grid=(grid,),
         in_specs=lut_specs + [x_spec, x_spec],
         out_specs=(y_spec, y_spec),
@@ -260,7 +288,7 @@ def _fft_tiles(xr, xi, *, n: int, forward: bool, interpret: bool):
             jax.ShapeDtypeStruct((batch, n2, n1), jnp.float32, vma=_vma(xr)),
         ),
         cost_estimate=pl.CostEstimate(
-            flops=8 * batch * n * (n1 + n2),
+            flops=8 * batch * n * (g1 * n1 + g2 * n2),
             bytes_accessed=4 * batch * n * 4,
             transcendentals=0,
         ),
@@ -307,10 +335,12 @@ def _fft2_tiles(xr, xi, *, ny: int, nz: int, forward: bool, interpret: bool):
     grid = batch // bt
     y1, y2 = split_for(ny)
     z1, z2 = split_for(nz)
+    gz = _packs(z1, z2, bt * y1 * y2)
+    gy = _packs(y1, y2, bt * z2 * z1)
 
     tabs = []
-    for n in (ny, nz):
-        w1, t, w2 = _tables_np(n, forward)
+    for n, g in ((ny, gy), (nz, gz)):
+        w1, t, w2 = _tables_np(n, forward, *g)
         tabs += [m for m in (w1, t, w2)]
     consts = [jnp.asarray(p) for m in tabs for p in (m.real, m.imag)]
     vma = _vma(xr)
@@ -327,7 +357,7 @@ def _fft2_tiles(xr, xi, *, ny: int, nz: int, forward: bool, interpret: bool):
                           memory_space=pltpu.VMEM)
 
     yr, yi = pl.pallas_call(
-        _make_kernel2d(ny, nz),
+        _make_kernel2d(ny, nz, gy, gz),
         grid=(grid,),
         in_specs=lut_specs + [x_spec, x_spec],
         out_specs=(y_spec, y_spec),
@@ -338,8 +368,8 @@ def _fft2_tiles(xr, xi, *, ny: int, nz: int, forward: bool, interpret: bool):
                                  vma=vma),
         ),
         cost_estimate=pl.CostEstimate(
-            flops=8 * batch * ny * nz * sum(sum(split_for(n))
-                                            for n in (ny, nz)),
+            flops=8 * batch * ny * nz * (gy[0] * y1 + gy[1] * y2
+                                         + gz[0] * z1 + gz[1] * z2),
             bytes_accessed=4 * batch * ny * nz * 4,
             transcendentals=0,
         ),
@@ -354,20 +384,21 @@ def _fft2_tiles(xr, xi, *, ny: int, nz: int, forward: bool, interpret: bool):
     return yr.reshape(batch, ny, nz), yi.reshape(batch, ny, nz)
 
 
-def _make_kernel_strided(n1: int, n2: int):
+def _make_kernel_strided(n1: int, n2: int, g1: int, g2: int):
     """Strided kernel: four-step DFT over the LEADING axis of [n1, n2, ct]
     tiles (transform axis pre-split, a column chunk trailing) — the
     ``radixStrided`` role of the reference's codegen
     (``templateFFT.cpp:1760``): transform a strided axis without a global
     transpose. The HBM layout never changes; the reorders run on the tile
-    in VMEM. Output tiles are [n2, n1, ct] (flat (k2, k1) = the transformed
+    in VMEM. ``g1``/``g2`` are block-diagonal pack factors (``_packs``).
+    Output tiles are [n2, n1, ct] (flat (k2, k1) = the transformed
     axis in natural order)."""
 
     def kernel(w1r, w1i, tr, ti, w2r, w2i, xr, xi, yr, yi):
         ct = xr.shape[-1]
         # Stage 1 contracts j1: [j1, j2, c] -> [j2, c, j1] -> [j2*c, j1].
-        ar = xr[:].transpose(1, 2, 0).reshape(n2 * ct, n1)
-        ai = xi[:].transpose(1, 2, 0).reshape(n2 * ct, n1)
+        ar = xr[:].transpose(1, 2, 0).reshape(n2 * ct // g1, g1 * n1)
+        ai = xi[:].transpose(1, 2, 0).reshape(n2 * ct // g1, g1 * n1)
         gr = _mm(ar, w1r[:]) - _mm(ai, w1i[:])
         gi = _mm(ar, w1i[:]) + _mm(ai, w1r[:])
         # Twiddle T[j2, k1] broadcast over the column chunk.
@@ -376,8 +407,8 @@ def _make_kernel_strided(n1: int, n2: int):
         hr = gr * tr[:][:, None, :] - gi * ti[:][:, None, :]
         hi = gr * ti[:][:, None, :] + gi * tr[:][:, None, :]
         # Stage 2 contracts j2: [j2, c, k1] -> [c, k1, j2] -> [c*k1, j2].
-        hr = hr.transpose(1, 2, 0).reshape(ct * n1, n2)
-        hi = hi.transpose(1, 2, 0).reshape(ct * n1, n2)
+        hr = hr.transpose(1, 2, 0).reshape(ct * n1 // g2, g2 * n2)
+        hi = hi.transpose(1, 2, 0).reshape(ct * n1 // g2, g2 * n2)
         zr = _mm(hr, w2r[:]) - _mm(hi, w2i[:])
         zi = _mm(hr, w2i[:]) + _mm(hi, w2r[:])
         # [c, k1, k2] -> [k2, k1, c]: leading flat (k2, k1) = output order.
@@ -400,8 +431,9 @@ def _fft_strided_tiles(xr, xi, *, n: int, forward: bool, interpret: bool):
     cols = xr.shape[1]
     ct = min(col_tile(n), cols)
     grid = cols // ct
+    g1, g2 = _packs(n1, n2, ct)
 
-    w1, t, w2 = _tables_np(n, forward)
+    w1, t, w2 = _tables_np(n, forward, g1, g2)
     consts = [jnp.asarray(p) for m in (w1, t, w2) for p in (m.real, m.imag)]
     vma = _vma(xr)
     if vma:
@@ -417,7 +449,7 @@ def _fft_strided_tiles(xr, xi, *, n: int, forward: bool, interpret: bool):
                           memory_space=pltpu.VMEM)
 
     yr, yi = pl.pallas_call(
-        _make_kernel_strided(n1, n2),
+        _make_kernel_strided(n1, n2, g1, g2),
         grid=(grid,),
         in_specs=lut_specs + [x_spec, x_spec],
         out_specs=(y_spec, y_spec),
@@ -426,7 +458,7 @@ def _fft_strided_tiles(xr, xi, *, n: int, forward: bool, interpret: bool):
             jax.ShapeDtypeStruct((n2, n1, cols), jnp.float32, vma=vma),
         ),
         cost_estimate=pl.CostEstimate(
-            flops=8 * cols * n * (n1 + n2),
+            flops=8 * cols * n * (g1 * n1 + g2 * n2),
             bytes_accessed=4 * cols * n * 4,
             transcendentals=0,
         ),

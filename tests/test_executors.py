@@ -91,6 +91,28 @@ def test_best_split_near_sqrt():
     assert dft_matmul._best_split(13) is None
 
 
+def test_pack_factor():
+    """Sub-MXU-width DFT factors pack g = 128/n transforms into one
+    block-diagonal matmul; g shrinks to divide the batch extent."""
+    assert dft_matmul.pack_factor(16, 4096) == 8
+    assert dft_matmul.pack_factor(32, 4096) == 4
+    assert dft_matmul.pack_factor(128, 4096) == 1
+    assert dft_matmul.pack_factor(256, 4096) == 1
+    assert dft_matmul.pack_factor(16, 12) == 4   # 8 doesn't divide 12
+    assert dft_matmul.pack_factor(16, 7) == 1
+    assert dft_matmul.pack_factor(16, 1) == 1    # 1D input: no batch
+
+
+def test_blockdiag_packed_matches_unpacked():
+    """The packed matmul is the same sums (off-block zeros are exact);
+    results must agree with the unpacked dense DFT to roundoff."""
+    import jax.numpy as jnp
+
+    x = tu.make_world_data((64, 16), dtype=np.complex128, seed=9)
+    got = np.asarray(dft_matmul._direct(jnp.asarray(x), True))
+    tu.assert_approx(got, np.fft.fft(x, axis=-1))
+
+
 def test_mm_precision_env(monkeypatch):
     """DFFT_MM_PRECISION parses the three tiers and defaults to HIGHEST."""
     import jax.lax as lax
