@@ -89,11 +89,18 @@ def test_bench_executor_menu(tmp_path):
     import distributedfft_tpu as dfft
 
     mesh = dfft.make_mesh(4)
-    secs, err, decomp = bench.bench_executor((16, 16, 16), mesh,
-                                             jnp.complex64, "xla")
-    assert secs > 0 and err < 1e-3 and decomp == "slab"
+    secs, err, plan = bench.bench_executor((16, 16, 16), mesh,
+                                           jnp.complex64, "xla")
+    assert secs > 0 and err < 1e-3 and plan.decomposition == "slab"
     with pytest.raises(ValueError):
         bench.bench_executor((16, 16, 16), mesh, jnp.complex64, "nope")
+    # Precision-suffixed candidates plan the base executor under that
+    # DFFT_MM_PRECISION tier and restore the env afterwards.
+    before = os.environ.get("DFFT_MM_PRECISION")
+    secs, err, plan = bench.bench_executor((16, 16, 16), mesh,
+                                           jnp.complex64, "matmul:high")
+    assert secs > 0 and err < 1e-3 and plan.executor == "matmul"
+    assert os.environ.get("DFFT_MM_PRECISION") == before
 
 
 def test_speed3d_profile_flag(tmp_path):
