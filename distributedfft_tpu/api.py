@@ -418,7 +418,7 @@ def _autotune(make_plan: Callable[[str], Plan3D]) -> Plan3D:
 
     import numpy as np
 
-    from .utils.timing import time_fn
+    from .utils.timing import time_fn_amortized
 
     names = [e.strip() for e in os.environ.get(
         "DFFT_AUTO_EXECUTORS", ",".join(_AUTO_CANDIDATES)).split(",")
@@ -462,12 +462,15 @@ def _autotune(make_plan: Callable[[str], Plan3D]) -> Plan3D:
             )
 
     # Phase 2: time the agreed candidates in lockstep (identical order and
-    # execution count on every process).
+    # execution count on every process). Amortized timing (>=10 dispatches
+    # per sync) so a noisy transport's per-call latency cannot pick the
+    # wrong winner — the same methodology as the reference timing nt
+    # executes inside one MPI_Wtime pair (fftSpeed3d_c2c.cpp:94-98).
     times: dict[str, float] = {}
     for ex in candidates:
         try:
             x = alloc_local(plans[ex])
-            t, _ = time_fn(plans[ex].fn, x, iters=2, warmup=1)
+            t, _ = time_fn_amortized(plans[ex].fn, x, iters=10, repeats=2)
         except Exception as e:  # noqa: BLE001
             errors.append(f"{ex}: {type(e).__name__}")
             t = math.inf
