@@ -114,11 +114,13 @@ def pack_factor(n: int, rows: int) -> int:
     block-diagonal (g*n, g*n) matmul multiplies the flops by g but lifts
     utilization by g^2: identical sums (the off-block zeros contribute
     exact +0 terms), ~g-fold faster on hardware. ``rows`` (the flattened
-    batch extent) must stay divisible by g."""
-    g = max(1, 128 // n)
-    while g > 1 and rows % g:
-        g //= 2
-    return g
+    batch extent) must stay divisible by g; the search walks every g down
+    from 128//n so a non-power-of-two cap (e.g. n=10 -> 12) still finds
+    the largest divisor of ``rows`` rather than bailing to 1."""
+    for g in range(max(1, 128 // n), 1, -1):
+        if rows % g == 0:
+            return g
+    return 1
 
 
 def _direct(x: jnp.ndarray, forward: bool) -> jnp.ndarray:

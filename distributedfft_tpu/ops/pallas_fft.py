@@ -176,17 +176,79 @@ def _four_step_pass(a3r, a3i, w1r, w1i, tr, ti, w2r, w2i, g1=1, g2=1):
     return zr, zi
 
 
+@functools.lru_cache(maxsize=None)
+def _pack_probe_ok(n1: int, n2: int, g1: int, g2: int) -> bool:
+    """Per-config Mosaic compile probe for the packed kernels' lane-changing
+    reshapes. The packed stage matmuls regroup rows with reshapes that
+    change the lane (last) dimension; interpret-mode tests cannot prove a
+    given Mosaic version lowers them — and acceptance can depend on the
+    pack widths themselves (a 128-lane-aligned g=8 relayout may lower
+    while a 120-lane g=12 one does not) — so on a real backend a one-block
+    kernel with the exact (n1, n2, g1, g2) about to be used is compiled
+    once per process, and the block-diagonal packing is auto-disabled for
+    that config (g1=g2=1 — correct, just slower) if the compiler rejects
+    it. ``DFFT_PALLAS_PACK=0/1`` overrides the probe in either direction."""
+    if jax.default_backend() == "cpu":
+        return True  # interpret mode executes the reshapes directly
+    try:
+        n = n1 * n2
+        # Smallest row tile the kernel's regroup reshapes accept: rows*n2
+        # divisible by g1 and rows*n1 by g2 (same invariant pack_factor
+        # guarantees for the real tile).
+        bt = next(r for r in range(8, 8 * g1 * g2 + 9)
+                  if (r * n2) % g1 == 0 and (r * n1) % g2 == 0)
+        w1, t, w2 = _tables_np(n, True, g1, g2)
+        consts = [jnp.asarray(p) for m in (w1, t, w2)
+                  for p in (m.real, m.imag)]
+        lut_specs = [
+            pl.BlockSpec(m.shape, lambda i: (0, 0), memory_space=pltpu.VMEM)
+            for m in (w1, w1, t, t, w2, w2)
+        ]
+        x_spec = pl.BlockSpec((bt, n1, n2), lambda i: (i, 0, 0),
+                              memory_space=pltpu.VMEM)
+        y_spec = pl.BlockSpec((bt, n2, n1), lambda i: (i, 0, 0),
+                              memory_space=pltpu.VMEM)
+        call = pl.pallas_call(
+            _make_kernel(n1, n2, g1, g2),
+            grid=(1,),
+            in_specs=lut_specs + [x_spec, x_spec],
+            out_specs=(y_spec, y_spec),
+            out_shape=(
+                jax.ShapeDtypeStruct((bt, n2, n1), jnp.float32),
+                jax.ShapeDtypeStruct((bt, n2, n1), jnp.float32),
+            ),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel",),
+                vmem_limit_bytes=_VMEM_LIMIT,
+            ),
+        )
+        z = jnp.zeros((bt, n1, n2), jnp.float32)
+        jax.jit(lambda a, b: call(*consts, a, b)).lower(z, z).compile()
+        return True
+    except Exception:  # noqa: BLE001 — any rejection means fall back
+        return False
+
+
 def _packs(n1: int, n2: int, rows: int) -> tuple[int, int]:
     """(g1, g2) block-diagonal pack factors for one four-step pass over
-    [rows, n1, n2] tiles (``DFFT_PALLAS_PACK=0`` disables, the hardware
-    fallback if a Mosaic version rejects the lane-changing reshapes)."""
+    [rows, n1, n2] tiles. ``DFFT_PALLAS_PACK=0`` force-disables,
+    ``=1`` force-enables; unset, a one-time compile probe
+    (:func:`_pack_probe_ok`) decides whether this Mosaic version accepts
+    the packed kernels' lane-changing reshapes."""
     import os
 
     from .dft_matmul import pack_factor
 
-    if os.environ.get("DFFT_PALLAS_PACK", "1") == "0":
+    env = os.environ.get("DFFT_PALLAS_PACK")
+    if env == "0":
         return 1, 1
-    return pack_factor(n1, rows * n2), pack_factor(n2, rows * n1)
+    g1 = pack_factor(n1, rows * n2)
+    g2 = pack_factor(n2, rows * n1)
+    if (g1, g2) == (1, 1):
+        return 1, 1
+    if env is None and not _pack_probe_ok(n1, n2, g1, g2):
+        return 1, 1
+    return g1, g2
 
 
 def _make_kernel(n1: int, n2: int, g1: int, g2: int):
@@ -604,7 +666,7 @@ def _four_step_ref(x2: jnp.ndarray, n: int, forward: bool) -> jnp.ndarray:
     under shard_map, where the Pallas interpreter's grid loop cannot carry
     varying-axes types; numerics are identical to the kernel."""
     n1, n2 = split_for(n)
-    w1, t, w2 = (jnp.asarray(m) for m in _tables_np(n, forward))
+    w1, t, w2 = (jnp.asarray(m) for m in _tables_np(n, forward, 1, 1))
     a = x2.reshape(-1, n1, n2)
     from .dft_matmul import mm_precision
 

@@ -98,9 +98,16 @@ def test_pack_factor():
     assert dft_matmul.pack_factor(32, 4096) == 4
     assert dft_matmul.pack_factor(128, 4096) == 1
     assert dft_matmul.pack_factor(256, 4096) == 1
-    assert dft_matmul.pack_factor(16, 12) == 4   # 8 doesn't divide 12
-    assert dft_matmul.pack_factor(16, 7) == 1
+    assert dft_matmul.pack_factor(16, 12) == 6   # 8 doesn't divide 12; 6 does
+    assert dft_matmul.pack_factor(16, 7) == 7    # 7*16 = 112 fits the MXU
     assert dft_matmul.pack_factor(16, 1) == 1    # 1D input: no batch
+    # Non-power-of-two caps walk every divisor down, not just halvings:
+    # 128//10 = 12; rows=512 is not divisible by 12 or 6 or 3, but 8
+    # divides — the halving search (12->6->3->1) missed it.
+    assert dft_matmul.pack_factor(10, 512) == 8
+    assert dft_matmul.pack_factor(20, 512) == 4  # 128//20 = 6 -> 4
+    assert dft_matmul.pack_factor(24, 512) == 4  # 128//24 = 5 -> 4
+    assert dft_matmul.pack_factor(10, 36) == 12  # full cap when it divides
 
 
 def test_blockdiag_packed_matches_unpacked():
