@@ -110,11 +110,70 @@ class BrickSpec:
 
     @property
     def wire_elems(self) -> int:
-        """Elements the padded ring actually ships (block * P per shift)."""
+        """Elements the padded ring actually ships (block * P per step)."""
         p = len(self.in_boxes)
         return sum(
             math.prod(st.block) * p for st in self.steps if st.shift
         )
+
+    @property
+    def wire_ratio(self) -> float:
+        """wire/payload blowup of the padded ring (1.0 = exact tables).
+
+        Bounded by construction: :func:`_overlap_steps` splits any ring
+        step whose sender overlap *shapes* are skewed (prod-of-maxes >>
+        max volume) into shape-similar groups, so the per-step block can
+        never be inflated by orthogonal overlap shapes. The residual
+        overhead is the ring's uniform-block cost itself: every shift
+        ships P blocks sized to that group's largest overlap — heFFTe's
+        alltoallv ships exact per-pair counts instead
+        (``src/heffte_reshape3d.cpp:375``), which the accounting here
+        makes visible (``plan_info`` prints this ratio per edge)."""
+        t = self.payload_elems
+        return self.wire_elems / t if t else 1.0
+
+
+# A ring step whose block (elementwise max over sender overlap shapes)
+# holds more than this factor times the largest single overlap volume is
+# shape-skewed — orthogonal overlap shapes like (a,1,1) vs (1,b,1) inflate
+# prod-of-maxes far past any real payload — and gets split into
+# shape-similar sender groups. Grouping trades one extra ppermute per
+# group for a strictly smaller wire total; the cap bounds the added
+# latency on pathological box sets.
+_SPLIT_FACTOR = 2.0
+_MAX_GROUPS_PER_SHIFT = 4
+
+
+def _shape_groups(shapes: dict[int, np.ndarray]) -> list[list[int]]:
+    """Partition senders into shape-similar groups: greedy best-fit by
+    descending overlap volume, opening a new group when joining any
+    existing one would inflate that group's block past _SPLIT_FACTOR x
+    its largest member volume."""
+    order = sorted(shapes, key=lambda i: -int(np.prod(shapes[i])))
+    groups: list[dict] = []
+    for i in order:
+        sh = shapes[i]
+        best, best_cost = None, None
+        for g in groups:
+            nb = np.maximum(g["block"], sh)
+            cost = int(np.prod(nb))
+            if cost <= _SPLIT_FACTOR * max(g["vol"], int(np.prod(sh))):
+                if best is None or cost < best_cost:
+                    best, best_cost = g, cost
+        if best is None and len(groups) >= _MAX_GROUPS_PER_SHIFT:
+            # Cap reached: fall into the group that inflates least.
+            for g in groups:
+                cost = int(np.prod(np.maximum(g["block"], sh)))
+                if best is None or cost < best_cost:
+                    best, best_cost = g, cost
+        if best is None:
+            groups.append({"members": [i], "block": sh.copy(),
+                           "vol": int(np.prod(sh))})
+        else:
+            best["members"].append(i)
+            best["block"] = np.maximum(best["block"], sh)
+            best["vol"] = max(best["vol"], int(np.prod(sh)))
+    return [g["members"] for g in groups]
 
 
 def _overlap_steps(
@@ -136,8 +195,43 @@ def _overlap_steps(
             recv_start[dst] = np.subtract(o.low, out_boxes[dst].low)
         if not true_size.any():
             continue  # no pair exchanges at this shift
-        block = tuple(int(true_size[:, d].max()) for d in range(3))
-        steps.append(_Step(s, block, send_start, true_size, recv_start))
+        # Shape-skew mitigation: split this shift's senders into
+        # shape-similar groups when the joint block is inflated well past
+        # the largest true overlap (the per-shift analog of heFFTe's
+        # exact alltoallv counts, src/heffte_reshape3d.cpp:375). Each
+        # group replays the same shift with the non-members' table rows
+        # zeroed — the receiver keys every merge off the tables, so a
+        # zero row is a no-op and correctness is untouched.
+        active = {i: true_size[i] for i in range(p) if true_size[i].any()}
+        joint = tuple(int(true_size[:, d].max()) for d in range(3))
+        max_vol = max(int(np.prod(sh)) for sh in active.values())
+        groups = [list(active)]
+        if math.prod(joint) > _SPLIT_FACTOR * max_vol and len(active) > 1:
+            cand = _shape_groups(active)
+            if len(cand) > 1:
+                # Only adopt the split when it strictly shrinks the wire.
+                split_wire = sum(
+                    math.prod(tuple(
+                        int(max(true_size[i][d] for i in g))
+                        for d in range(3)))
+                    for g in cand
+                )
+                if split_wire < math.prod(joint):
+                    groups = cand
+        for members in groups:
+            if len(groups) == 1:
+                g_send, g_true, g_recv = send_start, true_size, recv_start
+            else:
+                g_send = np.zeros((p, 3), np.int32)
+                g_true = np.zeros((p, 3), np.int32)
+                g_recv = np.zeros((p, 3), np.int32)
+                for i in members:
+                    dst = (i + s) % p
+                    g_send[i] = send_start[i]
+                    g_true[i] = true_size[i]
+                    g_recv[dst] = recv_start[dst]
+            block = tuple(int(g_true[:, d].max()) for d in range(3))
+            steps.append(_Step(s, block, g_send, g_true, g_recv))
     return steps
 
 

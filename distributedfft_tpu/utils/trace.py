@@ -236,7 +236,7 @@ def plan_info(plan) -> str:
         for label, bs in zip(("in->chain", "chain->out"), plan.brick_edges):
             t = bs.payload_elems * itemsize
             w = bs.wire_elems * itemsize
-            ov = f"+{(w / t - 1) * 100:.1f}%" if t else "n/a"
+            ov = f"ratio {bs.wire_ratio:.2f}x" if t else "ratio n/a"
             lines.append(
                 f"brick edge {label}: {len(bs.steps)} ring steps, "
                 f"payload {t * _MB:.2f} MB | wire {w * _MB:.2f} MB ({ov})"
