@@ -120,15 +120,18 @@ class BrickSpec:
     def wire_ratio(self) -> float:
         """wire/payload blowup of the padded ring (1.0 = exact tables).
 
-        Bounded by construction: :func:`_overlap_steps` splits any ring
-        step whose sender overlap *shapes* are skewed (prod-of-maxes >>
-        max volume) into shape-similar groups, so the per-step block can
-        never be inflated by orthogonal overlap shapes. The residual
-        overhead is the ring's uniform-block cost itself: every shift
-        ships P blocks sized to that group's largest overlap — heFFTe's
-        alltoallv ships exact per-pair counts instead
-        (``src/heffte_reshape3d.cpp:375``), which the accounting here
-        makes visible (``plan_info`` prints this ratio per edge)."""
+        :func:`_overlap_steps` mitigates shape skew — a ring step whose
+        sender overlap shapes are orthogonal (prod-of-maxes >> max
+        volume) is split into shape-similar groups when that wins at
+        least a ``_SPLIT_FACTOR`` wire reduction. The mitigation is
+        best-effort, not a hard bound: the group cap can force-merge
+        dissimilar shapes, and the ring's uniform-block cost itself
+        (every shift ships P blocks sized to that group's largest
+        overlap) always remains — heFFTe's alltoallv ships exact
+        per-pair counts instead (``src/heffte_reshape3d.cpp:375``).
+        This accounting makes the actual factor visible per plan
+        (``plan_info`` prints it per edge); tests pin it <= P for the
+        realistic uneven decompositions."""
         t = self.payload_elems
         return self.wire_elems / t if t else 1.0
 
@@ -209,14 +212,17 @@ def _overlap_steps(
         if math.prod(joint) > _SPLIT_FACTOR * max_vol and len(active) > 1:
             cand = _shape_groups(active)
             if len(cand) > 1:
-                # Only adopt the split when it strictly shrinks the wire.
+                # Adopt the split only for a real wire win (>= the same
+                # factor that triggered it): each extra group costs a
+                # full ppermute step on every device, so near-zero-gain
+                # splits are a net slowdown on latency-bound edges.
                 split_wire = sum(
                     math.prod(tuple(
                         int(max(true_size[i][d] for i in g))
                         for d in range(3)))
                     for g in cand
                 )
-                if split_wire < math.prod(joint):
+                if split_wire * _SPLIT_FACTOR <= math.prod(joint):
                     groups = cand
         for members in groups:
             if len(groups) == 1:

@@ -262,9 +262,7 @@ def test_shape_skew_step_split():
     slabs against x-slabs — would inflate the joint block to the product
     of per-dim maxes; the splitter must (a) ship strictly less than the
     unsplit ring would and (b) keep the reshape exact."""
-    from distributedfft_tpu.parallel.bricks import (
-        _Step, plan_brick_reshape,
-    )
+    from distributedfft_tpu.parallel.bricks import plan_brick_reshape
 
     n = 16
     w = world_box((n, n, n))
