@@ -13,6 +13,9 @@ cd "$(dirname "$0")/.."
 SHORT=${1:-}
 note() { printf '\n=== %s (%s) ===\n' "$1" "$(date +%T)"; }
 
+note "correctness smoke FIRST (real pallas_call, shard_map vma, ragged a2av, dd tier)"
+DFFT_SWEEP_TIMEOUT=1200 python benchmarks/hw_smoke.py
+
 note "flagship bench (512^3 c2c, all executors)"
 DFFT_BENCH_DEADLINE=1500 python bench.py | tee /tmp/hw_bench.json
 

@@ -102,20 +102,30 @@ def dd_to_host(hi, lo) -> np.ndarray:
 
 
 def _two_sum(a, b):
-    """Knuth two-sum: s + err == a + b exactly (f32 IEEE adds)."""
-    s = a + b
+    """Knuth two-sum: s + err == a + b exactly (f32 IEEE adds).
+
+    The sum is wrapped in an optimization barrier: under jit, XLA's
+    algebraic simplifier folds patterns like ``(a + b) - a -> b``, which
+    collapses the error term to zero and silently degrades the whole
+    engine to bf16 accuracy (caught by the jitted smoke run; eager
+    per-op dispatch never exposed it). The barrier makes ``s`` opaque so
+    every downstream difference is computed as written."""
+    s = lax.optimization_barrier(a + b)
     bb = s - a
     err = (a - (s - bb)) + (b - bb)
     return s, err
 
 
-def _dd_accumulate(parts):
-    """Compensated sum of f32 arrays (ordered largest-magnitude first)
-    into a (hi, lo) pair. Error ~2^-48 relative — far inside the tier."""
-    hi = parts[0]
+def _dd_accumulate_thunks(thunks):
+    """Compensated sum of lazily-produced f32 arrays (ordered
+    largest-magnitude first) into a (hi, lo) pair. Thunks keep at most
+    one partial product live at a time outside jit — at campaign sizes
+    the eager alternative (materialize ~68 full-array partials, then
+    sum) peaks at multiple GB. Error ~2^-48 relative."""
+    hi = thunks[0]()
     lo = jnp.zeros_like(hi)
-    for p in parts[1:]:
-        hi, e = _two_sum(hi, p)
+    for t in thunks[1:]:
+        hi, e = _two_sum(hi, t())
         lo = lo + e
     return _two_sum(hi, lo)
 
@@ -134,7 +144,10 @@ def _extract_slices(x: jnp.ndarray, n_slices: int) -> list[jnp.ndarray]:
     for s in range(n_slices):
         grid = 2.0 ** (1 - _B * (s + 1))
         big = jnp.float32(1.5 * (2 ** 23) * grid)
-        top = (r + big) - big
+        # The barrier stops XLA folding (r + big) - big back to r under
+        # jit (see _two_sum) — without it every slice silently becomes
+        # the full value and the scheme degrades to plain bf16.
+        top = lax.optimization_barrier(r + big) - big
         slices.append(top)
         r = r - top
     return slices
@@ -142,9 +155,13 @@ def _extract_slices(x: jnp.ndarray, n_slices: int) -> list[jnp.ndarray]:
 
 def _row_normalize(x: jnp.ndarray):
     """Exact power-of-two row scaling: returns (x * 2^-e, 2^e) with
-    |scaled| < 1 per row (rows = all leading axes; last axis = K)."""
+    |scaled| < 1 per row (rows = all leading axes; last axis = K). The
+    exponent is clamped to +-120 so the scale (and its inverse) stays
+    finite in f32 — rows with max magnitude below 2^-120 sit ~35 orders
+    under the tier and may round to zero rather than overflow to inf."""
     mu = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
     _, e = jnp.frexp(jnp.where(mu == 0, 1.0, mu))
+    e = jnp.clip(e, -120, 120)
     scale = jnp.ldexp(jnp.float32(1.0), -e)
     return x * scale, jnp.ldexp(jnp.float32(1.0), e)
 
@@ -152,13 +169,23 @@ def _row_normalize(x: jnp.ndarray):
 @functools.lru_cache(maxsize=None)
 def _w_slices_np(n: int, forward: bool, normalize: bool):
     """Host-exact slices of the n x n DFT matrix (f64), 7 bits each, as
-    float32 arrays (cast to bf16 at use). ``normalize`` folds the 1/n
-    inverse scale into the matrix (exact to f64, below the tier)."""
+    float32 arrays (cast to bf16 at use).
+
+    ``normalize`` folds only the NON-power-of-two residue of the 1/n
+    inverse scale into the matrix — ``w * 2^floor(log2 n) / n``, entries
+    staying O(1) so the fixed slice grids keep their full occupancy (a
+    plain ``w/n`` at n=512 zeroes the leading slices and pushes real
+    signal past the pair cutoff — measured 2e-11, outside the tier). The
+    remaining exact power of two is returned as ``k`` for the caller to
+    apply with ``ldexp`` (exact), giving a normalized inverse that stays
+    inside 1e-11 at every supported n."""
     sign = -2j if forward else 2j
     jk = np.outer(np.arange(n), np.arange(n))
     w = np.exp(sign * np.pi * (jk % n) / n)
+    k = 0
     if normalize:
-        w = w / n
+        k = int(math.floor(math.log2(n)))
+        w = w * (2.0 ** k / n)
     outs = []
     for part in (w.real, w.imag):
         r = part.copy()
@@ -169,64 +196,76 @@ def _w_slices_np(n: int, forward: bool, normalize: bool):
             sl.append(top.astype(np.float32))
             r = r - top
         outs.append(sl)
-    return tuple(outs[0]), tuple(outs[1])
+    return tuple(outs[0]), tuple(outs[1]), k
 
 
-def _sliced_mm(a_hi, a_lo, w_sl, subtract=False):
-    """Exact-sliced real contraction: partial products of (hi, lo) row
-    slices against the pre-sliced W, every matmul in bf16 with f32
-    accumulation. Returns the partial-product list (largest first),
-    negated when ``subtract`` (for the complex cross terms)."""
-    hi_n, hi_scale = _row_normalize(a_hi)
-    hi_sl = _extract_slices(hi_n, _SLICES_HI)
-    lo_n, lo_scale = _row_normalize(a_lo)
-    lo_sl = _extract_slices(lo_n, _SLICES_LO)
+def _sliced_mm(a_slices, w_sl, subtract=False):
+    """Exact-sliced real contraction: lazy partial products of (hi, lo)
+    row slices against the pre-sliced W, every matmul in bf16 with f32
+    accumulation. ``a_slices`` is the shared slicing of one operand (see
+    :func:`_operand_slices`). Returns (order_key, thunk) pairs, negated
+    when ``subtract`` (for the complex cross terms)."""
+    hi_sl, hi_scale, lo_sl, lo_scale = a_slices
 
-    def bmm(x_bf, w_bf):
+    def bmm(xs, ws):
         return lax.dot_general(
-            x_bf, w_bf, (((x_bf.ndim - 1,), (0,)), ((), ())),
+            xs.astype(jnp.bfloat16), ws.astype(jnp.bfloat16),
+            (((xs.ndim - 1,), (0,)), ((), ())),
             precision=lax.Precision.DEFAULT,
             preferred_element_type=jnp.float32,
         )
 
     sgn = jnp.float32(-1.0 if subtract else 1.0)
-    parts = []  # (order_key, term)
+    parts = []  # (order_key, thunk)
     for i, xs in enumerate(hi_sl):
-        xb = xs.astype(jnp.bfloat16)
         for j, ws in enumerate(w_sl):
             if i + j > _CUT_HI:
                 continue
-            term = bmm(xb, ws.astype(jnp.bfloat16)) * (hi_scale * sgn)
-            parts.append((i + j, term))
+            parts.append((i + j, functools.partial(
+                lambda x, w, s: bmm(x, w) * (s * sgn),
+                xs, ws, hi_scale)))
     for i, xs in enumerate(lo_sl):
-        xb = xs.astype(jnp.bfloat16)
         for j, ws in enumerate(w_sl):
             if i + j > _CUT_LO:
                 continue
-            term = bmm(xb, ws.astype(jnp.bfloat16)) * (lo_scale * sgn)
             # lo sits ~24 bits below hi: order after the hi diagonals.
-            parts.append((i + j + 24 // _B, term))
+            parts.append((i + j + 24 // _B, functools.partial(
+                lambda x, w, s: bmm(x, w) * (s * sgn),
+                xs, ws, lo_scale)))
     return parts
+
+
+def _operand_slices(a_hi, a_lo):
+    """Row-normalize and slice one real operand once (shared between the
+    two contractions that consume it)."""
+    hi_n, hi_scale = _row_normalize(a_hi)
+    lo_n, lo_scale = _row_normalize(a_lo)
+    return (_extract_slices(hi_n, _SLICES_HI), hi_scale,
+            _extract_slices(lo_n, _SLICES_LO), lo_scale)
 
 
 def _dd_dft_last(re_hi, re_lo, im_hi, im_lo, n: int, forward: bool,
                  normalize: bool):
     """dd complex DFT along the last axis via 4 exact-sliced real
-    contractions, recombined with compensated adds."""
-    wr_sl, wi_sl = _w_slices_np(n, forward, normalize)
+    contractions, recombined with compensated adds. Returns the result
+    planes plus the exact power-of-two post-scale exponent (nonzero only
+    on the normalized inverse)."""
+    wr_sl, wi_sl, k = _w_slices_np(n, forward, normalize)
     wr = [jnp.asarray(m) for m in wr_sl]
     wi = [jnp.asarray(m) for m in wi_sl]
+    re_slices = _operand_slices(re_hi, re_lo)
+    im_slices = _operand_slices(im_hi, im_lo)
 
     # Cr = Ar@Wr - Ai@Wi ; Ci = Ar@Wi + Ai@Wr
-    cr_parts = (_sliced_mm(re_hi, re_lo, wr)
-                + _sliced_mm(im_hi, im_lo, wi, subtract=True))
-    ci_parts = (_sliced_mm(re_hi, re_lo, wi)
-                + _sliced_mm(im_hi, im_lo, wr))
+    cr_parts = (_sliced_mm(re_slices, wr)
+                + _sliced_mm(im_slices, wi, subtract=True))
+    ci_parts = (_sliced_mm(re_slices, wi)
+                + _sliced_mm(im_slices, wr))
     cr_parts.sort(key=lambda kv: kv[0])
     ci_parts.sort(key=lambda kv: kv[0])
-    cr_hi, cr_lo = _dd_accumulate([t for _, t in cr_parts])
-    ci_hi, ci_lo = _dd_accumulate([t for _, t in ci_parts])
-    return cr_hi, cr_lo, ci_hi, ci_lo
+    cr_hi, cr_lo = _dd_accumulate_thunks([t for _, t in cr_parts])
+    ci_hi, ci_lo = _dd_accumulate_thunks([t for _, t in ci_parts])
+    return cr_hi, cr_lo, ci_hi, ci_lo, k
 
 
 # ------------------------------------------------------------ public API
@@ -246,11 +285,16 @@ def fft_axis_dd(hi: jnp.ndarray, lo: jnp.ndarray, axis: int,
     if moved:
         hi = jnp.moveaxis(hi, axis, -1)
         lo = jnp.moveaxis(lo, axis, -1)
-    parts = _dd_dft_last(
+    cr_hi, cr_lo, ci_hi, ci_lo, k = _dd_dft_last(
         jnp.real(hi), jnp.real(lo), jnp.imag(hi), jnp.imag(lo),
         n, forward, normalize=not forward,
     )
-    cr_hi, cr_lo, ci_hi, ci_lo = parts
+    if k:
+        # Exact power-of-two remainder of the 1/n inverse scale (the
+        # non-power-of-two residue is folded into W, see _w_slices_np).
+        s = jnp.float32(2.0 ** -k)
+        cr_hi, cr_lo = cr_hi * s, cr_lo * s
+        ci_hi, ci_lo = ci_hi * s, ci_lo * s
     out_hi = lax.complex(cr_hi, ci_hi)
     out_lo = lax.complex(cr_lo, ci_lo)
     if moved:
