@@ -225,7 +225,11 @@ def _worker(shape_n: int) -> None:
     mesh = dfft.make_mesh(n_dev) if n_dev > 1 else None
     dtype = jnp.complex64  # TPU: no C128
 
-    default_execs = "xla" if fast else "xla,pallas,matmul"
+    # Upgrade-phase menu: xla first (a line exists after one compile),
+    # then the fused Pallas path, the HIGH-precision MXU tier (~2x the
+    # matmul rate of HIGHEST; kept only if it passes the roundtrip
+    # gate), and the un-fused matmul engine.
+    default_execs = "xla" if fast else "xla,pallas,pallas:high,matmul"
     candidates = [
         e.strip()
         for e in os.environ.get(

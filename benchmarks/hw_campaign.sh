@@ -37,9 +37,12 @@ if [ "$SHORT" != "--short" ]; then
   DFFT_SWEEP_TIMEOUT=1200 python benchmarks/record_baseline.py \
       --shapes 768x512x384 --sizes
 
-  note "1D batch sweeps (radix 2/3/5, matmul vs pallas vs xla)"
-  DFFT_SWEEP_TIMEOUT=900 timeout 900 python benchmarks/batch_bench.py 1d \
-      -radix 2 -csv benchmarks/csv/batch_tpu_1d.csv || true
+  note "1D batch sweeps (runTest1D_opt.sh parity: radix 2/3/5/7, long-1D to 5^11)"
+  for radix in 2 3 5 7; do
+    DFFT_SWEEP_TIMEOUT=900 timeout 900 python benchmarks/batch_bench.py 1d \
+        -radix $radix -total 48828125 \
+        -csv benchmarks/csv/batch_tpu_1d_r${radix}.csv || true
+  done
 
   note "precision-tier comparison @256^3 (HIGHEST vs HIGH vs DEFAULT)"
   for prec in highest high default; do

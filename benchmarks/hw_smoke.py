@@ -49,7 +49,10 @@ _FAILED: list[str] = []  # steps whose gate failed (drives the exit code)
 def _record(step: str, status: str, value, detail: str = "") -> None:
     import jax
 
-    if status not in ("ok", "skip"):
+    # "rejected" is the pack probe's expected auto-fallback verdict (the
+    # production path handles it gracefully) — informational, not a
+    # failure; only numeric-gate FAILs and raised ERRORs gate the exit.
+    if status in ("FAIL", "ERROR"):
         _FAILED.append(step)
     path = _csv_path()
     fresh = not os.path.exists(path)
@@ -270,6 +273,29 @@ def step_dd_roundtrip(n: int = 256) -> None:
             f"gflops={gflops(shape, sec):.1f}")
 
 
+def step_dd_slab(shape=(32, 24, 16)) -> None:
+    """Distributed dd tier under shard_map on the real backend: the
+    barrier-guarded compensated arithmetic and the exchange collectives
+    through one compiled program."""
+    import jax
+    import numpy as np
+
+    import distributedfft_tpu as dfft
+    from distributedfft_tpu.ops import ddfft
+    from distributedfft_tpu.parallel.ddslab import build_dd_slab_fft3d
+
+    ndev = len(jax.devices())
+    mesh = dfft.make_mesh(min(2, ndev))
+    rng = np.random.default_rng(31)
+    x = rng.standard_normal(shape) + 1j * rng.standard_normal(shape)
+    hi, lo = ddfft.dd_from_host(x)
+    fwd, _ = build_dd_slab_fft3d(mesh, shape, forward=True)
+    yh, yl = fwd(hi, lo)
+    err = ddfft.max_err_vs_f64(yh, yl, np.fft.fftn(x))
+    _record(f"dd_slab_{'x'.join(map(str, shape))}_ndev{mesh.devices.size}",
+            "ok" if err < DD_GATE else "FAIL", err)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -315,6 +341,7 @@ def main() -> int:
         (step_pallas_shardmap, (64,)),
         (step_ragged_a2av, ()),
         (step_dd_fwd, (32 if args.quick else 64,)),
+        (step_dd_slab, ()),
         (step_dd_roundtrip, (64 if args.quick else 256,)),
     ]
     for fn, fargs in steps:

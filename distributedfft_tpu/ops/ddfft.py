@@ -49,6 +49,12 @@ the exact-table discipline of every executor here. Longer axes would need
 a dd four-step (dd twiddle multiply) and are out of scope until a
 hardware campaign justifies them.
 
+Dynamic-range note: two-float storage needs the lo component to be
+representable ~49 bits below hi, so the tier holds for magnitudes in
+roughly [1e-30, 3e38] (f32's exponent range shifted by the significand
+width). Below ~1e-30 the lo underflows and accuracy degrades gracefully
+toward plain f32 — inherent to the representation, not the transform.
+
 Verification: tests/test_ddfft.py holds the slices bf16-exact, checks the
 3D transform against numpy's float64 ``fftn`` at the 1e-11 tier on CPU,
 and the hardware campaign measures the same error on the real chip.
@@ -155,13 +161,15 @@ def _extract_slices(x: jnp.ndarray, n_slices: int) -> list[jnp.ndarray]:
 
 def _row_normalize(x: jnp.ndarray):
     """Exact power-of-two row scaling: returns (x * 2^-e, 2^e) with
-    |scaled| < 1 per row (rows = all leading axes; last axis = K). The
-    exponent is clamped to +-120 so the scale (and its inverse) stays
-    finite in f32 — rows with max magnitude below 2^-120 sit ~35 orders
-    under the tier and may round to zero rather than overflow to inf."""
+    |scaled| < 2 per row (rows = all leading axes; last axis = K). The
+    exponent is clamped to the f32-representable scale range [-126, 127]
+    so neither the scale nor its inverse overflows to inf: at e = 128
+    (row max near f32-max) the scaled row tops out just under 2 — inside
+    :func:`_extract_slices`' domain — and at the bottom, sub-2^-126 rows
+    sit ~20 orders below the tier and may lose occupancy, not blow up."""
     mu = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
     _, e = jnp.frexp(jnp.where(mu == 0, 1.0, mu))
-    e = jnp.clip(e, -120, 120)
+    e = jnp.clip(e, -126, 127)
     scale = jnp.ldexp(jnp.float32(1.0), -e)
     return x * scale, jnp.ldexp(jnp.float32(1.0), e)
 
