@@ -49,11 +49,15 @@ the exact-table discipline of every executor here. Longer axes would need
 a dd four-step (dd twiddle multiply) and are out of scope until a
 hardware campaign justifies them.
 
-Dynamic-range note: two-float storage needs the lo component to be
-representable ~49 bits below hi, so the tier holds for magnitudes in
-roughly [1e-30, 3e38] (f32's exponent range shifted by the significand
-width). Below ~1e-30 the lo underflows and accuracy degrades gracefully
-toward plain f32 — inherent to the representation, not the transform.
+Dynamic-range note: two-float storage needs the lo component to live
+~25-50 bits below hi, and TPU/host float units flush SUBNORMAL inputs
+to zero (DAZ), so lo is only reliable while it stays normal: the tier
+holds for magnitudes in roughly [1e-25, 3e38] (measured: 1e-25 at
+3.8e-14; degradation begins near 1e-28 as per-element lo values cross
+2^-126 and flush). Below that, accuracy degrades gracefully toward
+plain f32 — inherent to the representation on flush-to-zero hardware,
+not to the transform. Rescale data toward O(1) for tiny-magnitude
+worlds (standard practice; an exact power-of-two scale is free).
 
 Verification: tests/test_ddfft.py holds the slices bf16-exact, checks the
 3D transform against numpy's float64 ``fftn`` at the 1e-11 tier on CPU,
@@ -159,19 +163,14 @@ def _extract_slices(x: jnp.ndarray, n_slices: int) -> list[jnp.ndarray]:
     return slices
 
 
-def _row_normalize(x: jnp.ndarray):
-    """Exact power-of-two row scaling: returns (x * 2^-e, 2^e) with
-    |scaled| < 2 per row (rows = all leading axes; last axis = K). The
-    exponent is clamped to the f32-representable scale range [-126, 127]
-    so neither the scale nor its inverse overflows to inf: at e = 128
-    (row max near f32-max) the scaled row tops out just under 2 — inside
-    :func:`_extract_slices`' domain — and at the bottom, sub-2^-126 rows
-    sit ~20 orders below the tier and may lose occupancy, not blow up."""
+def _row_exponent(x: jnp.ndarray) -> jnp.ndarray:
+    """Per-row max exponent e with 2^-e an exact, finite f32 scale:
+    clamped to [-126, 127] so neither 2^-e nor 2^e overflows (at e = 128,
+    row max near f32-max, the scaled row tops out just under 2 — inside
+    :func:`_extract_slices`' domain)."""
     mu = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
     _, e = jnp.frexp(jnp.where(mu == 0, 1.0, mu))
-    e = jnp.clip(e, -126, 127)
-    scale = jnp.ldexp(jnp.float32(1.0), -e)
-    return x * scale, jnp.ldexp(jnp.float32(1.0), e)
+    return jnp.clip(e, -126, 127)
 
 
 @functools.lru_cache(maxsize=None)
@@ -207,13 +206,22 @@ def _w_slices_np(n: int, forward: bool, normalize: bool):
     return tuple(outs[0]), tuple(outs[1]), k
 
 
-def _sliced_mm(a_slices, w_sl, subtract=False):
+def _sliced_mm(a_slices, w_sl, common_e, subtract=False):
     """Exact-sliced real contraction: lazy partial products of (hi, lo)
     row slices against the pre-sliced W, every matmul in bf16 with f32
     accumulation. ``a_slices`` is the shared slicing of one operand (see
     :func:`_operand_slices`). Returns (order_key, thunk) pairs, negated
-    when ``subtract`` (for the complex cross terms)."""
-    hi_sl, hi_scale, lo_sl, lo_scale = a_slices
+    when ``subtract`` (for the complex cross terms).
+
+    Partials stay in the NORMALIZED domain: each term carries only the
+    exact power-of-two factor 2^(e_operand - common_e) <= 1 relative to
+    the contraction's common row exponent, and the caller applies
+    2^common_e once after accumulation. Scaling each term by its full
+    2^e instead underflows the far diagonals for small-magnitude rows
+    (measured: 7e-9 error at |x| ~ 1e-30, where terms near
+    2^-100 * 2^-49 flush to zero) — relative factors keep every term
+    that matters above the f32 floor."""
+    hi_sl, e_hi, lo_sl, e_lo = a_slices
 
     def bmm(xs, ws):
         return lax.dot_general(
@@ -224,56 +232,65 @@ def _sliced_mm(a_slices, w_sl, subtract=False):
         )
 
     sgn = jnp.float32(-1.0 if subtract else 1.0)
+    f_hi = jnp.ldexp(sgn, e_hi - common_e)
+    f_lo = jnp.ldexp(sgn, e_lo - common_e)
     parts = []  # (order_key, thunk)
     for i, xs in enumerate(hi_sl):
         for j, ws in enumerate(w_sl):
             if i + j > _CUT_HI:
                 continue
             parts.append((i + j, functools.partial(
-                lambda x, w, s: bmm(x, w) * (s * sgn),
-                xs, ws, hi_scale)))
+                lambda x, w, f: bmm(x, w) * f, xs, ws, f_hi)))
     for i, xs in enumerate(lo_sl):
         for j, ws in enumerate(w_sl):
             if i + j > _CUT_LO:
                 continue
             # lo sits ~24 bits below hi: order after the hi diagonals.
             parts.append((i + j + 24 // _B, functools.partial(
-                lambda x, w, s: bmm(x, w) * (s * sgn),
-                xs, ws, lo_scale)))
+                lambda x, w, f: bmm(x, w) * f, xs, ws, f_lo)))
     return parts
 
 
 def _operand_slices(a_hi, a_lo):
     """Row-normalize and slice one real operand once (shared between the
-    two contractions that consume it)."""
-    hi_n, hi_scale = _row_normalize(a_hi)
-    lo_n, lo_scale = _row_normalize(a_lo)
-    return (_extract_slices(hi_n, _SLICES_HI), hi_scale,
-            _extract_slices(lo_n, _SLICES_LO), lo_scale)
+    two contractions that consume it). Returns the slices plus the row
+    exponents (the scales are reapplied once, post-accumulation)."""
+    e_hi = _row_exponent(a_hi)
+    e_lo = _row_exponent(a_lo)
+    hi_n = a_hi * jnp.ldexp(jnp.float32(1.0), -e_hi)
+    lo_n = a_lo * jnp.ldexp(jnp.float32(1.0), -e_lo)
+    return (_extract_slices(hi_n, _SLICES_HI), e_hi,
+            _extract_slices(lo_n, _SLICES_LO), e_lo)
 
 
 def _dd_dft_last(re_hi, re_lo, im_hi, im_lo, n: int, forward: bool,
                  normalize: bool):
     """dd complex DFT along the last axis via 4 exact-sliced real
-    contractions, recombined with compensated adds. Returns the result
-    planes plus the exact power-of-two post-scale exponent (nonzero only
-    on the normalized inverse)."""
+    contractions, recombined with compensated adds in the normalized
+    domain, row scales (and the inverse's exact power-of-two remainder)
+    applied once at the end."""
     wr_sl, wi_sl, k = _w_slices_np(n, forward, normalize)
     wr = [jnp.asarray(m) for m in wr_sl]
     wi = [jnp.asarray(m) for m in wi_sl]
     re_slices = _operand_slices(re_hi, re_lo)
     im_slices = _operand_slices(im_hi, im_lo)
+    # One common row exponent for everything feeding an output (re and
+    # im operands both feed Cr and Ci): relative factors stay <= 1, and
+    # the full scale is applied exactly once after accumulation —
+    # combined with the inverse's power-of-two remainder k.
+    common_e = jnp.maximum(re_slices[1], im_slices[1])
 
     # Cr = Ar@Wr - Ai@Wi ; Ci = Ar@Wi + Ai@Wr
-    cr_parts = (_sliced_mm(re_slices, wr)
-                + _sliced_mm(im_slices, wi, subtract=True))
-    ci_parts = (_sliced_mm(re_slices, wi)
-                + _sliced_mm(im_slices, wr))
+    cr_parts = (_sliced_mm(re_slices, wr, common_e)
+                + _sliced_mm(im_slices, wi, common_e, subtract=True))
+    ci_parts = (_sliced_mm(re_slices, wi, common_e)
+                + _sliced_mm(im_slices, wr, common_e))
     cr_parts.sort(key=lambda kv: kv[0])
     ci_parts.sort(key=lambda kv: kv[0])
     cr_hi, cr_lo = _dd_accumulate_thunks([t for _, t in cr_parts])
     ci_hi, ci_lo = _dd_accumulate_thunks([t for _, t in ci_parts])
-    return cr_hi, cr_lo, ci_hi, ci_lo, k
+    back = jnp.ldexp(jnp.float32(1.0), common_e - k)
+    return (cr_hi * back, cr_lo * back, ci_hi * back, ci_lo * back)
 
 
 # ------------------------------------------------------------ public API
@@ -293,16 +310,10 @@ def fft_axis_dd(hi: jnp.ndarray, lo: jnp.ndarray, axis: int,
     if moved:
         hi = jnp.moveaxis(hi, axis, -1)
         lo = jnp.moveaxis(lo, axis, -1)
-    cr_hi, cr_lo, ci_hi, ci_lo, k = _dd_dft_last(
+    cr_hi, cr_lo, ci_hi, ci_lo = _dd_dft_last(
         jnp.real(hi), jnp.real(lo), jnp.imag(hi), jnp.imag(lo),
         n, forward, normalize=not forward,
     )
-    if k:
-        # Exact power-of-two remainder of the 1/n inverse scale (the
-        # non-power-of-two residue is folded into W, see _w_slices_np).
-        s = jnp.float32(2.0 ** -k)
-        cr_hi, cr_lo = cr_hi * s, cr_lo * s
-        ci_hi, ci_lo = ci_hi * s, ci_lo * s
     out_hi = lax.complex(cr_hi, ci_hi)
     out_lo = lax.complex(cr_lo, ci_lo)
     if moved:

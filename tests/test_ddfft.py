@@ -38,7 +38,8 @@ def test_slices_bf16_exact_and_reconstruct():
     reconstruct the value to the dropped-residual level."""
     rng = np.random.default_rng(3)
     x = jnp.asarray(rng.standard_normal((8, 64)).astype(np.float32))
-    xn, scale = ddfft._row_normalize(x)
+    e = ddfft._row_exponent(x)
+    xn = x * jnp.ldexp(jnp.float32(1.0), -e)
     slices = ddfft._extract_slices(xn, ddfft._SLICES_HI)
     recon = np.zeros((8, 64), np.float64)
     for s in slices:
@@ -163,15 +164,16 @@ def test_dd_slab_uneven_extent():
     assert ddfft.max_err_vs_f64(yh, yl, np.fft.fftn(x)) < 1e-12
 
 
-@pytest.mark.parametrize("scale", [1e37, 1e-30])
+@pytest.mark.parametrize("scale", [1e37, 1e-25])
 def test_dd_extreme_magnitudes_hold_tier(scale):
     """Rows near the f32 exponent limits must stay inside the tier: the
     row-normalization clamp has to keep |scaled| within the extraction
     domain (an overeager clamp at +-120 broke the bf16-exact invariant
     for ~1e37 data — 1.6e-3 measured — with no error raised). The low
-    end stops at ~1e-30: below that the lo component itself underflows
-    f32's exponent range (hi exponent - ~49 bits < 2^-149), an inherent
-    limit of two-float storage, documented in ddfft."""
+    end stops at ~1e-25: below that per-element lo values cross into
+    f32 subnormal range and flush-to-zero float units (TPU, most hosts)
+    zero them on the first multiply — an inherent limit of two-float
+    storage on DAZ hardware, documented in ddfft."""
     x = _rand_c128((2, 32), seed=41) * scale
     hi, lo = ddfft.dd_from_host(x)
     yh, yl = ddfft.fft_axis_dd(hi, lo, axis=-1)
