@@ -43,11 +43,13 @@ Slicing scheme (per row, after exact power-of-two row normalization):
   lo-slice i x W-slice j for i+j <= 2 (6 matmuls). A complex x complex
   contraction is 4 real contractions.
 
-Scope: dense-matrix DFT for axis lengths n <= ``DD_DENSE_MAX`` (=512) —
-covering the BASELINE.json accuracy configs (256^3; 512^3 per-axis) with
-the exact-table discipline of every executor here. Longer axes would need
-a dd four-step (dd twiddle multiply) and are out of scope until a
-hardware campaign justifies them.
+Scope: dense-matrix DFT for axis lengths n <= ``DD_DENSE_MAX`` (=512),
+extended by a dd four-step (two dense stages with an exact-dd twiddle,
+:func:`_dd_cmul` built on barrier-guarded Dekker two-products) to every
+length with a factor pair whose BOTH factors are <= 512 — all smooth
+lengths through 512^2 = 262,144, covering the BASELINE.json accuracy
+configs including 1024^3 and 2048^3 axes. Lengths with a prime factor
+above 512 are out of dd scope (a dd Bluestein would be needed).
 
 Dynamic-range note: two-float storage needs the lo component to live
 ~25-50 bits below hi, and TPU/host float units flush SUBNORMAL inputs
@@ -124,6 +126,56 @@ def _two_sum(a, b):
     bb = s - a
     err = (a - (s - bb)) + (b - bb)
     return s, err
+
+
+def _split(a):
+    """Dekker split of f32 into 12+12 significand-bit halves whose
+    pairwise products are exact. The scaled value is barrier-wrapped for
+    the same reason as :func:`_two_sum`."""
+    c = lax.optimization_barrier(jnp.float32(4097.0) * a)  # 2^12 + 1
+    big = c - (c - a)
+    return big, a - big
+
+
+def _two_prod(a, b):
+    """Dekker two-product: p + err == a * b exactly (no FMA needed)."""
+    p = a * b
+    ah, al = _split(a)
+    bh, bl = _split(b)
+    err = ((lax.optimization_barrier(ah * bh) - p) + ah * bl + al * bh) \
+        + al * bl
+    return p, err
+
+
+def _dd_mul(ah, al, bh, bl):
+    """Real dd x dd multiply: (ah+al)(bh+bl) to ~2^-48 relative."""
+    p, e = _two_prod(ah, bh)
+    e = e + (ah * bl + al * bh)
+    return _two_sum(p, e)
+
+
+def _dd_add(ah, al, bh, bl):
+    """Real dd + dd add (Knuth-compensated)."""
+    s, e = _two_sum(ah, bh)
+    return _two_sum(s, e + al + bl)
+
+
+def _dd_cmul(xh, xl, th, tl):
+    """Complex dd multiply by a complex dd constant: four real dd
+    products recombined with compensated adds (the dd twiddle apply of
+    the four-step; cf. the reference's inter-pass twiddle LUTs,
+    ``templateFFT.cpp:5144-5153``)."""
+    ar, ai = jnp.real(xh), jnp.imag(xh)
+    br, bi = jnp.real(xl), jnp.imag(xl)
+    cr, ci = jnp.real(th), jnp.imag(th)
+    dr, di = jnp.real(tl), jnp.imag(tl)
+    rr_h, rr_l = _dd_mul(ar, br, cr, dr)   # Re*Re
+    ii_h, ii_l = _dd_mul(ai, bi, ci, di)   # Im*Im
+    ri_h, ri_l = _dd_mul(ar, br, ci, di)   # Re*Im
+    ir_h, ir_l = _dd_mul(ai, bi, cr, dr)   # Im*Re
+    re_h, re_l = _dd_add(rr_h, rr_l, -ii_h, -ii_l)
+    im_h, im_l = _dd_add(ri_h, ri_l, ir_h, ir_l)
+    return lax.complex(re_h, im_h), lax.complex(re_l, im_l)
 
 
 def _dd_accumulate_thunks(thunks):
@@ -293,29 +345,98 @@ def _dd_dft_last(re_hi, re_lo, im_hi, im_lo, n: int, forward: bool,
     return (cr_hi * back, cr_lo * back, ci_hi * back, ci_lo * back)
 
 
+# ----------------------------------------------------- four-step (n > 512)
+
+def _dd_split(n: int) -> tuple[int, int] | None:
+    """Balanced factor pair with both factors dense-coverable — the same
+    native-scheduler split decision every other engine here uses
+    (``dfft_balanced_split``)."""
+    from .. import native
+
+    return native.balanced_split(n, DD_DENSE_MAX)
+
+
+@functools.lru_cache(maxsize=None)
+def _dd_twiddle_np(n: int, n1: int, n2: int, forward: bool):
+    """Inter-stage twiddle table (``dft_matmul._twiddle_np`` — one
+    twiddle convention in the repo) as an exact host-split dd pair
+    (complex64 hi + lo), shaped [n1, n2]."""
+    from .dft_matmul import _twiddle_np
+
+    t = _twiddle_np(n, n1, n2, forward)
+    th = t.astype(np.complex64)
+    tl = (t - th.astype(np.complex128)).astype(np.complex64)
+    return th, tl
+
+
+def _dd_four_step_last(hi, lo, n: int, forward: bool):
+    """dd DFT of the last axis via the four-step split n = n1*n2: two
+    dense dd stages with an exact-dd twiddle between them (the same
+    recursion as ``dft_matmul._fft_last``, at the dd tier). The inverse
+    normalization composes from the stages' own 1/n1 and 1/n2.
+
+    The twiddle path's Dekker splits compute ``4097 * a``, which
+    overflows f32 above ~8e34 — and the unnormalized stage-1 output
+    grows to n1 x the input. The DFT is linear, so the whole pass runs
+    on an exactly 2^-e down-scaled copy (global exponent of the stage-1
+    output) and the scale is restored once at the end."""
+    n1, n2 = _dd_split(n)
+    shp = hi.shape
+    hi = hi.reshape(shp[:-1] + (n1, n2))
+    lo = lo.reshape(shp[:-1] + (n1, n2))
+    # DFT_n1 over j1 (axis -2) -> [..., k1, j2].
+    hi, lo = fft_axis_dd(hi, lo, axis=-2, forward=forward)
+    # Exact global down-scale so the Dekker splits inside _dd_cmul stay
+    # far from the f32 ceiling (restored after stage 2 — linearity).
+    mu = jnp.max(jnp.abs(jnp.real(hi))) + jnp.max(jnp.abs(jnp.imag(hi)))
+    _, e = jnp.frexp(jnp.where(mu == 0, 1.0, mu))
+    e = jnp.clip(e, -126, 127)
+    down = jnp.ldexp(jnp.float32(1.0), -e)
+    hi, lo = hi * down, lo * down
+    th, tl = _dd_twiddle_np(n, n1, n2, forward)
+    hi, lo = _dd_cmul(hi, lo, jnp.asarray(th), jnp.asarray(tl))
+    # DFT_n2 over j2 (last axis) -> [..., k1, k2].
+    hi, lo = fft_axis_dd(hi, lo, axis=-1, forward=forward)
+    up = jnp.ldexp(jnp.float32(1.0), e)
+    hi, lo = hi * up, lo * up
+    # Output flat index k = k2*n1 + k1.
+    hi = jnp.swapaxes(hi, -1, -2).reshape(shp)
+    lo = jnp.swapaxes(lo, -1, -2).reshape(shp)
+    return hi, lo
+
+
 # ------------------------------------------------------------ public API
 
 def fft_axis_dd(hi: jnp.ndarray, lo: jnp.ndarray, axis: int,
                 forward: bool = True) -> tuple[jnp.ndarray, jnp.ndarray]:
     """dd complex DFT along ``axis`` of a (hi, lo) complex64 pair.
-    Forward unnormalized; inverse folds the exact 1/n into the matrix
-    (numpy convention, like every executor in this framework)."""
+    Forward unnormalized; inverse applies the exact 1/n (numpy
+    convention, like every executor in this framework). Lengths above
+    ``DD_DENSE_MAX`` take the dd four-step — covered when n has a factor
+    pair with BOTH factors <= 512 (all smooth lengths through
+    512^2 = 262,144); lengths with a prime factor above 512 are out of
+    dd scope (a dd Bluestein would be needed)."""
     n = hi.shape[axis]
-    if n > DD_DENSE_MAX:
+    four_step = n > DD_DENSE_MAX
+    if four_step and _dd_split(n) is None:
         raise ValueError(
-            f"dd executor covers axis lengths <= {DD_DENSE_MAX}; got {n} "
-            "(a dd four-step split is not implemented)"
+            f"dd executor: no n1*n2 split of {n} with both factors "
+            f"<= {DD_DENSE_MAX} (prime factors above 512 are out of "
+            "dd scope)"
         )
     moved = axis not in (-1, hi.ndim - 1)
     if moved:
         hi = jnp.moveaxis(hi, axis, -1)
         lo = jnp.moveaxis(lo, axis, -1)
-    cr_hi, cr_lo, ci_hi, ci_lo = _dd_dft_last(
-        jnp.real(hi), jnp.real(lo), jnp.imag(hi), jnp.imag(lo),
-        n, forward, normalize=not forward,
-    )
-    out_hi = lax.complex(cr_hi, ci_hi)
-    out_lo = lax.complex(cr_lo, ci_lo)
+    if four_step:
+        out_hi, out_lo = _dd_four_step_last(hi, lo, n, forward)
+    else:
+        cr_hi, cr_lo, ci_hi, ci_lo = _dd_dft_last(
+            jnp.real(hi), jnp.real(lo), jnp.imag(hi), jnp.imag(lo),
+            n, forward, normalize=not forward,
+        )
+        out_hi = lax.complex(cr_hi, ci_hi)
+        out_lo = lax.complex(cr_lo, ci_lo)
     if moved:
         out_hi = jnp.moveaxis(out_hi, -1, axis)
         out_lo = jnp.moveaxis(out_lo, -1, axis)

@@ -12,7 +12,8 @@ the same ``all_to_all`` collectives.
 
 Shapes follow the c64 pipeline's ceil-pad/crop discipline (zero rows are
 exact in dd arithmetic, so padding cannot perturb the tier). Axis extents
-are bounded by the dd engine's dense coverage (``ddfft.DD_DENSE_MAX``).
+follow the dd engine's coverage: dense through ``ddfft.DD_DENSE_MAX``,
+four-step beyond it for lengths whose factor pairs fit (1024, 2048, ...).
 """
 
 from __future__ import annotations
@@ -52,10 +53,10 @@ def build_dd_slab_fft3d(
     """
     shape = tuple(int(s) for s in shape)
     for n in shape:
-        if n > ddfft.DD_DENSE_MAX:
+        if n > ddfft.DD_DENSE_MAX and ddfft._dd_split(n) is None:
             raise ValueError(
-                f"dd slab covers axis lengths <= {ddfft.DD_DENSE_MAX}; "
-                f"got {shape}"
+                f"dd slab: axis length {n} has no dense-coverable "
+                f"four-step split (shape {shape})"
             )
     p = mesh.shape[axis_name]
     in_axis, out_axis = (0, 1) if forward else (1, 0)

@@ -181,7 +181,37 @@ def test_dd_extreme_magnitudes_hold_tier(scale):
     assert ddfft.max_err_vs_f64(yh, yl, want) < 1e-12
 
 
-def test_dd_axis_too_long_rejected():
-    hi = jnp.zeros((2, 1024), jnp.complex64)
-    with pytest.raises(ValueError, match="dd executor covers"):
+def test_dd_four_step_long_axes():
+    """Lengths past DD_DENSE_MAX via the dd four-step (two dense stages
+    + exact-dd twiddle): 1024 = 32*32 and non-power-of-two 600 = 24*25,
+    still at the tier — the BASELINE.json 1024^3 double config's axis."""
+    for n in (1024, 600):
+        x = _rand_c128((2, n), seed=n)
+        hi, lo = ddfft.dd_from_host(x)
+        yh, yl = ddfft.fft_axis_dd(hi, lo, axis=-1)
+        err = ddfft.max_err_vs_f64(yh, yl, np.fft.fft(x, axis=-1))
+        assert err < 1e-12, (n, err)
+        bh, bl = ddfft.fft_axis_dd(yh, yl, axis=-1, forward=False)
+        back = ddfft.dd_to_host(bh, bl)
+        rerr = np.max(np.abs(back - x)) / np.max(np.abs(x))
+        assert rerr < 1e-11, (n, rerr)
+
+
+def test_dd_four_step_large_magnitude():
+    """The four-step's Dekker splits compute 4097*a, which overflows f32
+    above ~8e34 — and stage-1 output grows to n1 x input. The exact
+    down-scale guard must keep ~1e35 data inside the tier instead of
+    returning silent NaNs."""
+    n = 1024
+    x = _rand_c128((2, n), seed=43) * 1e35
+    hi, lo = ddfft.dd_from_host(x)
+    yh, yl = ddfft.fft_axis_dd(hi, lo, axis=-1)
+    assert np.all(np.isfinite(np.asarray(yh)))
+    err = ddfft.max_err_vs_f64(yh, yl, np.fft.fft(x, axis=-1))
+    assert err < 1e-12, err
+
+
+def test_dd_large_prime_rejected():
+    hi = jnp.zeros((2, 1031), jnp.complex64)  # prime > DD_DENSE_MAX
+    with pytest.raises(ValueError, match="no n1\\*n2 split"):
         ddfft.fft_axis_dd(hi, hi, axis=-1)
