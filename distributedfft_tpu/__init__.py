@@ -16,6 +16,7 @@ Quick start::
 
 from .api import (  # noqa: F401
     BACKWARD,
+    DDPlan3D,
     FORWARD,
     Plan3D,
     alloc_local,
@@ -24,10 +25,12 @@ from .api import (  # noqa: F401
     plan_brick_dft_c2c_3d,
     plan_brick_dft_c2r_3d,
     plan_brick_dft_r2c_3d,
+    plan_dd_dft_c2c_3d,
     plan_dft_c2c_3d,
     plan_dft_c2r_3d,
     plan_dft_r2c_3d,
 )
+from .ops.ddfft import dd_from_host, dd_to_host  # noqa: F401
 from .geometry import Box3, world_box  # noqa: F401
 from .local import (  # noqa: F401
     LocalPlan,
