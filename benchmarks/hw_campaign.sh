@@ -44,6 +44,13 @@ if [ "$SHORT" != "--short" ]; then
         -csv benchmarks/csv/batch_tpu_1d_r${radix}.csv || true
   done
 
+  note "dd (emulated double) tier rows @256^3 and 512^3"
+  for n in 256 512; do
+    DFFT_SWEEP_TIMEOUT=900 timeout 900 python benchmarks/speed3d.py \
+        c2c dd $n $n $n -iters 3 \
+        -csv benchmarks/csv/dd_tier_tpu.csv || true
+  done
+
   note "precision-tier comparison @256^3 (HIGHEST vs HIGH vs DEFAULT)"
   for prec in highest high default; do
     DFFT_MM_PRECISION=$prec DFFT_SWEEP_TIMEOUT=900 \

@@ -53,6 +53,27 @@ def test_speed3d_staged_r2c(capsys):
     assert "t0_r2c_zy" in out and "t2_exchange" in out and "t3_fft_x" in out
 
 
+def test_speed3d_dd_tier(capsys, tmp_path):
+    """The dd precision tier through the speed3d CLI: slab mesh, result
+    block with a double-tier roundtrip error, CSV row."""
+    csv = str(tmp_path / "dd.csv")
+    speed3d.main(["c2c", "dd", "16", "16", "16",
+                  "-ndev", "4", "-iters", "1", "-csv", csv])
+    out = capsys.readouterr().out
+    assert "precision: dd" in out and "decomposition: slab" in out
+    assert "max error:" in out
+    err = float(out.split("max error:")[1].split()[0])
+    assert err < 1e-11
+    rows = open(csv).read().splitlines()
+    assert rows[1].startswith("c2c,dd,16")
+
+
+def test_speed3d_dd_rejects_r2c():
+    with pytest.raises(SystemExit, match="c2c only"):
+        speed3d.main(["r2c", "dd", "16", "16", "16", "-ndev", "4",
+                      "-iters", "1"])
+
+
 def test_speed3d_a2av(capsys):
     speed3d.main(["c2c", "double", "10", "9", "7",
                   "-ndev", "8", "-slabs", "-a2av", "-iters", "1"])
