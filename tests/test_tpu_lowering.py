@@ -1,0 +1,55 @@
+"""TPU-platform lowering smoke for the Pallas kernels — no chip needed.
+
+``jax.export`` runs the full TPU lowering pipeline on any host, including
+building and serializing the Mosaic MLIR module for every ``pallas_call``
+— so Mosaic front-end rejections (unsupported ops, the packed kernels'
+lane-changing reshapes, bad block shapes) surface here, in CI, instead of
+on first hardware contact. This cannot prove the later Mosaic-to-target
+compile succeeds (register/VMEM pressure is target-stage; the per-config
+compile probe and hw_smoke own that on real backends), but it pins the
+front half that killed interpret-mode-only coverage in earlier rounds.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import export
+
+from distributedfft_tpu.ops import pallas_fft
+
+
+def _export_ok(fn, *args):
+    export.export(jax.jit(fn), platforms=["tpu"])(*args)
+
+
+@pytest.mark.parametrize("n", [256, 512, 1024])
+def test_fused_1d_lowers_for_tpu(n, monkeypatch):
+    monkeypatch.setenv("DFFT_PALLAS_PACK", "1")  # force packed kernels
+    z = jnp.zeros((2048, n), jnp.float32)
+    _export_ok(
+        lambda a, b: pallas_fft._fft_tiles(
+            a, b, n=n, forward=True, interpret=False), z, z)
+
+
+def test_fused_2d_plane_lowers_for_tpu(monkeypatch):
+    monkeypatch.setenv("DFFT_PALLAS_PACK", "1")
+    z = jnp.zeros((2, 512, 512), jnp.float32)
+    _export_ok(
+        lambda a, b: pallas_fft._fft2_tiles(
+            a, b, ny=512, nz=512, forward=True, interpret=False), z, z)
+
+
+def test_strided_lowers_for_tpu(monkeypatch):
+    monkeypatch.setenv("DFFT_PALLAS_PACK", "1")
+    z = jnp.zeros((512, 2048), jnp.float32)
+    _export_ok(
+        lambda a, b: pallas_fft._fft_strided_tiles(
+            a, b, n=512, forward=True, interpret=False), z, z)
+
+
+def test_unpacked_fallback_lowers_for_tpu(monkeypatch):
+    monkeypatch.setenv("DFFT_PALLAS_PACK", "0")  # the auto-fallback shape
+    z = jnp.zeros((2048, 512), jnp.float32)
+    _export_ok(
+        lambda a, b: pallas_fft._fft_tiles(
+            a, b, n=512, forward=False, interpret=False), z, z)
