@@ -125,6 +125,22 @@ def _tables_np(n: int, forward: bool, g1: int = 1, g2: int = 1):
     return f32(w1), f32(t), f32(w2)
 
 
+def _interpret_mode() -> bool:
+    """True on the CPU test backend (kernels run in the Pallas
+    interpreter; shard_map calls route to the jnp mirrors).
+    ``DFFT_FORCE_REAL_LOWERING=1`` (``utils.compat.force_real_lowering``,
+    shared with the exchange mirrors) forces the REAL pallas_call path
+    regardless of backend — not executable on CPU, but it lets
+    ``jax.export``-based lowering tests build the actual Mosaic module
+    (including the shard_map/vma path) on a chipless host
+    (tests/test_tpu_lowering.py)."""
+    from ..utils.compat import force_real_lowering
+
+    if force_real_lowering():
+        return False
+    return jax.default_backend() == "cpu"
+
+
 def _vma(x) -> frozenset:
     """Varying-across-mesh-axes set of a traced value (empty outside
     shard_map); pallas_call outputs must declare the same set."""
@@ -548,7 +564,7 @@ def fft_axis0(x: jnp.ndarray, forward: bool = True,
     pad = (-cols) % ct
     if pad:
         x2 = jnp.pad(x2, ((0, 0), (0, pad)))
-    interpret = jax.default_backend() == "cpu"
+    interpret = _interpret_mode()
     if interpret and _vma(x2):
         y = _four_step_ref(x2.T, n, forward).T
     else:
@@ -575,7 +591,7 @@ def fft2_last(x: jnp.ndarray, forward: bool = True) -> jnp.ndarray:
     pad = (-batch) % bt
     if pad:
         x2 = jnp.pad(x2, ((0, pad), (0, 0), (0, 0)))
-    interpret = jax.default_backend() == "cpu"
+    interpret = _interpret_mode()
     if interpret and _vma(x2):
         # CPU test backend under shard_map: the interpreter's grid loop
         # cannot carry varying-axes types — per-axis jnp mirror, numerics
@@ -622,7 +638,7 @@ def _fft_last_big(x2: jnp.ndarray, n: int, forward: bool) -> jnp.ndarray:
     # DFT over j1 via the vmapped strided kernel — in-VMEM reorders, no
     # HBM swapaxes round trip (the mirror path under shard_map on CPU
     # takes the explicit transposes instead).
-    if jax.default_backend() == "cpu" and _vma(a):
+    if _interpret_mode() and _vma(a):
         b = jnp.swapaxes(a, -1, -2).reshape(batch * m2, m1)
         b = _fft_eligible(b, m1, forward)
         b = jnp.swapaxes(b.reshape(batch, m2, m1), -1, -2)  # [batch, k1, j2]
@@ -650,7 +666,7 @@ def _fft_eligible(x2: jnp.ndarray, n: int, forward: bool) -> jnp.ndarray:
     pad = (-batch) % bt
     if pad:
         x2 = jnp.pad(x2, ((0, pad), (0, 0)))
-    interpret = jax.default_backend() == "cpu"
+    interpret = _interpret_mode()
     if interpret and _vma(x2):
         y = _four_step_ref(x2, n, forward)
     else:

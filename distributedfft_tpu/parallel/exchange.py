@@ -149,16 +149,19 @@ def ragged_all_to_all_exchange(
     """
     import jax
 
+    from ..utils.compat import force_real_lowering
+
     S = x.shape[split_axis]
     c = -(-S // p)
     if platform is None:
         platform = jax.default_backend()
-    if platform == "cpu":
+    if platform == "cpu" and not force_real_lowering():
         # XLA:CPU has no ragged-all-to-all lowering; the ceil-padded dense
         # exchange produces the bit-identical result (the padding positions
         # the ragged path never writes stay zero either way), so the CPU
         # test backend mirrors through it — the same discipline as the
-        # Pallas kernel's interpreter-mode mirror.
+        # Pallas kernel's interpreter-mode mirror (and the same
+        # force_real_lowering override for chipless lowering tests).
         x = _pad_axis(x, split_axis, p * c)
         return lax.all_to_all(x, axis_name, split_axis=split_axis,
                               concat_axis=concat_axis, tiled=True)

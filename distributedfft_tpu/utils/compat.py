@@ -16,3 +16,17 @@ def pvary(x, axes: tuple):
     if hasattr(lax, "pcast"):
         return lax.pcast(x, tuple(axes), to="varying")
     return lax.pvary(x, tuple(axes))  # pragma: no cover — jax < 0.9
+
+
+def force_real_lowering() -> bool:
+    """True when DFFT_FORCE_REAL_LOWERING=1: trace the REAL target paths
+    (Pallas kernels instead of interpret/jnp mirrors, ragged collectives
+    instead of the dense CPU stand-in) regardless of the host backend.
+    The resulting program cannot *execute* on CPU — the switch exists so
+    ``jax.export``-based lowering tests can build the actual TPU modules
+    (Mosaic kernels, ragged all-to-all) on a chipless host
+    (tests/test_tpu_lowering.py). One switch for every mirror site, so a
+    lowering test can never silently embed a mirror."""
+    import os
+
+    return os.environ.get("DFFT_FORCE_REAL_LOWERING") == "1"
