@@ -795,7 +795,7 @@ class DDPlan3D:
 
     shape: tuple[int, int, int]
     direction: int
-    decomposition: str            # "single" | "slab"
+    decomposition: str            # "single" | "slab" | "pencil"
     mesh: Mesh | None
     fn: Callable
     in_sharding: NamedSharding | None
@@ -817,11 +817,12 @@ def plan_dd_dft_c2c_3d(
 ) -> DDPlan3D:
     """Create a 3D C2C FFT plan at the emulated double-precision tier.
 
-    Single device (``mesh=None``) runs the dd engine whole-cube; a mesh
-    runs the dd slab pipeline (t0..t3 with both dd components through the
-    same collectives, :mod:`..parallel.ddslab`). The accuracy analog of
-    the reference's f64 ``fft_mpi_plan_dft_c2c_3d`` on hardware without
-    f64 (measured ~1e-13 forward / <1e-11 roundtrip)."""
+    Single device (``mesh=None``) runs the dd engine whole-cube; a 1D
+    mesh runs the dd slab pipeline, a 2D mesh the dd pencil pipeline
+    (both dd components through the same collectives,
+    :mod:`..parallel.ddslab`). The accuracy analog of the reference's
+    f64 ``fft_mpi_plan_dft_c2c_3d`` on hardware without f64 (measured
+    ~1e-13 forward / <1e-11 roundtrip)."""
     from .ops import ddfft
 
     shape, forward = _check_direction(shape, direction)
@@ -836,18 +837,30 @@ def plan_dd_dft_c2c_3d(
         from .parallel.mesh import make_mesh
 
         mesh = make_mesh(mesh)
-    if len(mesh.axis_names) != 1:
-        raise ValueError("dd plans support single-device or 1D slab meshes")
-    from .parallel.ddslab import build_dd_slab_fft3d
+    if len(mesh.axis_names) == 1:
+        from .parallel.ddslab import build_dd_slab_fft3d
 
-    fn, spec = build_dd_slab_fft3d(mesh, shape, forward=forward,
-                                   axis_name=mesh.axis_names[0])
-    return DDPlan3D(
-        shape=shape, direction=direction, decomposition="slab", mesh=mesh,
-        fn=fn,
-        in_sharding=NamedSharding(mesh, spec.in_pspec),
-        out_sharding=NamedSharding(mesh, spec.out_pspec),
-    )
+        fn, spec = build_dd_slab_fft3d(mesh, shape, forward=forward,
+                                       axis_name=mesh.axis_names[0])
+        return DDPlan3D(
+            shape=shape, direction=direction, decomposition="slab",
+            mesh=mesh, fn=fn,
+            in_sharding=NamedSharding(mesh, spec.in_pspec),
+            out_sharding=NamedSharding(mesh, spec.out_pspec),
+        )
+    if len(mesh.axis_names) == 2:
+        from .parallel.ddslab import build_dd_pencil_fft3d
+
+        row, col = mesh.axis_names[:2]
+        fn, spec = build_dd_pencil_fft3d(
+            mesh, shape, row_axis=row, col_axis=col, forward=forward)
+        return DDPlan3D(
+            shape=shape, direction=direction, decomposition="pencil",
+            mesh=mesh, fn=fn,
+            in_sharding=NamedSharding(mesh, spec.in_spec),
+            out_sharding=NamedSharding(mesh, spec.out_spec),
+        )
+    raise ValueError("dd plans support single-device, 1D, or 2D meshes")
 
 
 def execute(plan: Plan3D, x, *, scale: Scale = Scale.NONE):

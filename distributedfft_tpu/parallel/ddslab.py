@@ -1,4 +1,5 @@
-"""Slab-decomposed distributed 3D FFT at the emulated-f64 (dd) tier.
+"""Slab- and pencil-decomposed distributed 3D FFT at the emulated-f64
+(dd) tier.
 
 The reference's distributed engine is double precision end to end
 (``3dmpifft_opt`` computes f64 C2C across GPUs; accuracy gate 1e-11,
@@ -32,7 +33,16 @@ except ImportError:  # pragma: no cover
 from ..geometry import pad_to
 from ..ops import ddfft
 from .exchange import _crop_axis, _pad_axis, exchange_uneven
+from .pencil import PencilSpec, chain_geometry
 from .slab import SlabSpec
+
+
+def _check_dd_extent(n: int, shape) -> None:
+    if n > ddfft.DD_DENSE_MAX and ddfft._dd_split(n) is None:
+        raise ValueError(
+            f"dd pipeline: axis length {n} has no dense-coverable "
+            f"four-step split (shape {tuple(shape)})"
+        )
 
 
 def build_dd_slab_fft3d(
@@ -53,11 +63,7 @@ def build_dd_slab_fft3d(
     """
     shape = tuple(int(s) for s in shape)
     for n in shape:
-        if n > ddfft.DD_DENSE_MAX and ddfft._dd_split(n) is None:
-            raise ValueError(
-                f"dd slab: axis length {n} has no dense-coverable "
-                f"four-step split (shape {shape})"
-            )
+        _check_dd_extent(n, shape)
     p = mesh.shape[axis_name]
     in_axis, out_axis = (0, 1) if forward else (1, 0)
     spec = SlabSpec(shape, p, axis_name, in_axis, out_axis)
@@ -97,5 +103,64 @@ def build_dd_slab_fft3d(
         hi, lo = mapped(hi, lo)
         return (_crop_axis(hi, out_axis, n_out),
                 _crop_axis(lo, out_axis, n_out))
+
+    return fn, spec
+
+
+def build_dd_pencil_fft3d(
+    mesh: Mesh,
+    shape: tuple[int, int, int],
+    *,
+    row_axis: str = "row",
+    col_axis: str = "col",
+    forward: bool = True,
+    algorithm: str = "alltoall",
+) -> tuple[Callable, PencilSpec]:
+    """Jitted distributed dd 3D C2C transform over a 2D (rows x cols)
+    mesh — the canonical pencil chain (z-pencils -> x-pencils forward;
+    see :mod:`.pencil`) with every stage at the dd tier and both dd
+    components through each exchange."""
+    shape = tuple(int(s) for s in shape)
+    for n in shape:
+        _check_dd_extent(n, shape)
+    perm = (0, 1, 2) if forward else (1, 2, 0)
+    order = "col_first" if forward else "row_first"
+    rows, cols = mesh.shape[row_axis], mesh.shape[col_axis]
+    spec = PencilSpec(shape, rows, cols, row_axis, col_axis, perm, order)
+    n = spec.shape
+    seq, last_fft, in_pads, out_crops = chain_geometry(
+        perm, order, rows, cols, row_axis, col_axis, n)
+    platform = mesh.devices.flat[0].platform
+
+    def local_fn(hi, lo):
+        for mesh_ax, parts, split, concat in seq:
+            hi, lo = ddfft.fft_axis_dd(hi, lo, split, forward=forward)
+            kw = dict(split_axis=split, concat_axis=concat,
+                      axis_size=parts, algorithm=algorithm,
+                      platform=platform)
+            hi = exchange_uneven(hi, mesh_ax, **kw)
+            lo = exchange_uneven(lo, mesh_ax, **kw)
+            hi = _crop_axis(hi, concat, n[concat])
+            lo = _crop_axis(lo, concat, n[concat])
+        return ddfft.fft_axis_dd(hi, lo, last_fft, forward=forward)
+
+    in_spec, out_spec = spec.in_spec, spec.out_spec
+    mapped = _shard_map(local_fn, mesh=mesh,
+                        in_specs=(in_spec, in_spec),
+                        out_specs=(out_spec, out_spec))
+    in_sh = NamedSharding(mesh, in_spec)
+
+    @jax.jit
+    def fn(hi, lo):
+        for ax, to in in_pads:
+            hi = _pad_axis(hi, ax, to)
+            lo = _pad_axis(lo, ax, to)
+        hi = lax.with_sharding_constraint(hi, in_sh)
+        lo = lax.with_sharding_constraint(lo, in_sh)
+        hi, lo = mapped(hi, lo)
+        for ax, to in out_crops:
+            hi = _crop_axis(hi, ax, to)
+            lo = _crop_axis(lo, ax, to)
+        return hi, lo
 
     return fn, spec

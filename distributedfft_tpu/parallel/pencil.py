@@ -109,6 +109,24 @@ class PencilSpec:
         return self._pspec(*self.out_placement)
 
 
+def chain_geometry(perm, order, rows, cols, row_axis, col_axis, n):
+    """The pencil chain's static geometry, shared by the c64 and dd
+    builders (one source of truth for the exchange-order taxonomy):
+    returns ``(seq, last_fft, in_pads, out_crops)`` where ``seq`` lists
+    ``(mesh_axis, parts, split_axis, concat_axis)`` per exchange."""
+    a, b, c = perm
+    if order == "col_first":
+        seq = [(col_axis, cols, c, b), (row_axis, rows, b, a)]
+        last_fft = a
+    else:
+        seq = [(row_axis, rows, c, a), (col_axis, cols, a, b)]
+        last_fft = b
+    in_pads = ((a, pad_to(n[a], rows)), (b, pad_to(n[b], cols)))
+    # Each exchange's split axis keeps its pad on the global output.
+    out_crops = tuple((split, n[split]) for _, _, split, _ in seq)
+    return seq, last_fft, in_pads, out_crops
+
+
 def build_pencil_general(
     mesh: Mesh,
     shape: tuple[int, int, int],
@@ -137,15 +155,8 @@ def build_pencil_general(
                       row_axis, col_axis, tuple(perm), order)
     ex = get_executor(executor) if isinstance(executor, str) else executor
     n = spec.shape
-    a, b, c = perm
-    if order == "col_first":
-        # (mesh_axis, parts, split_axis, concat_axis) per exchange; the fft
-        # before each exchange runs on its split axis.
-        seq = [(col_axis, cols, c, b), (row_axis, rows, b, a)]
-        last_fft = a
-    else:
-        seq = [(row_axis, rows, c, a), (col_axis, cols, a, b)]
-        last_fft = b
+    seq, last_fft, in_pads, out_crops = chain_geometry(
+        perm, order, rows, cols, row_axis, col_axis, n)
 
     def local_fn(x):
         for mesh_ax, parts, split, concat in seq:
@@ -157,9 +168,6 @@ def build_pencil_general(
         return ex(x, (last_fft,), forward)
 
     in_spec, out_spec = spec.in_spec, spec.out_spec
-    in_pads = ((a, pad_to(n[a], rows)), (b, pad_to(n[b], cols)))
-    # Each exchange's split axis keeps its pad on the global output.
-    out_crops = tuple((split, n[split]) for _, _, split, _ in seq)
 
     def pre(x):
         for ax, to in in_pads:

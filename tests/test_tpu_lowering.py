@@ -66,7 +66,7 @@ def test_strided_lowers_for_tpu(monkeypatch):
 def test_shardmap_vma_path_lowers_for_tpu(monkeypatch):
     """The REAL pallas_call under shard_map — the varying-axes/pvary
     path no CPU test can execute (the interpreter mirrors it with jnp
-    math). DFFT_PALLAS_INTERPRET=0 forces the real kernels at trace
+    math). DFFT_FORCE_REAL_LOWERING=1 forces the real kernels at trace
     time so the export builds the actual Mosaic module inside the
     shard_map program, collectives and all."""
     import distributedfft_tpu as dfft
