@@ -109,6 +109,19 @@ def test_ragged_alltoallv_lowers_for_tpu(monkeypatch):
     assert "ragged_all_to_all" in exp.mlir_module()
 
 
+def test_dd_distributed_lowers_for_tpu():
+    """The dd slab and pencil programs (compensated arithmetic with
+    optimization barriers + bf16 sliced matmuls + collectives) through
+    the TPU pipeline."""
+    import distributedfft_tpu as dfft
+
+    x = jax.ShapeDtypeStruct((32, 24, 16), jnp.complex64)
+    for mesh in (dfft.make_mesh(8), dfft.make_mesh((2, 4))):
+        plan = dfft.plan_dd_dft_c2c_3d((32, 24, 16), mesh)
+        export.export(jax.jit(lambda a, b: plan.fn(a, b)),
+                      platforms=["tpu"])(x, x)
+
+
 def test_unpacked_fallback_lowers_for_tpu(monkeypatch):
     monkeypatch.setenv("DFFT_PALLAS_PACK", "0")  # the auto-fallback shape
     z = jnp.zeros((2048, 512), jnp.float32)
