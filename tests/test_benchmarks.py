@@ -124,6 +124,20 @@ def test_bench_executor_menu(tmp_path):
     assert os.environ.get("DFFT_MM_PRECISION") == before
 
 
+def test_bench_donated_chain():
+    """Donated-execution timing chains x <- plan(x) (c2c is
+    shape-preserving), so the consumed buffer is never reused."""
+    sys.path.insert(0, REPO)
+    import bench
+    import jax.numpy as jnp
+
+    import distributedfft_tpu as dfft
+
+    mesh = dfft.make_mesh(4)
+    secs = bench.bench_donated((16, 16, 16), mesh, jnp.complex64, "xla")
+    assert secs > 0
+
+
 def test_speed3d_profile_flag(tmp_path):
     d = str(tmp_path / "prof")
     speed3d.main(["c2c", "double", "16", "16", "16",
