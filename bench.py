@@ -85,6 +85,11 @@ def bench_executor(shape, mesh, dtype, executor: str):
     (seconds, max_err, plan) or raises. Plans are returned so the caller
     can reuse them (stage breakdown, donation rebuild) without paying a
     second compile through the tunnel."""
+    with _precision_env(executor) as base:
+        return _bench_executor_inner(shape, mesh, dtype, base)
+
+
+def _bench_executor_inner(shape, mesh, dtype, executor):
     import functools
 
     import jax
@@ -95,14 +100,6 @@ def bench_executor(shape, mesh, dtype, executor: str):
         max_rel_err, sync, time_fn_amortized,
     )
 
-    with _precision_env(executor) as base:
-        return _bench_executor_inner(
-            shape, mesh, dtype, base, functools, jax, jnp, dfft,
-            max_rel_err, sync, time_fn_amortized)
-
-
-def _bench_executor_inner(shape, mesh, dtype, executor, functools, jax, jnp,
-                          dfft, max_rel_err, sync, time_fn_amortized):
     plan = dfft.plan_dft_c2c_3d(
         shape, mesh, direction=dfft.FORWARD, dtype=dtype, donate=False,
         executor=executor,

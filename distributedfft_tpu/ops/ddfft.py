@@ -71,7 +71,6 @@ from __future__ import annotations
 import functools
 import math
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -286,20 +285,22 @@ def _sliced_mm(a_slices, w_sl, common_e, subtract=False):
     sgn = jnp.float32(-1.0 if subtract else 1.0)
     f_hi = jnp.ldexp(sgn, e_hi - common_e)
     f_lo = jnp.ldexp(sgn, e_lo - common_e)
+
+    def term(xs, ws, f):
+        # functools.partial (not a closure) so each thunk binds its own
+        # slice pair instead of the loop variables.
+        return functools.partial(lambda x, w, s: bmm(x, w) * s, xs, ws, f)
+
     parts = []  # (order_key, thunk)
     for i, xs in enumerate(hi_sl):
         for j, ws in enumerate(w_sl):
-            if i + j > _CUT_HI:
-                continue
-            parts.append((i + j, functools.partial(
-                lambda x, w, f: bmm(x, w) * f, xs, ws, f_hi)))
+            if i + j <= _CUT_HI:
+                parts.append((i + j, term(xs, ws, f_hi)))
     for i, xs in enumerate(lo_sl):
         for j, ws in enumerate(w_sl):
-            if i + j > _CUT_LO:
-                continue
-            # lo sits ~24 bits below hi: order after the hi diagonals.
-            parts.append((i + j + 24 // _B, functools.partial(
-                lambda x, w, f: bmm(x, w) * f, xs, ws, f_lo)))
+            if i + j <= _CUT_LO:
+                # lo sits ~24 bits below hi: order after the hi diagonals.
+                parts.append((i + j + 24 // _B, term(xs, ws, f_lo)))
     return parts
 
 

@@ -28,7 +28,6 @@ from dataclasses import dataclass
 from typing import Callable
 
 import jax
-import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -39,7 +38,7 @@ except ImportError:  # pragma: no cover
 
 from ..geometry import pad_to
 from ..ops.executors import get_c2r, get_executor, get_r2c
-from .exchange import exchange, exchange_uneven
+from .exchange import exchange_uneven
 from .slab import _crop_axis, _pad_axis
 
 
