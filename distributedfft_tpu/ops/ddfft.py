@@ -455,6 +455,48 @@ def fftn_dd(hi: jnp.ndarray, lo: jnp.ndarray, axes=None,
     return hi, lo
 
 
+def rfftn_dd(hi: jnp.ndarray, lo: jnp.ndarray,
+             ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """dd real-to-complex 3D DFT: real float32 (hi, lo) pairs in,
+    half-spectrum complex dd out (last axis shrunk to n2//2+1) — the
+    double tier of heFFTe's ``fft3d_r2c`` (``heffte_fft3d_r2c.h``).
+
+    The last axis runs as a full complex dd DFT and keeps the
+    non-redundant half — 2x the flops of a packed half-complex r2c, a
+    deliberate trade: the dd tier is the *accuracy* surface and the
+    packed trick's pack/unpack algebra would need its own dd error
+    analysis (the c64 executors keep the fast packed path,
+    ``ops/realfft.py``)."""
+    n2 = hi.shape[-1]
+    chi = lax.complex(hi, jnp.zeros_like(hi))
+    clo = lax.complex(lo, jnp.zeros_like(lo))
+    chi, clo = fft_axis_dd(chi, clo, axis=-1)
+    h = n2 // 2 + 1
+    chi, clo = chi[..., :h], clo[..., :h]
+    for ax in range(hi.ndim - 1):
+        chi, clo = fft_axis_dd(chi, clo, axis=ax)
+    return chi, clo
+
+
+def irfftn_dd(hi: jnp.ndarray, lo: jnp.ndarray, n2: int,
+              ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Inverse of :func:`rfftn_dd`: half-spectrum complex dd in, real dd
+    out with numpy 1/N scaling (imaginary residue dropped). The full
+    hermitian last axis is rebuilt from the non-redundant half before a
+    plain complex dd inverse (the odd-n discipline of
+    ``executors._matmul_c2r``)."""
+    for ax in range(hi.ndim - 1):
+        hi, lo = fft_axis_dd(hi, lo, axis=ax, forward=False)
+    h = hi.shape[-1]
+
+    def mirror(y):
+        m = lax.slice_in_dim(y, 1, n2 - h + 1, axis=-1)
+        return jnp.concatenate([y, jnp.conj(jnp.flip(m, axis=-1))], axis=-1)
+
+    hi, lo = fft_axis_dd(mirror(hi), mirror(lo), axis=-1, forward=False)
+    return jnp.real(hi), jnp.real(lo)
+
+
 def max_err_vs_f64(hi, lo, want: np.ndarray) -> float:
     """max |dd - want| / max |want| against a host float64 reference —
     the roundtrip/accuracy metric of the reference harnesses
