@@ -401,16 +401,23 @@ def main() -> None:
         return res
 
     # Phase A — insurance: smallest credible TPU number, fastest possible
-    # path (one executor, no extras), printed the moment it exists.
-    remaining = deadline - time.time()
-    insurance_cap = min(240.0, max(120.0, remaining - 120))
-    result, note = _run_attempt(
-        256, insurance_cap, extra_env={"DFFT_BENCH_FAST": "1"})
-    if result is not None:
-        print(json.dumps(_guard_cpu(result)), flush=True)
-        have_line = True
-    else:
-        errors.append(f"tpu@256-insurance: {note}")
+    # path (one executor, no extras), printed the moment it exists. A
+    # timed-out attempt is retried once: on a slow-but-alive tunnel the
+    # first attempt's completed compiles sit in the persistent compile
+    # cache, so the retry mostly just measures — far better odds than
+    # escalating to the 512^3 compiles.
+    for attempt in range(2):
+        remaining = deadline - time.time()
+        if remaining < 140:
+            break
+        insurance_cap = min(240.0, max(120.0, remaining - 120))
+        result, note = _run_attempt(
+            256, insurance_cap, extra_env={"DFFT_BENCH_FAST": "1"})
+        if result is not None:
+            print(json.dumps(_guard_cpu(result)), flush=True)
+            have_line = True
+            break
+        errors.append(f"tpu@256-insurance[{attempt}]: {note}")
 
     # Phase B — upgrade in place: the flagship 512^3 with the full
     # tournament, donation, and stage breakdown. Its line supersedes the
