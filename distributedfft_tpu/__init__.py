@@ -26,6 +26,8 @@ from .api import (  # noqa: F401
     plan_brick_dft_c2r_3d,
     plan_brick_dft_r2c_3d,
     plan_dd_dft_c2c_3d,
+    plan_dd_dft_c2r_3d,
+    plan_dd_dft_r2c_3d,
     plan_dft_c2c_3d,
     plan_dft_c2r_3d,
     plan_dft_r2c_3d,

@@ -787,8 +787,9 @@ class DDPlan3D:
     """A compiled 3D FFT plan at the emulated-f64 (double-double) tier.
 
     Same plan-owns-everything discipline as :class:`Plan3D`, but I/O is a
-    (hi, lo) complex64 pair (~49 significand bits — the reference's f64
-    accuracy gate territory, ``test_common.h:138``; see
+    (hi, lo) two-float pair — complex64 for c2c, float32 on the real side
+    of r2c/c2r plans — carrying ~49 significand bits (the reference's
+    f64 accuracy gate territory, ``test_common.h:138``; see
     :mod:`distributedfft_tpu.ops.ddfft`). Host conversion via
     ``dd_from_host`` / ``dd_to_host``.
     """
@@ -861,6 +862,53 @@ def plan_dd_dft_c2c_3d(
             out_sharding=NamedSharding(mesh, spec.out_spec),
         )
     raise ValueError("dd plans support single-device, 1D, or 2D meshes")
+
+
+def plan_dd_dft_r2c_3d(
+    shape: Sequence[int],
+    mesh: Mesh | int | None = None,
+    *,
+    direction: int = FORWARD,
+) -> DDPlan3D:
+    """Real<->complex 3D plan at the emulated double tier — heFFTe's
+    ``fft3d_r2c`` double gate on f32/bf16 hardware. ``shape`` is the
+    real-space world; forward takes real float32 dd pairs and returns
+    half-spectrum complex dd pairs (last axis ``N2//2+1``), backward
+    inverts with numpy 1/N scaling. Single-device or 1D slab mesh."""
+    from .ops import ddfft
+
+    shape, forward = _check_direction(shape, direction)
+    if mesh is None:
+        if forward:
+            fn = jax.jit(ddfft.rfftn_dd)
+        else:
+            fn = jax.jit(functools.partial(ddfft.irfftn_dd, n2=shape[2]))
+        return DDPlan3D(shape=shape, direction=direction,
+                        decomposition="single", mesh=None, fn=fn,
+                        in_sharding=None, out_sharding=None)
+    if isinstance(mesh, int):
+        from .parallel.mesh import make_mesh
+
+        mesh = make_mesh(mesh)
+    if len(mesh.axis_names) != 1:
+        raise ValueError("dd r2c plans support single-device or 1D slab "
+                         "meshes")
+    from .parallel.ddslab import build_dd_slab_rfft3d
+
+    fn, spec = build_dd_slab_rfft3d(mesh, shape, forward=forward,
+                                    axis_name=mesh.axis_names[0])
+    return DDPlan3D(
+        shape=shape, direction=direction, decomposition="slab", mesh=mesh,
+        fn=fn,
+        in_sharding=NamedSharding(mesh, spec.in_pspec),
+        out_sharding=NamedSharding(mesh, spec.out_pspec),
+    )
+
+
+def plan_dd_dft_c2r_3d(shape, mesh=None, **kw) -> DDPlan3D:
+    """Convenience alias: the inverse of :func:`plan_dd_dft_r2c_3d`."""
+    kw.setdefault("direction", BACKWARD)
+    return plan_dd_dft_r2c_3d(shape, mesh, **kw)
 
 
 def execute(plan: Plan3D, x, *, scale: Scale = Scale.NONE):

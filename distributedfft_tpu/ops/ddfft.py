@@ -478,22 +478,26 @@ def rfftn_dd(hi: jnp.ndarray, lo: jnp.ndarray,
     return chi, clo
 
 
+def mirror_half_spectrum(y: jnp.ndarray, n2: int,
+                         axis: int = -1) -> jnp.ndarray:
+    """Rebuild the full hermitian axis (true extent ``n2``) from its
+    non-redundant half (the odd-n discipline of
+    ``executors._matmul_c2r``); one home for the index algebra, shared by
+    the single-device and distributed dd c2r paths."""
+    h = y.shape[axis]
+    m = lax.slice_in_dim(y, 1, n2 - h + 1, axis=axis)
+    return jnp.concatenate([y, jnp.conj(jnp.flip(m, axis=axis))], axis=axis)
+
+
 def irfftn_dd(hi: jnp.ndarray, lo: jnp.ndarray, n2: int,
               ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Inverse of :func:`rfftn_dd`: half-spectrum complex dd in, real dd
-    out with numpy 1/N scaling (imaginary residue dropped). The full
-    hermitian last axis is rebuilt from the non-redundant half before a
-    plain complex dd inverse (the odd-n discipline of
-    ``executors._matmul_c2r``)."""
+    out with numpy 1/N scaling (imaginary residue dropped)."""
     for ax in range(hi.ndim - 1):
         hi, lo = fft_axis_dd(hi, lo, axis=ax, forward=False)
-    h = hi.shape[-1]
-
-    def mirror(y):
-        m = lax.slice_in_dim(y, 1, n2 - h + 1, axis=-1)
-        return jnp.concatenate([y, jnp.conj(jnp.flip(m, axis=-1))], axis=-1)
-
-    hi, lo = fft_axis_dd(mirror(hi), mirror(lo), axis=-1, forward=False)
+    hi, lo = fft_axis_dd(mirror_half_spectrum(hi, n2),
+                         mirror_half_spectrum(lo, n2),
+                         axis=-1, forward=False)
     return jnp.real(hi), jnp.real(lo)
 
 

@@ -22,6 +22,7 @@ from __future__ import annotations
 from typing import Callable
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding
 
@@ -103,6 +104,87 @@ def build_dd_slab_fft3d(
         hi, lo = mapped(hi, lo)
         return (_crop_axis(hi, out_axis, n_out),
                 _crop_axis(lo, out_axis, n_out))
+
+    return fn, spec
+
+
+def build_dd_slab_rfft3d(
+    mesh: Mesh,
+    shape: tuple[int, int, int],
+    *,
+    axis_name: str = "slab",
+    forward: bool = True,
+    algorithm: str = "alltoall",
+) -> tuple[Callable, SlabSpec]:
+    """Slab-distributed dd r2c (forward) / c2r (backward) — the double
+    tier of heFFTe's distributed ``fft3d_r2c``. The real axis (2) is
+    device-local, so the r2c shrink happens before any exchange, exactly
+    like the c64 pipeline (:func:`..slab.build_slab_rfft3d`); the r2c
+    itself is the dd full-transform-and-slice (``ddfft.rfftn_dd``
+    rationale). Forward maps real dd X-slab pairs ``[N0, N1, N2]`` to
+    complex dd Y-slab pairs ``[N0, N1, N2//2+1]``; backward inverts."""
+    shape = tuple(int(s) for s in shape)
+    for n in shape:
+        _check_dd_extent(n, shape)
+    p = mesh.shape[axis_name]
+    spec = SlabSpec(shape, p, axis_name,
+                    in_axis=0 if forward else 1,
+                    out_axis=1 if forward else 0)
+    n0, n1, n2 = shape
+    n0p, n1p = spec.n0p, spec.n1p
+    h = n2 // 2 + 1
+    platform = mesh.devices.flat[0].platform
+
+    if forward:
+
+        def local_fn(hi, lo):  # real f32 [n0p/p, N1, N2] per device
+            chi = lax.complex(hi, jnp.zeros_like(hi))
+            clo = lax.complex(lo, jnp.zeros_like(lo))
+            chi, clo = ddfft.fft_axis_dd(chi, clo, 2)    # t0a: Z lines
+            chi, clo = chi[..., :h], clo[..., :h]        # r2c shrink
+            chi, clo = ddfft.fft_axis_dd(chi, clo, 1)    # t0b: Y lines
+            kw = dict(split_axis=1, concat_axis=0, axis_size=p,
+                      algorithm=algorithm, platform=platform)
+            chi = exchange_uneven(chi, axis_name, **kw)
+            clo = exchange_uneven(clo, axis_name, **kw)
+            chi = _crop_axis(chi, 0, n0)
+            clo = _crop_axis(clo, 0, n0)
+            return ddfft.fft_axis_dd(chi, clo, 0)        # t3: X lines
+
+        pre = lambda v: _pad_axis(v, 0, n0p)  # noqa: E731
+        post = lambda v: _crop_axis(v, 1, n1)  # noqa: E731
+    else:
+
+        def local_fn(hi, lo):  # complex dd [N0, n1p/p, h] per device
+            hi, lo = ddfft.fft_axis_dd(hi, lo, 0, forward=False)
+            kw = dict(split_axis=0, concat_axis=1, axis_size=p,
+                      algorithm=algorithm, platform=platform)
+            hi = exchange_uneven(hi, axis_name, **kw)
+            lo = exchange_uneven(lo, axis_name, **kw)
+            hi = _crop_axis(hi, 1, n1)
+            lo = _crop_axis(lo, 1, n1)
+            hi, lo = ddfft.fft_axis_dd(hi, lo, 1, forward=False)
+            hi, lo = ddfft.fft_axis_dd(
+                ddfft.mirror_half_spectrum(hi, n2, axis=2),
+                ddfft.mirror_half_spectrum(lo, n2, axis=2),
+                2, forward=False)
+            return jnp.real(hi), jnp.real(lo)
+
+        pre = lambda v: _pad_axis(v, 1, n1p)  # noqa: E731
+        post = lambda v: _crop_axis(v, 0, n0)  # noqa: E731
+
+    in_spec, out_spec = spec.in_pspec, spec.out_pspec
+    mapped = _shard_map(local_fn, mesh=mesh,
+                        in_specs=(in_spec, in_spec),
+                        out_specs=(out_spec, out_spec))
+    in_sh = NamedSharding(mesh, in_spec)
+
+    @jax.jit
+    def fn(hi, lo):
+        hi = lax.with_sharding_constraint(pre(hi), in_sh)
+        lo = lax.with_sharding_constraint(pre(lo), in_sh)
+        hi, lo = mapped(hi, lo)
+        return post(hi), post(lo)
 
     return fn, spec
 
