@@ -161,6 +161,22 @@ def plan_info(plan) -> str:
     """Human-readable plan dump — the ``outputPlanInfo`` analog
     (``fft_mpi_3d_api.cpp:433-464`` writes per-rank plan/exchange tables to
     ``rank_i_gpu_j.txt``); here one string covering every device."""
+    if not hasattr(plan, "executor"):  # DDPlan3D: the emulated-f64 tier
+        lines = [
+            f"plan: {plan.shape} "
+            f"({'forward' if plan.forward else 'backward'}, dd tier)",
+            f"decomposition: {plan.decomposition}",
+            "executor: dd (double-double over exact-sliced bf16 matmuls)",
+        ]
+        if plan.mesh is not None:
+            lines.append(
+                "mesh: "
+                + " x ".join(f"{n}={s}" for n, s in plan.mesh.shape.items())
+                + f" ({plan.mesh.devices.size} devices)"
+            )
+            lines.append(f"in sharding:  {plan.in_sharding.spec}")
+            lines.append(f"out sharding: {plan.out_sharding.spec}")
+        return "\n".join(lines)
     lines = [
         f"plan: {plan.in_shape} -> {plan.out_shape} "
         f"({'forward' if plan.forward else 'backward'}"

@@ -318,6 +318,16 @@ def test_dd_r2c_plan_api():
         assert np.max(np.abs(back - x)) / np.max(np.abs(x)) < 1e-11
 
 
+def test_dd_plan_info():
+    import distributedfft_tpu as dfft
+
+    mesh = dfft.make_mesh(8)
+    p = dfft.plan_dd_dft_c2c_3d((16, 16, 16), mesh)
+    info = dfft.plan_info(p)
+    assert "dd tier" in info and "decomposition: slab" in info
+    assert "8 devices" in info
+
+
 def test_dd_large_prime_rejected():
     hi = jnp.zeros((2, 1031), jnp.complex64)  # prime > DD_DENSE_MAX
     with pytest.raises(ValueError, match="no n1\\*n2 split"):
