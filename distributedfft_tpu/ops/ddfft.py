@@ -90,6 +90,23 @@ _CUT_HI = 6  # keep hi-slice i x W-slice j when i + j <= _CUT_HI
 _CUT_LO = 2  # lo starts ~2^-24 down; i + j <= 2 reaches 2^-24-7*4 ~ 2^-52
 
 
+def _dd_depth() -> tuple[int, int, int]:
+    """(hi slices, hi pair cut, lo pair cut) — the engine's accuracy/
+    speed frontier, env-tunable for the hardware campaign
+    (``DFFT_DD_DEPTH=s,ch,cl``). Measured on the 1D engine: default
+    8,6,2 ~5e-14; 7,5,2 ~9e-13; 7,5,1 ~6e-12 (still inside the 1e-11
+    tier at ~30% fewer matmuls); 6,4,1 ~9e-11 (outside). Read at trace
+    time: set before planning; tuning sweeps must clear the jit caches
+    like the tile sweeps do."""
+    import os
+
+    env = os.environ.get("DFFT_DD_DEPTH")
+    if not env:
+        return _SLICES_HI, _CUT_HI, _CUT_LO
+    s, ch, cl = (int(v) for v in env.split(","))
+    return s, ch, cl
+
+
 # ------------------------------------------------------------ dd helpers
 
 def dd_from_host(x) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -285,6 +302,7 @@ def _sliced_mm(a_slices, w_sl, common_e, subtract=False):
     sgn = jnp.float32(-1.0 if subtract else 1.0)
     f_hi = jnp.ldexp(sgn, e_hi - common_e)
     f_lo = jnp.ldexp(sgn, e_lo - common_e)
+    _, cut_hi, cut_lo = _dd_depth()
 
     def term(xs, ws, f):
         # functools.partial (not a closure) so each thunk binds its own
@@ -294,11 +312,11 @@ def _sliced_mm(a_slices, w_sl, common_e, subtract=False):
     parts = []  # (order_key, thunk)
     for i, xs in enumerate(hi_sl):
         for j, ws in enumerate(w_sl):
-            if i + j <= _CUT_HI:
+            if i + j <= cut_hi:
                 parts.append((i + j, term(xs, ws, f_hi)))
     for i, xs in enumerate(lo_sl):
         for j, ws in enumerate(w_sl):
-            if i + j <= _CUT_LO:
+            if i + j <= cut_lo:
                 # lo sits ~24 bits below hi: order after the hi diagonals.
                 parts.append((i + j + 24 // _B, term(xs, ws, f_lo)))
     return parts
@@ -312,7 +330,7 @@ def _operand_slices(a_hi, a_lo):
     e_lo = _row_exponent(a_lo)
     hi_n = a_hi * jnp.ldexp(jnp.float32(1.0), -e_hi)
     lo_n = a_lo * jnp.ldexp(jnp.float32(1.0), -e_lo)
-    return (_extract_slices(hi_n, _SLICES_HI), e_hi,
+    return (_extract_slices(hi_n, _dd_depth()[0]), e_hi,
             _extract_slices(lo_n, _SLICES_LO), e_lo)
 
 
