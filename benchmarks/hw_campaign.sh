@@ -51,6 +51,13 @@ if [ "$SHORT" != "--short" ]; then
         -csv benchmarks/csv/dd_tier_tpu.csv || true
   done
 
+  note "dd depth frontier @256^3 (accuracy vs matmul count)"
+  for depth in 8,6,2 7,5,2 7,5,1; do
+    DFFT_DD_DEPTH=$depth timeout 900 python benchmarks/speed3d.py \
+        c2c dd 256 256 256 -iters 3 \
+        -csv benchmarks/csv/dd_depth_tpu.csv || true
+  done
+
   note "precision-tier comparison @256^3 (HIGHEST vs HIGH vs DEFAULT)"
   for prec in highest high default; do
     DFFT_MM_PRECISION=$prec DFFT_SWEEP_TIMEOUT=900 \
