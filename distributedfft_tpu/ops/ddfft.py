@@ -194,16 +194,37 @@ def _dd_cmul(xh, xl, th, tl):
     return lax.complex(re_h, im_h), lax.complex(re_l, im_l)
 
 
-def _dd_accumulate_thunks(thunks):
-    """Compensated sum of lazily-produced f32 arrays (ordered
-    largest-magnitude first) into a (hi, lo) pair. Thunks keep at most
-    one partial product live at a time outside jit — at campaign sizes
-    the eager alternative (materialize ~68 full-array partials, then
-    sum) peaks at multiple GB. Error ~2^-48 relative."""
-    hi = thunks[0]()
+# Partial-product diagonals at or past this order key are summed in
+# plain f32 before entering the compensated chain: their magnitude is
+# <= ~2^-28 of the row max, so the plain sum's rounding (~25 adds x
+# eps x 2^-28 ~ 2^-49) sits below the tier while costing 1 VPU op per
+# term instead of the two-sum chain's ~8 — the accumulation is roughly
+# half the engine's non-MXU work.
+_PLAIN_SUM_KEY = 4
+
+
+def _dd_accumulate_parts(parts):
+    """Compensated sum of (order_key, thunk) partial products into a
+    (hi, lo) pair. Thunks keep at most one partial live at a time
+    outside jit — at campaign sizes the eager alternative (materialize
+    ~68 full-array partials, then sum) peaks at multiple GB. Terms are
+    consumed largest-magnitude first; deep diagonals (key >=
+    ``_PLAIN_SUM_KEY``) fold into one plain-f32 term. Error ~2^-48
+    relative."""
+    big = [t for k, t in parts if k < _PLAIN_SUM_KEY]
+    small = [t for k, t in parts if k >= _PLAIN_SUM_KEY]
+    if not big:  # degenerate depth settings: everything is "small"
+        big, small = small[:1], small[1:]
+    hi = big[0]()
     lo = jnp.zeros_like(hi)
-    for t in thunks[1:]:
+    for t in big[1:]:
         hi, e = _two_sum(hi, t())
+        lo = lo + e
+    if small:
+        tail = small[0]()
+        for t in small[1:]:
+            tail = tail + t()
+        hi, e = _two_sum(hi, tail)
         lo = lo + e
     return _two_sum(hi, lo)
 
@@ -358,8 +379,8 @@ def _dd_dft_last(re_hi, re_lo, im_hi, im_lo, n: int, forward: bool,
                 + _sliced_mm(im_slices, wr, common_e))
     cr_parts.sort(key=lambda kv: kv[0])
     ci_parts.sort(key=lambda kv: kv[0])
-    cr_hi, cr_lo = _dd_accumulate_thunks([t for _, t in cr_parts])
-    ci_hi, ci_lo = _dd_accumulate_thunks([t for _, t in ci_parts])
+    cr_hi, cr_lo = _dd_accumulate_parts(cr_parts)
+    ci_hi, ci_lo = _dd_accumulate_parts(ci_parts)
     back = jnp.ldexp(jnp.float32(1.0), common_e - k)
     return (cr_hi * back, cr_lo * back, ci_hi * back, ci_lo * back)
 
