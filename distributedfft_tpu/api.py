@@ -890,19 +890,30 @@ def plan_dd_dft_r2c_3d(
         from .parallel.mesh import make_mesh
 
         mesh = make_mesh(mesh)
-    if len(mesh.axis_names) != 1:
-        raise ValueError("dd r2c plans support single-device or 1D slab "
-                         "meshes")
-    from .parallel.ddslab import build_dd_slab_rfft3d
+    if len(mesh.axis_names) == 1:
+        from .parallel.ddslab import build_dd_slab_rfft3d
 
-    fn, spec = build_dd_slab_rfft3d(mesh, shape, forward=forward,
-                                    axis_name=mesh.axis_names[0])
-    return DDPlan3D(
-        shape=shape, direction=direction, decomposition="slab", mesh=mesh,
-        fn=fn,
-        in_sharding=NamedSharding(mesh, spec.in_pspec),
-        out_sharding=NamedSharding(mesh, spec.out_pspec),
-    )
+        fn, spec = build_dd_slab_rfft3d(mesh, shape, forward=forward,
+                                        axis_name=mesh.axis_names[0])
+        return DDPlan3D(
+            shape=shape, direction=direction, decomposition="slab",
+            mesh=mesh, fn=fn,
+            in_sharding=NamedSharding(mesh, spec.in_pspec),
+            out_sharding=NamedSharding(mesh, spec.out_pspec),
+        )
+    if len(mesh.axis_names) == 2:
+        from .parallel.ddslab import build_dd_pencil_rfft3d
+
+        row, col = mesh.axis_names[:2]
+        fn, spec = build_dd_pencil_rfft3d(
+            mesh, shape, row_axis=row, col_axis=col, forward=forward)
+        return DDPlan3D(
+            shape=shape, direction=direction, decomposition="pencil",
+            mesh=mesh, fn=fn,
+            in_sharding=NamedSharding(mesh, spec.in_spec),
+            out_sharding=NamedSharding(mesh, spec.out_spec),
+        )
+    raise ValueError("dd r2c plans support single-device, 1D, or 2D meshes")
 
 
 def plan_dd_dft_c2r_3d(shape, mesh=None, **kw) -> DDPlan3D:

@@ -31,7 +31,7 @@ try:  # jax >= 0.6 exposes shard_map at top level
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
-from ..geometry import pad_to
+from ..geometry import pad_to  # noqa: F401 — used by the r2c chains
 from ..ops import ddfft
 from .exchange import _crop_axis, _pad_axis, exchange_uneven
 from .pencil import PencilSpec, chain_geometry
@@ -174,6 +174,100 @@ def build_dd_slab_rfft3d(
         post = lambda v: _crop_axis(v, 0, n0)  # noqa: E731
 
     in_spec, out_spec = spec.in_pspec, spec.out_pspec
+    mapped = _shard_map(local_fn, mesh=mesh,
+                        in_specs=(in_spec, in_spec),
+                        out_specs=(out_spec, out_spec))
+    in_sh = NamedSharding(mesh, in_spec)
+
+    @jax.jit
+    def fn(hi, lo):
+        hi = lax.with_sharding_constraint(pre(hi), in_sh)
+        lo = lax.with_sharding_constraint(pre(lo), in_sh)
+        hi, lo = mapped(hi, lo)
+        return post(hi), post(lo)
+
+    return fn, spec
+
+
+def build_dd_pencil_rfft3d(
+    mesh: Mesh,
+    shape: tuple[int, int, int],
+    *,
+    row_axis: str = "row",
+    col_axis: str = "col",
+    forward: bool = True,
+    algorithm: str = "alltoall",
+) -> tuple[Callable, PencilSpec]:
+    """Pencil-distributed dd r2c (forward) / c2r (backward) — the last
+    cell of the dd decomposition matrix (mirrors the c64
+    :func:`..pencil.build_pencil_rfft3d` chain: real Z lines shrink
+    before the first exchange; canonical z->x pencils forward)."""
+    shape = tuple(int(s) for s in shape)
+    for n in shape:
+        _check_dd_extent(n, shape)
+    rows, cols = mesh.shape[row_axis], mesh.shape[col_axis]
+    spec = PencilSpec(
+        shape, rows, cols, row_axis, col_axis,
+        perm=(0, 1, 2) if forward else (1, 2, 0),
+        order="col_first" if forward else "row_first",
+    )
+    n0, n1, n2 = shape
+    n0p, n1pc, n1pr = spec.n0p, spec.n1p_col, spec.n1p_row
+    h = n2 // 2 + 1
+    n2hp = pad_to(h, cols)
+    platform = mesh.devices.flat[0].platform
+
+    if forward:
+
+        def local_fn(hi, lo):  # real f32 [n0p/rows, n1pc/cols, N2]
+            chi = lax.complex(hi, jnp.zeros_like(hi))
+            clo = lax.complex(lo, jnp.zeros_like(lo))
+            chi, clo = ddfft.fft_axis_dd(chi, clo, 2)   # t0: real Z lines
+            chi, clo = chi[..., :h], clo[..., :h]       # r2c shrink
+            kw = dict(split_axis=2, concat_axis=1, axis_size=cols,
+                      algorithm=algorithm, platform=platform)
+            chi = exchange_uneven(chi, col_axis, **kw)
+            clo = exchange_uneven(clo, col_axis, **kw)
+            chi = _crop_axis(chi, 1, n1)
+            clo = _crop_axis(clo, 1, n1)
+            chi, clo = ddfft.fft_axis_dd(chi, clo, 1)   # Y lines
+            kw = dict(split_axis=1, concat_axis=0, axis_size=rows,
+                      algorithm=algorithm, platform=platform)
+            chi = exchange_uneven(chi, row_axis, **kw)
+            clo = exchange_uneven(clo, row_axis, **kw)
+            chi = _crop_axis(chi, 0, n0)
+            clo = _crop_axis(clo, 0, n0)
+            return ddfft.fft_axis_dd(chi, clo, 0)       # t3: X lines
+
+        pre = lambda v: _pad_axis(_pad_axis(v, 0, n0p), 1, n1pc)  # noqa: E731
+        post = lambda v: _crop_axis(_crop_axis(v, 1, n1), 2, h)  # noqa: E731
+    else:
+
+        def local_fn(hi, lo):  # complex dd [N0, n1pr/rows, n2hp/cols]
+            hi, lo = ddfft.fft_axis_dd(hi, lo, 0, forward=False)
+            kw = dict(split_axis=0, concat_axis=1, axis_size=rows,
+                      algorithm=algorithm, platform=platform)
+            hi = exchange_uneven(hi, row_axis, **kw)
+            lo = exchange_uneven(lo, row_axis, **kw)
+            hi = _crop_axis(hi, 1, n1)
+            lo = _crop_axis(lo, 1, n1)
+            hi, lo = ddfft.fft_axis_dd(hi, lo, 1, forward=False)
+            kw = dict(split_axis=1, concat_axis=2, axis_size=cols,
+                      algorithm=algorithm, platform=platform)
+            hi = exchange_uneven(hi, col_axis, **kw)
+            lo = exchange_uneven(lo, col_axis, **kw)
+            hi = _crop_axis(hi, 2, h)
+            lo = _crop_axis(lo, 2, h)
+            hi, lo = ddfft.fft_axis_dd(
+                ddfft.mirror_half_spectrum(hi, n2, axis=2),
+                ddfft.mirror_half_spectrum(lo, n2, axis=2),
+                2, forward=False)
+            return jnp.real(hi), jnp.real(lo)
+
+        pre = lambda v: _pad_axis(_pad_axis(v, 1, n1pr), 2, n2hp)  # noqa: E731
+        post = lambda v: _crop_axis(_crop_axis(v, 0, n0), 1, n1)  # noqa: E731
+
+    in_spec, out_spec = spec.in_spec, spec.out_spec
     mapped = _shard_map(local_fn, mesh=mesh,
                         in_specs=(in_spec, in_spec),
                         out_specs=(out_spec, out_spec))

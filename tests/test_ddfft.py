@@ -300,7 +300,8 @@ def test_dd_plan_api():
 
 
 def test_dd_r2c_plan_api():
-    """dd r2c/c2r through the plan surface, single-device and slab."""
+    """dd r2c/c2r through the plan surface: single-device, slab, and
+    pencil meshes."""
     import distributedfft_tpu as dfft
 
     shape = (16, 16, 16)
@@ -308,7 +309,7 @@ def test_dd_r2c_plan_api():
     x = rng.standard_normal(shape)
     hi, lo = dfft.dd_from_host(x)
 
-    for mesh in (None, dfft.make_mesh(8)):
+    for mesh in (None, dfft.make_mesh(8), dfft.make_mesh((2, 4))):
         pf = dfft.plan_dd_dft_r2c_3d(shape, mesh)
         pb = dfft.plan_dd_dft_c2r_3d(shape, mesh)
         yh, yl = pf(hi, lo)
@@ -336,6 +337,24 @@ def test_dd_depth_knob(monkeypatch):
     err_full = ddfft.max_err_vs_f64(yh, yl, want)
     assert err_full < 1e-12
     assert err_full <= err_shallow
+
+
+def test_dd_pencil_r2c_uneven_tier():
+    """Pencil dd r2c at an uneven shape (shrunk complex axis 9 not
+    divisible by cols=4): forward vs numpy f64 rfftn at the tier."""
+    import distributedfft_tpu as dfft
+
+    mesh = dfft.make_mesh((2, 4))
+    shape = (8, 12, 16)  # h = 9; 9 % 4 != 0 -> padded exchange path
+    rng = np.random.default_rng(73)
+    x = rng.standard_normal(shape)
+    hi, lo = dfft.dd_from_host(x)
+    pf = dfft.plan_dd_dft_r2c_3d(shape, mesh)
+    assert pf.decomposition == "pencil"
+    yh, yl = pf(hi, lo)
+    want = np.fft.rfftn(x)
+    assert yh.shape == want.shape
+    assert ddfft.max_err_vs_f64(yh, yl, want) < 1e-12
 
 
 def test_dd_plan_info():
