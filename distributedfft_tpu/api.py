@@ -654,8 +654,15 @@ def _wrap_brick_io(
                                     inner.in_shape)
     out_target = _even_fallback_spec(m, inner.out_sharding.spec,
                                      inner.out_shape)
-    to_canon, in_bspec = plan_bricks_to_spec(m, in_boxes, in_target)
-    from_canon, out_bspec = plan_spec_to_bricks(m, out_target, out_boxes)
+    # algorithm="alltoallv" on the plan selects the exact-count ragged
+    # transport for the brick edges (wire == payload); other algorithms
+    # keep the padded ppermute ring.
+    brick_alg = ("a2av" if inner.options.algorithm == "alltoallv"
+                 else "ring")
+    to_canon, in_bspec = plan_bricks_to_spec(m, in_boxes, in_target,
+                                             algorithm=brick_alg)
+    from_canon, out_bspec = plan_spec_to_bricks(m, out_target, out_boxes,
+                                                algorithm=brick_alg)
     inner_fn = inner.fn
 
     jit_kw: dict = {"donate_argnums": 0} if inner.options.donate else {}

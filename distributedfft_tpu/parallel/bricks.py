@@ -64,6 +64,11 @@ def pad_shape_for(boxes: Sequence[Box3]) -> tuple[int, int, int]:
     return tuple(max(b.shape[d] for b in boxes) for d in range(3))
 
 
+def _check_algorithm(algorithm: str) -> None:
+    if algorithm not in ("ring", "a2av"):
+        raise ValueError(f"algorithm must be ring|a2av, got {algorithm!r}")
+
+
 def _validate(boxes: Sequence[Box3], world: Box3, label: str) -> None:
     if not world_complete(boxes, world):
         raise ValueError(
@@ -98,10 +103,17 @@ class BrickSpec:
     in_pad: tuple[int, int, int]
     out_pad: tuple[int, int, int]
     steps: tuple[_Step, ...]
+    algorithm: str = "ring"   # "ring" (padded ppermute) | "a2av" (exact)
+    # a2av plans skip the ring's step construction entirely; their payload
+    # comes straight from the exact tables.
+    payload_override: int | None = None
 
     @property
     def payload_elems(self) -> int:
-        """True overlap elements crossing the wire (exact-table payload)."""
+        """True overlap elements crossing the wire (exact-table payload,
+        self-overlaps excluded — they never leave the device)."""
+        if self.payload_override is not None:
+            return self.payload_override
         return sum(
             int(np.prod(st.true_size[i]))
             for st in self.steps if st.shift
@@ -110,7 +122,10 @@ class BrickSpec:
 
     @property
     def wire_elems(self) -> int:
-        """Elements the padded ring actually ships (block * P per step)."""
+        """Elements actually shipped: the padded ring sends block * P per
+        step; the a2av tier sends exactly the payload (ragged runs)."""
+        if self.algorithm == "a2av":
+            return self.payload_elems
         p = len(self.in_boxes)
         return sum(
             math.prod(st.block) * p for st in self.steps if st.shift
@@ -311,6 +326,185 @@ def _ring_reshape(
     return acc
 
 
+# ---------------------------------------------- exact-count (a2av) tier
+
+@dataclass(frozen=True)
+class _A2AVTables:
+    """Plan-time tables of the exact-count brick transport (all numpy).
+
+    SPMD programs need uniform static shapes, so per-device geometry
+    travels as *data*: each device gets its own row of gather/scatter
+    index maps (pack: padded-brick flat index per send-buffer slot;
+    unpack: padded-out-brick flat index per receive-buffer slot, with an
+    out-of-range sentinel on the padding slots that ``mode='drop'``
+    discards) plus its offset/size rows for ``lax.ragged_all_to_all``.
+    Only the true run sizes cross the wire — the heFFTe ``alltoallv``
+    exact-count discipline (``src/heffte_reshape3d.cpp:375``)."""
+
+    pack_idx: np.ndarray    # [P, send_cap] int32
+    unpack_idx: np.ndarray  # [P, recv_cap] int32 (sentinel = prod(out_pad))
+    send_off: np.ndarray    # [P, P] int32: run start in sender i's buffer
+    sizes: np.ndarray       # [P, P] int64: elements i -> d
+    out_off: np.ndarray     # [P, P] int32: landing offset of i's run at d
+    send_cap: int
+    recv_cap: int
+
+
+def _a2av_tables(
+    in_boxes: Sequence[Box3], out_boxes: Sequence[Box3],
+    in_pad: tuple[int, int, int], out_pad: tuple[int, int, int],
+) -> _A2AVTables:
+    p = len(in_boxes)
+    sizes = np.zeros((p, p), np.int64)
+    runs: dict[tuple[int, int], tuple[np.ndarray, np.ndarray]] = {}
+    for i in range(p):
+        for d in range(p):
+            o = in_boxes[i].intersect(out_boxes[d])
+            if o.empty:
+                continue
+            sizes[i, d] = o.size
+            # C-order traversal of the overlap box on BOTH sides: the
+            # sender's flat-source indices and the receiver's flat-dest
+            # indices line up element for element.
+            def flat(low_ref, pad):
+                g = np.mgrid[tuple(
+                    slice(lo - rl, hi - rl)
+                    for lo, hi, rl in zip(o.low, o.high, low_ref))]
+                return np.ravel_multi_index(
+                    [g[k].ravel() for k in range(3)], pad).astype(np.int32)
+
+            runs[(i, d)] = (flat(in_boxes[i].low, in_pad),
+                            flat(out_boxes[d].low, out_pad))
+    send_tot = sizes.sum(axis=1)
+    recv_tot = sizes.sum(axis=0)
+    send_cap = int(send_tot.max()) if p else 0
+    recv_cap = int(recv_tot.max()) if p else 0
+    send_off = np.zeros((p, p), np.int32)
+    out_off = np.zeros((p, p), np.int32)
+    for i in range(p):
+        send_off[i] = np.concatenate(
+            ([0], np.cumsum(sizes[i])[:-1])).astype(np.int32)
+    for d in range(p):
+        out_off[:, d] = np.concatenate(
+            ([0], np.cumsum(sizes[:, d])[:-1])).astype(np.int32)
+    pack_idx = np.zeros((p, max(send_cap, 1)), np.int32)
+    sentinel = int(np.prod(out_pad))
+    unpack_idx = np.full((p, max(recv_cap, 1)), sentinel, np.int32)
+    for i in range(p):
+        for d in range(p):
+            if not sizes[i, d]:
+                continue
+            src_idx, _ = runs[(i, d)]
+            s0 = send_off[i, d]
+            pack_idx[i, s0:s0 + sizes[i, d]] = src_idx
+    for d in range(p):
+        for i in range(p):
+            if not sizes[i, d]:
+                continue
+            _, dst_idx = runs[(i, d)]
+            r0 = out_off[i, d]
+            unpack_idx[d, r0:r0 + sizes[i, d]] = dst_idx
+    return _A2AVTables(pack_idx, unpack_idx, send_off, sizes, out_off,
+                       send_cap, recv_cap)
+
+
+def _a2av_payload(t: _A2AVTables) -> int:
+    """Off-device elements the exact transport ships (diagonal self-runs
+    never leave the device)."""
+    return int(t.sizes.sum() - np.trace(t.sizes))
+
+
+def _a2av_gather_idx(t: _A2AVTables, p: int) -> np.ndarray:
+    """[P, recv_cap] flat indices into the all_gathered send buffers for
+    the CPU emulation (same offset tables as the real collective)."""
+    cap = max(t.send_cap, 1)
+    gidx = np.zeros((p, max(t.recv_cap, 1)), np.int64)
+    for d in range(p):
+        for s in range(p):
+            if not t.sizes[s, d]:
+                continue
+            r0 = t.out_off[s, d]
+            gidx[d, r0:r0 + t.sizes[s, d]] = (
+                s * cap + t.send_off[s, d] + np.arange(t.sizes[s, d]))
+    return gidx
+
+
+def _a2av_reshape(
+    x: jnp.ndarray,
+    pack_row: jnp.ndarray,     # [1, send_cap] this device's gather map
+    unpack_row: jnp.ndarray,   # [1, recv_cap] this device's scatter map
+    gidx_row: jnp.ndarray,     # [1, recv_cap] CPU-emulation gather map
+    axis_names: tuple[str, ...],
+    t: _A2AVTables,
+    out_pad: tuple[int, int, int],
+) -> jnp.ndarray:
+    """The exact-count reshape of one local brick (inside shard_map).
+    The big per-device index maps arrive as SHARDED OPERANDS (one row
+    per device) rather than embedded [P, cap] constants, so executable
+    size stays O(brick), not O(P x brick). On backends without the
+    ragged op (XLA:CPU, unless force_real_lowering), an all_gather
+    emulation with the *same tables* stands in — so the CPU tests
+    exercise every index map, and only the collective itself differs on
+    hardware."""
+    import jax as _jax
+
+    from ..utils.compat import force_real_lowering
+
+    i = lax.axis_index(axis_names)
+    rcap = max(t.recv_cap, 1)
+    sendbuf = x.reshape(-1)[pack_row[0]]  # [send_cap]
+
+    platform = _jax.default_backend()
+    if platform == "cpu" and not force_real_lowering():
+        # Emulation: gather every sender's buffer, then assemble my
+        # receive buffer from the same offset tables via one gather.
+        ag = lax.all_gather(sendbuf, axis_names)  # [P, send_cap]
+        y = ag.reshape(-1)[gidx_row[0]]
+    else:
+        out = jnp.zeros((rcap,), x.dtype)
+        soff = jnp.asarray(t.send_off)[i]
+        ssz = jnp.asarray(t.sizes.astype(np.int32))[i]
+        ooff = jnp.asarray(t.out_off)[i]
+        rsz = jnp.asarray(t.sizes.astype(np.int32).T)[i]
+        y = lax.ragged_all_to_all(
+            sendbuf, out, soff, ssz, ooff, rsz, axis_name=axis_names)
+    accf = jnp.zeros((math.prod(out_pad),), x.dtype)
+    # Sentinel indices on padding slots fall out of bounds and drop.
+    accf = accf.at[unpack_row[0]].set(y, mode="drop")
+    return accf.reshape(out_pad)
+
+
+def _a2av_mapped(
+    mesh: Mesh,
+    names: tuple[str, ...],
+    p: int,
+    tables: _A2AVTables,
+    out_pad: tuple[int, int, int],
+    data_in_spec: P,
+    data_out_spec: P,
+    squeeze_in: bool,
+    expand_out: bool,
+) -> Callable:
+    """Build ``fn(x)`` for the a2av transport: the index tables travel as
+    shard_map operands sharded one row per device."""
+    pack_tbl = jnp.asarray(tables.pack_idx)
+    unpack_tbl = jnp.asarray(tables.unpack_idx)
+    gidx_tbl = jnp.asarray(_a2av_gather_idx(tables, p))
+    row = P(names, None)
+
+    def _local(x, prow, urow, grow):
+        v = x[0] if squeeze_in else x
+        y = _a2av_reshape(v, prow, urow, grow, names, tables, out_pad)
+        return y[None] if expand_out else y
+
+    mapped = _shard_map(
+        _local, mesh=mesh,
+        in_specs=(data_in_spec, row, row, row),
+        out_specs=data_out_spec,
+    )
+    return lambda x: mapped(x, pack_tbl, unpack_tbl, gidx_tbl)
+
+
 def plan_brick_reshape(
     mesh: Mesh,
     in_boxes: Sequence[Box3],
@@ -318,6 +512,7 @@ def plan_brick_reshape(
     *,
     axis_name: str | Sequence[str] | None = None,
     jit: bool = True,
+    algorithm: str = "ring",
 ) -> tuple[Callable, BrickSpec]:
     """Compile an arbitrary-box reshape over one or more mesh axes.
 
@@ -327,7 +522,15 @@ def plan_brick_reshape(
     analog of constructing a ``reshape3d_alltoallv`` object from the in/out
     box lists (``heffte_reshape3d.h:60-170``): all overlap maps are
     resolved here, execution only replays them.
+
+    ``algorithm`` picks the transport: ``"ring"`` (default) ships padded
+    uniform blocks over a ppermute ring (pipelinable, p2p-like);
+    ``"a2av"`` ships exactly the true overlap runs via
+    ``lax.ragged_all_to_all`` — the heFFTe exact-count ``alltoallv``
+    (``src/heffte_reshape3d.cpp:375``; wire == payload, see
+    ``BrickSpec.wire_ratio``).
     """
+    _check_algorithm(algorithm)
     names, p = _resolve_axes(mesh, axis_name)
     if len(in_boxes) != p or len(out_boxes) != p:
         raise ValueError(
@@ -340,17 +543,27 @@ def plan_brick_reshape(
 
     in_pad = pad_shape_for(in_boxes)
     out_pad = pad_shape_for(out_boxes)
-    steps = _overlap_steps(in_boxes, out_boxes)
-    spec = BrickSpec(tuple(in_boxes), tuple(out_boxes), world, in_pad,
-                     out_pad, tuple(steps))
+    if algorithm == "a2av":
+        tables = _a2av_tables(in_boxes, out_boxes, in_pad, out_pad)
+        spec = BrickSpec(tuple(in_boxes), tuple(out_boxes), world, in_pad,
+                         out_pad, (), algorithm,
+                         payload_override=_a2av_payload(tables))
+        fn = _a2av_mapped(mesh, names, p, tables, out_pad,
+                          P(names), P(names),
+                          squeeze_in=True, expand_out=True)
+    else:
+        steps = _overlap_steps(in_boxes, out_boxes)
+        spec = BrickSpec(tuple(in_boxes), tuple(out_boxes), world, in_pad,
+                         out_pad, tuple(steps), algorithm)
 
-    def _local(x: jnp.ndarray) -> jnp.ndarray:
-        return _ring_reshape(x[0], names, p, steps, in_pad, out_pad)[None]
+        def _local(x: jnp.ndarray) -> jnp.ndarray:
+            return _ring_reshape(x[0], names, p, steps, in_pad,
+                                 out_pad)[None]
 
-    fn = _shard_map(
-        _local, mesh=mesh,
-        in_specs=P(names), out_specs=P(names),
-    )
+        fn = _shard_map(
+            _local, mesh=mesh,
+            in_specs=P(names), out_specs=P(names),
+        )
     if jit:
         fn = jax.jit(fn)
     return fn, spec
@@ -413,14 +626,17 @@ def plan_bricks_to_spec(
     to_spec: P,
     *,
     jit: bool = False,
+    algorithm: str = "ring",
 ) -> tuple[Callable, BrickSpec]:
     """Arbitrary in-bricks -> a true global array sharded by ``to_spec``.
 
-    The entry edge of a brick-I/O FFT plan: the overlap ring lands each
-    device's shard of the ``to_spec`` layout, and shard_map's out_specs
-    reassemble the true (unpadded) global — which requires ``to_spec`` to
-    divide the world evenly.
+    The entry edge of a brick-I/O FFT plan: the overlap reshape lands
+    each device's shard of the ``to_spec`` layout, and shard_map's
+    out_specs reassemble the true (unpadded) global — which requires
+    ``to_spec`` to divide the world evenly. ``algorithm`` as in
+    :func:`plan_brick_reshape`.
     """
+    _check_algorithm(algorithm)
     world = find_world(in_boxes)
     _validate(in_boxes, world, "input")
     out_boxes, shard_shape = _even_spec_boxes(mesh, to_spec, world, "target")
@@ -428,14 +644,24 @@ def plan_bricks_to_spec(
     if len(in_boxes) != p:
         raise ValueError(f"need {p} input bricks, got {len(in_boxes)}")
     in_pad = pad_shape_for(in_boxes)
-    steps = _overlap_steps(in_boxes, out_boxes)
-    spec = BrickSpec(tuple(in_boxes), tuple(out_boxes), world, in_pad,
-                     shard_shape, tuple(steps))
+    if algorithm == "a2av":
+        tables = _a2av_tables(in_boxes, out_boxes, in_pad, shard_shape)
+        spec = BrickSpec(tuple(in_boxes), tuple(out_boxes), world, in_pad,
+                         shard_shape, (), algorithm,
+                         payload_override=_a2av_payload(tables))
+        fn = _a2av_mapped(mesh, names, p, tables, shard_shape,
+                          P(names), to_spec,
+                          squeeze_in=True, expand_out=False)
+    else:
+        steps = _overlap_steps(in_boxes, out_boxes)
+        spec = BrickSpec(tuple(in_boxes), tuple(out_boxes), world, in_pad,
+                         shard_shape, tuple(steps), algorithm)
 
-    def _local(x: jnp.ndarray) -> jnp.ndarray:
-        return _ring_reshape(x[0], names, p, steps, in_pad, shard_shape)
+        def _local(x: jnp.ndarray) -> jnp.ndarray:
+            return _ring_reshape(x[0], names, p, steps, in_pad, shard_shape)
 
-    fn = _shard_map(_local, mesh=mesh, in_specs=P(names), out_specs=to_spec)
+        fn = _shard_map(_local, mesh=mesh, in_specs=P(names),
+                        out_specs=to_spec)
     if jit:
         fn = jax.jit(fn)
     return fn, spec
@@ -447,10 +673,12 @@ def plan_spec_to_bricks(
     out_boxes: Sequence[Box3],
     *,
     jit: bool = False,
+    algorithm: str = "ring",
 ) -> tuple[Callable, BrickSpec]:
     """A true global array sharded by ``from_spec`` -> arbitrary out-bricks
     (the exit edge of a brick-I/O FFT plan). ``from_spec`` must divide the
-    world evenly."""
+    world evenly. ``algorithm`` as in :func:`plan_brick_reshape`."""
+    _check_algorithm(algorithm)
     world = find_world(out_boxes)
     _validate(out_boxes, world, "output")
     in_boxes, shard_shape = _even_spec_boxes(mesh, from_spec, world, "source")
@@ -458,14 +686,25 @@ def plan_spec_to_bricks(
     if len(out_boxes) != p:
         raise ValueError(f"need {p} output bricks, got {len(out_boxes)}")
     out_pad = pad_shape_for(out_boxes)
-    steps = _overlap_steps(in_boxes, out_boxes)
-    spec = BrickSpec(tuple(in_boxes), tuple(out_boxes), world, shard_shape,
-                     out_pad, tuple(steps))
+    if algorithm == "a2av":
+        tables = _a2av_tables(in_boxes, out_boxes, shard_shape, out_pad)
+        spec = BrickSpec(tuple(in_boxes), tuple(out_boxes), world,
+                         shard_shape, out_pad, (), algorithm,
+                         payload_override=_a2av_payload(tables))
+        fn = _a2av_mapped(mesh, names, p, tables, out_pad,
+                          from_spec, P(names),
+                          squeeze_in=False, expand_out=True)
+    else:
+        steps = _overlap_steps(in_boxes, out_boxes)
+        spec = BrickSpec(tuple(in_boxes), tuple(out_boxes), world,
+                         shard_shape, out_pad, tuple(steps), algorithm)
 
-    def _local(x: jnp.ndarray) -> jnp.ndarray:
-        return _ring_reshape(x, names, p, steps, shard_shape, out_pad)[None]
+        def _local(x: jnp.ndarray) -> jnp.ndarray:
+            return _ring_reshape(x, names, p, steps, shard_shape,
+                                 out_pad)[None]
 
-    fn = _shard_map(_local, mesh=mesh, in_specs=from_spec, out_specs=P(names))
+        fn = _shard_map(_local, mesh=mesh, in_specs=from_spec,
+                        out_specs=P(names))
     if jit:
         fn = jax.jit(fn)
     return fn, spec

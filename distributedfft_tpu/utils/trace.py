@@ -253,8 +253,10 @@ def plan_info(plan) -> str:
             t = bs.payload_elems * itemsize
             w = bs.wire_elems * itemsize
             ov = f"ratio {bs.wire_ratio:.2f}x" if t else "ratio n/a"
+            how = (f"{len(bs.steps)} ring steps" if bs.algorithm == "ring"
+                   else "a2av exact counts")
             lines.append(
-                f"brick edge {label}: {len(bs.steps)} ring steps, "
+                f"brick edge {label}: {how}, "
                 f"payload {t * _MB:.2f} MB | wire {w * _MB:.2f} MB ({ov})"
             )
     # Per-device memory footprint estimate — the heFFTe benchmark's

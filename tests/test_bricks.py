@@ -299,6 +299,70 @@ def test_shape_skew_step_split():
     np.testing.assert_array_equal(got, x)
 
 
+@pytest.mark.parametrize("case", ["slabs", "uneven", "nongrid"])
+def test_a2av_exact_transport(case):
+    """The exact-count (ragged alltoallv) brick transport reproduces the
+    ring's results bit-for-bit on even, uneven, and non-grid partitions,
+    with wire == payload (the heFFTe alltoallv discipline the padded
+    ring can only approximate)."""
+    from distributedfft_tpu.parallel.bricks import plan_brick_reshape
+
+    mesh = _mesh()
+    if case == "slabs":
+        w = world_box((16, 16, 16))
+        ins, outs = make_slabs(w, 8), make_pencils(w, (2, 4), 2)
+    elif case == "uneven":
+        w = world_box((13, 16, 12))
+        ins = make_slabs(w, 8, axis=0, rule=ceil_splits)
+        outs = make_slabs(w, 8, axis=1)
+    else:
+        w = world_box((12, 10, 8))
+        ins = make_pencils(w, (4, 2), 0)
+        outs = make_slabs(w, 8, rule=ceil_splits)
+
+    rng = np.random.default_rng(83)
+    shape = w.shape
+    x = (rng.standard_normal(shape)
+         + 1j * rng.standard_normal(shape)).astype(np.complex64)
+    fn, spec = plan_brick_reshape(mesh, ins, outs, algorithm="a2av")
+    assert spec.algorithm == "a2av"
+    assert spec.wire_ratio == 1.0  # exact counts: wire == payload
+    stack = scatter_bricks(x, ins, spec.in_pad, mesh=mesh)
+    got = gather_bricks(fn(stack), outs)
+    np.testing.assert_array_equal(got, x)
+
+
+def test_brick_plan_a2av_edges():
+    """algorithm='alltoallv' on a brick-I/O plan routes both edges over
+    the exact-count transport (wire == payload in plan_info terms)."""
+    shape = (16, 16, 16)
+    mesh = dfft.make_mesh(8)
+    w = world_box(shape)
+    ins = make_pencils(w, (4, 2), 2)
+    outs = make_slabs(w, 8, axis=1)
+    plan = dfft.plan_brick_dft_c2c_3d(
+        shape, mesh, ins, outs, dtype=np.complex64, algorithm="alltoallv")
+    for bs in plan.brick_edges:
+        assert bs.algorithm == "a2av" and bs.wire_ratio == 1.0
+    rng = np.random.default_rng(89)
+    x = (rng.standard_normal(shape)
+         + 1j * rng.standard_normal(shape)).astype(np.complex64)
+    stack = scatter_bricks(x, ins, plan.in_shape[1:], mesh=mesh)
+    got = gather_bricks(plan(stack), outs)
+    want = np.fft.fftn(x)
+    np.testing.assert_allclose(got, want, rtol=0,
+                               atol=2e-3 * np.abs(want).max())
+
+
+def test_a2av_bad_algorithm_rejected():
+    from distributedfft_tpu.parallel.bricks import plan_brick_reshape
+
+    w = world_box((8, 8, 8))
+    boxes = make_slabs(w, 8)
+    with pytest.raises(ValueError, match="ring|a2av"):
+        plan_brick_reshape(_mesh(), boxes, boxes, algorithm="nope")
+
+
 def test_brick_r2c_roundtrip_matches_numpy():
     """Brick-I/O r2c: real bricks in, shrunk-world complex bricks out
     (heFFTe fft3d_r2c brick tier), inverse back to the real bricks."""

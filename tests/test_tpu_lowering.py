@@ -109,6 +109,31 @@ def test_ragged_alltoallv_lowers_for_tpu(monkeypatch):
     assert "ragged_all_to_all" in exp.mlir_module()
 
 
+def test_brick_a2av_lowers_for_tpu(monkeypatch):
+    """The exact-count brick transport's real path (gather-pack ->
+    lax.ragged_all_to_all -> scatter-unpack) through the TPU pipeline."""
+    import distributedfft_tpu as dfft
+    from distributedfft_tpu.geometry import (
+        ceil_splits, make_slabs, world_box,
+    )
+    from distributedfft_tpu.parallel.bricks import plan_brick_reshape
+
+    monkeypatch.setenv("DFFT_FORCE_REAL_LOWERING", "1")
+    mesh = dfft.make_mesh(8)
+    w = world_box((13, 16, 12))
+    ins = make_slabs(w, 8, axis=0, rule=ceil_splits)
+    outs = make_slabs(w, 8, axis=1)
+    fn, spec = plan_brick_reshape(mesh, ins, outs, algorithm="a2av")
+    x = jax.ShapeDtypeStruct((8,) + spec.in_pad, jnp.complex64)
+    exp = export.export(
+        jax.jit(fn), platforms=["tpu"],
+        disabled_checks=[
+            export.DisabledSafetyCheck.custom_call("ragged_all_to_all"),
+        ],
+    )(x)
+    assert "ragged_all_to_all" in exp.mlir_module()
+
+
 def test_dd_distributed_lowers_for_tpu():
     """The dd slab and pencil programs (compensated arithmetic with
     optimization barriers + bf16 sliced matmuls + collectives) through
