@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Persistent TPU-tunnel prober with auto-campaign trigger.
+
+The axon tunnel that backs `jax.devices()` on this box is intermittent:
+when it is down, backend init *hangs* (never errors), so every probe must
+run in a killable subprocess.  Rounds 1-3 lost their hardware windows to
+exactly this — the r3 verdict's top item is "keep trying all round, and
+fire the campaign the moment a probe succeeds".  This script is that:
+
+  * probe loop: one subprocess per attempt (`import jax; jax.devices()`),
+    hard timeout, one log line per attempt (timestamped, appended and
+    flushed so the log itself is committable evidence of continuous
+    attempts, mirroring the one-run report discipline of the reference
+    driver, 3dmpifft_opt/fftSpeed3d_c2c.cpp:123-137);
+  * on the first successful probe: immediately exec the short hardware
+    campaign (smoke -> bench -> tile sweep, benchmarks/hw_campaign.sh
+    --short) and exit 0 so the orchestrating session is notified and can
+    commit the rows while the window is still open;
+  * on deadline without a live probe: exit 3, leaving the log as the
+    committed proof of continuous attempts across the round.
+
+Usage:
+    python benchmarks/tpu_prober.py [--hours H] [--interval S] [--no-campaign]
+"""
+from __future__ import annotations
+
+import argparse
+import datetime as _dt
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+LOG = REPO / "benchmarks" / "results" / "prober_r04.log"
+
+PROBE_SRC = (
+    "import jax; d = jax.devices(); "
+    "print('PLATFORM=' + d[0].platform + ' N=' + str(len(d)))"
+)
+
+
+def _log(line: str) -> None:
+    stamp = _dt.datetime.now().strftime("%Y-%m-%d %H:%M:%S")
+    LOG.parent.mkdir(parents=True, exist_ok=True)
+    with LOG.open("a") as f:
+        f.write(f"[{stamp}] {line}\n")
+    print(f"[{stamp}] {line}", flush=True)
+
+
+def probe_once(timeout: float) -> tuple[bool, str]:
+    """One killable backend-init attempt. True only for a real TPU."""
+    env = dict(os.environ)
+    # Make sure the probe actually attempts the axon backend (a stray
+    # JAX_PLATFORMS=cpu from a test environment would always "succeed").
+    env.pop("JAX_PLATFORMS", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", PROBE_SRC],
+            capture_output=True, text=True, timeout=timeout, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"timeout after {int(timeout)}s (tunnel down: init hang)"
+    except OSError as e:
+        return False, f"spawn failed: {e}"
+    out = (proc.stdout or "").strip().splitlines()
+    marker = next((l for l in out if l.startswith("PLATFORM=")), "")
+    if proc.returncode == 0 and marker and "cpu" not in marker.lower():
+        return True, marker
+    tail = "; ".join((proc.stderr or "").strip().splitlines()[-2:])[-300:]
+    return False, f"rc={proc.returncode} {marker or tail}"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hours", type=float, default=11.0)
+    ap.add_argument("--interval", type=float, default=150.0,
+                    help="sleep between failed probes (seconds)")
+    ap.add_argument("--probe-timeout", type=float, default=90.0)
+    ap.add_argument("--no-campaign", action="store_true",
+                    help="log the live probe and exit without running "
+                         "hw_campaign.sh (monitoring mode)")
+    args = ap.parse_args()
+
+    deadline = time.time() + args.hours * 3600.0
+    _log(f"prober start: deadline in {args.hours:.1f}h, "
+         f"interval {args.interval:.0f}s, probe timeout "
+         f"{args.probe_timeout:.0f}s")
+    attempt = 0
+    while time.time() < deadline:
+        attempt += 1
+        t0 = time.time()
+        ok, note = probe_once(args.probe_timeout)
+        _log(f"probe[{attempt}] {'LIVE' if ok else 'down'}: {note} "
+             f"({time.time() - t0:.0f}s)")
+        if ok:
+            if args.no_campaign:
+                return 0
+            _log("tunnel LIVE -> launching hw_campaign.sh --short")
+            camp_env = dict(os.environ)
+            # The campaign must run on the TPU the probe just saw — a
+            # stray JAX_PLATFORMS=cpu (stripped for the probe above)
+            # would silently benchmark CPU while the log claims LIVE.
+            camp_env.pop("JAX_PLATFORMS", None)
+            rc = subprocess.call(
+                ["bash", str(REPO / "benchmarks" / "hw_campaign.sh"),
+                 "--short"],
+                cwd=REPO, env=camp_env,
+                stdout=(LOG.parent / "campaign_r04.log").open("a"),
+                stderr=subprocess.STDOUT,
+            )
+            _log(f"hw_campaign.sh --short finished rc={rc} "
+                 f"(rows in benchmarks/csv; full log in "
+                 f"results/campaign_r04.log)")
+            return 0 if rc == 0 else 2
+        time.sleep(max(0.0, args.interval - (time.time() - t0)))
+    _log(f"prober deadline reached after {attempt} attempts; tunnel never "
+         f"came up")
+    return 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())
