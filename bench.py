@@ -440,9 +440,11 @@ def main() -> None:
         attempt += 1
         if time.time() - started < insurance_cap * 0.5:
             # Fast failure: back off, but never sleep away the last
-            # viable attempt window.
+            # viable attempt window (after the first failure the loop
+            # also demands the fallback reserve, so preserve both).
             time.sleep(min(backoff, max(
-                0.0, deadline - time.time() - min_attempt_window)))
+                0.0, deadline - time.time()
+                - min_attempt_window - fallback_reserve)))
             backoff = min(backoff * 2, 120.0)
 
     # Phase B — upgrade in place: the flagship 512^3 with the full

@@ -881,7 +881,8 @@ def plan_dd_dft_r2c_3d(
     ``fft3d_r2c`` double gate on f32/bf16 hardware. ``shape`` is the
     real-space world; forward takes real float32 dd pairs and returns
     half-spectrum complex dd pairs (last axis ``N2//2+1``), backward
-    inverts with numpy 1/N scaling. Single-device or 1D slab mesh."""
+    inverts with numpy 1/N scaling. Single-device, 1D slab mesh, or 2D
+    pencil mesh (the latter via ``build_dd_pencil_rfft3d``)."""
     from .ops import ddfft
 
     shape, forward = _check_direction(shape, direction)

@@ -110,8 +110,12 @@ def _dd_depth() -> tuple[int, int, int]:
 # ------------------------------------------------------------ dd helpers
 
 def dd_from_host(x) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Exact split of a host float64/complex128 array into (hi, lo)
-    float32/complex64 device arrays with x == hi + lo (in f64)."""
+    """Two-float split of a host float64/complex128 array into (hi, lo)
+    float32/complex64 device arrays. The split is not exact: the f64
+    residual ``x - f64(hi)`` can need up to 29 significand bits, so
+    ``lo`` itself rounds — the pair carries ~49 significand bits
+    (relative residual ~2^-49; see the module docstring and
+    ``test_dd_host_roundtrip_exact``'s 1e-13 bound)."""
     x = np.asarray(x)
     if np.iscomplexobj(x):
         hi = x.astype(np.complex64)

@@ -437,6 +437,7 @@ def _a2av_reshape(
     axis_names: tuple[str, ...],
     t: _A2AVTables,
     out_pad: tuple[int, int, int],
+    platform: str,
 ) -> jnp.ndarray:
     """The exact-count reshape of one local brick (inside shard_map).
     The big per-device index maps arrive as SHARDED OPERANDS (one row
@@ -445,16 +446,15 @@ def _a2av_reshape(
     ragged op (XLA:CPU, unless force_real_lowering), an all_gather
     emulation with the *same tables* stands in — so the CPU tests
     exercise every index map, and only the collective itself differs on
-    hardware."""
-    import jax as _jax
-
+    hardware. ``platform`` is the mesh devices' platform, resolved at
+    plan time (a CPU-device mesh under a non-CPU default backend must
+    still take the emulation path)."""
     from ..utils.compat import force_real_lowering
 
     i = lax.axis_index(axis_names)
     rcap = max(t.recv_cap, 1)
     sendbuf = x.reshape(-1)[pack_row[0]]  # [send_cap]
 
-    platform = _jax.default_backend()
     if platform == "cpu" and not force_real_lowering():
         # Emulation: gather every sender's buffer, then assemble my
         # receive buffer from the same offset tables via one gather.
@@ -491,10 +491,12 @@ def _a2av_mapped(
     unpack_tbl = jnp.asarray(tables.unpack_idx)
     gidx_tbl = jnp.asarray(_a2av_gather_idx(tables, p))
     row = P(names, None)
+    platform = mesh.devices.flat[0].platform
 
     def _local(x, prow, urow, grow):
         v = x[0] if squeeze_in else x
-        y = _a2av_reshape(v, prow, urow, grow, names, tables, out_pad)
+        y = _a2av_reshape(v, prow, urow, grow, names, tables, out_pad,
+                          platform)
         return y[None] if expand_out else y
 
     mapped = _shard_map(

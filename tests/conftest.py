@@ -23,3 +23,33 @@ import jax
 # before any backend is initialized.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+# Persistent compilation cache: the dd tier's programs are hundreds of
+# matmuls and dominate suite wall time on a small box; repeat runs (the
+# driver's test gate, local iteration) hit the cache and skip those
+# compiles entirely. Cold runs are unaffected.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get("DFFT_TEST_CACHE", "/tmp/dfft_jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
+import pytest  # noqa: E402
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False,
+        help="also run tests marked slow (big-compile duplicates and "
+             "deep parameterizations)")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow"):
+        return
+    if any("::" in a for a in config.invocation_params.args):
+        return  # an explicitly-named node ID always runs
+    skip = pytest.mark.skip(reason="slow tier: pass --runslow")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

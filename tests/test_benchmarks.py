@@ -39,23 +39,32 @@ def test_speed3d_staged(capsys):
     assert "t0_fft_yz" in out and "t2_all_to_all" in out and "t3_fft_x" in out
 
 
+@pytest.mark.slow
 def test_speed3d_staged_pencil(capsys):
+    # Slow tier: the pencil staged builder is covered directly in
+    # test_staged.py; the CLI -staged glue by test_speed3d_staged.
     speed3d.main(["c2c", "double", "16", "16", "16",
                   "-ndev", "8", "-pencils", "-staged", "-iters", "1"])
     out = capsys.readouterr().out
     assert "t2a_exchange_col" in out and "t2b_exchange_row" in out
 
 
+@pytest.mark.slow
 def test_speed3d_staged_r2c(capsys):
+    # Slow tier: the r2c staged builder is covered directly in
+    # test_staged.py; the CLI -staged glue by test_speed3d_staged.
     speed3d.main(["r2c", "double", "16", "16", "16",
                   "-ndev", "8", "-slabs", "-staged", "-iters", "1"])
     out = capsys.readouterr().out
     assert "t0_r2c_zy" in out and "t2_exchange" in out and "t3_fft_x" in out
 
 
+@pytest.mark.slow
 def test_speed3d_dd_tier(capsys, tmp_path):
     """The dd precision tier through the speed3d CLI: slab mesh, result
-    block with a double-tier roundtrip error, CSV row."""
+    block with a double-tier roundtrip error, CSV row. Slow tier: the
+    CLI glue is thin over plan_dd_dft_c2c_3d (whose surfaces the default
+    gate executes) and the dd compile dominates suite wall time."""
     csv = str(tmp_path / "dd.csv")
     speed3d.main(["c2c", "dd", "16", "16", "16",
                   "-ndev", "4", "-iters", "1", "-csv", csv])

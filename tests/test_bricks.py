@@ -203,7 +203,11 @@ def test_brick_plan_info_accounting():
     assert "payload" in info and "wire" in info
 
 
-@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+@pytest.mark.parametrize(
+    "seed",
+    [0, pytest.param(1, marks=pytest.mark.slow),
+     pytest.param(2, marks=pytest.mark.slow),
+     pytest.param(3, marks=pytest.mark.slow)])
 def test_random_partition_fuzz(seed):
     """Property test: ANY pair of random non-grid box partitions round-trips
     exactly through the overlap-map ring (heFFTe's shuffled-boxes testing

@@ -127,12 +127,14 @@ def test_dd_middle_axis():
 def test_dd_slab_distributed_tier():
     """The dd engine distributed over the virtual 8-device mesh: forward
     vs numpy f64 fftn and the full roundtrip, both inside the 1e-11 tier
-    — the reference's distributed-f64 capability on TPU collectives."""
+    — the reference's distributed-f64 capability on TPU collectives.
+    Smallest proving extents (compile time dominates on a 1-core box);
+    uneven extents are covered by the r2c slab and pencil c2c cases."""
     import distributedfft_tpu as dfft
     from distributedfft_tpu.parallel.ddslab import build_dd_slab_fft3d
 
     mesh = dfft.make_mesh(8)
-    shape = (32, 24, 16)
+    shape = (16, 8, 8)
     x = _rand_c128(shape, seed=23)
     hi, lo = ddfft.dd_from_host(x)
     fwd, spec = build_dd_slab_fft3d(mesh, shape, forward=True)
@@ -149,9 +151,12 @@ def test_dd_slab_distributed_tier():
     assert rerr < 1e-11, rerr
 
 
+@pytest.mark.slow
 def test_dd_slab_uneven_extent():
     """Ceil-pad/crop discipline at the dd tier: a split-axis extent not
-    divisible by the mesh (zero rows are exact in dd arithmetic)."""
+    divisible by the mesh (zero rows are exact in dd arithmetic). Slow
+    tier: the default gate proves dd unevenness via the r2c slab and
+    pencil c2c cases; this adds the c2c-slab corner."""
     import distributedfft_tpu as dfft
     from distributedfft_tpu.parallel.ddslab import build_dd_slab_fft3d
 
@@ -236,7 +241,7 @@ def test_dd_r2c_tier():
     """dd r2c/c2r: half-spectrum forward vs numpy f64 rfftn and the real
     roundtrip, inside the tier (even and odd last extents)."""
     rng = np.random.default_rng(59)
-    for shape in ((16, 12, 24), (8, 12, 15)):
+    for shape in ((8, 6, 10), (4, 6, 9)):
         x = rng.standard_normal(shape)
         hi, lo = ddfft.dd_from_host(x)
         yh, yl = ddfft.rfftn_dd(hi, lo)
@@ -277,11 +282,14 @@ def test_dd_slab_r2c_distributed_tier():
 
 
 def test_dd_plan_api():
-    """The dd tier through the standard plan surface: single-device and
-    slab-mesh plans, host conversion helpers exported at package top."""
+    """The dd tier through the standard plan surface: the single-device
+    plan executes (jitted, smallest proving size); mesh plans are
+    constructed and checked for metadata — their execution is covered by
+    the dedicated distributed-tier cases (the facade calls the same
+    builders)."""
     import distributedfft_tpu as dfft
 
-    shape = (16, 16, 16)
+    shape = (8, 8, 8)
     x = _rand_c128(shape, seed=47)
     hi, lo = dfft.dd_from_host(x)
 
@@ -293,15 +301,59 @@ def test_dd_plan_api():
     mesh = dfft.make_mesh(8)
     pf = dfft.plan_dd_dft_c2c_3d(shape, mesh)
     pb = dfft.plan_dd_dft_c2c_3d(shape, mesh, direction=dfft.BACKWARD)
+    assert pf.decomposition == "slab" and pf.in_sharding is not None
+    assert pb.decomposition == "slab" and not pb.forward
+
+
+@pytest.mark.slow
+def test_dd_plan_api_slab_roundtrip():
+    """Full slab roundtrip through the plan facade (slow tier: the
+    default gate proves the same programs via build_dd_slab_fft3d)."""
+    import distributedfft_tpu as dfft
+
+    shape = (16, 16, 16)
+    x = _rand_c128(shape, seed=47)
+    hi, lo = dfft.dd_from_host(x)
+    mesh = dfft.make_mesh(8)
+    pf = dfft.plan_dd_dft_c2c_3d(shape, mesh)
+    pb = dfft.plan_dd_dft_c2c_3d(shape, mesh, direction=dfft.BACKWARD)
     bh, bl = pb(*pf(hi, lo))
     back = dfft.dd_to_host(bh, bl)
     assert np.max(np.abs(back - x)) / np.max(np.abs(x)) < 1e-11
-    assert pf.decomposition == "slab" and pf.in_sharding is not None
 
 
 def test_dd_r2c_plan_api():
-    """dd r2c/c2r through the plan surface: single-device, slab, and
-    pencil meshes."""
+    """dd r2c/c2r through the plan surface: the single-device pair
+    executes (jitted roundtrip at the tier); slab and pencil plans are
+    constructed and checked for metadata — their execution is covered by
+    the dedicated distributed r2c cases."""
+    import distributedfft_tpu as dfft
+
+    shape = (8, 8, 8)
+    rng = np.random.default_rng(67)
+    x = rng.standard_normal(shape)
+    hi, lo = dfft.dd_from_host(x)
+
+    pf = dfft.plan_dd_dft_r2c_3d(shape)
+    pb = dfft.plan_dd_dft_c2r_3d(shape)
+    yh, yl = pf(hi, lo)
+    assert yh.shape == (8, 8, 5)
+    bh, bl = pb(yh, yl)
+    back = dfft.dd_to_host(bh, bl)
+    assert np.max(np.abs(back - x)) / np.max(np.abs(x)) < 1e-11
+
+    for mesh in (dfft.make_mesh(8), dfft.make_mesh((2, 4))):
+        mf = dfft.plan_dd_dft_r2c_3d(shape, mesh)
+        mb = dfft.plan_dd_dft_c2r_3d(shape, mesh)
+        assert mf.in_sharding is not None and mb.in_sharding is not None
+        assert mf.decomposition in ("slab", "pencil")
+
+
+@pytest.mark.slow
+def test_dd_r2c_plan_api_full_matrix():
+    """Executing r2c/c2r roundtrips through the plan facade on every
+    decomposition (slow tier: the default gate executes each surface
+    once via the dedicated distributed cases)."""
     import distributedfft_tpu as dfft
 
     shape = (16, 16, 16)
@@ -355,6 +407,12 @@ def test_dd_pencil_r2c_uneven_tier():
     want = np.fft.rfftn(x)
     assert yh.shape == want.shape
     assert ddfft.max_err_vs_f64(yh, yl, want) < 1e-12
+
+    # c2r back through the facade: the default gate's pencil-c2r proof.
+    pb = dfft.plan_dd_dft_c2r_3d(shape, mesh)
+    bh, bl = pb(yh, yl)
+    back = dfft.dd_to_host(bh, bl)
+    assert np.max(np.abs(back - x)) / np.max(np.abs(x)) < 1e-11
 
 
 def test_dd_plan_info():
