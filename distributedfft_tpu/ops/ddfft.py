@@ -207,30 +207,47 @@ def _dd_cmul(xh, xl, th, tl):
 _PLAIN_SUM_KEY = 4
 
 
-def _dd_accumulate_parts(parts):
-    """Compensated sum of (order_key, thunk) partial products into a
-    (hi, lo) pair. Thunks keep at most one partial live at a time
-    outside jit — at campaign sizes the eager alternative (materialize
-    ~68 full-array partials, then sum) peaks at multiple GB. Terms are
-    consumed largest-magnitude first; deep diagonals (key >=
-    ``_PLAIN_SUM_KEY``) fold into one plain-f32 term. Error ~2^-48
-    relative."""
+def _dd_accumulate_quad(parts):
+    """Compensated accumulation of the Cr and Ci chains together from
+    (order_key, thunk) parts, where each thunk yields the four quadrant
+    terms of one stacked slice-product (see :func:`_quad_term`): two
+    terms for the Cr chain and two for the Ci chain, consumed in key
+    order exactly as the per-contraction chains did. Driving both
+    chains from one pass keeps at most ONE stacked product live at a
+    time outside jit — at campaign sizes materializing the ~34 products
+    up front peaks at multiple GB. Terms are consumed largest-magnitude
+    first; deep diagonals (key >= ``_PLAIN_SUM_KEY``) fold into one
+    plain-f32 term per chain. Error ~2^-48 relative per chain."""
     big = [t for k, t in parts if k < _PLAIN_SUM_KEY]
     small = [t for k, t in parts if k >= _PLAIN_SUM_KEY]
     if not big:  # degenerate depth settings: everything is "small"
         big, small = small[:1], small[1:]
-    hi = big[0]()
-    lo = jnp.zeros_like(hi)
+    cr_a, cr_b, ci_a, ci_b = big[0]()
+    cr_hi, cr_lo = _two_sum(cr_a, cr_b)
+    ci_hi, ci_lo = _two_sum(ci_a, ci_b)
     for t in big[1:]:
-        hi, e = _two_sum(hi, t())
-        lo = lo + e
+        cr_a, cr_b, ci_a, ci_b = t()
+        cr_hi, e = _two_sum(cr_hi, cr_a)
+        cr_lo = cr_lo + e
+        cr_hi, e = _two_sum(cr_hi, cr_b)
+        cr_lo = cr_lo + e
+        ci_hi, e = _two_sum(ci_hi, ci_a)
+        ci_lo = ci_lo + e
+        ci_hi, e = _two_sum(ci_hi, ci_b)
+        ci_lo = ci_lo + e
     if small:
-        tail = small[0]()
+        cr_a, cr_b, ci_a, ci_b = small[0]()
+        cr_t = cr_a + cr_b
+        ci_t = ci_a + ci_b
         for t in small[1:]:
-            tail = tail + t()
-        hi, e = _two_sum(hi, tail)
-        lo = lo + e
-    return _two_sum(hi, lo)
+            cr_a, cr_b, ci_a, ci_b = t()
+            cr_t = cr_t + cr_a + cr_b
+            ci_t = ci_t + ci_a + ci_b
+        cr_hi, e = _two_sum(cr_hi, cr_t)
+        cr_lo = cr_lo + e
+        ci_hi, e = _two_sum(ci_hi, ci_t)
+        ci_lo = ci_lo + e
+    return _two_sum(cr_hi, cr_lo), _two_sum(ci_hi, ci_lo)
 
 
 # ------------------------------------------------------- slicing engine
@@ -299,52 +316,36 @@ def _w_slices_np(n: int, forward: bool, normalize: bool):
     return tuple(outs[0]), tuple(outs[1]), k
 
 
-def _sliced_mm(a_slices, w_sl, common_e, subtract=False):
-    """Exact-sliced real contraction: lazy partial products of (hi, lo)
-    row slices against the pre-sliced W, every matmul in bf16 with f32
-    accumulation. ``a_slices`` is the shared slicing of one operand (see
-    :func:`_operand_slices`). Returns (order_key, thunk) pairs, negated
-    when ``subtract`` (for the complex cross terms).
+def _stacked_dot(xs, ws):
+    """One bf16 MXU product of a row-stacked operand slice against a
+    column-stacked W slice: [2R, n] @ [n, 2n] -> f32 [2R, 2n]. Rows are
+    the re operand over the im operand; columns are Wr beside Wi — four
+    independent real contractions in ONE matmul (rows and columns never
+    mix under contraction, so every partial stays sliced-exact). This
+    quarters the dot count of the old per-contraction layout (136 -> 34
+    per axis) and feeds the MXU 4x-larger tiles."""
+    return lax.dot_general(
+        xs.astype(jnp.bfloat16), ws.astype(jnp.bfloat16),
+        (((1,), (0,)), ((), ())),
+        precision=lax.Precision.DEFAULT,
+        preferred_element_type=jnp.float32,
+    )
 
-    Partials stay in the NORMALIZED domain: each term carries only the
-    exact power-of-two factor 2^(e_operand - common_e) <= 1 relative to
-    the contraction's common row exponent, and the caller applies
-    2^common_e once after accumulation. Scaling each term by its full
-    2^e instead underflows the far diagonals for small-magnitude rows
-    (measured: 7e-9 error at |x| ~ 1e-30, where terms near
-    2^-100 * 2^-49 flush to zero) — relative factors keep every term
-    that matters above the f32 floor."""
-    hi_sl, e_hi, lo_sl, e_lo = a_slices
 
-    def bmm(xs, ws):
-        return lax.dot_general(
-            xs.astype(jnp.bfloat16), ws.astype(jnp.bfloat16),
-            (((xs.ndim - 1,), (0,)), ((), ())),
-            precision=lax.Precision.DEFAULT,
-            preferred_element_type=jnp.float32,
-        )
-
-    sgn = jnp.float32(-1.0 if subtract else 1.0)
-    f_hi = jnp.ldexp(sgn, e_hi - common_e)
-    f_lo = jnp.ldexp(sgn, e_lo - common_e)
-    _, cut_hi, cut_lo = _dd_depth()
-
-    def term(xs, ws, f):
-        # functools.partial (not a closure) so each thunk binds its own
-        # slice pair instead of the loop variables.
-        return functools.partial(lambda x, w, s: bmm(x, w) * s, xs, ws, f)
-
-    parts = []  # (order_key, thunk)
-    for i, xs in enumerate(hi_sl):
-        for j, ws in enumerate(w_sl):
-            if i + j <= cut_hi:
-                parts.append((i + j, term(xs, ws, f_hi)))
-    for i, xs in enumerate(lo_sl):
-        for j, ws in enumerate(w_sl):
-            if i + j <= cut_lo:
-                # lo sits ~24 bits below hi: order after the hi diagonals.
-                parts.append((i + j + 24 // _B, term(xs, ws, f_lo)))
-    return parts
+def _quad_term(xs, ws, fr, fi, r, n):
+    """The four chain terms of one stacked slice-product: Cr gets
+    (+Ar@Wr * fr, -Ai@Wi * fi), Ci gets (+Ar@Wi * fr, +Ai@Wr * fi).
+    The scales are exact powers of two (negation included), applied in
+    the NORMALIZED domain: each term carries only 2^(e_operand -
+    common_e) <= 1 relative to the contraction's common row exponent,
+    and the caller applies 2^common_e once after accumulation. Scaling
+    each term by its full 2^e instead underflows the far diagonals for
+    small-magnitude rows (measured: 7e-9 error at |x| ~ 1e-30, where
+    terms near 2^-100 * 2^-49 flush to zero) — relative factors keep
+    every term that matters above the f32 floor."""
+    d = _stacked_dot(xs, ws)
+    return (d[:r, :n] * fr, d[r:, n:] * (-fi),
+            d[:r, n:] * fr, d[r:, :n] * fi)
 
 
 def _operand_slices(a_hi, a_lo):
@@ -361,13 +362,15 @@ def _operand_slices(a_hi, a_lo):
 
 def _dd_dft_last(re_hi, re_lo, im_hi, im_lo, n: int, forward: bool,
                  normalize: bool):
-    """dd complex DFT along the last axis via 4 exact-sliced real
-    contractions, recombined with compensated adds in the normalized
-    domain, row scales (and the inverse's exact power-of-two remainder)
-    applied once at the end."""
+    """dd complex DFT along the last axis: the four real contractions
+    Cr = Ar@Wr - Ai@Wi, Ci = Ar@Wi + Ai@Wr run as ONE stacked matmul
+    per kept slice pair ([re;im] rows x [Wr|Wi] columns — see
+    :func:`_stacked_dot`), recombined with compensated adds in the
+    normalized domain, row scales (and the inverse's exact power-of-two
+    remainder) applied once at the end."""
     wr_sl, wi_sl, k = _w_slices_np(n, forward, normalize)
-    wr = [jnp.asarray(m) for m in wr_sl]
-    wi = [jnp.asarray(m) for m in wi_sl]
+    w_st = [jnp.asarray(np.concatenate((r, i), axis=1))
+            for r, i in zip(wr_sl, wi_sl)]
     re_slices = _operand_slices(re_hi, re_lo)
     im_slices = _operand_slices(im_hi, im_lo)
     # One common row exponent for everything feeding an output (re and
@@ -376,17 +379,41 @@ def _dd_dft_last(re_hi, re_lo, im_hi, im_lo, n: int, forward: bool,
     # combined with the inverse's power-of-two remainder k.
     common_e = jnp.maximum(re_slices[1], im_slices[1])
 
-    # Cr = Ar@Wr - Ai@Wi ; Ci = Ar@Wi + Ai@Wr
-    cr_parts = (_sliced_mm(re_slices, wr, common_e)
-                + _sliced_mm(im_slices, wi, common_e, subtract=True))
-    ci_parts = (_sliced_mm(re_slices, wi, common_e)
-                + _sliced_mm(im_slices, wr, common_e))
-    cr_parts.sort(key=lambda kv: kv[0])
-    ci_parts.sort(key=lambda kv: kv[0])
-    cr_hi, cr_lo = _dd_accumulate_parts(cr_parts)
-    ci_hi, ci_lo = _dd_accumulate_parts(ci_parts)
+    lead = re_hi.shape[:-1]
+    r = math.prod(lead) if lead else 1
+
+    def flat(a):
+        return a.reshape(r, n)
+
+    def fcol(e):  # [R, 1] exact power-of-two scale column
+        return jnp.ldexp(jnp.float32(1.0), e - common_e).reshape(r, 1)
+
+    hi_st = [jnp.concatenate((flat(a), flat(b)), axis=0)
+             for a, b in zip(re_slices[0], im_slices[0])]
+    lo_st = [jnp.concatenate((flat(a), flat(b)), axis=0)
+             for a, b in zip(re_slices[2], im_slices[2])]
+    fr_hi, fi_hi = fcol(re_slices[1]), fcol(im_slices[1])
+    fr_lo, fi_lo = fcol(re_slices[3]), fcol(im_slices[3])
+    _, cut_hi, cut_lo = _dd_depth()
+
+    parts = []  # (order_key, thunk -> 4 quadrant terms)
+    for i, xs in enumerate(hi_st):
+        for j, ws in enumerate(w_st):
+            if i + j <= cut_hi:
+                parts.append((i + j, functools.partial(
+                    _quad_term, xs, ws, fr_hi, fi_hi, r, n)))
+    for i, xs in enumerate(lo_st):
+        for j, ws in enumerate(w_st):
+            if i + j <= cut_lo:
+                # lo sits ~24 bits below hi: order after the hi diagonals.
+                parts.append((i + j + 24 // _B, functools.partial(
+                    _quad_term, xs, ws, fr_lo, fi_lo, r, n)))
+    parts.sort(key=lambda kv: kv[0])
+    (cr_hi, cr_lo), (ci_hi, ci_lo) = _dd_accumulate_quad(parts)
     back = jnp.ldexp(jnp.float32(1.0), common_e - k)
-    return (cr_hi * back, cr_lo * back, ci_hi * back, ci_lo * back)
+    out_shape = lead + (n,)
+    return tuple(v.reshape(out_shape) * s for v, s in (
+        (cr_hi, back), (cr_lo, back), (ci_hi, back), (ci_lo, back)))
 
 
 # ----------------------------------------------------- four-step (n > 512)
@@ -422,20 +449,28 @@ def _dd_four_step_last(hi, lo, n: int, forward: bool):
     The twiddle path's Dekker splits compute ``4097 * a``, which
     overflows f32 above ~8e34 — and the unnormalized stage-1 output
     grows to n1 x the input. The DFT is linear, so the whole pass runs
-    on an exactly 2^-e down-scaled copy (global exponent of the stage-1
-    output) and the scale is restored once at the end."""
+    on an exactly 2^-e down-scaled copy and the scale is restored once
+    at the end. The exponent comes from a static bound on the INPUT —
+    |stage-1 out| <= n1 * max|in|, so e = exp(max|in|) + ceil(log2 n1)
+    — rather than a max over the stage-1 output: the input reduction has
+    no dependency on stage 1, so XLA can overlap it with the stage-1
+    matmuls instead of serializing a full-array reduction between the
+    stages (the plan-time-resolution discipline of the reference's
+    launch parameters, ``templateFFT.cpp:6212-6260``)."""
     n1, n2 = _dd_split(n)
     shp = hi.shape
+    # Overflow bound off the critical path: computed on the input,
+    # before stage 1. The extra log2(n1) headroom (vs the old measured
+    # stage-1 max) costs <= 9 bits of down-scale; scaled lo components
+    # sit ~2^-60 at worst — far above the f32 subnormal floor.
+    mu = jnp.max(jnp.abs(jnp.real(hi))) + jnp.max(jnp.abs(jnp.imag(hi)))
+    _, e = jnp.frexp(jnp.where(mu == 0, 1.0, mu))
+    e = jnp.clip(e + int(math.ceil(math.log2(n1))), -126, 127)
+    down = jnp.ldexp(jnp.float32(1.0), -e)
     hi = hi.reshape(shp[:-1] + (n1, n2))
     lo = lo.reshape(shp[:-1] + (n1, n2))
     # DFT_n1 over j1 (axis -2) -> [..., k1, j2].
     hi, lo = fft_axis_dd(hi, lo, axis=-2, forward=forward)
-    # Exact global down-scale so the Dekker splits inside _dd_cmul stay
-    # far from the f32 ceiling (restored after stage 2 — linearity).
-    mu = jnp.max(jnp.abs(jnp.real(hi))) + jnp.max(jnp.abs(jnp.imag(hi)))
-    _, e = jnp.frexp(jnp.where(mu == 0, 1.0, mu))
-    e = jnp.clip(e, -126, 127)
-    down = jnp.ldexp(jnp.float32(1.0), -e)
     hi, lo = hi * down, lo * down
     th, tl = _dd_twiddle_np(n, n1, n2, forward)
     hi, lo = _dd_cmul(hi, lo, jnp.asarray(th), jnp.asarray(tl))
