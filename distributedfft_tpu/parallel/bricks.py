@@ -107,6 +107,10 @@ class BrickSpec:
     # a2av plans skip the ring's step construction entirely; their payload
     # comes straight from the exact tables.
     payload_override: int | None = None
+    # Per-device bytes of the a2av RLE index-map operands (None for the
+    # ring): O(overlap cross-section), reported by plan_info so campaign
+    # configs can see the footprint stays sublinear in brick volume.
+    a2av_table_bytes: int | None = None
 
     @property
     def payload_elems(self) -> int:
@@ -333,21 +337,65 @@ class _A2AVTables:
     """Plan-time tables of the exact-count brick transport (all numpy).
 
     SPMD programs need uniform static shapes, so per-device geometry
-    travels as *data*: each device gets its own row of gather/scatter
-    index maps (pack: padded-brick flat index per send-buffer slot;
-    unpack: padded-out-brick flat index per receive-buffer slot, with an
-    out-of-range sentinel on the padding slots that ``mode='drop'``
-    discards) plus its offset/size rows for ``lax.ragged_all_to_all``.
-    Only the true run sizes cross the wire — the heFFTe ``alltoallv``
-    exact-count discipline (``src/heffte_reshape3d.cpp:375``)."""
+    travels as *data*: each device gets its own rows of RUN-LENGTH
+    encoded gather/scatter maps plus its offset/size rows for
+    ``lax.ragged_all_to_all``. An overlap box decomposes into
+    constant-stride z-runs (one per (x, y) cross-section point), so the
+    shipped tables are O(volume / nz) — cross-section, not volume — and
+    the element index maps are expanded on device by
+    :func:`_expand_runs` (a searchsorted over the run ends). Each run r
+    is (``*_start[r]``: flat index of its first element;
+    ``*_end[r]``: cumulative element count through r). Only the true
+    run sizes cross the wire — the heFFTe ``alltoallv`` exact-count
+    discipline (``src/heffte_reshape3d.cpp:375``, whose O(P)
+    count/offset tables this generalizes to arbitrary boxes)."""
 
-    pack_idx: np.ndarray    # [P, send_cap] int32
-    unpack_idx: np.ndarray  # [P, recv_cap] int32 (sentinel = prod(out_pad))
-    send_off: np.ndarray    # [P, P] int32: run start in sender i's buffer
-    sizes: np.ndarray       # [P, P] int64: elements i -> d
-    out_off: np.ndarray     # [P, P] int32: landing offset of i's run at d
+    pack_start: np.ndarray    # [P, Rs] int32: send z-run flat starts
+    pack_end: np.ndarray      # [P, Rs] int32: cumulative send elements
+    unpack_start: np.ndarray  # [P, Ru] int32: recv z-run flat starts
+    unpack_end: np.ndarray    # [P, Ru] int32: cumulative recv elements
+    # CPU-emulation gather runs, one per (sender, dest) pair: kept as
+    # (sender row, start offset within that row) int32 pairs so indexing
+    # the 2D all_gathered buffer never needs a flat index past int32
+    # (jnp would silently downcast an int64 table with x64 off).
+    gather_row: np.ndarray    # [P, Rg] int32: sender index per run
+    gather_off: np.ndarray    # [P, Rg] int32: start within sender's buffer
+    gather_end: np.ndarray    # [P, Rg] int32: cumulative elements
+    send_off: np.ndarray      # [P, P] int32: run start in sender i's buffer
+    sizes: np.ndarray         # [P, P] int64: elements i -> d
+    out_off: np.ndarray       # [P, P] int32: landing offset of i's run at d
     send_cap: int
     recv_cap: int
+
+    @property
+    def table_bytes_per_device(self) -> int:
+        """Bytes of index-map operands each device ships on the ragged
+        (hardware) path — the footprint ``plan_info`` reports; sublinear
+        in brick volume for grid-run boxes, scaling with the overlap
+        cross-sections. The CPU emulation adds its three [Rg] int32
+        gather rows (Rg <= P), not counted here."""
+        p = self.sizes.shape[0]
+        return int(self.pack_start.shape[1] * 8     # start+end int32
+                   + self.unpack_start.shape[1] * 8
+                   + 4 * p * 4)                     # off/size int32 rows
+
+
+def _pack_runs(rows: list[list[tuple[int, int]]], dtype=np.int32):
+    """[(flat_start, length), ...] per device -> padded (start, end)
+    arrays. ``end`` is the cumulative element count (monotone; padding
+    repeats the last end so searchsorted never lands on a pad run)."""
+    p = len(rows)
+    rcap = max(1, max((len(r) for r in rows), default=1))
+    start = np.zeros((p, rcap), dtype)
+    end = np.zeros((p, rcap), dtype)
+    for i, runs in enumerate(rows):
+        c = 0
+        for r, (s, ln) in enumerate(runs):
+            start[i, r] = s
+            c += ln
+            end[i, r] = c
+        end[i, len(runs):] = c
+    return start, end
 
 
 def _a2av_tables(
@@ -356,25 +404,14 @@ def _a2av_tables(
 ) -> _A2AVTables:
     p = len(in_boxes)
     sizes = np.zeros((p, p), np.int64)
-    runs: dict[tuple[int, int], tuple[np.ndarray, np.ndarray]] = {}
+    overlaps: dict[tuple[int, int], Box3] = {}
     for i in range(p):
         for d in range(p):
             o = in_boxes[i].intersect(out_boxes[d])
             if o.empty:
                 continue
             sizes[i, d] = o.size
-            # C-order traversal of the overlap box on BOTH sides: the
-            # sender's flat-source indices and the receiver's flat-dest
-            # indices line up element for element.
-            def flat(low_ref, pad):
-                g = np.mgrid[tuple(
-                    slice(lo - rl, hi - rl)
-                    for lo, hi, rl in zip(o.low, o.high, low_ref))]
-                return np.ravel_multi_index(
-                    [g[k].ravel() for k in range(3)], pad).astype(np.int32)
-
-            runs[(i, d)] = (flat(in_boxes[i].low, in_pad),
-                            flat(out_boxes[d].low, out_pad))
+            overlaps[(i, d)] = o
     send_tot = sizes.sum(axis=1)
     recv_tot = sizes.sum(axis=0)
     send_cap = int(send_tot.max()) if p else 0
@@ -387,25 +424,48 @@ def _a2av_tables(
     for d in range(p):
         out_off[:, d] = np.concatenate(
             ([0], np.cumsum(sizes[:, d])[:-1])).astype(np.int32)
-    pack_idx = np.zeros((p, max(send_cap, 1)), np.int32)
-    sentinel = int(np.prod(out_pad))
-    unpack_idx = np.full((p, max(recv_cap, 1)), sentinel, np.int32)
+
+    def z_runs(o: Box3, low_ref, pad) -> list[tuple[int, int]]:
+        # C-order z-runs of the overlap box relative to a padded brick:
+        # one run per (x, y) point, all of length nz, consecutive in
+        # exactly the element order the old per-element maps used.
+        nz = o.high[2] - o.low[2]
+        xs = np.arange(o.low[0] - low_ref[0], o.high[0] - low_ref[0])
+        ys = np.arange(o.low[1] - low_ref[1], o.high[1] - low_ref[1])
+        base = (xs[:, None] * (pad[1] * pad[2])
+                + ys[None, :] * pad[2]
+                + (o.low[2] - low_ref[2])).ravel()
+        return [(int(b), nz) for b in base]
+
+    pack_rows: list[list[tuple[int, int]]] = [[] for _ in range(p)]
+    unpack_rows: list[list[tuple[int, int]]] = [[] for _ in range(p)]
+    gather_rows: list[list[tuple[int, int]]] = [[] for _ in range(p)]
     for i in range(p):
         for d in range(p):
-            if not sizes[i, d]:
+            if (i, d) not in overlaps:
                 continue
-            src_idx, _ = runs[(i, d)]
-            s0 = send_off[i, d]
-            pack_idx[i, s0:s0 + sizes[i, d]] = src_idx
+            o = overlaps[(i, d)]
+            pack_rows[i].extend(z_runs(o, in_boxes[i].low, in_pad))
     for d in range(p):
         for i in range(p):
-            if not sizes[i, d]:
+            if (i, d) not in overlaps:
                 continue
-            _, dst_idx = runs[(i, d)]
-            r0 = out_off[i, d]
-            unpack_idx[d, r0:r0 + sizes[i, d]] = dst_idx
-    return _A2AVTables(pack_idx, unpack_idx, send_off, sizes, out_off,
-                       send_cap, recv_cap)
+            o = overlaps[(i, d)]
+            unpack_rows[d].extend(z_runs(o, out_boxes[d].low, out_pad))
+            # Emulation gather: sender i's run sits contiguous at
+            # offset send_off[i, d] in row i of the all_gathered buffer —
+            # ONE run per pair, stored as (row, offset) int32.
+            gather_rows[d].append(
+                ((i, int(send_off[i, d])), int(sizes[i, d])))
+    pack_start, pack_end = _pack_runs(pack_rows)
+    unpack_start, unpack_end = _pack_runs(unpack_rows)
+    grow_rows = [[(r, ln) for (r, _), ln in row] for row in gather_rows]
+    goff_rows = [[(off, ln) for (_, off), ln in row] for row in gather_rows]
+    gather_row, gather_end = _pack_runs(grow_rows)
+    gather_off, _ = _pack_runs(goff_rows)
+    return _A2AVTables(pack_start, pack_end, unpack_start, unpack_end,
+                       gather_row, gather_off, gather_end,
+                       send_off, sizes, out_off, send_cap, recv_cap)
 
 
 def _a2av_payload(t: _A2AVTables) -> int:
@@ -414,52 +474,67 @@ def _a2av_payload(t: _A2AVTables) -> int:
     return int(t.sizes.sum() - np.trace(t.sizes))
 
 
-def _a2av_gather_idx(t: _A2AVTables, p: int) -> np.ndarray:
-    """[P, recv_cap] flat indices into the all_gathered send buffers for
-    the CPU emulation (same offset tables as the real collective)."""
-    cap = max(t.send_cap, 1)
-    gidx = np.zeros((p, max(t.recv_cap, 1)), np.int64)
-    for d in range(p):
-        for s in range(p):
-            if not t.sizes[s, d]:
-                continue
-            r0 = t.out_off[s, d]
-            gidx[d, r0:r0 + t.sizes[s, d]] = (
-                s * cap + t.send_off[s, d] + np.arange(t.sizes[s, d]))
-    return gidx
+def _expand_runs(start_row, end_row, cap: int, fill):
+    """Expand one device's RLE map rows into a [cap] element-index
+    vector: slot s of the buffer belongs to run r = the first run whose
+    cumulative end exceeds s, at offset s - end[r-1]. Padding slots
+    (s >= total elements) get ``fill`` (0 for harmless gathers, the
+    out-of-range sentinel for ``mode='drop'`` scatters). O(cap log R)
+    integer work per execute — traded for shipping O(R) instead of
+    O(cap) table operands (R = overlap cross-section, not volume)."""
+    fs, ce = start_row, end_row
+    cs = jnp.concatenate([jnp.zeros((1,), ce.dtype), ce[:-1]])
+    s = jnp.arange(cap, dtype=ce.dtype)
+    r = jnp.minimum(jnp.searchsorted(ce, s, side="right"),
+                    fs.shape[0] - 1)
+    idx = fs[r] + (s - cs[r])
+    return jnp.where(s < ce[-1], idx.astype(fs.dtype), fill)
 
 
 def _a2av_reshape(
     x: jnp.ndarray,
-    pack_row: jnp.ndarray,     # [1, send_cap] this device's gather map
-    unpack_row: jnp.ndarray,   # [1, recv_cap] this device's scatter map
-    gidx_row: jnp.ndarray,     # [1, recv_cap] CPU-emulation gather map
+    pack_rows: tuple[jnp.ndarray, jnp.ndarray],    # [1, Rs] x2 RLE rows
+    unpack_rows: tuple[jnp.ndarray, jnp.ndarray],  # [1, Ru] x2 RLE rows
+    gather_rows,  # [1, Rg] x3 (row, off, end) rows (CPU) | None on TPU
     axis_names: tuple[str, ...],
     t: _A2AVTables,
     out_pad: tuple[int, int, int],
     platform: str,
 ) -> jnp.ndarray:
     """The exact-count reshape of one local brick (inside shard_map).
-    The big per-device index maps arrive as SHARDED OPERANDS (one row
-    per device) rather than embedded [P, cap] constants, so executable
-    size stays O(brick), not O(P x brick). On backends without the
-    ragged op (XLA:CPU, unless force_real_lowering), an all_gather
-    emulation with the *same tables* stands in — so the CPU tests
-    exercise every index map, and only the collective itself differs on
-    hardware. ``platform`` is the mesh devices' platform, resolved at
+    The per-device index maps arrive as RLE rows (SHARDED OPERANDS, one
+    row per device — O(cross-section) bytes) and are expanded to element
+    indices on device (:func:`_expand_runs`), so neither the executable
+    nor the operands carry O(P x brick) element tables. On backends
+    without the ragged op (XLA:CPU, unless force_real_lowering), an
+    all_gather emulation with the *same tables* stands in — so the CPU
+    tests exercise every run map, and only the collective itself differs
+    on hardware. ``platform`` is the mesh devices' platform, resolved at
     plan time (a CPU-device mesh under a non-CPU default backend must
     still take the emulation path)."""
     from ..utils.compat import force_real_lowering
 
     i = lax.axis_index(axis_names)
+    scap = max(t.send_cap, 1)
     rcap = max(t.recv_cap, 1)
-    sendbuf = x.reshape(-1)[pack_row[0]]  # [send_cap]
+    pack_idx = _expand_runs(pack_rows[0][0], pack_rows[1][0], scap, 0)
+    sendbuf = x.reshape(-1)[pack_idx]  # [send_cap]
 
     if platform == "cpu" and not force_real_lowering():
         # Emulation: gather every sender's buffer, then assemble my
-        # receive buffer from the same offset tables via one gather.
+        # receive buffer from the same offset tables via a 2D gather
+        # ((sender row, column) pairs — never a flat index, so int32
+        # suffices at any world size).
+        grow, goff, gend = (a[0] for a in gather_rows)
+        cs = jnp.concatenate([jnp.zeros((1,), gend.dtype), gend[:-1]])
+        s = jnp.arange(rcap, dtype=gend.dtype)
+        rr = jnp.minimum(jnp.searchsorted(gend, s, side="right"),
+                         grow.shape[0] - 1)
+        valid = s < gend[-1]
+        row = jnp.where(valid, grow[rr], 0)
+        col = jnp.where(valid, goff[rr] + (s - cs[rr]), 0)
         ag = lax.all_gather(sendbuf, axis_names)  # [P, send_cap]
-        y = ag.reshape(-1)[gidx_row[0]]
+        y = ag[row, col]
     else:
         out = jnp.zeros((rcap,), x.dtype)
         soff = jnp.asarray(t.send_off)[i]
@@ -468,9 +543,12 @@ def _a2av_reshape(
         rsz = jnp.asarray(t.sizes.astype(np.int32).T)[i]
         y = lax.ragged_all_to_all(
             sendbuf, out, soff, ssz, ooff, rsz, axis_name=axis_names)
+    sentinel = jnp.int32(math.prod(out_pad))
+    unpack_idx = _expand_runs(
+        unpack_rows[0][0], unpack_rows[1][0], rcap, sentinel)
     accf = jnp.zeros((math.prod(out_pad),), x.dtype)
     # Sentinel indices on padding slots fall out of bounds and drop.
-    accf = accf.at[unpack_row[0]].set(y, mode="drop")
+    accf = accf.at[unpack_idx].set(y, mode="drop")
     return accf.reshape(out_pad)
 
 
@@ -485,26 +563,33 @@ def _a2av_mapped(
     squeeze_in: bool,
     expand_out: bool,
 ) -> Callable:
-    """Build ``fn(x)`` for the a2av transport: the index tables travel as
-    shard_map operands sharded one row per device."""
-    pack_tbl = jnp.asarray(tables.pack_idx)
-    unpack_tbl = jnp.asarray(tables.unpack_idx)
-    gidx_tbl = jnp.asarray(_a2av_gather_idx(tables, p))
-    row = P(names, None)
+    """Build ``fn(x)`` for the a2av transport: the RLE run tables travel
+    as shard_map operands sharded one row per device (the emulation
+    gather rows only on CPU meshes, where the ragged op cannot lower)."""
     platform = mesh.devices.flat[0].platform
+    row = P(names, None)
+    operands = [jnp.asarray(tables.pack_start),
+                jnp.asarray(tables.pack_end),
+                jnp.asarray(tables.unpack_start),
+                jnp.asarray(tables.unpack_end)]
+    with_gather = platform == "cpu"
+    if with_gather:
+        operands += [jnp.asarray(tables.gather_row),
+                     jnp.asarray(tables.gather_off),
+                     jnp.asarray(tables.gather_end)]
 
-    def _local(x, prow, urow, grow):
+    def _local(x, ps, pe, us, ue, *g):
         v = x[0] if squeeze_in else x
-        y = _a2av_reshape(v, prow, urow, grow, names, tables, out_pad,
-                          platform)
+        y = _a2av_reshape(v, (ps, pe), (us, ue), g or None, names,
+                          tables, out_pad, platform)
         return y[None] if expand_out else y
 
     mapped = _shard_map(
         _local, mesh=mesh,
-        in_specs=(data_in_spec, row, row, row),
+        in_specs=(data_in_spec,) + (row,) * len(operands),
         out_specs=data_out_spec,
     )
-    return lambda x: mapped(x, pack_tbl, unpack_tbl, gidx_tbl)
+    return lambda x: mapped(x, *operands)
 
 
 def plan_brick_reshape(
@@ -549,7 +634,8 @@ def plan_brick_reshape(
         tables = _a2av_tables(in_boxes, out_boxes, in_pad, out_pad)
         spec = BrickSpec(tuple(in_boxes), tuple(out_boxes), world, in_pad,
                          out_pad, (), algorithm,
-                         payload_override=_a2av_payload(tables))
+                         payload_override=_a2av_payload(tables),
+                         a2av_table_bytes=tables.table_bytes_per_device)
         fn = _a2av_mapped(mesh, names, p, tables, out_pad,
                           P(names), P(names),
                           squeeze_in=True, expand_out=True)
@@ -650,7 +736,8 @@ def plan_bricks_to_spec(
         tables = _a2av_tables(in_boxes, out_boxes, in_pad, shard_shape)
         spec = BrickSpec(tuple(in_boxes), tuple(out_boxes), world, in_pad,
                          shard_shape, (), algorithm,
-                         payload_override=_a2av_payload(tables))
+                         payload_override=_a2av_payload(tables),
+                         a2av_table_bytes=tables.table_bytes_per_device)
         fn = _a2av_mapped(mesh, names, p, tables, shard_shape,
                           P(names), to_spec,
                           squeeze_in=True, expand_out=False)
@@ -692,7 +779,8 @@ def plan_spec_to_bricks(
         tables = _a2av_tables(in_boxes, out_boxes, shard_shape, out_pad)
         spec = BrickSpec(tuple(in_boxes), tuple(out_boxes), world,
                          shard_shape, out_pad, (), algorithm,
-                         payload_override=_a2av_payload(tables))
+                         payload_override=_a2av_payload(tables),
+                         a2av_table_bytes=tables.table_bytes_per_device)
         fn = _a2av_mapped(mesh, names, p, tables, out_pad,
                           from_spec, P(names),
                           squeeze_in=False, expand_out=True)

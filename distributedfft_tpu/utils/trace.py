@@ -255,9 +255,13 @@ def plan_info(plan) -> str:
             ov = f"ratio {bs.wire_ratio:.2f}x" if t else "ratio n/a"
             how = (f"{len(bs.steps)} ring steps" if bs.algorithm == "ring"
                    else "a2av exact counts")
+            tbl = ("" if bs.a2av_table_bytes is None else
+                   f" | index tables {bs.a2av_table_bytes / 1024:.1f} "
+                   f"KB/device (RLE)")
             lines.append(
                 f"brick edge {label}: {how}, "
                 f"payload {t * _MB:.2f} MB | wire {w * _MB:.2f} MB ({ov})"
+                + tbl
             )
     # Per-device memory footprint estimate — the heFFTe benchmark's
     # "MB/rank" report (benchmarks/speed3d.h:156-181) and the reference's

@@ -133,3 +133,44 @@ def test_payload_accounting_even_no_overhead():
     plan = dfft.plan_dft_c2c_3d(shape, mesh, dtype=CDT)
     [e] = exchange_payloads(plan.logic, shape, 16)
     assert e["true_bytes"] == e["alltoallv_bytes"] == e["alltoall_bytes"]
+
+
+def test_a2av_table_footprint_sublinear():
+    """The a2av index-map operands are RLE (z-runs), so their per-device
+    bytes scale with the overlap CROSS-SECTION, not the brick volume —
+    the bound that makes campaign-size brick plans constructible
+    (heFFTe ships O(P) count/offset tables, src/heffte_reshape3d.cpp:375;
+    the per-element alternative here would be 4 bytes per brick element).
+    Volume grows 8x between the two worlds; the tables may grow ~4x
+    (cross-section) but must stay far below the volume factor."""
+    from distributedfft_tpu.geometry import Box3, split_world
+    from distributedfft_tpu.parallel.bricks import (
+        _a2av_tables, pad_shape_for)
+
+    def table_bytes(n):
+        world = Box3((0, 0, 0), (n, n, n))
+        in_boxes = split_world(world, (2, 2, 2))   # grid bricks
+        out_boxes = split_world(world, (8, 1, 1))  # slab bricks
+        t = _a2av_tables(in_boxes, out_boxes, pad_shape_for(in_boxes),
+                         pad_shape_for(out_boxes))
+        # element maps this replaces: ~4 bytes per send+recv element
+        elem_bytes = 8 * max(t.send_cap, t.recv_cap)
+        return t.table_bytes_per_device, elem_bytes
+
+    small, small_elem = table_bytes(32)
+    big, big_elem = table_bytes(64)
+    assert big <= 5 * small, (small, big)          # ~cross-section growth
+    assert big * 10 <= big_elem, (big, big_elem)   # far below element maps
+
+
+def test_a2av_table_bytes_in_plan_info():
+    from distributedfft_tpu.geometry import Box3, split_world
+
+    shape = (16, 12, 10)
+    mesh = dfft.make_mesh(8)
+    world = Box3((0, 0, 0), shape)
+    boxes = split_world(world, (2, 2, 2))
+    plan = dfft.plan_brick_dft_c2c_3d(shape, mesh, boxes, boxes,
+                                      algorithm="alltoallv", dtype=CDT)
+    info = dfft.plan_info(plan)
+    assert "index tables" in info and "RLE" in info
