@@ -238,10 +238,15 @@ def _worker(shape_n: int) -> None:
     dtype = jnp.complex64  # TPU: no C128
 
     # Upgrade-phase menu: xla first (a line exists after one compile),
-    # then the fused Pallas path, the HIGH-precision MXU tier (~2x the
-    # matmul rate of HIGHEST; kept only if it passes the roundtrip
-    # gate), and the un-fused matmul engine.
-    default_execs = "xla" if fast else "xla,pallas,pallas:high,matmul"
+    # then the fused Pallas path, the HIGH-precision MXU tiers (~2x the
+    # matmul rate of HIGHEST; kept only if they pass the roundtrip
+    # gate), and the un-fused matmul engine. matmul:high is the MXU
+    # four-step at 3-pass bf16 — the round-2 hardware rows had plain
+    # matmul already beating xla at 1D n=512 (113.3 vs 103.5 GFlops/s,
+    # csv/pallas_tune_tpu.csv), so its HIGH tier is a real candidate for
+    # the 512^3 flagship.
+    default_execs = ("xla" if fast
+                     else "xla,pallas,pallas:high,matmul,matmul:high")
     candidates = [
         e.strip()
         for e in os.environ.get(

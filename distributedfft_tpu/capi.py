@@ -1,0 +1,138 @@
+"""Transform-time C API bridge — the ``heffte_c`` surface for C callers.
+
+heFFTe exposes its C++ transforms to C (and through it, Fortran) via
+opaque plan handles and typed execute calls (``heffte_c.h:52-179``,
+``src/heffte_c.cpp``). This framework's runtime is Python/JAX, so the
+bridge runs the other way around: :func:`install_c_api` registers ctypes
+trampolines into ``libdfft_native.so``'s function-pointer table, after
+which any C/C++/Fortran code living in a Python-hosted process can call
+the plain C ABI
+
+.. code-block:: c
+
+    long long dfft_plan_c2c_3d(long long nx, ny, nz, int direction);
+    int       dfft_execute_c2c(long long plan, const float* in, float* out);
+    void      dfft_destroy_plan_c(long long plan);
+
+with interleaved complex64 buffers (C-order, full world per call). The
+native side's ``dfft_c_selftest`` drives the complete plan → execute →
+destroy lifecycle from compiled C — the proof the ABI carries a real
+transform, not a Python detour (``tests/test_capi.py``).
+
+Single-process scope: the C caller sees the whole world array; plans may
+still be distributed over a local mesh (the bridge scatters/gathers
+through the plan's shardings). Multi-host C drivers are out of scope —
+the multi-host tier speaks Python (``parallel/multihost.py``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+
+import numpy as np
+
+from . import native as _native
+
+__all__ = ["install_c_api", "c_api_installed", "c_selftest"]
+
+_lock = threading.Lock()
+_installed = False
+# The CFUNCTYPE objects must outlive every C call: ctypes callbacks are
+# freed with their Python wrapper, and a dangling pointer in the native
+# table would crash the next C caller.
+_keepalive: list = []
+_plans: dict[int, tuple] = {}
+_next_id = 0
+
+_PLAN_FN = ctypes.CFUNCTYPE(
+    ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
+    ctypes.c_longlong, ctypes.c_int)
+_EXEC_FN = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_longlong, ctypes.POINTER(ctypes.c_float),
+    ctypes.POINTER(ctypes.c_float))
+_DESTROY_FN = ctypes.CFUNCTYPE(None, ctypes.c_longlong)
+
+
+def install_c_api(mesh=None) -> bool:
+    """Install the transform bridge into ``libdfft_native.so``.
+
+    ``mesh`` (a Mesh, device count, or None for single-device) is the
+    mesh every C-created plan runs on. Returns False when the native
+    library is unavailable (no toolchain); True once C callers can use
+    the ABI. Idempotent; a second call re-points the plan mesh."""
+    global _installed
+    lib = _native._load()
+    if lib is None:
+        return False
+
+    from . import api as _api
+
+    @_PLAN_FN
+    def _plan(nx, ny, nz, direction):
+        global _next_id
+        if min(nx, ny, nz) < 1 or direction not in (-1, 1):
+            return -1  # C-side argument validation: no zero-extent plans
+        try:
+            p = _api.plan_dft_c2c_3d(
+                (int(nx), int(ny), int(nz)), mesh, direction=int(direction),
+                dtype=np.complex64)
+        except Exception:
+            return -1
+        with _lock:
+            pid = _next_id
+            _next_id += 1
+            _plans[pid] = (p, (int(nx), int(ny), int(nz)))
+        return pid
+
+    @_EXEC_FN
+    def _exec(pid, in_ptr, out_ptr):
+        with _lock:
+            entry = _plans.get(int(pid))
+        if entry is None:
+            return 2
+        plan, shape = entry
+        n = shape[0] * shape[1] * shape[2]
+        try:
+            buf = np.ctypeslib.as_array(in_ptr, shape=(2 * n,))
+            x = buf.view(np.complex64).reshape(shape)
+            y = np.asarray(plan(x), dtype=np.complex64)
+            out = np.ctypeslib.as_array(out_ptr, shape=(2 * n,))
+            out.view(np.complex64).reshape(shape)[...] = y
+        except Exception:
+            return 3
+        return 0
+
+    @_DESTROY_FN
+    def _destroy(pid):
+        with _lock:
+            _plans.pop(int(pid), None)
+
+    lib.dfft_c_api_install.argtypes = [_PLAN_FN, _EXEC_FN, _DESTROY_FN]
+    with _lock:
+        # Append (never replace) under the lock: a reinstall must not
+        # drop the trampolines an in-flight C call may still be using.
+        _keepalive.extend([_plan, _exec, _destroy])
+        lib.dfft_c_api_install(_plan, _exec, _destroy)
+        _installed = True
+    return True
+
+
+def c_api_installed() -> bool:
+    lib = _native._load()
+    if lib is None or not _installed:
+        return False
+    lib.dfft_c_api_ready.restype = ctypes.c_int
+    return bool(lib.dfft_c_api_ready())
+
+
+def c_selftest(shape=(8, 6, 5)) -> float:
+    """Run the native side's C-driven roundtrip (plan + execute + destroy
+    all issued from compiled C). Returns the relative max error
+    (negative = failure; see ``dfft_c_selftest``)."""
+    lib = _native._load()
+    if lib is None:
+        return -1.0
+    lib.dfft_c_selftest.restype = ctypes.c_double
+    lib.dfft_c_selftest.argtypes = [ctypes.c_longlong] * 3
+    return float(lib.dfft_c_selftest(*map(int, shape)))

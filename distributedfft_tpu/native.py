@@ -72,7 +72,7 @@ def _load():
         lib.dfft_trace_count.restype = ll
         lib.dfft_trace_dump.restype = ctypes.c_int
         lib.dfft_trace_dump.argtypes = [ctypes.c_char_p, ll, ll]
-        if lib.dfft_abi_version() != 2:
+        if lib.dfft_abi_version() != 3:
             return None
         _lib = lib
         return _lib

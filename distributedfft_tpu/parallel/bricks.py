@@ -482,19 +482,29 @@ def _expand_runs(start_row, end_row, cap: int, fill):
     out-of-range sentinel for ``mode='drop'`` scatters). O(cap log R)
     integer work per execute — traded for shipping O(R) instead of
     O(cap) table operands (R = overlap cross-section, not volume)."""
-    fs, ce = start_row, end_row
+    r, off, valid = _run_slots(end_row, cap)
+    idx = (start_row[r] + off).astype(start_row.dtype)
+    return jnp.where(valid, idx, fill)
+
+
+def _run_slots(end_row, cap: int):
+    """Shared run-expansion core: for each buffer slot s in [0, cap),
+    (run index, offset within that run, validity). Slot s belongs to the
+    first run whose cumulative end exceeds s; slots past the final end
+    are invalid (padding)."""
+    ce = end_row
     cs = jnp.concatenate([jnp.zeros((1,), ce.dtype), ce[:-1]])
     s = jnp.arange(cap, dtype=ce.dtype)
     r = jnp.minimum(jnp.searchsorted(ce, s, side="right"),
-                    fs.shape[0] - 1)
-    idx = fs[r] + (s - cs[r])
-    return jnp.where(s < ce[-1], idx.astype(fs.dtype), fill)
+                    ce.shape[0] - 1)
+    return r, s - cs[r], s < ce[-1]
 
 
 def _a2av_reshape(
     x: jnp.ndarray,
     pack_rows: tuple[jnp.ndarray, jnp.ndarray],    # [1, Rs] x2 RLE rows
     unpack_rows: tuple[jnp.ndarray, jnp.ndarray],  # [1, Ru] x2 RLE rows
+    count_rows: tuple[jnp.ndarray, ...],  # [1, P] x4 off/size rows
     gather_rows,  # [1, Rg] x3 (row, off, end) rows (CPU) | None on TPU
     axis_names: tuple[str, ...],
     t: _A2AVTables,
@@ -502,19 +512,19 @@ def _a2av_reshape(
     platform: str,
 ) -> jnp.ndarray:
     """The exact-count reshape of one local brick (inside shard_map).
-    The per-device index maps arrive as RLE rows (SHARDED OPERANDS, one
-    row per device — O(cross-section) bytes) and are expanded to element
-    indices on device (:func:`_expand_runs`), so neither the executable
-    nor the operands carry O(P x brick) element tables. On backends
-    without the ragged op (XLA:CPU, unless force_real_lowering), an
-    all_gather emulation with the *same tables* stands in — so the CPU
-    tests exercise every run map, and only the collective itself differs
-    on hardware. ``platform`` is the mesh devices' platform, resolved at
+    Every per-device table arrives as a SHARDED OPERAND (one row per
+    device): the RLE index maps (O(cross-section) bytes, expanded to
+    element indices on device by :func:`_expand_runs`) and the ragged
+    off/size rows (O(P) each), so neither the executable nor the
+    operands carry O(P x anything) constants. On backends without the
+    ragged op (XLA:CPU, unless force_real_lowering), an all_gather
+    emulation with the *same tables* stands in — so the CPU tests
+    exercise every run map, and only the collective itself differs on
+    hardware. ``platform`` is the mesh devices' platform, resolved at
     plan time (a CPU-device mesh under a non-CPU default backend must
     still take the emulation path)."""
     from ..utils.compat import force_real_lowering
 
-    i = lax.axis_index(axis_names)
     scap = max(t.send_cap, 1)
     rcap = max(t.recv_cap, 1)
     pack_idx = _expand_runs(pack_rows[0][0], pack_rows[1][0], scap, 0)
@@ -526,21 +536,14 @@ def _a2av_reshape(
         # ((sender row, column) pairs — never a flat index, so int32
         # suffices at any world size).
         grow, goff, gend = (a[0] for a in gather_rows)
-        cs = jnp.concatenate([jnp.zeros((1,), gend.dtype), gend[:-1]])
-        s = jnp.arange(rcap, dtype=gend.dtype)
-        rr = jnp.minimum(jnp.searchsorted(gend, s, side="right"),
-                         grow.shape[0] - 1)
-        valid = s < gend[-1]
+        rr, off, valid = _run_slots(gend, rcap)
         row = jnp.where(valid, grow[rr], 0)
-        col = jnp.where(valid, goff[rr] + (s - cs[rr]), 0)
+        col = jnp.where(valid, goff[rr] + off, 0)
         ag = lax.all_gather(sendbuf, axis_names)  # [P, send_cap]
         y = ag[row, col]
     else:
         out = jnp.zeros((rcap,), x.dtype)
-        soff = jnp.asarray(t.send_off)[i]
-        ssz = jnp.asarray(t.sizes.astype(np.int32))[i]
-        ooff = jnp.asarray(t.out_off)[i]
-        rsz = jnp.asarray(t.sizes.astype(np.int32).T)[i]
+        soff, ssz, ooff, rsz = (a[0] for a in count_rows)
         y = lax.ragged_all_to_all(
             sendbuf, out, soff, ssz, ooff, rsz, axis_name=axis_names)
     sentinel = jnp.int32(math.prod(out_pad))
@@ -563,25 +566,29 @@ def _a2av_mapped(
     squeeze_in: bool,
     expand_out: bool,
 ) -> Callable:
-    """Build ``fn(x)`` for the a2av transport: the RLE run tables travel
-    as shard_map operands sharded one row per device (the emulation
-    gather rows only on CPU meshes, where the ragged op cannot lower)."""
+    """Build ``fn(x)`` for the a2av transport: every per-device table —
+    RLE run rows AND the ragged off/size rows — travels as a shard_map
+    operand sharded one row per device (the emulation gather rows only
+    on CPU meshes, where the ragged op cannot lower)."""
     platform = mesh.devices.flat[0].platform
     row = P(names, None)
+    sz32 = tables.sizes.astype(np.int32)
     operands = [jnp.asarray(tables.pack_start),
                 jnp.asarray(tables.pack_end),
                 jnp.asarray(tables.unpack_start),
-                jnp.asarray(tables.unpack_end)]
+                jnp.asarray(tables.unpack_end),
+                jnp.asarray(tables.send_off), jnp.asarray(sz32),
+                jnp.asarray(tables.out_off), jnp.asarray(sz32.T.copy())]
     with_gather = platform == "cpu"
     if with_gather:
         operands += [jnp.asarray(tables.gather_row),
                      jnp.asarray(tables.gather_off),
                      jnp.asarray(tables.gather_end)]
 
-    def _local(x, ps, pe, us, ue, *g):
+    def _local(x, ps, pe, us, ue, soff, ssz, ooff, rsz, *g):
         v = x[0] if squeeze_in else x
-        y = _a2av_reshape(v, (ps, pe), (us, ue), g or None, names,
-                          tables, out_pad, platform)
+        y = _a2av_reshape(v, (ps, pe), (us, ue), (soff, ssz, ooff, rsz),
+                          g or None, names, tables, out_pad, platform)
         return y[None] if expand_out else y
 
     mapped = _shard_map(

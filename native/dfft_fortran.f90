@@ -1,0 +1,64 @@
+! dfft_fortran — Fortran 2003 bindings for the transform-time C API.
+!
+! The heFFTe Fortran surface (SWIG-generated modules over heffte_c,
+! heffte/heffteBenchmark/fortran/generated/*.f90) re-designed as a plain
+! ISO_C_BINDING module over this framework's C ABI (native/dfft_native.cpp:
+! dfft_plan_c2c_3d / dfft_execute_c2c / dfft_destroy_plan_c). Usable from
+! any F2003+ compiler inside a Python-hosted process after
+! distributedfft_tpu.capi.install_c_api() has been called (see
+! distributedfft_tpu/capi.py for the hosting contract).
+!
+! Buffers are interleaved single-precision complex (complex(c_float_complex)
+! arrays pass through unchanged), C-order [nx][ny][nz] worlds — note the
+! layout is C-order, so a Fortran-natural (nz, ny, nx) array maps directly.
+!
+! No Fortran toolchain ships in this repo's build image, so this module is
+! provided as source and is NOT exercised by CI (PARITY.md H10 records the
+! gap); it compiles with gfortran >= 5 / flang against libdfft_native.so.
+
+module dfft
+  use, intrinsic :: iso_c_binding
+  implicit none
+
+  integer(c_int), parameter :: DFFT_FORWARD = -1
+  integer(c_int), parameter :: DFFT_BACKWARD = 1
+
+  interface
+     ! long long dfft_plan_c2c_3d(long long nx, ny, nz, int direction)
+     function dfft_plan_c2c_3d(nx, ny, nz, direction) bind(c) result(plan)
+       import :: c_long_long, c_int
+       integer(c_long_long), value :: nx, ny, nz
+       integer(c_int), value :: direction
+       integer(c_long_long) :: plan
+     end function dfft_plan_c2c_3d
+
+     ! int dfft_execute_c2c(long long plan, const float* in, float* out)
+     function dfft_execute_c2c(plan, input, output) bind(c) result(rc)
+       import :: c_long_long, c_int, c_float_complex
+       integer(c_long_long), value :: plan
+       complex(c_float_complex), dimension(*), intent(in) :: input
+       complex(c_float_complex), dimension(*), intent(out) :: output
+       integer(c_int) :: rc
+     end function dfft_execute_c2c
+
+     ! void dfft_destroy_plan_c(long long plan)
+     subroutine dfft_destroy_plan_c(plan) bind(c)
+       import :: c_long_long
+       integer(c_long_long), value :: plan
+     end subroutine dfft_destroy_plan_c
+
+     ! int dfft_c_api_ready(void)
+     function dfft_c_api_ready() bind(c) result(ready)
+       import :: c_int
+       integer(c_int) :: ready
+     end function dfft_c_api_ready
+
+     ! double dfft_c_selftest(long long nx, ny, nz)
+     function dfft_c_selftest(nx, ny, nz) bind(c) result(err)
+       import :: c_long_long, c_double
+       integer(c_long_long), value :: nx, ny, nz
+       real(c_double) :: err
+     end function dfft_c_selftest
+  end interface
+
+end module dfft
