@@ -153,3 +153,33 @@ def test_unpacked_fallback_lowers_for_tpu(monkeypatch):
     _export_ok(
         lambda a, b: pallas_fft._fft_tiles(
             a, b, n=512, forward=False, interpret=False), z, z)
+
+
+@pytest.mark.parametrize(
+    "shape,kind",
+    [((1024, 1024, 1024), "slab"),
+     ((2048, 2048, 2048), "slab"),      # 8.6e9 elements: past int32
+     ((1536, 1024, 768), "pencil")])    # BASELINE.json non-cubic config
+def test_campaign_configs_lower_for_tpu(shape, kind):
+    """The BASELINE.json campaign shapes through the full TPU lowering
+    pipeline, chiplessly — where 64-bit index-math bugs (2048^3 has more
+    elements than int32 holds) and shape/layout rejections would
+    otherwise wait for first hardware contact. Cheap (~2 s: lowering
+    traces scale with program size, not data size), so it stays in the
+    default gate."""
+    import distributedfft_tpu as dfft
+    from distributedfft_tpu.parallel.pencil import build_pencil_fft3d
+    from distributedfft_tpu.parallel.slab import build_slab_fft3d
+
+    if kind == "slab":
+        mesh = dfft.make_mesh(8)
+        fn, _ = build_slab_fft3d(
+            mesh, shape, axis_name=mesh.axis_names[0], executor="xla",
+            forward=True)
+    else:
+        mesh = dfft.make_mesh((2, 4))
+        fn, _ = build_pencil_fft3d(
+            mesh, shape, row_axis=mesh.axis_names[0],
+            col_axis=mesh.axis_names[1], executor="xla", forward=True)
+    x = jax.ShapeDtypeStruct(shape, jnp.complex64)
+    export.export(jax.jit(lambda v: fn(v)), platforms=["tpu"])(x)
