@@ -700,15 +700,27 @@ def plan_dft_r2c_3d(
     options: PlanOptions | None = None,
     in_spec: P | None = None,
     out_spec: P | None = None,
+    r2c_axis: int = 2,
 ) -> Plan3D:
     """Create a distributed real-to-complex (forward) / complex-to-real
     (backward) 3D FFT plan — heFFTe ``fft3d_r2c`` parity
     (``heffte_fft3d_r2c.h``; r2c box shrink ``heffte_geometry.h:94``).
 
     ``shape`` is the *real-space* world shape. The complex side is shrunk
-    along axis 2 to ``N2//2+1``. Forward input is real; backward output is
-    real with numpy 1/N scaling.
+    along ``r2c_axis`` (default 2) to ``N//2+1`` — heFFTe's
+    ``r2c_direction`` ctor argument (``heffte_fft3d_r2c.h:71-84``).
+    Forward input is real; backward output is real with numpy 1/N
+    scaling. Non-default ``r2c_axis`` runs the canonical chain on a
+    transposed view (one extra device transpose per edge; the chain's
+    collectives are unchanged).
     """
+    if r2c_axis != 2:
+        return _r2c_axis_wrapped(
+            shape, mesh, r2c_axis, direction=direction,
+            decomposition=decomposition, executor=executor, dtype=dtype,
+            donate=donate, algorithm=algorithm, options=options,
+            in_spec=in_spec, out_spec=out_spec,
+        )
     shape, forward = _check_direction(shape, direction)
     opts = _resolve_options(decomposition, executor, donate, algorithm, options)
     if opts.executor == "auto":
@@ -787,6 +799,68 @@ def plan_dft_c2r_3d(shape, mesh=None, **kw) -> Plan3D:
     half-spectrum in, real out; heFFTe ``fft3d_r2c::backward``)."""
     kw.setdefault("direction", BACKWARD)
     return plan_dft_r2c_3d(shape, mesh, **kw)
+
+
+def _r2c_axis_wrapped(shape, mesh, axis: int, *, direction, decomposition,
+                      executor, dtype, donate, algorithm, options, in_spec,
+                      out_spec) -> Plan3D:
+    """r2c/c2r with the halved axis != 2 (heFFTe ``r2c_direction`` 0/1):
+    the canonical chain (real axis = 2) runs on a transposed view.
+    Caller-facing metadata — shapes, shardings, boxes — is permuted back
+    to the caller's axis convention; ``spec``/``logic`` keep the inner
+    chain's (transposed) convention, which ``plan_info`` labels. The
+    swap permutation is its own inverse, so one ``perm`` serves both
+    directions."""
+    if axis not in (0, 1):
+        raise ValueError(f"r2c_axis must be 0, 1, or 2; got {axis}")
+    shape, forward = _check_direction(shape, direction)
+    perm = [0, 1, 2]
+    perm[axis], perm[2] = perm[2], perm[axis]
+    pshape = tuple(shape[p] for p in perm)
+
+    def permute_spec(s):
+        if s is None:
+            return None
+        ent = tuple(s) + (None,) * (3 - len(tuple(s)))
+        return P(*(ent[p] for p in perm))
+
+    inner = plan_dft_r2c_3d(
+        pshape, mesh, direction=direction, decomposition=decomposition,
+        executor=executor, dtype=dtype, donate=donate, algorithm=algorithm,
+        options=options, in_spec=permute_spec(in_spec),
+        out_spec=permute_spec(out_spec),
+    )
+
+    inner_fn = inner.fn
+    fn = jax.jit(
+        lambda x: jnp.transpose(inner_fn(jnp.transpose(x, perm)), perm),
+        donate_argnums=(0,) if inner.options.donate else (),
+    )
+
+    def permute_shape(s):
+        return tuple(s[p] for p in perm)
+
+    def permute_sharding(sh):
+        return (None if sh is None
+                else NamedSharding(sh.mesh, permute_spec(sh.spec)))
+
+    def permute_boxes(boxes):
+        return [Box3(tuple(b.low[p] for p in perm),
+                     tuple(b.high[p] for p in perm)) for b in boxes]
+
+    return Plan3D(
+        shape=shape, direction=direction, dtype=inner.dtype,
+        decomposition=inner.decomposition, executor=inner.executor,
+        mesh=inner.mesh, fn=fn, spec=inner.spec,
+        in_sharding=permute_sharding(inner.in_sharding),
+        out_sharding=permute_sharding(inner.out_sharding),
+        in_boxes=permute_boxes(inner.in_boxes),
+        out_boxes=permute_boxes(inner.out_boxes),
+        in_shape=permute_shape(inner.in_shape),
+        out_shape=permute_shape(inner.out_shape),
+        in_dtype=inner.in_dtype, out_dtype=inner.out_dtype,
+        real=True, options=inner.options, logic=inner.logic,
+    )
 
 
 @dataclass

@@ -195,6 +195,14 @@ def plan_info(plan) -> str:
         )
         lines.append(f"in sharding:  {plan.in_sharding.spec}")
         lines.append(f"out sharding: {plan.out_sharding.spec}")
+    if plan.real:
+        half = plan.out_shape if plan.forward else plan.in_shape
+        full = plan.in_shape if plan.forward else plan.out_shape
+        ax = next((i for i in range(3) if half[i] != full[i]), 2)
+        if ax != 2:
+            lines.append(
+                f"r2c axis: {ax} (canonical chain runs on the transposed "
+                f"view; spec/logic rows below are in chain convention)")
     lp = getattr(plan, "logic", None)
     if lp is not None:
         if lp.slab_axes is not None:

@@ -143,3 +143,53 @@ def test_half_complex_odd_n_fallback(executor):
     y = np.fft.rfft(x, axis=1)
     back = np.asarray(get_c2r(executor)(jnp.asarray(y), 9, 1))
     np.testing.assert_allclose(back, x, atol=1e-11)
+
+
+@pytest.mark.parametrize("axis", [0, 1])
+def test_r2c_axis_choice_matches_numpy(axis):
+    """heFFTe's r2c_direction argument (heffte_fft3d_r2c.h:71-84): the
+    halved axis is caller-chosen; the half-spectrum equals the full DFT
+    sliced along that axis."""
+    import distributedfft_tpu as dfft
+
+    shape = (8, 10, 6)
+    rng = np.random.default_rng(4242)
+    x = rng.standard_normal(shape)
+    pf = dfft.plan_dft_r2c_3d(shape, None, r2c_axis=axis)
+    y = np.asarray(pf(x))
+    h = shape[axis] // 2 + 1
+    want = np.take(np.fft.fftn(x), np.arange(h), axis=axis)
+    assert y.shape == want.shape
+    tu.assert_approx(y, want)
+
+    pb = dfft.plan_dft_c2r_3d(shape, None, r2c_axis=axis)
+    back = np.asarray(pb(y))
+    assert back.shape == shape
+    tu.assert_approx(back, x)
+
+
+@pytest.mark.parametrize("axis", [0, 1])
+def test_r2c_axis_choice_distributed(axis):
+    import distributedfft_tpu as dfft
+
+    shape = (16, 8, 8)
+    mesh = dfft.make_mesh(8)
+    rng = np.random.default_rng(73)
+    x = rng.standard_normal(shape)
+    pf = dfft.plan_dft_r2c_3d(shape, mesh, r2c_axis=axis)
+    pb = dfft.plan_dft_c2r_3d(shape, mesh, r2c_axis=axis)
+    assert pf.in_sharding is not None
+    h = shape[axis] // 2 + 1
+    want = np.take(np.fft.fftn(x), np.arange(h), axis=axis)
+    y = np.asarray(pf(x))
+    assert y.shape == want.shape
+    tu.assert_approx(y, want)
+    back = np.asarray(pb(y))
+    tu.assert_approx(back, x)
+
+
+def test_r2c_axis_invalid():
+    import distributedfft_tpu as dfft
+
+    with pytest.raises(ValueError, match="r2c_axis"):
+        dfft.plan_dft_r2c_3d((8, 8, 8), None, r2c_axis=3)
