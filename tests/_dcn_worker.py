@@ -9,6 +9,7 @@ MPI_Init (``fftSpeed3d_c2c.cpp:18``).
 Usage: python tests/_dcn_worker.py <coordinator_port> <process_id>
 """
 
+import os
 import sys
 
 import jax
@@ -74,22 +75,50 @@ def main() -> None:
     w = world_box(shape)
     ins = make_slabs(w, 8, axis=2, rule=ceil_splits)
     outs = make_slabs(w, 8, axis=1)
-    fn, bspec = plan_brick_reshape(mesh, ins, outs)
-    local_stack = np.zeros((4,) + bspec.in_pad, world.dtype)
-    for k in range(4):
-        b = ins[pid * 4 + k]
-        s = b.shape
-        local_stack[k, :s[0], :s[1], :s[2]] = world[b.slices()]
-    xs = mh.host_local_to_global(
-        mesh, P(("dcn", "slab"), None, None, None), local_stack)
-    # global_to_host_local allgathers the FULL output stack to every host;
-    # validate all 8 bricks (4 of them landed across the process boundary).
-    got_stack = np.asarray(mh.global_to_host_local(fn(xs)))
-    assert got_stack.shape[0] == 8, got_stack.shape
-    for j, b in enumerate(outs):
-        s = b.shape
-        np.testing.assert_array_equal(
-            got_stack[j, :s[0], :s[1], :s[2]], world[b.slices()])
+    # BOTH transports cross the process boundary: the padded ppermute
+    # ring and the exact-count a2av tier (RLE tables expanded on device;
+    # on the CPU backend its all_gather emulation runs the same maps).
+    for alg in ("ring", "a2av"):
+        fn, bspec = plan_brick_reshape(mesh, ins, outs, algorithm=alg)
+        local_stack = np.zeros((4,) + bspec.in_pad, world.dtype)
+        for k in range(4):
+            b = ins[pid * 4 + k]
+            s = b.shape
+            local_stack[k, :s[0], :s[1], :s[2]] = world[b.slices()]
+        xs = mh.host_local_to_global(
+            mesh, P(("dcn", "slab"), None, None, None), local_stack)
+        # global_to_host_local allgathers the FULL output stack to every
+        # host; validate all 8 bricks (4 landed across the boundary).
+        got_stack = np.asarray(mh.global_to_host_local(fn(xs)))
+        assert got_stack.shape[0] == 8, got_stack.shape
+        for j, b in enumerate(outs):
+            s = b.shape
+            np.testing.assert_array_equal(
+                got_stack[j, :s[0], :s[1], :s[2]], world[b.slices()],
+                err_msg=f"algorithm={alg} brick {j}")
+
+    if os.environ.get("DFFT_DCN_DD") == "1":
+        # The emulated-double tier across the process boundary: a dd
+        # pencil plan over the hybrid (dcn=2) x (slab=4) mesh — the
+        # reference's distributed-f64 capability spanning the DCN tier.
+        rshape = (8, 8, 8)
+        rworld = (rng.standard_normal(rshape)
+                  + 1j * rng.standard_normal(rshape)).astype(np.complex128)
+        hi, lo = dfft.dd_from_host(rworld)
+        pf = dfft.plan_dd_dft_c2c_3d(rshape, mesh)
+        pb = dfft.plan_dd_dft_c2c_3d(rshape, mesh, direction=dfft.BACKWARD)
+        assert pf.decomposition == "pencil"
+        yh, yl = pf(hi, lo)
+        got_dd = dfft.dd_to_host(mh.global_to_host_local(yh),
+                                 mh.global_to_host_local(yl))
+        dd_ref = np.fft.fftn(rworld)
+        dd_err = np.max(np.abs(got_dd - dd_ref)) / np.max(np.abs(dd_ref))
+        assert dd_err < 1e-11, f"dd forward err {dd_err}"
+        bh, bl = pb(yh, yl)
+        back = dfft.dd_to_host(mh.global_to_host_local(bh),
+                               mh.global_to_host_local(bl))
+        dd_rerr = np.max(np.abs(back - rworld)) / np.max(np.abs(rworld))
+        assert dd_rerr < 1e-11, f"dd roundtrip err {dd_rerr}"
 
     mh.sync_global_devices("dcn-smoke-done")
     print(f"DCN_WORKER_OK pid={pid} err={err:.3e} rerr={rerr:.3e}", flush=True)

@@ -57,12 +57,7 @@ def test_plan_over_hybrid_mesh():
     assert np.max(np.abs(np.asarray(bwd(fwd(jnp.asarray(x)))) - x)) < 1e-11
 
 
-def test_two_process_dcn_smoke():
-    """REAL multi-process run: two CPU processes under
-    jax.distributed.initialize form the (dcn=2) x (slab=4) hybrid mesh and
-    run a 3D plan end-to-end against np.fft — heFFTe's multiple-ranks-on-
-    one-box CI strategy (test/CMakeLists.txt:1-7,31-33) with
-    jax.distributed playing mpiexec."""
+def _run_dcn_workers(extra_env: dict | None = None, timeout: float = 240):
     import os
     import socket
     import subprocess
@@ -79,6 +74,7 @@ def test_two_process_dcn_smoke():
     env["PALLAS_AXON_POOL_IPS"] = ""  # skip axon PJRT registration entirely
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
     procs = [
         subprocess.Popen(
             [sys.executable, worker, str(port), str(pid)],
@@ -90,7 +86,7 @@ def test_two_process_dcn_smoke():
     outs = []
     try:
         for p in procs:
-            out, err = p.communicate(timeout=240)
+            out, err = p.communicate(timeout=timeout)
             outs.append((p.returncode, out, err))
     finally:
         for p in procs:
@@ -99,3 +95,22 @@ def test_two_process_dcn_smoke():
     for rc, out, err in outs:
         assert rc == 0, f"worker failed rc={rc}\nstdout:{out}\nstderr:{err[-2000:]}"
         assert "DCN_WORKER_OK" in out, out
+
+
+def test_two_process_dcn_smoke():
+    """REAL multi-process run: two CPU processes under
+    jax.distributed.initialize form the (dcn=2) x (slab=4) hybrid mesh and
+    run a 3D plan end-to-end against np.fft — heFFTe's multiple-ranks-on-
+    one-box CI strategy (test/CMakeLists.txt:1-7,31-33) with
+    jax.distributed playing mpiexec. The worker also runs the brick
+    reshape over BOTH transports (ring + exact-count a2av) across the
+    process boundary."""
+    _run_dcn_workers()
+
+
+@pytest.mark.slow
+def test_two_process_dcn_dd_tier():
+    """The emulated-double tier across the process boundary: dd pencil
+    plans over the hybrid mesh (slow tier: two dd compiles in
+    subprocesses dominate)."""
+    _run_dcn_workers({"DFFT_DCN_DD": "1"}, timeout=480)
