@@ -273,6 +273,42 @@ def step_dd_roundtrip(n: int = 256) -> None:
             f"gflops={gflops(shape, sec):.1f}")
 
 
+def step_matmul_high(n: int = 256) -> None:
+    """The matmul:high flagship candidate (MXU four-step at the 3-pass
+    bf16 tier): roundtrip gate + amortized forward rate — the row that
+    decides whether the HIGH tier carries the 512^3 tournament
+    (bench.py's menu; plain matmul already beat xla at 1D n=512 on the
+    round-2 hardware rows)."""
+    import jax
+    import jax.numpy as jnp
+
+    import distributedfft_tpu as dfft
+    from distributedfft_tpu.utils.timing import gflops, time_fn_amortized
+
+    saved = os.environ.get("DFFT_MM_PRECISION")
+    os.environ["DFFT_MM_PRECISION"] = "high"
+    try:
+        shape = (n, n, n)
+        fwd = dfft.plan_dft_c2c_3d(shape, None, executor="matmul",
+                                   dtype=jnp.complex64)
+        bwd = dfft.plan_dft_c2c_3d(shape, None, executor="matmul",
+                                   dtype=jnp.complex64,
+                                   direction=dfft.BACKWARD)
+        x = _rand_c64(jax.random.PRNGKey(11), shape)
+        back = bwd(fwd(x))
+        err = _maxrel(back, x)
+        _record(f"matmul_high_roundtrip_{n}",
+                "ok" if err < C64_GATE else "FAIL", err)
+        sec, _ = time_fn_amortized(fwd.fn, x, iters=5, repeats=2)
+        _record(f"matmul_high_fwd_time_{n}", "ok", round(sec, 6),
+                f"gflops={gflops(shape, sec):.1f}")
+    finally:
+        if saved is None:
+            os.environ.pop("DFFT_MM_PRECISION", None)
+        else:
+            os.environ["DFFT_MM_PRECISION"] = saved
+
+
 def step_dd_slab(shape=(32, 24, 16)) -> None:
     """Distributed dd tier under shard_map on the real backend: the
     barrier-guarded compensated arithmetic and the exchange collectives
@@ -340,6 +376,7 @@ def main() -> int:
         (step_pack_probe, (n,)),
         (step_pallas_shardmap, (64,)),
         (step_ragged_a2av, ()),
+        (step_matmul_high, (128 if args.quick else 256,)),
         (step_dd_fwd, (32 if args.quick else 64,)),
         (step_dd_slab, ()),
         (step_dd_roundtrip, (64 if args.quick else 256,)),
