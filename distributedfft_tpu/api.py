@@ -824,12 +824,18 @@ def _r2c_axis_wrapped(shape, mesh, axis: int, *, direction, decomposition,
         ent = tuple(s) + (None,) * (3 - len(tuple(s)))
         return P(*(ent[p] for p in perm))
 
-    inner = plan_dft_r2c_3d(
-        pshape, mesh, direction=direction, decomposition=decomposition,
-        executor=executor, dtype=dtype, donate=donate, algorithm=algorithm,
-        options=options, in_spec=permute_spec(in_spec),
-        out_spec=permute_spec(out_spec),
-    )
+    try:
+        inner = plan_dft_r2c_3d(
+            pshape, mesh, direction=direction, decomposition=decomposition,
+            executor=executor, dtype=dtype, donate=donate,
+            algorithm=algorithm, options=options,
+            in_spec=permute_spec(in_spec), out_spec=permute_spec(out_spec),
+        )
+    except ValueError as e:
+        raise ValueError(
+            f"{e} [note: r2c_axis={axis} plans run on a transposed view — "
+            f"specs and extents in this message are in the chain "
+            f"convention (axes {axis} and 2 swapped)]") from e
 
     inner_fn = inner.fn
     fn = jax.jit(

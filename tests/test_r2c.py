@@ -193,3 +193,30 @@ def test_r2c_axis_invalid():
 
     with pytest.raises(ValueError, match="r2c_axis"):
         dfft.plan_dft_r2c_3d((8, 8, 8), None, r2c_axis=3)
+
+
+def test_r2c_axis_with_user_specs_and_auto():
+    """r2c_axis composes with user layouts (specs permute through the
+    transposed chain and back) and with the auto-executor tournament;
+    invalid layouts report the chain-convention note."""
+    import distributedfft_tpu as dfft
+    from jax.sharding import PartitionSpec as P
+
+    mesh = dfft.make_mesh(8)
+    ax = mesh.axis_names[0]
+    shape = (16, 8, 8)
+    x = tu.make_world_data(shape, dtype=np.float64).real
+    full = np.fft.fftn(x)
+    want = np.take(full, np.arange(9), axis=0)
+
+    pf = dfft.plan_dft_r2c_3d(shape, mesh, r2c_axis=0,
+                              in_spec=P(None, ax, None),
+                              out_spec=P(None, ax, None))
+    tu.assert_approx(np.asarray(pf(x)), want)
+
+    pauto = dfft.plan_dft_r2c_3d(shape, mesh, r2c_axis=0, executor="auto")
+    tu.assert_approx(np.asarray(pauto(x)), want)
+
+    with pytest.raises(ValueError, match="chain convention"):
+        dfft.plan_dft_r2c_3d(shape, mesh, r2c_axis=0,
+                             out_spec=P(ax, None, None))
