@@ -48,8 +48,10 @@ extended by a dd four-step (two dense stages with an exact-dd twiddle,
 :func:`_dd_cmul` built on barrier-guarded Dekker two-products) to every
 length with a factor pair whose BOTH factors are <= 512 — all smooth
 lengths through 512^2 = 262,144, covering the BASELINE.json accuracy
-configs including 1024^3 and 2048^3 axes. Lengths with a prime factor
-above 512 are out of dd scope (a dd Bluestein would be needed).
+configs including 1024^3 and 2048^3 axes — and by a dd Bluestein
+(:func:`_dd_bluestein_last`: chirp-z over a padded power of two, built
+entirely from the same dd machinery) to lengths with prime factors
+above 512, up to prime axes ~131072 (measured ~7e-14 at n=521/1031).
 
 Dynamic-range note: two-float storage needs the lo component to live
 ~25-50 bits below hi, and TPU/host float units flush SUBNORMAL inputs
@@ -465,7 +467,10 @@ def _dd_four_step_last(hi, lo, n: int, forward: bool):
     # sit ~2^-60 at worst — far above the f32 subnormal floor.
     mu = jnp.max(jnp.abs(jnp.real(hi))) + jnp.max(jnp.abs(jnp.imag(hi)))
     _, e = jnp.frexp(jnp.where(mu == 0, 1.0, mu))
-    e = jnp.clip(e + int(math.ceil(math.log2(n1))), -126, 127)
+    # 126 (not 127): 2^-127 is subnormal and flushes to zero — a 127
+    # clip silently zeroes the whole transform for huge-but-finite
+    # inputs (the bound reaches 127 at ~2^(126 - log2 n1) already).
+    e = jnp.clip(e + int(math.ceil(math.log2(n1))), -126, 126)
     down = jnp.ldexp(jnp.float32(1.0), -e)
     hi = hi.reshape(shp[:-1] + (n1, n2))
     lo = lo.reshape(shp[:-1] + (n1, n2))
@@ -484,6 +489,71 @@ def _dd_four_step_last(hi, lo, n: int, forward: bool):
     return hi, lo
 
 
+# ------------------------------------------------- Bluestein (large primes)
+
+# Largest padded length the dd Bluestein accepts: 2^18 = 512*512 is the
+# largest power of two the dd four-step covers, bounding prime axes at
+# ~131072 (the same chirp-z fallback role as dft_matmul's Bluestein,
+# itself the over-radix-13 answer the reference lacks).
+_DD_BLUESTEIN_MAX_M = DD_DENSE_MAX * DD_DENSE_MAX
+
+
+def _dd_bluestein_m(n: int) -> int | None:
+    m = 1
+    while m < 2 * n - 1:
+        m *= 2
+    return m if m <= _DD_BLUESTEIN_MAX_M else None
+
+
+@functools.lru_cache(maxsize=None)
+def _dd_bluestein_np(n: int, m: int, forward: bool):
+    """Host-exact Bluestein tables as dd pairs: the chirp and kernel
+    spectrum come from ``dft_matmul._bluestein_tables`` (ONE chirp
+    convention in the repo, like :func:`_dd_twiddle_np` reuses its
+    twiddle), with the inverse's 1/n folded into the output chirp. The
+    kernel spectrum is host-f64 ``np.fft.fft`` output (error ~1e-16,
+    below the dd pair's ~3.5e-15 storage grid), so no on-device kernel
+    transform is needed."""
+    from .dft_matmul import _bluestein_tables
+
+    w, big = _bluestein_tables(n, m, forward)
+    wout = w if forward else w / n  # inverse: numpy 1/n convention
+
+    def dd(z):
+        zh = z.astype(np.complex64)
+        return zh, (z - zh.astype(np.complex128)).astype(np.complex64)
+
+    return dd(w), dd(wout), dd(big)
+
+
+def _dd_bluestein_last(hi, lo, n: int, forward: bool):
+    """dd DFT of a last axis whose length has a prime factor above
+    ``DD_DENSE_MAX``: the chirp-z identity X_k = w_k * (x.w (*) conj-
+    chirp)_k realized as two dd four-step FFTs of the padded power-of-two
+    length m >= 2n-1 with dd chirp multiplies between (every piece is the
+    existing machinery: :func:`_dd_cmul`, :func:`fft_axis_dd`). The same
+    static input down-scale as the four-step keeps the Dekker splits
+    clear of the f32 ceiling (|FFT_m| <= m * max|x|, |B| ~ sqrt(m))."""
+    m = _dd_bluestein_m(n)
+    (wh, wl), (oh, ol), (bh, bl) = (
+        (jnp.asarray(a), jnp.asarray(b_)) for a, b_ in
+        _dd_bluestein_np(n, m, forward))
+    mu = jnp.max(jnp.abs(jnp.real(hi))) + jnp.max(jnp.abs(jnp.imag(hi)))
+    _, e = jnp.frexp(jnp.where(mu == 0, 1.0, mu))
+    # 126 (not 127): 2^-127 is subnormal and flushes to zero — a 127
+    # clip silently zeroes the whole transform for ~2^126-max inputs.
+    e = jnp.clip(e, -126, 126)
+    down = jnp.ldexp(jnp.float32(1.0), -e)
+    ah, al = _dd_cmul(hi * down, lo * down, wh, wl)
+    pad = [(0, 0)] * (ah.ndim - 1) + [(0, m - n)]
+    fh, fl = fft_axis_dd(jnp.pad(ah, pad), jnp.pad(al, pad), axis=-1)
+    gh, gl = _dd_cmul(fh, fl, bh, bl)
+    ch, cl = fft_axis_dd(gh, gl, axis=-1, forward=False)
+    yh, yl = _dd_cmul(ch[..., :n], cl[..., :n], oh, ol)
+    up = jnp.ldexp(jnp.float32(1.0), e)
+    return yh * up, yl * up
+
+
 # ------------------------------------------------------------ public API
 
 def fft_axis_dd(hi: jnp.ndarray, lo: jnp.ndarray, axis: int,
@@ -491,23 +561,28 @@ def fft_axis_dd(hi: jnp.ndarray, lo: jnp.ndarray, axis: int,
     """dd complex DFT along ``axis`` of a (hi, lo) complex64 pair.
     Forward unnormalized; inverse applies the exact 1/n (numpy
     convention, like every executor in this framework). Lengths above
-    ``DD_DENSE_MAX`` take the dd four-step — covered when n has a factor
-    pair with BOTH factors <= 512 (all smooth lengths through
-    512^2 = 262,144); lengths with a prime factor above 512 are out of
-    dd scope (a dd Bluestein would be needed)."""
+    ``DD_DENSE_MAX`` take the dd four-step when n has a factor pair with
+    BOTH factors <= 512 (all smooth lengths through 512^2 = 262,144);
+    lengths with a prime factor above 512 take the dd Bluestein
+    (chirp-z over a padded power of two, itself a dd four-step) up to
+    prime axes ~131072."""
     n = hi.shape[axis]
     four_step = n > DD_DENSE_MAX
-    if four_step and _dd_split(n) is None:
+    bluestein = four_step and _dd_split(n) is None
+    if bluestein and _dd_bluestein_m(n) is None:
         raise ValueError(
             f"dd executor: no n1*n2 split of {n} with both factors "
-            f"<= {DD_DENSE_MAX} (prime factors above 512 are out of "
-            "dd scope)"
+            f"<= {DD_DENSE_MAX}, and the Bluestein pad 2^ceil(log2(2n-1)) "
+            f"exceeds {_DD_BLUESTEIN_MAX_M} — prime axes above "
+            f"{_DD_BLUESTEIN_MAX_M // 2} are out of dd scope"
         )
     moved = axis not in (-1, hi.ndim - 1)
     if moved:
         hi = jnp.moveaxis(hi, axis, -1)
         lo = jnp.moveaxis(lo, axis, -1)
-    if four_step:
+    if bluestein:
+        out_hi, out_lo = _dd_bluestein_last(hi, lo, n, forward)
+    elif four_step:
         out_hi, out_lo = _dd_four_step_last(hi, lo, n, forward)
     else:
         cr_hi, cr_lo, ci_hi, ci_lo = _dd_dft_last(

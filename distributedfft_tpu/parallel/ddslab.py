@@ -39,10 +39,15 @@ from .slab import SlabSpec
 
 
 def _check_dd_extent(n: int, shape) -> None:
-    if n > ddfft.DD_DENSE_MAX and ddfft._dd_split(n) is None:
+    # Every per-axis transform in these pipelines is full-length local,
+    # so the coverage rule is exactly fft_axis_dd's: dense, four-step,
+    # or Bluestein (prime factors above 512, padded length <= 512^2).
+    if (n > ddfft.DD_DENSE_MAX and ddfft._dd_split(n) is None
+            and ddfft._dd_bluestein_m(n) is None):
         raise ValueError(
             f"dd pipeline: axis length {n} has no dense-coverable "
-            f"four-step split (shape {tuple(shape)})"
+            f"four-step split and exceeds the Bluestein pad bound "
+            f"(shape {tuple(shape)})"
         )
 
 
