@@ -273,6 +273,24 @@ def step_dd_roundtrip(n: int = 256) -> None:
             f"gflops={gflops(shape, sec):.1f}")
 
 
+def step_dd_bluestein(n: int = 521) -> None:
+    """The dd tier's chirp-z path on the chip: a prime axis through two
+    dd four-step FFTs plus dd chirp multiplies — a different composition
+    of the same exactness assumptions the dense rows validate."""
+    import jax
+    import numpy as np
+
+    from distributedfft_tpu.ops import ddfft
+
+    rng = np.random.default_rng(101)
+    x = rng.standard_normal((2, n)) + 1j * rng.standard_normal((2, n))
+    hi, lo = ddfft.dd_from_host(x)
+    yh, yl = jax.jit(lambda a, b: ddfft.fft_axis_dd(a, b, axis=-1))(hi, lo)
+    err = ddfft.max_err_vs_f64(yh, yl, np.fft.fft(x, axis=-1))
+    _record(f"dd_bluestein_{n}", "ok" if err < DD_GATE else "FAIL", err,
+            "prime axis via chirp-z")
+
+
 def step_matmul_high(n: int = 256) -> None:
     """The matmul:high flagship candidate (MXU four-step at the 3-pass
     bf16 tier): roundtrip gate + amortized forward rate — the row that
@@ -378,6 +396,7 @@ def main() -> int:
         (step_ragged_a2av, ()),
         (step_matmul_high, (128 if args.quick else 256,)),
         (step_dd_fwd, (32 if args.quick else 64,)),
+        (step_dd_bluestein, (521,)),
         (step_dd_slab, ()),
         (step_dd_roundtrip, (64 if args.quick else 256,)),
     ]
