@@ -57,6 +57,8 @@ def parse_args(argv):
                         "(MPI_Alltoallv analog; TPU backend only, the CPU "
                         "test backend mirrors the dense path)")
     p.add_argument("-executor", default="xla", help="local FFT backend (xla|matmul|...)")
+    p.add_argument("-r2c_axis", type=int, default=2, choices=(0, 1, 2),
+                   help="halved axis for r2c/c2r (heFFTe r2c_direction)")
     p.add_argument("-ndev", type=int, default=None, help="device count (default: all)")
     p.add_argument("-grid", type=int, nargs=2, metavar=("R", "C"),
                    help="explicit 2D pencil mesh")
@@ -147,6 +149,10 @@ def main(argv=None) -> None:
     algorithm = ("ppermute" if args.p2p_pl
                  else "alltoallv" if args.a2av else "alltoall")
 
+    if args.r2c_axis != 2 and (args.kind != "r2c"
+                               or args.precision == "dd"):
+        raise SystemExit("-r2c_axis applies to the c64/c128 r2c path only")
+
     if args.precision == "dd":
         # Emulated-double tier: the CLI meaning of "double precision" on
         # hardware without f64 (see ops/ddfft.py). c2c, single-device or
@@ -212,6 +218,8 @@ def main(argv=None) -> None:
     plan_fn = dfft.plan_dft_r2c_3d if args.kind == "r2c" else dfft.plan_dft_c2c_3d
     kw = dict(decomposition=decomposition, executor=args.executor,
               dtype=dtype, algorithm=algorithm)
+    if args.kind == "r2c" and args.r2c_axis != 2:
+        kw["r2c_axis"] = args.r2c_axis
     if args.bricks:
         if mesh is None:
             raise SystemExit("-bricks needs a multi-device mesh")
@@ -304,6 +312,13 @@ def main(argv=None) -> None:
         print("note: -staged is not available with -ingrid/-outgrid; "
               "ignoring", file=sys.stderr)
         args.staged = False
+    if args.staged and args.kind == "r2c" and args.r2c_axis != 2:
+        # Same mismatch: the staged builders run the canonical axis-2
+        # chain, while the timed plan runs the transposed view (plus a
+        # device transpose per edge).
+        print("note: -staged is not available with -r2c_axis != 2; "
+              "ignoring", file=sys.stderr)
+        args.staged = False
     if args.staged:
         stages = None
         if fwd.mesh is None:
@@ -368,7 +383,12 @@ def main(argv=None) -> None:
             "algorithm", "executor", "seconds", "gflops", "max_err",
         ))
         deco = f"bricks-{fwd.decomposition}" if args.bricks else fwd.decomposition
-        rec.record(args.kind, args.precision, *shape, ndev, deco,
+        # Non-default r2c_axis is the variable under study in an
+        # r2c_direction sweep: encode it in the kind column (schema
+        # unchanged for default rows).
+        kind = (f"r2c_axis{args.r2c_axis}"
+                if args.kind == "r2c" and args.r2c_axis != 2 else args.kind)
+        rec.record(kind, args.precision, *shape, ndev, deco,
                    algorithm, args.executor, f"{seconds:.6f}", f"{gf:.1f}",
                    f"{max_err:.3e}")
     if args.trace:

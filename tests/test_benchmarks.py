@@ -77,6 +77,27 @@ def test_speed3d_dd_tier(capsys, tmp_path):
     assert rows[1].startswith("c2c,dd,16")
 
 
+def test_speed3d_r2c_axis_flag(capsys):
+    """-r2c_axis routes heFFTe's r2c_direction through the CLI; the
+    roundtrip verify is axis-agnostic."""
+    speed3d.main(["r2c", "double", "8", "16", "8",
+                  "-ndev", "8", "-slabs", "-iters", "1", "-r2c_axis", "1"])
+    out = capsys.readouterr().out
+    assert "(16, 8, 8)" not in out  # caller convention preserved
+    assert "-> (8, 9, 8)" in out and "max error:" in out
+    err = float(out.split("max error:")[1].split()[0])
+    assert err < 1e-11
+
+
+def test_speed3d_r2c_axis_rejects_c2c_and_dd():
+    with pytest.raises(SystemExit, match="r2c path only"):
+        speed3d.main(["c2c", "double", "8", "8", "8", "-ndev", "4",
+                      "-iters", "1", "-r2c_axis", "0"])
+    with pytest.raises(SystemExit, match="r2c path only"):
+        speed3d.main(["r2c", "dd", "8", "8", "8", "-ndev", "4",
+                      "-iters", "1", "-r2c_axis", "0"])
+
+
 def test_speed3d_dd_rejects_r2c():
     with pytest.raises(SystemExit, match="c2c only"):
         speed3d.main(["r2c", "dd", "16", "16", "16", "-ndev", "4",
