@@ -801,6 +801,34 @@ def plan_dft_c2r_3d(shape, mesh=None, **kw) -> Plan3D:
     return plan_dft_r2c_3d(shape, mesh, **kw)
 
 
+def _swap_perm(axis: int) -> list[int]:
+    """The self-inverse permutation swapping ``axis`` with 2 (one perm
+    serves both directions of every transposed-view wrapper)."""
+    perm = [0, 1, 2]
+    perm[axis], perm[2] = perm[2], perm[axis]
+    return perm
+
+
+def _permute_spec3(s, perm):
+    """Permute a (possibly short) 3-dim PartitionSpec by ``perm``."""
+    if s is None:
+        return None
+    ent = tuple(s) + (None,) * (3 - len(tuple(s)))
+    return P(*(ent[p] for p in perm))
+
+
+def _permute_sharding3(sh, perm):
+    return (None if sh is None
+            else NamedSharding(sh.mesh, _permute_spec3(sh.spec, perm)))
+
+
+def _chain_convention_note(e: Exception, axis: int) -> ValueError:
+    return ValueError(
+        f"{e} [note: r2c_axis={axis} plans run on a transposed view — "
+        f"specs and extents in this message are in the chain "
+        f"convention (axes {axis} and 2 swapped)]")
+
+
 def _r2c_axis_wrapped(shape, mesh, axis: int, *, direction, decomposition,
                       executor, dtype, donate, algorithm, options, in_spec,
                       out_spec) -> Plan3D:
@@ -814,28 +842,19 @@ def _r2c_axis_wrapped(shape, mesh, axis: int, *, direction, decomposition,
     if axis not in (0, 1):
         raise ValueError(f"r2c_axis must be 0, 1, or 2; got {axis}")
     shape, forward = _check_direction(shape, direction)
-    perm = [0, 1, 2]
-    perm[axis], perm[2] = perm[2], perm[axis]
+    perm = _swap_perm(axis)
     pshape = tuple(shape[p] for p in perm)
-
-    def permute_spec(s):
-        if s is None:
-            return None
-        ent = tuple(s) + (None,) * (3 - len(tuple(s)))
-        return P(*(ent[p] for p in perm))
 
     try:
         inner = plan_dft_r2c_3d(
             pshape, mesh, direction=direction, decomposition=decomposition,
             executor=executor, dtype=dtype, donate=donate,
             algorithm=algorithm, options=options,
-            in_spec=permute_spec(in_spec), out_spec=permute_spec(out_spec),
+            in_spec=_permute_spec3(in_spec, perm),
+            out_spec=_permute_spec3(out_spec, perm),
         )
     except ValueError as e:
-        raise ValueError(
-            f"{e} [note: r2c_axis={axis} plans run on a transposed view — "
-            f"specs and extents in this message are in the chain "
-            f"convention (axes {axis} and 2 swapped)]") from e
+        raise _chain_convention_note(e, axis) from e
 
     inner_fn = inner.fn
     fn = jax.jit(
@@ -846,10 +865,6 @@ def _r2c_axis_wrapped(shape, mesh, axis: int, *, direction, decomposition,
     def permute_shape(s):
         return tuple(s[p] for p in perm)
 
-    def permute_sharding(sh):
-        return (None if sh is None
-                else NamedSharding(sh.mesh, permute_spec(sh.spec)))
-
     def permute_boxes(boxes):
         return [Box3(tuple(b.low[p] for p in perm),
                      tuple(b.high[p] for p in perm)) for b in boxes]
@@ -858,8 +873,8 @@ def _r2c_axis_wrapped(shape, mesh, axis: int, *, direction, decomposition,
         shape=shape, direction=direction, dtype=inner.dtype,
         decomposition=inner.decomposition, executor=inner.executor,
         mesh=inner.mesh, fn=fn, spec=inner.spec,
-        in_sharding=permute_sharding(inner.in_sharding),
-        out_sharding=permute_sharding(inner.out_sharding),
+        in_sharding=_permute_sharding3(inner.in_sharding, perm),
+        out_sharding=_permute_sharding3(inner.out_sharding, perm),
         in_boxes=permute_boxes(inner.in_boxes),
         out_boxes=permute_boxes(inner.out_boxes),
         in_shape=permute_shape(inner.in_shape),
@@ -956,15 +971,22 @@ def plan_dd_dft_r2c_3d(
     mesh: Mesh | int | None = None,
     *,
     direction: int = FORWARD,
+    r2c_axis: int = 2,
 ) -> DDPlan3D:
     """Real<->complex 3D plan at the emulated double tier — heFFTe's
     ``fft3d_r2c`` double gate on f32/bf16 hardware. ``shape`` is the
     real-space world; forward takes real float32 dd pairs and returns
-    half-spectrum complex dd pairs (last axis ``N2//2+1``), backward
-    inverts with numpy 1/N scaling. Single-device, 1D slab mesh, or 2D
-    pencil mesh (the latter via ``build_dd_pencil_rfft3d``)."""
+    half-spectrum complex dd pairs (``r2c_axis`` — default 2, heFFTe's
+    ``r2c_direction`` — shrunk to ``N//2+1``), backward inverts with
+    numpy 1/N scaling. Single-device, 1D slab mesh, or 2D pencil mesh
+    (the latter via ``build_dd_pencil_rfft3d``). Non-default
+    ``r2c_axis`` runs the canonical chain on a transposed view of both
+    dd components (the same discipline as :func:`plan_dft_r2c_3d`)."""
     from .ops import ddfft
 
+    if r2c_axis != 2:
+        return _dd_r2c_axis_wrapped(shape, mesh, r2c_axis,
+                                    direction=direction)
     shape, forward = _check_direction(shape, direction)
     if mesh is None:
         if forward:
@@ -1008,6 +1030,35 @@ def plan_dd_dft_c2r_3d(shape, mesh=None, **kw) -> DDPlan3D:
     """Convenience alias: the inverse of :func:`plan_dd_dft_r2c_3d`."""
     kw.setdefault("direction", BACKWARD)
     return plan_dd_dft_r2c_3d(shape, mesh, **kw)
+
+
+def _dd_r2c_axis_wrapped(shape, mesh, axis: int, *, direction) -> DDPlan3D:
+    """dd r2c/c2r with the halved axis != 2: the canonical chain runs on
+    a transposed view of BOTH dd components; shapes and shardings are
+    permuted back to the caller's convention (the
+    :func:`_r2c_axis_wrapped` discipline at the dd tier)."""
+    if axis not in (0, 1):
+        raise ValueError(f"r2c_axis must be 0, 1, or 2; got {axis}")
+    shape, _ = _check_direction(shape, direction)
+    perm = _swap_perm(axis)
+    pshape = tuple(shape[p] for p in perm)
+    try:
+        inner = plan_dd_dft_r2c_3d(pshape, mesh, direction=direction)
+    except ValueError as e:
+        raise _chain_convention_note(e, axis) from e
+
+    inner_fn = inner.fn
+
+    def fn(hi, lo):
+        yh, yl = inner_fn(jnp.transpose(hi, perm), jnp.transpose(lo, perm))
+        return jnp.transpose(yh, perm), jnp.transpose(yl, perm)
+
+    return DDPlan3D(
+        shape=shape, direction=direction, decomposition=inner.decomposition,
+        mesh=inner.mesh, fn=jax.jit(fn),
+        in_sharding=_permute_sharding3(inner.in_sharding, perm),
+        out_sharding=_permute_sharding3(inner.out_sharding, perm),
+    )
 
 
 def execute(plan: Plan3D, x, *, scale: Scale = Scale.NONE):
