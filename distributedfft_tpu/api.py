@@ -39,7 +39,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import geometry as geo
 from .geometry import Box3, world_box
-from .ops.executors import Scale, apply_scale, get_c2r, get_executor, get_r2c
+from .ops.executors import (
+    Scale, apply_scale, get_c2r, get_executor, get_r2c, scale_factor,
+)
 from .plan_logic import (
     DEFAULT_OPTIONS,
     LogicPlan,
@@ -908,8 +910,22 @@ class DDPlan3D:
     def forward(self) -> bool:
         return self.direction == FORWARD
 
-    def __call__(self, hi, lo):
-        return self.fn(hi, lo)
+    def __call__(self, hi, lo, *, scale: Scale = Scale.NONE):
+        yh, yl = self.fn(hi, lo)
+        if scale != Scale.NONE:
+            yh, yl = _jitted_dd_scale()(
+                yh, yl, scale_factor(scale, math.prod(self.shape)))
+        return yh, yl
+
+
+@functools.lru_cache(maxsize=1)
+def _jitted_dd_scale():
+    """One compiled dd-scalar product per (shapes, scale) — scaled calls
+    replay a fused kernel instead of eagerly dispatching the compensated
+    chain (the plan-owns-everything discipline)."""
+    from .ops import ddfft
+
+    return jax.jit(ddfft.dd_scale, static_argnums=2)
 
 
 def plan_dd_dft_c2c_3d(

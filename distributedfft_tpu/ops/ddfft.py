@@ -654,6 +654,30 @@ def irfftn_dd(hi: jnp.ndarray, lo: jnp.ndarray, n2: int,
     return jnp.real(hi), jnp.real(lo)
 
 
+def dd_scale(hi, lo, s: float) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Multiply a dd pair by a host scalar AT THE TIER: a plain f32
+    multiply rounds each component to 2^-24 and collapses the pair to
+    single precision. Exact powers of two short-circuit (exact f32
+    multiplies); everything else goes through the real dd x dd product
+    (~2^-48) on each component — the dd analog of the roc backend's
+    ``scale_element`` normalization kernel."""
+    if s == 1.0:
+        return hi, lo
+    m, _ = math.frexp(s)
+    if m == 0.5:  # exact power of two
+        f = jnp.float32(s)
+        return hi * f, lo * f
+    sh = np.float32(s)
+    sl = np.float32(s - float(sh))
+    if jnp.issubdtype(jnp.asarray(hi).dtype, jnp.complexfloating):
+        rh, rl = _dd_mul(jnp.real(hi), jnp.real(lo),
+                         jnp.float32(sh), jnp.float32(sl))
+        ih, il = _dd_mul(jnp.imag(hi), jnp.imag(lo),
+                         jnp.float32(sh), jnp.float32(sl))
+        return lax.complex(rh, ih), lax.complex(rl, il)
+    return _dd_mul(hi, lo, jnp.float32(sh), jnp.float32(sl))
+
+
 def max_err_vs_f64(hi, lo, want: np.ndarray) -> float:
     """max |dd - want| / max |want| against a host float64 reference —
     the roundtrip/accuracy metric of the reference harnesses

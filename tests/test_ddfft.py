@@ -466,6 +466,33 @@ def test_dd_r2c_axis_distributed_executes():
         np.abs(x)) < 1e-11
 
 
+def test_dd_plan_scale_enum():
+    """heFFTe's scale enum at the dd tier: FULL divides by N, SYMMETRIC
+    by sqrt(N), both applied as dd-scalar products that preserve the
+    tier (a plain f32 multiply would collapse the pair to 2^-24)."""
+    import distributedfft_tpu as dfft
+    from distributedfft_tpu.api import Scale
+
+    shape = (8, 8, 8)
+    n = 512
+    x = _rand_c128(shape, seed=107)
+    hi, lo = dfft.dd_from_host(x)
+    p = dfft.plan_dd_dft_c2c_3d(shape)
+    want = np.fft.fftn(x)
+    yh, yl = p(hi, lo, scale=Scale.FULL)
+    assert ddfft.max_err_vs_f64(yh, yl, want / n) < 1e-12
+    sh_, sl_ = p(hi, lo, scale=Scale.SYMMETRIC)
+    assert ddfft.max_err_vs_f64(sh_, sl_, want / np.sqrt(n)) < 1e-12
+    # real pairs (r2c side) scale too — JITTED, the regression mode for
+    # the compensated chain (XLA folds two-sum patterns eager never hits)
+    import jax
+
+    rh, rl = ddfft.dd_from_host(np.abs(x.real))
+    zh, zl = jax.jit(ddfft.dd_scale, static_argnums=2)(rh, rl, 1.0 / 3.0)
+    got = ddfft.dd_to_host(zh, zl)
+    assert np.max(np.abs(got - np.abs(x.real) / 3.0)) < 1e-12
+
+
 def test_dd_plan_info():
     import distributedfft_tpu as dfft
 
