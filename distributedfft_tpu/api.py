@@ -933,6 +933,7 @@ def plan_dd_dft_c2c_3d(
     mesh: Mesh | int | None = None,
     *,
     direction: int = FORWARD,
+    donate: bool = False,
 ) -> DDPlan3D:
     """Create a 3D C2C FFT plan at the emulated double-precision tier.
 
@@ -945,10 +946,11 @@ def plan_dd_dft_c2c_3d(
     from .ops import ddfft
 
     shape, forward = _check_direction(shape, direction)
+    dn = (0, 1) if donate else ()
     if mesh is None:
         fn = jax.jit(
             functools.partial(ddfft.fftn_dd, axes=(0, 1, 2),
-                              forward=forward))
+                              forward=forward), donate_argnums=dn)
         return DDPlan3D(shape=shape, direction=direction,
                         decomposition="single", mesh=None, fn=fn,
                         in_sharding=None, out_sharding=None)
@@ -960,7 +962,8 @@ def plan_dd_dft_c2c_3d(
         from .parallel.ddslab import build_dd_slab_fft3d
 
         fn, spec = build_dd_slab_fft3d(mesh, shape, forward=forward,
-                                       axis_name=mesh.axis_names[0])
+                                       axis_name=mesh.axis_names[0],
+                                       donate=donate)
         return DDPlan3D(
             shape=shape, direction=direction, decomposition="slab",
             mesh=mesh, fn=fn,
@@ -972,7 +975,8 @@ def plan_dd_dft_c2c_3d(
 
         row, col = mesh.axis_names[:2]
         fn, spec = build_dd_pencil_fft3d(
-            mesh, shape, row_axis=row, col_axis=col, forward=forward)
+            mesh, shape, row_axis=row, col_axis=col, forward=forward,
+            donate=donate)
         return DDPlan3D(
             shape=shape, direction=direction, decomposition="pencil",
             mesh=mesh, fn=fn,
@@ -988,6 +992,7 @@ def plan_dd_dft_r2c_3d(
     *,
     direction: int = FORWARD,
     r2c_axis: int = 2,
+    donate: bool = False,
 ) -> DDPlan3D:
     """Real<->complex 3D plan at the emulated double tier — heFFTe's
     ``fft3d_r2c`` double gate on f32/bf16 hardware. ``shape`` is the
@@ -997,13 +1002,21 @@ def plan_dd_dft_r2c_3d(
     numpy 1/N scaling. Single-device, 1D slab mesh, or 2D pencil mesh
     (the latter via ``build_dd_pencil_rfft3d``). Non-default
     ``r2c_axis`` runs the canonical chain on a transposed view of both
-    dd components (the same discipline as :func:`plan_dft_r2c_3d`)."""
+    dd components (the same discipline as :func:`plan_dft_r2c_3d`).
+    ``donate`` is accepted for API symmetry but is a no-op here: real
+    and half-spectrum buffers differ in dtype and size, so XLA can
+    never alias them."""
     from .ops import ddfft
 
     if r2c_axis != 2:
         return _dd_r2c_axis_wrapped(shape, mesh, r2c_axis,
                                     direction=direction)
     shape, forward = _check_direction(shape, direction)
+    # r2c/c2r buffers can never alias (f32 real world vs complex64
+    # half-spectrum differ in dtype and size on every decomposition), so
+    # donation would only emit unusable-donation warnings per execute:
+    # accepted for API symmetry, documented no-op.
+    del donate
     if mesh is None:
         if forward:
             fn = jax.jit(ddfft.rfftn_dd)

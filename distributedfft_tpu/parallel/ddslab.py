@@ -19,6 +19,8 @@ four-step beyond it for lengths whose factor pairs fit (1024, 2048, ...).
 
 from __future__ import annotations
 
+import functools
+
 from typing import Callable
 
 import jax
@@ -58,6 +60,7 @@ def build_dd_slab_fft3d(
     axis_name: str = "slab",
     forward: bool = True,
     algorithm: str = "alltoall",
+    donate: bool = False,
 ) -> tuple[Callable, SlabSpec]:
     """Jitted distributed dd 3D C2C transform over a 1D mesh.
 
@@ -100,7 +103,8 @@ def build_dd_slab_fft3d(
                         out_specs=(out_spec, out_spec))
     in_sh = NamedSharding(mesh, in_spec)
 
-    @jax.jit
+    @functools.partial(
+        jax.jit, donate_argnums=(0, 1) if donate else ())
     def fn(hi, lo):
         hi = _pad_axis(hi, in_axis, n_inp)
         lo = _pad_axis(lo, in_axis, n_inp)
@@ -296,6 +300,7 @@ def build_dd_pencil_fft3d(
     col_axis: str = "col",
     forward: bool = True,
     algorithm: str = "alltoall",
+    donate: bool = False,
 ) -> tuple[Callable, PencilSpec]:
     """Jitted distributed dd 3D C2C transform over a 2D (rows x cols)
     mesh — the canonical pencil chain (z-pencils -> x-pencils forward;
@@ -331,7 +336,8 @@ def build_dd_pencil_fft3d(
                         out_specs=(out_spec, out_spec))
     in_sh = NamedSharding(mesh, in_spec)
 
-    @jax.jit
+    @functools.partial(
+        jax.jit, donate_argnums=(0, 1) if donate else ())
     def fn(hi, lo):
         for ax, to in in_pads:
             hi = _pad_axis(hi, ax, to)

@@ -493,6 +493,22 @@ def test_dd_plan_scale_enum():
     assert np.max(np.abs(got - np.abs(x.real) / 3.0)) < 1e-12
 
 
+def test_dd_plan_donate():
+    """Buffer donation at the dd tier (the reference's bufferDev
+    ping-pong discipline at campaign sizes): donated plans stay at the
+    tier and invalidate their inputs."""
+    import distributedfft_tpu as dfft
+
+    shape = (8, 8, 8)
+    x = _rand_c128(shape, seed=109)
+    hi, lo = dfft.dd_from_host(x)
+    p = dfft.plan_dd_dft_c2c_3d(shape, None, donate=True)
+    yh, yl = p(hi, lo)
+    assert ddfft.max_err_vs_f64(yh, yl, np.fft.fftn(x)) < 1e-12
+    with pytest.raises((ValueError, RuntimeError)):
+        p(hi, lo)  # donated buffers are gone
+
+
 def test_dd_plan_info():
     import distributedfft_tpu as dfft
 
