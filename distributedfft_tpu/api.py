@@ -725,6 +725,15 @@ def plan_dft_r2c_3d(
         )
     shape, forward = _check_direction(shape, direction)
     opts = _resolve_options(decomposition, executor, donate, algorithm, options)
+    if opts.donate:
+        # r2c/c2r buffers can never alias (real world vs complex
+        # half-spectrum differ in dtype and size), so donation would
+        # only emit unusable-donation warnings per execute and skew the
+        # plan_info memory estimate: accepted for API symmetry,
+        # documented no-op (same policy as the dd tier).
+        import dataclasses
+
+        opts = dataclasses.replace(opts, donate=False)
     if opts.executor == "auto":
         return _auto_plan(
             functools.partial(plan_dft_r2c_3d, shape, mesh), opts,
