@@ -17,7 +17,10 @@ note "correctness smoke FIRST (real pallas_call, shard_map vma, ragged a2av, dd 
 DFFT_SWEEP_TIMEOUT=1200 python benchmarks/hw_smoke.py
 
 note "flagship bench (512^3 c2c, all executors)"
-DFFT_BENCH_DEADLINE=1500 python bench.py | tee /tmp/hw_bench.json
+# Tee into the committed results dir: a mid-round campaign line must
+# survive to the round-end commit even if nobody is watching.
+DFFT_BENCH_DEADLINE=1500 python bench.py \
+    | tee benchmarks/results/hw_bench_campaign.json
 
 note "kernel tile sweep @512 (1D + strided)"
 DFFT_SWEEP_TIMEOUT=1200 python benchmarks/tune_pallas.py \
