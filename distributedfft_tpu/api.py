@@ -714,7 +714,9 @@ def plan_dft_r2c_3d(
     Forward input is real; backward output is real with numpy 1/N
     scaling. Non-default ``r2c_axis`` runs the canonical chain on a
     transposed view (one extra device transpose per edge; the chain's
-    collectives are unchanged).
+    collectives are unchanged). ``donate`` is accepted for API symmetry
+    but is a no-op on r2c/c2r plans: real and half-spectrum buffers
+    differ in dtype and size, so XLA can never alias them.
     """
     if r2c_axis != 2:
         return _r2c_axis_wrapped(
