@@ -33,7 +33,7 @@ import sys
 import time
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
-LOG = REPO / "benchmarks" / "results" / "prober_r04.log"
+LOG = REPO / "benchmarks" / "results" / "prober_r05.log"
 
 PROBE_SRC = (
     "import jax; d = jax.devices(); "
@@ -107,12 +107,12 @@ def main() -> int:
                 ["bash", str(REPO / "benchmarks" / "hw_campaign.sh"),
                  "--short"],
                 cwd=REPO, env=camp_env,
-                stdout=(LOG.parent / "campaign_r04.log").open("a"),
+                stdout=(LOG.parent / "campaign_r05.log").open("a"),
                 stderr=subprocess.STDOUT,
             )
             _log(f"hw_campaign.sh --short finished rc={rc} "
                  f"(rows in benchmarks/csv; full log in "
-                 f"results/campaign_r04.log)")
+                 f"results/campaign_r05.log)")
             return 0 if rc == 0 else 2
         time.sleep(max(0.0, args.interval - (time.time() - t0)))
     _log(f"prober deadline reached after {attempt} attempts; tunnel never "

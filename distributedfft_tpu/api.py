@@ -91,6 +91,10 @@ class Plan3D:
     in_dtype: Any = None
     out_dtype: Any = None
     real: bool = False
+    # The halved axis of an r2c/c2r plan (heFFTe ``r2c_direction``).
+    # Stored explicitly because shape inference is ambiguous when the
+    # halved extent is 1 or 2 (N//2+1 == N there).
+    r2c_axis: int = 2
     options: PlanOptions = DEFAULT_OPTIONS
     # The resolved plan skeleton (axis assignment, stage chain, device-count
     # negotiation record) — surfaced by plan_info.
@@ -893,7 +897,7 @@ def _r2c_axis_wrapped(shape, mesh, axis: int, *, direction, decomposition,
         in_shape=permute_shape(inner.in_shape),
         out_shape=permute_shape(inner.out_shape),
         in_dtype=inner.in_dtype, out_dtype=inner.out_dtype,
-        real=True, options=inner.options, logic=inner.logic,
+        real=True, r2c_axis=axis, options=inner.options, logic=inner.logic,
     )
 
 

@@ -60,7 +60,11 @@ def install_c_api(mesh=None) -> bool:
     ``mesh`` (a Mesh, device count, or None for single-device) is the
     mesh every C-created plan runs on. Returns False when the native
     library is unavailable (no toolchain); True once C callers can use
-    the ABI. Idempotent; a second call re-points the plan mesh."""
+    the ABI. Idempotent; a second call re-points the plan mesh. The
+    native callback slots are atomics, so a reinstall can never be
+    observed torn — but reinstalling while a C thread is inside
+    ``dfft_execute_*`` may still run the *old* bridge once more; callers
+    switching meshes must quiesce C-side executes first."""
     global _installed
     lib = _native._load()
     if lib is None:

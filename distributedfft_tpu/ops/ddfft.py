@@ -664,7 +664,7 @@ def dd_scale(hi, lo, s: float) -> tuple[jnp.ndarray, jnp.ndarray]:
     if s == 1.0:
         return hi, lo
     m, _ = math.frexp(s)
-    if m == 0.5:  # exact power of two
+    if abs(m) == 0.5:  # exact (signed) power of two
         f = jnp.float32(s)
         return hi * f, lo * f
     sh = np.float32(s)

@@ -196,9 +196,9 @@ def plan_info(plan) -> str:
         lines.append(f"in sharding:  {plan.in_sharding.spec}")
         lines.append(f"out sharding: {plan.out_sharding.spec}")
     if plan.real:
-        half = plan.out_shape if plan.forward else plan.in_shape
-        full = plan.in_shape if plan.forward else plan.out_shape
-        ax = next((i for i in range(3) if half[i] != full[i]), 2)
+        # The halved axis travels on the plan (Plan3D.r2c_axis) — shape
+        # diffing is ambiguous for extents 1 and 2 where N//2+1 == N.
+        ax = getattr(plan, "r2c_axis", 2)
         if ax != 2:
             lines.append(
                 f"r2c axis: {ax} (canonical chain runs on the transposed "

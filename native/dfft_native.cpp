@@ -16,6 +16,7 @@
 // fallbacks, and tests assert bit-identical results between the two.
 
 #include <algorithm>
+#include <atomic>
 #include <chrono>
 #include <cmath>
 #include <cstdint>
@@ -294,18 +295,28 @@ typedef long long (*dfft_plan_cb)(long long nx, long long ny, long long nz,
 typedef int (*dfft_exec_cb)(long long plan_id, const float* in, float* out);
 typedef void (*dfft_destroy_cb)(long long plan_id);
 
-static dfft_plan_cb g_plan_cb = 0;
-static dfft_exec_cb g_exec_cb = 0;
-static dfft_destroy_cb g_destroy_cb = 0;
+// Callback slots are atomics: install/reinstall (e.g. switching the
+// active mesh) may race a concurrent native reader, and the Python-side
+// lock cannot cover C threads already inside dfft_execute_c2c. Atomics
+// rule out torn installs; a reinstall while an execute is in flight is
+// still the caller's quiescence problem (the old callback may run one
+// more time), which install_c_api documents.
+static std::atomic<dfft_plan_cb> g_plan_cb{0};
+static std::atomic<dfft_exec_cb> g_exec_cb{0};
+static std::atomic<dfft_destroy_cb> g_destroy_cb{0};
 
 void dfft_c_api_install(dfft_plan_cb p, dfft_exec_cb e, dfft_destroy_cb d) {
-  g_plan_cb = p;
-  g_exec_cb = e;
-  g_destroy_cb = d;
+  g_plan_cb.store(p, std::memory_order_release);
+  g_exec_cb.store(e, std::memory_order_release);
+  g_destroy_cb.store(d, std::memory_order_release);
 }
 
 int dfft_c_api_ready() {
-  return (g_plan_cb && g_exec_cb && g_destroy_cb) ? 1 : 0;
+  return (g_plan_cb.load(std::memory_order_acquire) &&
+          g_exec_cb.load(std::memory_order_acquire) &&
+          g_destroy_cb.load(std::memory_order_acquire))
+             ? 1
+             : 0;
 }
 
 // direction: -1 forward / +1 backward (FFTW sign convention, matching
@@ -313,18 +324,21 @@ int dfft_c_api_ready() {
 // -1 when the bridge is not installed / planning failed.
 long long dfft_plan_c2c_3d(long long nx, long long ny, long long nz,
                            int direction) {
-  if (!g_plan_cb) return -1;
-  return g_plan_cb(nx, ny, nz, direction);
+  dfft_plan_cb cb = g_plan_cb.load(std::memory_order_acquire);
+  if (!cb) return -1;
+  return cb(nx, ny, nz, direction);
 }
 
 // Executes the planned transform: 0 on success.
 int dfft_execute_c2c(long long plan, const float* in, float* out) {
-  if (!g_exec_cb) return 1;
-  return g_exec_cb(plan, in, out);
+  dfft_exec_cb cb = g_exec_cb.load(std::memory_order_acquire);
+  if (!cb) return 1;
+  return cb(plan, in, out);
 }
 
 void dfft_destroy_plan_c(long long plan) {
-  if (g_destroy_cb) g_destroy_cb(plan);
+  dfft_destroy_cb cb = g_destroy_cb.load(std::memory_order_acquire);
+  if (cb) cb(plan);
 }
 
 // Self-test driven entirely from compiled C: ramp data (the reference

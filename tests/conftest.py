@@ -27,10 +27,14 @@ jax.config.update("jax_enable_x64", True)
 # Persistent compilation cache: the dd tier's programs are hundreds of
 # matmuls and dominate suite wall time on a small box; repeat runs (the
 # driver's test gate, local iteration) hit the cache and skip those
-# compiles entirely. Cold runs are unaffected.
+# compiles entirely. Cold runs are unaffected. The default path is
+# per-user (uid suffix) so a shared /tmp can be neither pre-squatted
+# (permission failures) nor poisoned by another account.
 jax.config.update(
     "jax_compilation_cache_dir",
-    os.environ.get("DFFT_TEST_CACHE", "/tmp/dfft_jax_cache"),
+    os.environ.get(
+        "DFFT_TEST_CACHE", f"/tmp/dfft_jax_cache_{os.getuid()}"
+    ),
 )
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
 

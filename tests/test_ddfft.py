@@ -491,6 +491,11 @@ def test_dd_plan_scale_enum():
     zh, zl = jax.jit(ddfft.dd_scale, static_argnums=2)(rh, rl, 1.0 / 3.0)
     got = ddfft.dd_to_host(zh, zl)
     assert np.max(np.abs(got - np.abs(x.real) / 3.0)) < 1e-12
+    # negative exact powers of two take the exact f32 short-circuit too
+    # (frexp mantissa -0.5): bit-exact, not merely ~2^-48
+    nh, nl = jax.jit(ddfft.dd_scale, static_argnums=2)(rh, rl, -0.25)
+    gotn = ddfft.dd_to_host(nh, nl)
+    assert np.array_equal(gotn, ddfft.dd_to_host(rh, rl) * -0.25)
 
 
 def test_dd_plan_donate():
