@@ -68,7 +68,10 @@ def parse_args(argv):
     p.add_argument("-outgrid", type=int, nargs=3, metavar=("PX", "PY", "PZ"),
                    help="output processor grid (heFFTe -outgrid)")
     p.add_argument("-staged", action="store_true",
-                   help="separately-jitted t0..t3 stage timing (slab c2c only)")
+                   help="separately-jitted t0..t3 stage timing (slab and "
+                        "pencil, c2c and r2c; dd tier: c2c slab/single "
+                        "only; not with -bricks/-ingrid/-outgrid/"
+                        "-r2c_axis)")
     p.add_argument("-iters", type=int, default=5)
     p.add_argument("-cpu", action="store_true",
                    help="run on (virtual) CPU devices instead of TPU")
@@ -414,7 +417,7 @@ def _run_dd(args, shape, ndev) -> None:
     if args.kind != "c2c":
         raise SystemExit("-precision dd supports c2c only")
     for flag in ("bricks", "pencils", "grid", "ingrid", "outgrid",
-                 "staged", "a2av", "p2p_pl"):
+                 "a2av", "p2p_pl"):
         if getattr(args, flag, None):
             raise SystemExit(f"-{flag} is not available at the dd tier")
 
@@ -443,6 +446,20 @@ def _run_dd(args, shape, ndev) -> None:
     hi, lo = make_input()
     sync(lo)
 
+    stage_times = None
+    if args.staged:
+        from distributedfft_tpu.parallel.ddslab import (
+            build_dd_single_stages, build_dd_slab_stages,
+        )
+        from distributedfft_tpu.utils.timing import time_staged
+
+        if mesh is None:
+            stages = build_dd_single_stages(shape)
+        else:
+            stages, _ = build_dd_slab_stages(
+                mesh, shape, axis_name=mesh.axis_names[0])
+        stage_times, _ = time_staged(stages, (hi, lo), iters=args.iters)
+
     max_err = float("nan")
     if not args.no_verify:
         bh, bl = bwd(*fwd(hi, lo))
@@ -454,7 +471,7 @@ def _run_dd(args, shape, ndev) -> None:
     seconds, _ = time_fn_amortized(lambda: fwd(hi, lo), iters=args.iters,
                                    repeats=2)
     gf = gflops(shape, seconds)
-    print(result_block(shape, ndev, seconds, max_err))
+    print(result_block(shape, ndev, seconds, max_err, stage_times))
 
     if args.csv:
         rec = tr.CsvRecorder(args.csv, (

@@ -351,3 +351,102 @@ def build_dd_pencil_fft3d(
         return hi, lo
 
     return fn, spec
+
+
+def _dd_yz_planes(pair, *, forward: bool = True):
+    """The shared t0 stage body: dd transforms of the local YZ planes."""
+    hi, lo = pair
+    for ax in (1, 2):
+        hi, lo = ddfft.fft_axis_dd(hi, lo, ax, forward=forward)
+    return hi, lo
+
+
+def build_dd_single_stages(
+    shape: tuple[int, int, int],
+    *,
+    forward: bool = True,
+) -> list:
+    """Single-device dd staged pipeline — t0 (YZ planes) / t3 (X lines)
+    as separate jits over (hi, lo) pairs, the dd-tier analog of
+    ``staged.build_single_stages`` (per-stage breakdown of
+    ``fft_mpi_3d_api.cpp:184-201`` at the accuracy tier)."""
+    shape = tuple(int(s) for s in shape)
+    for n in shape:
+        _check_dd_extent(n, shape)
+
+    def yz(pair):
+        return _dd_yz_planes(pair, forward=forward)
+
+    def x_line(pair):
+        return ddfft.fft_axis_dd(*pair, 0, forward=forward)
+
+    return [("t0_dd_fft_yz", jax.jit(yz)),
+            ("t3_dd_fft_x", jax.jit(x_line))]
+
+
+def build_dd_slab_stages(
+    mesh: Mesh,
+    shape: tuple[int, int, int],
+    *,
+    axis_name: str = "slab",
+    algorithm: str = "alltoall",
+) -> tuple[list, SlabSpec]:
+    """Forward dd slab transform as separately-jitted t0/t2/t3 stages.
+
+    The dd twin of ``slab.build_slab_stages``: each stage maps a
+    (hi, lo) pair, and t2 moves both components through the same global
+    transpose. Fusing hides the ICI cost (SURVEY.md §7), so the dd tier
+    keeps a staged mode for measurement exactly like the c64 tier.
+    """
+    shape = tuple(int(s) for s in shape)
+    for n in shape:
+        _check_dd_extent(n, shape)
+    p = mesh.shape[axis_name]
+    spec = SlabSpec(shape, p, axis_name)
+    n0, n1, _ = shape
+    n0p = spec.n0p
+    xs, ys = spec.in_pspec, spec.out_pspec
+    x_slab = NamedSharding(mesh, xs)
+    y_slab = NamedSharding(mesh, ys)
+    platform = mesh.devices.flat[0].platform
+
+    def smap(f, ins, outs):
+        return _shard_map(f, mesh=mesh, in_specs=((ins, ins),),
+                          out_specs=(outs, outs))
+
+    def t0(pair):
+        hi, lo = pair
+        hi = _pad_axis(hi, 0, n0p)
+        lo = _pad_axis(lo, 0, n0p)
+        hi = lax.with_sharding_constraint(hi, x_slab)
+        lo = lax.with_sharding_constraint(lo, x_slab)
+        return smap(_dd_yz_planes, xs, xs)((hi, lo))
+
+    def local_exchange(pair):
+        kw = dict(split_axis=1, concat_axis=0, axis_size=p,
+                  algorithm=algorithm, platform=platform)
+        return (exchange_uneven(pair[0], axis_name, **kw),
+                exchange_uneven(pair[1], axis_name, **kw))
+
+    def local_x(pair):
+        hi, lo = pair
+        hi = _crop_axis(hi, 0, n0)
+        lo = _crop_axis(lo, 0, n0)
+        return ddfft.fft_axis_dd(hi, lo, 0, forward=True)
+
+    def t3(pair):
+        hi, lo = smap(local_x, ys, ys)(pair)
+        return _crop_axis(hi, 1, n1), _crop_axis(lo, 1, n1)
+
+    pair_x = (x_slab, x_slab)
+    pair_y = (y_slab, y_slab)
+    stages = [
+        ("t0_dd_fft_yz", jax.jit(t0, out_shardings=pair_x)),
+        ("t2_all_to_all", jax.jit(smap(local_exchange, xs, ys),
+                                  in_shardings=(pair_x,),
+                                  out_shardings=pair_y)),
+        # No out_shardings pin on t3: the final crop (axis 1 back to n1)
+        # need not divide the mesh for uneven worlds.
+        ("t3_dd_fft_x", jax.jit(t3, in_shardings=(pair_y,))),
+    ]
+    return stages, spec
