@@ -205,7 +205,8 @@ def step_ragged_a2av(S: int = 13) -> None:
     got = jax.jit(sm(ragged))(x)
     want = jax.jit(sm(dense))(x)
     diff = float(np.asarray(jnp.max(jnp.abs(got - want))))
-    _record(f"ragged_a2av_S{S}_p{p}", "ok" if diff == 0.0 else "FAIL", diff)
+    _record(f"ragged_all_to_all_S{S}_p{p}", "ok" if diff == 0.0 else "FAIL",
+            diff, f"first real execution of lax.ragged_all_to_all (p={p})")
 
 
 def step_dd_fwd(n: int = 64) -> None:
@@ -388,12 +389,16 @@ def main() -> int:
     n = 128 if args.quick else 512
     batch = 256 if args.quick else 4096
     steps = [
+        # a2av FIRST: lax.ragged_all_to_all is the one code path with
+        # zero executions anywhere off-chip (XLA:CPU lacks the op, the
+        # test suite mirrors it densely) — its first real execution must
+        # happen before anything else can wedge the backend.
+        (step_ragged_a2av, ()),
         (step_pallas_1d, (n, batch)),
         (step_pallas_2d, (n, 4 if not args.quick else 2)),
         (step_pallas_strided, (n, batch)),
         (step_pack_probe, (n,)),
         (step_pallas_shardmap, (64,)),
-        (step_ragged_a2av, ()),
         (step_matmul_high, (128 if args.quick else 256,)),
         (step_dd_fwd, (32 if args.quick else 64,)),
         (step_dd_bluestein, (521,)),
