@@ -401,7 +401,7 @@ def plan_dft_c2c_3d(
 
 #: Executor candidates tried by ``executor="auto"`` (override with the
 #: DFFT_AUTO_EXECUTORS env var, comma-separated).
-_AUTO_CANDIDATES = ("xla", "pallas", "matmul")
+_AUTO_CANDIDATES = ("xla", "xla_minor", "pallas", "matmul")
 
 
 def _autotune(make_plan: Callable[[str], Plan3D]) -> Plan3D:
@@ -580,7 +580,11 @@ def plan_brick_dft_c2c_3d(
     I/O travels as *brick stacks*: ``[P, *pad]`` arrays sharded one brick
     per device (see :func:`~.parallel.bricks.scatter_bricks` /
     ``gather_bricks``); ``plan.in_shape``/``plan.out_shape`` give the stack
-    shapes. The canonical chain endpoints must divide the world evenly over
+    shapes. Boxes may declare per-rank storage axis orders
+    (``Box3.order`` — heFFTe ``box3d::order``/``use_reorder``,
+    ``heffte_geometry.h:67-92``): each brick then travels in its declared
+    order and the plan's order edge canonicalizes/restores it on device.
+    The canonical chain endpoints must divide the world evenly over
     the mesh (pick a mesh whose axis sizes divide the extents); the user
     boxes themselves carry no such restriction.
     """
@@ -638,7 +642,8 @@ def _wrap_brick_io(
     (shared by the c2c and r2c brick planners)."""
     from .geometry import find_world
     from .parallel.bricks import (
-        pad_shape_for, plan_bricks_to_spec, plan_spec_to_bricks,
+        plan_bricks_to_spec, plan_spec_to_bricks, reorder_stack,
+        stack_pad_for,
     )
 
     if inner.mesh is None or inner.in_sharding is None:
@@ -669,13 +674,23 @@ def _wrap_brick_io(
                                              algorithm=brick_alg)
     from_canon, out_bspec = plan_spec_to_bricks(m, out_target, out_boxes,
                                                 algorithm=brick_alg)
+    # Per-box storage orders (heFFTe box3d::order / use_reorder): the
+    # caller's bricks arrive/leave in their declared axis order; the
+    # order edge canonicalizes before the ring and permutes back after.
+    in_reorder = reorder_stack(m, in_boxes, to_canonical=True)
+    out_reorder = reorder_stack(m, out_boxes, to_canonical=False)
     inner_fn = inner.fn
 
     jit_kw: dict = {"donate_argnums": 0} if inner.options.donate else {}
 
     @functools.partial(jax.jit, **jit_kw)
     def fn(stack):
-        return from_canon(inner_fn(to_canon(stack)))
+        if in_reorder is not None:
+            stack = in_reorder(stack)
+        out = from_canon(inner_fn(to_canon(stack)))
+        if out_reorder is not None:
+            out = out_reorder(out)
+        return out
 
     p = len(in_boxes)
     names = tuple(m.axis_names)
@@ -685,8 +700,8 @@ def _wrap_brick_io(
         decomposition=inner.decomposition, executor=inner.executor, mesh=m,
         fn=fn, spec=inner.spec, in_sharding=stack_sh, out_sharding=stack_sh,
         in_boxes=list(in_boxes), out_boxes=list(out_boxes),
-        in_shape=(p,) + pad_shape_for(in_boxes),
-        out_shape=(p,) + pad_shape_for(out_boxes),
+        in_shape=(p,) + stack_pad_for(in_boxes),
+        out_shape=(p,) + stack_pad_for(out_boxes),
         in_dtype=inner.in_dtype, out_dtype=inner.out_dtype,
         real=inner.real, options=inner.options, logic=inner.logic,
         brick_edges=(in_bspec, out_bspec),

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import itertools
 import math
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Iterable, Sequence
 
 
@@ -30,16 +30,31 @@ class Box3:
     Unlike the reference's inclusive-``high`` convention
     (``heffte_geometry.h:67``), ``high`` is exclusive, so ``shape`` is simply
     ``high - low`` and empty boxes are representable with ``low == high``.
+
+    ``order`` is the box's *storage* axis order (heFFTe ``box3d::order``,
+    ``heffte_geometry.h:67-92``): the caller's local buffer for this box
+    holds the brick transposed by ``order`` in the numpy sense —
+    ``stored = canonical.transpose(order)``, i.e. stored dimension ``j``
+    runs over world axis ``order[j]`` (slowest dimension first, C order).
+    heFFTe lists its order fast-to-slow, so a heFFTe box with order
+    ``(f, m, s)`` maps to ``order=(s, m, f)`` here. Like the reference,
+    ``order`` does not participate in box equality/comparison
+    (``box3d::operator==`` ignores order).
     """
 
     low: tuple[int, int, int]
     high: tuple[int, int, int]
+    order: tuple[int, int, int] = field(default=(0, 1, 2), compare=False)
 
     def __post_init__(self) -> None:
         if len(self.low) != 3 or len(self.high) != 3:
             raise ValueError("Box3 requires 3D low/high tuples")
         if any(h < l for l, h in zip(self.low, self.high)):
             raise ValueError(f"Box3 high must be >= low, got {self.low}..{self.high}")
+        if tuple(sorted(self.order)) != (0, 1, 2):
+            raise ValueError(
+                f"Box3 order must be a permutation of (0, 1, 2), "
+                f"got {self.order!r}")
 
     @property
     def shape(self) -> tuple[int, int, int]:
@@ -54,6 +69,17 @@ class Box3:
     def empty(self) -> bool:
         return self.size == 0
 
+    @property
+    def storage_shape(self) -> tuple[int, int, int]:
+        """Shape of the caller's buffer for this box: ``shape`` permuted
+        by ``order`` (identity order -> ``shape``)."""
+        s = self.shape
+        return tuple(s[o] for o in self.order)  # type: ignore[return-value]
+
+    def with_order(self, order: Sequence[int]) -> "Box3":
+        """Same box, different declared storage order."""
+        return Box3(self.low, self.high, tuple(int(o) for o in order))  # type: ignore[arg-type]
+
     def contains(self, other: "Box3") -> bool:
         return all(
             sl <= ol and oh <= sh
@@ -63,7 +89,7 @@ class Box3:
     def intersect(self, other: "Box3") -> "Box3":
         low = tuple(max(a, b) for a, b in zip(self.low, other.low))
         high = tuple(max(l, min(a, b)) for l, a, b in zip(low, self.high, other.high))
-        return Box3(low, high)  # type: ignore[arg-type]
+        return Box3(low, high, self.order)  # type: ignore[arg-type]
 
     def slices(self) -> tuple[slice, slice, slice]:
         """Numpy-style slices selecting this box out of the world array."""
@@ -81,7 +107,7 @@ class Box3:
         n = self.high[axis] - self.low[axis]
         high = list(self.high)
         high[axis] = self.low[axis] + n // 2 + 1
-        return Box3(self.low, tuple(high))  # type: ignore[arg-type]
+        return Box3(self.low, tuple(high), self.order)  # type: ignore[arg-type]
 
 
 def world_box(shape: Sequence[int]) -> Box3:

@@ -56,6 +56,8 @@ __all__ = [
     "scatter_bricks",
     "gather_bricks",
     "pad_shape_for",
+    "stack_pad_for",
+    "reorder_stack",
 ]
 
 
@@ -820,11 +822,11 @@ def scatter_bricks(
     builds brick stacks directly on device.
     """
     if pad is None:
-        pad = pad_shape_for(boxes)
+        pad = stack_pad_for(boxes)
     stack = np.zeros((len(boxes),) + tuple(pad), x.dtype)
     for i, b in enumerate(boxes):
-        s = b.shape
-        stack[i, : s[0], : s[1], : s[2]] = x[b.slices()]
+        s = b.storage_shape
+        stack[i, : s[0], : s[1], : s[2]] = x[b.slices()].transpose(b.order)
     if mesh is None:
         return stack
     names, _ = _resolve_axes(mesh, axis_name)
@@ -833,11 +835,97 @@ def scatter_bricks(
 
 
 def gather_bricks(stack, boxes: Sequence[Box3]) -> np.ndarray:
-    """Brick stack [P, *pad] -> host world array (test/verification side)."""
+    """Brick stack [P, *pad] -> host world array (test/verification side).
+    Each brick is read in its box's declared storage ``order``."""
     world = find_world(boxes)
     out = np.zeros(world.shape, np.asarray(stack[0]).dtype)
     arr = np.asarray(stack)
     for i, b in enumerate(boxes):
-        s = b.shape
-        out[b.slices()] = arr[i, : s[0], : s[1], : s[2]]
+        s = b.storage_shape
+        out[b.slices()] = arr[i, : s[0], : s[1], : s[2]].transpose(
+            _inv_perm(b.order))
     return out
+
+
+# --------------------------------------------------- per-box storage order
+
+def stack_pad_for(boxes: Sequence[Box3]) -> tuple[int, int, int]:
+    """Common padded shape of a *user-facing* brick stack: max extents of
+    the boxes' storage shapes (``Box3.order`` applied). Identity orders
+    collapse to :func:`pad_shape_for`."""
+    return tuple(max(b.storage_shape[d] for b in boxes) for d in range(3))
+
+
+def _inv_perm(order) -> tuple[int, int, int]:
+    """Inverse of a 3-axis permutation: transpose(order) then
+    transpose(_inv_perm(order)) is the identity."""
+    return tuple(sorted(range(3), key=lambda a: order[a]))
+
+
+def has_orders(boxes: Sequence[Box3]) -> bool:
+    return any(tuple(b.order) != (0, 1, 2) for b in boxes)
+
+
+def _fix_extents(x: jnp.ndarray, pad: tuple[int, int, int]) -> jnp.ndarray:
+    """Crop/zero-pad each axis of a 3D block to ``pad`` (true brick data
+    lives at the low corner and fits either way)."""
+    for a, want in enumerate(pad):
+        if x.shape[a] > want:
+            x = lax.slice_in_dim(x, 0, want, axis=a)
+        elif x.shape[a] < want:
+            w = [(0, 0)] * 3
+            w[a] = (0, want - x.shape[a])
+            x = jnp.pad(x, w)
+    return x
+
+
+def reorder_stack(
+    mesh: Mesh,
+    boxes: Sequence[Box3],
+    *,
+    to_canonical: bool,
+    axis_name=None,
+):
+    """Device-side order edge for brick stacks (heFFTe ``transpose_packer``
+    / ``plan_options::use_reorder`` role, ``heffte_pack3d.h:116``,
+    ``heffte_plan_logic.h:69-89``, applied at the user I/O boundary).
+
+    Returns a shard_map'd function mapping a brick stack between the
+    callers' declared storage orders and canonical (x, y, z) axis order:
+
+    * ``to_canonical=True``: ``[P, *stack_pad_for]`` (each brick stored as
+      ``canonical.transpose(box.order)``) -> ``[P, *pad_shape_for]``.
+    * ``to_canonical=False``: the inverse edge for plan outputs.
+
+    Each device's permutation is static plan data; inside ``shard_map``
+    the per-device transpose is selected by ``lax.switch`` on the
+    linearized device index (XLA dedups identical branches, so the
+    common all-identity-but-one case stays small). Returns ``None`` when
+    every order is the identity (no edge needed).
+    """
+    if not has_orders(boxes):
+        return None
+    names, p = _resolve_axes(mesh, axis_name)
+    if len(boxes) != p:
+        raise ValueError(f"need {p} boxes, got {len(boxes)}")
+    spad = stack_pad_for(boxes)
+    cpad = pad_shape_for(boxes)
+
+    def branch(order):
+        inv = _inv_perm(order)
+
+        def run(block):
+            if to_canonical:
+                return _fix_extents(jnp.transpose(block, inv), cpad)
+            return _fix_extents(jnp.transpose(block, order), spad)
+
+        return run
+
+    branches = [branch(tuple(b.order)) for b in boxes]
+
+    def local(x):
+        i = lax.axis_index(names)
+        return lax.switch(i, branches, x[0])[None]
+
+    return _shard_map(local, mesh=mesh, in_specs=P(names, None, None, None),
+                      out_specs=P(names, None, None, None))

@@ -427,3 +427,84 @@ def test_brick_plan_scale_and_donate():
     np.testing.assert_allclose(gather_bricks(y, outs), np.fft.fftn(x),
                                atol=1e-3)
     assert stack2.is_deleted()  # donation consumed the input stack
+
+
+# ------------------------------------------------- per-box storage order
+
+def test_box3_order_field():
+    b = Box3((0, 0, 0), (4, 6, 8), (2, 0, 1))
+    assert b.storage_shape == (8, 4, 6)
+    assert b.r2c(2).order == (2, 0, 1)
+    # equality ignores order, like heffte box3d::operator==
+    assert b == Box3((0, 0, 0), (4, 6, 8))
+    with pytest.raises(ValueError):
+        Box3((0, 0, 0), (4, 4, 4), (0, 0, 2))
+
+
+def test_scatter_gather_bricks_with_orders():
+    shape = (8, 6, 4)
+    w = world_box(shape)
+    boxes = [b.with_order(o) for b, o in zip(
+        make_slabs(w, 4, axis=0),
+        [(0, 1, 2), (2, 1, 0), (1, 2, 0), (0, 2, 1)])]
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal(shape) + 1j * rng.standard_normal(shape)
+    stack = scatter_bricks(x, boxes)
+    # each brick travels transposed by its order
+    b1 = boxes[1]
+    s1 = b1.storage_shape
+    np.testing.assert_array_equal(
+        stack[1, :s1[0], :s1[1], :s1[2]],
+        x[b1.slices()].transpose(b1.order))
+    np.testing.assert_array_equal(gather_bricks(stack, boxes), x)
+
+
+@pytest.mark.parametrize("algorithm", ["alltoall", "alltoallv"])
+def test_brick_plan_shuffled_orders(algorithm):
+    """heFFTe's shuffled-order fft3d test (test_fft3d.h:155-167 with
+    box3d::order variations): per-rank bricks whose buffers are stored in
+    non-canonical axis orders, different on input and output."""
+    shape = (16, 12, 8)
+    mesh = dfft.make_mesh(8)
+    w = world_box(shape)
+    in_orders = [(0, 1, 2), (2, 1, 0), (1, 0, 2), (2, 0, 1),
+                 (0, 2, 1), (1, 2, 0), (0, 1, 2), (2, 1, 0)]
+    out_orders = list(reversed(in_orders))
+    ins = [b.with_order(o) for b, o in zip(
+        make_pencils(w, (4, 2), 2), in_orders)]
+    outs = [b.with_order(o) for b, o in zip(
+        make_slabs(w, 8, axis=1, rule=ceil_splits), out_orders)]
+    rng = np.random.default_rng(11)
+    x = (rng.standard_normal(shape)
+         + 1j * rng.standard_normal(shape)).astype(np.complex64)
+    plan = dfft.plan_brick_dft_c2c_3d(
+        shape, mesh, ins, outs, dtype=np.complex64, algorithm=algorithm)
+    assert plan.in_shape == (8,) + tuple(
+        max(b.storage_shape[d] for b in ins) for d in range(3))
+    stack = scatter_bricks(x, ins, mesh=mesh)
+    got = gather_bricks(plan(stack), outs)
+    ref = np.fft.fftn(x)
+    assert np.max(np.abs(got - ref)) / np.max(np.abs(ref)) < 1e-3
+
+
+def test_brick_r2c_shuffled_orders_roundtrip():
+    shape = (8, 12, 16)
+    mesh = dfft.make_mesh(8)
+    w = world_box(shape)
+    cw = world_box((8, 12, 16 // 2 + 1))
+    ins = [b.with_order((1, 2, 0)) for b in make_slabs(w, 8, axis=0)]
+    outs = [b.with_order((2, 0, 1)) for b in
+            make_slabs(cw, 8, axis=0, rule=ceil_splits)]
+    rng = np.random.default_rng(13)
+    x = rng.standard_normal(shape).astype(np.float32)
+    fwd = dfft.plan_brick_dft_r2c_3d(shape, mesh, ins, outs,
+                                     dtype=np.complex64)
+    bwd = dfft.plan_brick_dft_c2r_3d(shape, mesh, outs, ins,
+                                     dtype=np.complex64)
+    stack = scatter_bricks(x, ins, mesh=mesh)
+    y = fwd(stack)
+    ref = np.fft.rfftn(x)
+    got = gather_bricks(y, outs)
+    assert np.max(np.abs(got - ref)) / np.max(np.abs(ref)) < 1e-3
+    back = gather_bricks(bwd(y), ins)
+    np.testing.assert_allclose(back, x, atol=1e-4)
