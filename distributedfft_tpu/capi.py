@@ -19,6 +19,29 @@ native side's ``dfft_c_selftest`` drives the complete plan → execute →
 destroy lifecycle from compiled C — the proof the ABI carries a real
 transform, not a Python detour (``tests/test_capi.py``).
 
+The *typed* surface (heFFTe's full C type matrix, ``heffte_c.h:63,
+141-179``) extends this through a second callback pair
+(``dfft_c_api_install_typed``):
+
+.. code-block:: c
+
+    long long dfft_plan_r2c_3d(nx, ny, nz, direction, r2c_axis);
+    int       dfft_execute_r2c / dfft_execute_c2r(plan, float*, float*);
+    long long dfft_plan_z2z_3d(nx, ny, nz, direction);       /* double */
+    int       dfft_execute_z2z(plan, double*, double*);
+    long long dfft_plan_d2z_3d(nx, ny, nz, direction, axis); /* double r2c */
+    int       dfft_execute_d2z / dfft_execute_z2d(plan, double*, double*);
+    int       dfft_upload(plan, const void*);   /* plan-resident buffers */
+    int       dfft_execute_resident(plan);
+    int       dfft_download(plan, void*);
+
+Double buffers are plain C doubles; the bridge splits them into (hi, lo)
+float32 dd pairs (:mod:`.ops.ddfft`) and recombines on output — the
+framework's f64 tier on f32/bf16 hardware. The resident-buffer ops keep
+input/output on device between calls, so a C driver can repeat-execute
+(the reference's warm + timed loop, ``fftSpeed3d_c2c.cpp:94-98``)
+without a host round-trip per call.
+
 Single-process scope: the C caller sees the whole world array; plans may
 still be distributed over a local mesh (the bridge scatters/gathers
 through the plan's shardings). Multi-host C drivers are out of scope —
@@ -34,7 +57,8 @@ import numpy as np
 
 from . import native as _native
 
-__all__ = ["install_c_api", "c_api_installed", "c_selftest"]
+__all__ = ["install_c_api", "c_api_installed", "c_selftest",
+           "c_selftest_r2c", "c_selftest_z2z", "c_selftest_resident"]
 
 _lock = threading.Lock()
 _installed = False
@@ -42,7 +66,9 @@ _installed = False
 # freed with their Python wrapper, and a dangling pointer in the native
 # table would crash the next C caller.
 _keepalive: list = []
-_plans: dict[int, tuple] = {}
+# pid -> _Entry; shared by the v1 (c2c) and typed surfaces, so one
+# destroy entry point serves every plan kind.
+_plans: dict[int, "_Entry"] = {}
 _next_id = 0
 
 _PLAN_FN = ctypes.CFUNCTYPE(
@@ -52,6 +78,36 @@ _EXEC_FN = ctypes.CFUNCTYPE(
     ctypes.c_int, ctypes.c_longlong, ctypes.POINTER(ctypes.c_float),
     ctypes.POINTER(ctypes.c_float))
 _DESTROY_FN = ctypes.CFUNCTYPE(None, ctypes.c_longlong)
+# Typed surface: plan2(kind, nx, ny, nz, direction, axis) and
+# exec2(plan, op, in, out) — see the native dispatch table
+# (dfft_c_api_install_typed) for the kind/op codes.
+_PLAN2_FN = ctypes.CFUNCTYPE(
+    ctypes.c_longlong, ctypes.c_int, ctypes.c_longlong, ctypes.c_longlong,
+    ctypes.c_longlong, ctypes.c_int, ctypes.c_int)
+_EXEC2_FN = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_longlong, ctypes.c_int, ctypes.c_void_p,
+    ctypes.c_void_p)
+
+_KIND_C2C_F, _KIND_R2C_F, _KIND_C2C_D, _KIND_R2C_D = 0, 1, 2, 3
+_OP_EXEC, _OP_UPLOAD, _OP_RUN, _OP_DOWNLOAD = 0, 1, 2, 3
+
+
+class _Entry:
+    """Registry record for one C-created plan: the compiled plan, its
+    host-buffer geometry, and (when used) the resident device buffers."""
+
+    __slots__ = ("plan", "kind", "in_shape", "out_shape", "in_np",
+                 "out_np", "resident_in", "resident_out")
+
+    def __init__(self, plan, kind, in_shape, out_shape, in_np, out_np):
+        self.plan = plan
+        self.kind = kind
+        self.in_shape = in_shape    # host logical shape of the input
+        self.out_shape = out_shape  # host logical shape of the output
+        self.in_np = in_np          # host numpy dtype of the input
+        self.out_np = out_np        # host numpy dtype of the output
+        self.resident_in = None
+        self.resident_out = None
 
 
 def install_c_api(mesh=None) -> bool:
@@ -72,37 +128,145 @@ def install_c_api(mesh=None) -> bool:
 
     from . import api as _api
 
-    @_PLAN_FN
-    def _plan(nx, ny, nz, direction):
+    def _half(shape, axis):
+        s = list(shape)
+        s[axis] = s[axis] // 2 + 1
+        return tuple(s)
+
+    def _make_entry(kind, shape, direction, axis):
+        """Build the plan + host-geometry record for one C plan request."""
+        fwd = direction == _api.FORWARD
+        if kind == _KIND_C2C_F:
+            p = _api.plan_dft_c2c_3d(shape, mesh, direction=direction,
+                                     dtype=np.complex64)
+            return _Entry(p, kind, shape, shape, np.complex64, np.complex64)
+        if kind == _KIND_R2C_F:
+            h = _half(shape, axis)
+            if fwd:
+                p = _api.plan_dft_r2c_3d(shape, mesh, r2c_axis=axis,
+                                         dtype=np.complex64)
+                return _Entry(p, kind, shape, h, np.float32, np.complex64)
+            p = _api.plan_dft_c2r_3d(shape, mesh, r2c_axis=axis,
+                                     dtype=np.complex64)
+            return _Entry(p, kind, h, shape, np.complex64, np.float32)
+        if kind == _KIND_C2C_D:
+            p = _api.plan_dd_dft_c2c_3d(shape, mesh, direction=direction)
+            return _Entry(p, kind, shape, shape, np.complex128,
+                          np.complex128)
+        if kind == _KIND_R2C_D:
+            h = _half(shape, axis)
+            if fwd:
+                p = _api.plan_dd_dft_r2c_3d(shape, mesh, r2c_axis=axis)
+                return _Entry(p, kind, shape, h, np.float64, np.complex128)
+            p = _api.plan_dd_dft_c2r_3d(shape, mesh, r2c_axis=axis)
+            return _Entry(p, kind, h, shape, np.complex128, np.float64)
+        return None
+
+    def _register(kind, nx, ny, nz, direction, axis):
         global _next_id
-        if min(nx, ny, nz) < 1 or direction not in (-1, 1):
+        if (min(nx, ny, nz) < 1 or direction not in (-1, 1)
+                or axis not in (0, 1, 2) or not 0 <= kind <= 3):
             return -1  # C-side argument validation: no zero-extent plans
         try:
-            p = _api.plan_dft_c2c_3d(
-                (int(nx), int(ny), int(nz)), mesh, direction=int(direction),
-                dtype=np.complex64)
+            entry = _make_entry(kind, (int(nx), int(ny), int(nz)),
+                                int(direction), int(axis))
         except Exception:
+            return -1
+        if entry is None:
             return -1
         with _lock:
             pid = _next_id
             _next_id += 1
-            _plans[pid] = (p, (int(nx), int(ny), int(nz)))
+            _plans[pid] = entry
         return pid
+
+    def _host_view(ptr, shape, np_dtype):
+        """Reinterpret a C buffer pointer as the numpy array the entry's
+        side declares (interleaved re/im floats or doubles for complex)."""
+        n = int(np.prod(shape))
+        if np.issubdtype(np_dtype, np.complexfloating):
+            base = (ctypes.c_float if np_dtype == np.complex64
+                    else ctypes.c_double)
+            buf = np.ctypeslib.as_array(
+                ctypes.cast(ptr, ctypes.POINTER(base)), shape=(2 * n,))
+            return buf.view(np_dtype).reshape(shape)
+        base = ctypes.c_float if np_dtype == np.float32 else ctypes.c_double
+        return np.ctypeslib.as_array(
+            ctypes.cast(ptr, ctypes.POINTER(base)), shape=(n,)).reshape(shape)
+
+    def _to_device(entry, x_np):
+        """Host array -> the plan's device-side input value."""
+        import jax
+
+        from .ops import ddfft as _dd
+
+        sh = getattr(entry.plan, "in_sharding", None)
+        if entry.kind in (_KIND_C2C_D, _KIND_R2C_D):
+            hi, lo = _dd.dd_from_host(x_np)
+            if sh is not None:
+                hi, lo = jax.device_put(hi, sh), jax.device_put(lo, sh)
+            return (hi, lo)
+        return jax.device_put(x_np) if sh is None else jax.device_put(
+            x_np, sh)
+
+    def _run(entry, dev_in):
+        if entry.kind in (_KIND_C2C_D, _KIND_R2C_D):
+            return entry.plan(*dev_in)
+        return entry.plan(dev_in)
+
+    def _to_host(entry, dev_out):
+        from .ops import ddfft as _dd
+
+        if entry.kind in (_KIND_C2C_D, _KIND_R2C_D):
+            return _dd.dd_to_host(*dev_out).astype(entry.out_np, copy=False)
+        return np.asarray(dev_out, dtype=entry.out_np)
+
+    @_PLAN_FN
+    def _plan(nx, ny, nz, direction):
+        return _register(_KIND_C2C_F, nx, ny, nz, direction, 2)
 
     @_EXEC_FN
     def _exec(pid, in_ptr, out_ptr):
+        return _exec2(pid, _OP_EXEC,
+                      ctypes.cast(in_ptr, ctypes.c_void_p),
+                      ctypes.cast(out_ptr, ctypes.c_void_p))
+
+    @_PLAN2_FN
+    def _plan2(kind, nx, ny, nz, direction, axis):
+        return _register(kind, nx, ny, nz, direction, axis)
+
+    @_EXEC2_FN
+    def _exec2(pid, op, in_ptr, out_ptr):
         with _lock:
             entry = _plans.get(int(pid))
         if entry is None:
             return 2
-        plan, shape = entry
-        n = shape[0] * shape[1] * shape[2]
         try:
-            buf = np.ctypeslib.as_array(in_ptr, shape=(2 * n,))
-            x = buf.view(np.complex64).reshape(shape)
-            y = np.asarray(plan(x), dtype=np.complex64)
-            out = np.ctypeslib.as_array(out_ptr, shape=(2 * n,))
-            out.view(np.complex64).reshape(shape)[...] = y
+            if op == _OP_EXEC:
+                x = _host_view(in_ptr, entry.in_shape, entry.in_np)
+                y = _to_host(entry, _run(entry, _to_device(entry, x)))
+                _host_view(out_ptr, entry.out_shape, entry.out_np)[...] = y
+            elif op == _OP_UPLOAD:
+                x = _host_view(in_ptr, entry.in_shape, entry.in_np)
+                entry.resident_in = _to_device(entry, np.array(x))
+                # A new upload invalidates any previous run's output —
+                # downloading before the next execute must be an error
+                # (code 5), never stale data with rc 0.
+                entry.resident_out = None
+            elif op == _OP_RUN:
+                if entry.resident_in is None:
+                    return 4
+                from .utils.timing import sync
+
+                entry.resident_out = _run(entry, entry.resident_in)
+                sync(entry.resident_out)
+            elif op == _OP_DOWNLOAD:
+                if entry.resident_out is None:
+                    return 5
+                y = _to_host(entry, entry.resident_out)
+                _host_view(out_ptr, entry.out_shape, entry.out_np)[...] = y
+            else:
+                return 6
         except Exception:
             return 3
         return 0
@@ -113,11 +277,13 @@ def install_c_api(mesh=None) -> bool:
             _plans.pop(int(pid), None)
 
     lib.dfft_c_api_install.argtypes = [_PLAN_FN, _EXEC_FN, _DESTROY_FN]
+    lib.dfft_c_api_install_typed.argtypes = [_PLAN2_FN, _EXEC2_FN]
     with _lock:
         # Append (never replace) under the lock: a reinstall must not
         # drop the trampolines an in-flight C call may still be using.
-        _keepalive.extend([_plan, _exec, _destroy])
+        _keepalive.extend([_plan, _exec, _destroy, _plan2, _exec2])
         lib.dfft_c_api_install(_plan, _exec, _destroy)
+        lib.dfft_c_api_install_typed(_plan2, _exec2)
         _installed = True
     return True
 
@@ -140,3 +306,40 @@ def c_selftest(shape=(8, 6, 5)) -> float:
     lib.dfft_c_selftest.restype = ctypes.c_double
     lib.dfft_c_selftest.argtypes = [ctypes.c_longlong] * 3
     return float(lib.dfft_c_selftest(*map(int, shape)))
+
+
+def c_selftest_r2c(shape=(8, 6, 5), r2c_axis: int = 2) -> float:
+    """C-driven r2c/c2r roundtrip through the typed ABI
+    (``dfft_c_selftest_r2c``); negative = failure."""
+    lib = _native._load()
+    if lib is None:
+        return -1.0
+    lib.dfft_c_selftest_r2c.restype = ctypes.c_double
+    lib.dfft_c_selftest_r2c.argtypes = [ctypes.c_longlong] * 3 + [
+        ctypes.c_int]
+    return float(lib.dfft_c_selftest_r2c(*map(int, shape), int(r2c_axis)))
+
+
+def c_selftest_z2z(shape=(8, 6, 5)) -> float:
+    """C-driven DOUBLE roundtrip (dd tier) through the typed ABI
+    (``dfft_c_selftest_z2z``); the 1e-11 double gate from compiled C."""
+    lib = _native._load()
+    if lib is None:
+        return -1.0
+    lib.dfft_c_selftest_z2z.restype = ctypes.c_double
+    lib.dfft_c_selftest_z2z.argtypes = [ctypes.c_longlong] * 3
+    return float(lib.dfft_c_selftest_z2z(*map(int, shape)))
+
+
+def c_selftest_resident(shape=(8, 6, 5), repeats: int = 3) -> float:
+    """C-driven plan-resident lifecycle: upload once, execute
+    ``repeats`` times device-side, download once, inverse, roundtrip
+    error (``dfft_c_selftest_resident``); negative = failure."""
+    lib = _native._load()
+    if lib is None:
+        return -1.0
+    lib.dfft_c_selftest_resident.restype = ctypes.c_double
+    lib.dfft_c_selftest_resident.argtypes = [ctypes.c_longlong] * 3 + [
+        ctypes.c_int]
+    return float(lib.dfft_c_selftest_resident(*map(int, shape),
+                                              int(repeats)))
