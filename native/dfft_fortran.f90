@@ -12,9 +12,12 @@
 ! arrays pass through unchanged), C-order [nx][ny][nz] worlds — note the
 ! layout is C-order, so a Fortran-natural (nz, ny, nx) array maps directly.
 !
-! No Fortran toolchain ships in this repo's build image, so this module is
-! provided as source and is NOT exercised by CI (PARITY.md H10 records the
-! gap); it compiles with gfortran >= 5 / flang against libdfft_native.so.
+! Verification: tests/test_fortran_binding.py cross-validates every
+! bind(c) interface below against the extern "C" declarations in
+! dfft_native.cpp (a vendored checker — no Fortran toolchain ships in
+! this repo's build image), and CI installs gfortran to compile this
+! module plus dfft_fortran_smoke.f90 and run a transform driven from
+! Fortran (make -C native fortran).
 
 module dfft
   use, intrinsic :: iso_c_binding
@@ -59,6 +62,131 @@ module dfft
        integer(c_long_long), value :: nx, ny, nz
        real(c_double) :: err
      end function dfft_c_selftest
+
+     ! --- typed surface (heffte_c.h:63,141-179 parity) ---
+
+     ! long long dfft_plan_r2c_3d(nx, ny, nz, int direction, int r2c_axis)
+     function dfft_plan_r2c_3d(nx, ny, nz, direction, r2c_axis) &
+          bind(c) result(plan)
+       import :: c_long_long, c_int
+       integer(c_long_long), value :: nx, ny, nz
+       integer(c_int), value :: direction, r2c_axis
+       integer(c_long_long) :: plan
+     end function dfft_plan_r2c_3d
+
+     ! int dfft_execute_r2c(long long plan, const float* in, float* out)
+     function dfft_execute_r2c(plan, input, output) bind(c) result(rc)
+       import :: c_long_long, c_int, c_float
+       integer(c_long_long), value :: plan
+       real(c_float), dimension(*), intent(in) :: input
+       real(c_float), dimension(*), intent(out) :: output
+       integer(c_int) :: rc
+     end function dfft_execute_r2c
+
+     ! int dfft_execute_c2r(long long plan, const float* in, float* out)
+     function dfft_execute_c2r(plan, input, output) bind(c) result(rc)
+       import :: c_long_long, c_int, c_float
+       integer(c_long_long), value :: plan
+       real(c_float), dimension(*), intent(in) :: input
+       real(c_float), dimension(*), intent(out) :: output
+       integer(c_int) :: rc
+     end function dfft_execute_c2r
+
+     ! long long dfft_plan_z2z_3d(nx, ny, nz, int direction)  (double tier)
+     function dfft_plan_z2z_3d(nx, ny, nz, direction) bind(c) result(plan)
+       import :: c_long_long, c_int
+       integer(c_long_long), value :: nx, ny, nz
+       integer(c_int), value :: direction
+       integer(c_long_long) :: plan
+     end function dfft_plan_z2z_3d
+
+     ! int dfft_execute_z2z(long long plan, const double* in, double* out)
+     function dfft_execute_z2z(plan, input, output) bind(c) result(rc)
+       import :: c_long_long, c_int, c_double
+       integer(c_long_long), value :: plan
+       real(c_double), dimension(*), intent(in) :: input
+       real(c_double), dimension(*), intent(out) :: output
+       integer(c_int) :: rc
+     end function dfft_execute_z2z
+
+     ! long long dfft_plan_d2z_3d(nx, ny, nz, int direction, int r2c_axis)
+     function dfft_plan_d2z_3d(nx, ny, nz, direction, r2c_axis) &
+          bind(c) result(plan)
+       import :: c_long_long, c_int
+       integer(c_long_long), value :: nx, ny, nz
+       integer(c_int), value :: direction, r2c_axis
+       integer(c_long_long) :: plan
+     end function dfft_plan_d2z_3d
+
+     ! int dfft_execute_d2z(long long plan, const double* in, double* out)
+     function dfft_execute_d2z(plan, input, output) bind(c) result(rc)
+       import :: c_long_long, c_int, c_double
+       integer(c_long_long), value :: plan
+       real(c_double), dimension(*), intent(in) :: input
+       real(c_double), dimension(*), intent(out) :: output
+       integer(c_int) :: rc
+     end function dfft_execute_d2z
+
+     ! int dfft_execute_z2d(long long plan, const double* in, double* out)
+     function dfft_execute_z2d(plan, input, output) bind(c) result(rc)
+       import :: c_long_long, c_int, c_double
+       integer(c_long_long), value :: plan
+       real(c_double), dimension(*), intent(in) :: input
+       real(c_double), dimension(*), intent(out) :: output
+       integer(c_int) :: rc
+     end function dfft_execute_z2d
+
+     ! --- plan-resident device buffers ---
+
+     ! int dfft_upload(long long plan, const void* in)
+     function dfft_upload(plan, input) bind(c) result(rc)
+       import :: c_long_long, c_int, c_ptr
+       integer(c_long_long), value :: plan
+       type(c_ptr), value :: input
+       integer(c_int) :: rc
+     end function dfft_upload
+
+     ! int dfft_execute_resident(long long plan)
+     function dfft_execute_resident(plan) bind(c) result(rc)
+       import :: c_long_long, c_int
+       integer(c_long_long), value :: plan
+       integer(c_int) :: rc
+     end function dfft_execute_resident
+
+     ! int dfft_download(long long plan, void* out)
+     function dfft_download(plan, output) bind(c) result(rc)
+       import :: c_long_long, c_int, c_ptr
+       integer(c_long_long), value :: plan
+       type(c_ptr), value :: output
+       integer(c_int) :: rc
+     end function dfft_download
+
+     ! --- typed selftests ---
+
+     ! double dfft_c_selftest_r2c(nx, ny, nz, int r2c_axis)
+     function dfft_c_selftest_r2c(nx, ny, nz, r2c_axis) &
+          bind(c) result(err)
+       import :: c_long_long, c_int, c_double
+       integer(c_long_long), value :: nx, ny, nz
+       integer(c_int), value :: r2c_axis
+       real(c_double) :: err
+     end function dfft_c_selftest_r2c
+
+     ! double dfft_c_selftest_z2z(nx, ny, nz)
+     function dfft_c_selftest_z2z(nx, ny, nz) bind(c) result(err)
+       import :: c_long_long, c_double
+       integer(c_long_long), value :: nx, ny, nz
+       real(c_double) :: err
+     end function dfft_c_selftest_z2z
+
+     ! double dfft_c_selftest_resident(nx, ny, nz, int repeats)
+     function dfft_c_selftest_resident(nx, ny, nz, repeats) &
+          bind(c) result(err)
+       import :: c_long_long, c_int, c_double
+       integer(c_long_long), value :: nx, ny, nz
+       integer(c_int), value :: repeats
+       real(c_double) :: err
+     end function dfft_c_selftest_resident
   end interface
 
 end module dfft
