@@ -183,6 +183,53 @@ def bench_donated(shape, mesh, dtype, executor: str):
     return best
 
 
+# Public per-chip peak specs for achieved-vs-peak (MFU/roofline)
+# reporting: device_kind substring -> (bf16 peak TFlop/s, HBM GB/s).
+_TPU_SPECS = {
+    "v5 lite": (197.0, 819.0), "v5e": (197.0, 819.0),
+    "v5p": (459.0, 2765.0), "v5": (459.0, 2765.0),
+    "v4": (275.0, 1228.0),
+    "v6 lite": (918.0, 1640.0), "v6e": (918.0, 1640.0),
+}
+
+
+def _roofline(shape, seconds, n_dev):
+    """Memory-roofline context for the flagship metric: a 3D FFT streams
+    the array once per axis (3 passes, read + write each) — the minimum
+    HBM traffic of any staged implementation. pct_of_roofline says how
+    close the measured time is to that bound on this chip, which is what
+    makes a sub-baseline number interpretable as chip-limited vs
+    code-limited (round-4 verdict item 1). Model, not measurement: real
+    XLA fusion can beat 3 passes (fused chains) or trail it (internal
+    transposes); the exchange traffic of multi-chip plans rides ICI and
+    is not in this bound."""
+    import math
+
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    kl = kind.lower()
+    spec = next((v for k, v in _TPU_SPECS.items() if k in kl), None)
+    if spec is None:
+        return {"device_kind": kind}
+    peak_tf, hbm_gbps = spec
+    bytes_per_dev = 8 * math.prod(shape) / n_dev  # complex64
+    min_seconds = 3 * 2 * bytes_per_dev / (hbm_gbps * 1e9)
+    return {
+        "device_kind": kind,
+        "roofline": {
+            "model": "3-pass HBM stream (min traffic of a staged 3D FFT)",
+            "hbm_gbps_per_chip": hbm_gbps,
+            "bf16_peak_tflops_per_chip": peak_tf,
+            "min_seconds": round(min_seconds, 6),
+            "roofline_gflops": round(
+                5 * math.prod(shape) * math.log2(math.prod(shape))
+                / min_seconds / 1e9, 1),
+            "pct_of_roofline": round(100.0 * min_seconds / seconds, 1),
+        },
+    }
+
+
 def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
           all_times, donated=False, stages=None):
     import jax
@@ -206,6 +253,8 @@ def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
         "donated": donated,
         "all": {e: round(t, 6) for e, t in all_times.items()},
     }
+    if jax.default_backend() == "tpu":
+        out.update(_roofline(shape, seconds, n_dev))
     if stages:
         out["stages"] = stages
     print(json.dumps(out), flush=True)
@@ -246,7 +295,8 @@ def _worker(shape_n: int) -> None:
     # csv/pallas_tune_tpu.csv), so its HIGH tier is a real candidate for
     # the 512^3 flagship.
     default_execs = ("xla" if fast
-                     else "xla,pallas,pallas:high,matmul,matmul:high")
+                     else "xla,xla_minor,pallas,pallas:high,"
+                          "matmul,matmul:high")
     candidates = [
         e.strip()
         for e in os.environ.get(

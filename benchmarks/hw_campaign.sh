@@ -61,6 +61,14 @@ if [ "$SHORT" != "--short" ]; then
         -csv benchmarks/csv/dd_depth_tpu.csv || true
   done
 
+  note "matmul four-step split frontier @512 (contraction-dim rebalance toward the MXU edge, docs/MFU_ANALYSIS.md)"
+  for split in 16x32 8x64 4x128 2x256; do
+    DFFT_MM_SPLIT=512=$split DFFT_MM_PRECISION=high timeout 900 \
+      python benchmarks/speed3d.py c2c single 512 512 512 \
+      -executor matmul -iters 3 \
+      -csv benchmarks/csv/mm_split_tpu.csv || true
+  done
+
   note "precision-tier comparison @256^3 (HIGHEST vs HIGH vs DEFAULT)"
   for prec in highest high default; do
     DFFT_MM_PRECISION=$prec DFFT_SWEEP_TIMEOUT=900 \

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax.numpy as jnp
 import numpy as np
@@ -59,6 +60,36 @@ def _twiddle_np(n: int, n1: int, n2: int, forward: bool) -> np.ndarray:
     return np.exp(sign * np.pi * (k1j2 % n) / n)
 
 
+def _split_override(n: int) -> tuple[int, int] | None:
+    """Per-length four-step split override from ``DFFT_MM_SPLIT``
+    (e.g. ``"512=4x128,256=2x128"``) — the contraction-dim rebalance
+    knob of the campaign's MXU-edge experiments (docs/MFU_ANALYSIS.md):
+    the balanced split minimizes flops, but a lopsided split whose large
+    factor sits at the 128 MXU edge can trade flops for utilization.
+    Read at trace time, like DFFT_MM_PRECISION. Invalid entries raise
+    (a silently-ignored typo would invalidate a whole sweep)."""
+    spec = os.environ.get("DFFT_MM_SPLIT", "").strip()
+    if not spec:
+        return None
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            key, val = part.split("=")
+            a, b = (int(v) for v in val.split("x"))
+        except ValueError:
+            raise ValueError(
+                f"DFFT_MM_SPLIT entry {part!r} is not N=AxB") from None
+        if int(key) == n:
+            if a * b != n or a < 2 or b < 2:
+                raise ValueError(
+                    f"DFFT_MM_SPLIT {part!r}: {a}x{b} != {n} or "
+                    f"factor < 2")
+            return (a, b)
+    return None
+
+
 def _best_split(n: int) -> tuple[int, int] | None:
     """Divisor pair (n1, n2), n1 <= n2, with n1 as close to sqrt(n) as
     possible. Returns None for primes (no nontrivial divisor).
@@ -66,10 +97,11 @@ def _best_split(n: int) -> tuple[int, int] | None:
     Delegates to the native runtime core (``dfft_balanced_split``,
     ``native/dfft_native.cpp`` — the per-axis split decision of the
     reference's FFTScheduler, ``templateFFT.cpp:3941-4100``), with its
-    Python mirror as the toolchain-less fallback."""
+    Python mirror as the toolchain-less fallback. ``DFFT_MM_SPLIT``
+    overrides per length (see :func:`_split_override`)."""
     from .. import native
 
-    return native.balanced_split(n, n)
+    return _split_override(n) or native.balanced_split(n, n)
 
 
 def mm_precision() -> "lax.Precision":

@@ -99,6 +99,29 @@ def _matmul_executor(x: Array, axes: Sequence[int], forward: bool = True) -> Arr
 register_executor("matmul", _matmul_executor)
 
 
+def _xla_minor_executor(x: Array, axes: Sequence[int],
+                        forward: bool = True) -> Array:
+    """XLA FFT with the transformed axis explicitly rotated to the minor
+    (lane) dimension first — a layout experiment for the executor
+    tournament: TPU vector lanes run over the minor-most dim, and a
+    leading-axis FFT otherwise leaves the layout choice to XLA's internal
+    fft expansion. Mathematically identical to ``xla``; only the
+    transpose placement differs (XLA fuses adjacent transposes, so the
+    cost model is decided by the compiler, measured by the tournament —
+    the role of the reference's side-by-side backend plans,
+    ``fft_mpi_3d_api.cpp:318-429``)."""
+    fft = jnp.fft.fft if forward else jnp.fft.ifft
+    for ax in tuple(axes):
+        if ax == x.ndim - 1 or ax == -1:
+            x = fft(x, axis=-1)
+        else:
+            x = jnp.moveaxis(fft(jnp.moveaxis(x, ax, -1), axis=-1), -1, ax)
+    return x
+
+
+register_executor("xla_minor", _xla_minor_executor)
+
+
 # --- real <-> complex transforms (the heFFTe r2c/c2r executor surface,
 # ``heffte_backend_rocm.h:567`` ``rocfft_executor_r2c``; geometry shrink
 # ``box3d::r2c``, ``heffte_geometry.h:94``). Each executor may register its
