@@ -351,6 +351,40 @@ def step_dd_slab(shape=(32, 24, 16)) -> None:
             "ok" if err < DD_GATE else "FAIL", err)
 
 
+def step_brick_orders(shape=(16, 12, 8)) -> None:
+    """Per-box storage-order edge (lax.switch over per-device transposes
+    inside shard_map) on the real backend: shuffled-order brick plan vs
+    the host reference."""
+    import jax
+    import numpy as np
+
+    import distributedfft_tpu as dfft
+    from distributedfft_tpu.geometry import make_slabs, world_box
+    from distributedfft_tpu.parallel.bricks import (
+        gather_bricks, scatter_bricks,
+    )
+
+    ndev = len(jax.devices())
+    p = min(2, ndev)
+    mesh = dfft.make_mesh(p)
+    w = world_box(shape)
+    orders = [(2, 1, 0), (1, 2, 0), (0, 2, 1), (2, 0, 1)]
+    ins = [b.with_order(orders[i % len(orders)])
+           for i, b in enumerate(make_slabs(w, p, axis=0))]
+    outs = [b.with_order(orders[(i + 1) % len(orders)])
+            for i, b in enumerate(make_slabs(w, p, axis=1))]
+    rng = np.random.default_rng(17)
+    x = (rng.standard_normal(shape)
+         + 1j * rng.standard_normal(shape)).astype(np.complex64)
+    plan = dfft.plan_brick_dft_c2c_3d(shape, mesh, ins, outs,
+                                      dtype=np.complex64)
+    got = gather_bricks(plan(scatter_bricks(x, ins, mesh=mesh)), outs)
+    ref = np.fft.fftn(x)
+    err = float(np.max(np.abs(got - ref)) / np.max(np.abs(ref)))
+    _record(f"brick_orders_p{p}", "ok" if err < C64_GATE else "FAIL", err,
+            "box3d::order edge (switch+transpose under shard_map)")
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -394,6 +428,7 @@ def main() -> int:
         # test suite mirrors it densely) — its first real execution must
         # happen before anything else can wedge the backend.
         (step_ragged_a2av, ()),
+        (step_brick_orders, ()),
         (step_pallas_1d, (n, batch)),
         (step_pallas_2d, (n, 4 if not args.quick else 2)),
         (step_pallas_strided, (n, batch)),

@@ -69,8 +69,8 @@ def parse_args(argv):
                    help="output processor grid (heFFTe -outgrid)")
     p.add_argument("-staged", action="store_true",
                    help="separately-jitted t0..t3 stage timing (slab and "
-                        "pencil, c2c and r2c; dd tier: c2c slab/single "
-                        "only; not with -bricks/-ingrid/-outgrid/"
+                        "pencil, c2c and r2c; dd tier: c2c single/slab/"
+                        "pencil; not with -bricks/-ingrid/-outgrid/"
                         "-r2c_axis)")
     p.add_argument("-iters", type=int, default=5)
     p.add_argument("-cpu", action="store_true",
@@ -398,6 +398,13 @@ def main(argv=None) -> None:
         print(f"trace written to {tr.finalize_tracing()}")
 
 
+def _spec_axis_sizes(sharding):
+    """Per-array-dim total shard counts of a NamedSharding (1 where
+    unsharded) — the divisibility guard for pinned input shardings."""
+    entries = (tuple(sharding.spec) + (None,) * 3)[:3]
+    return [mesh_prod(sharding.mesh, e) if e else 1 for e in entries]
+
+
 def _run_dd(args, shape, ndev) -> None:
     """The dd (emulated double precision) benchmark path: roundtrip
     verification and amortized timing of ``plan_dd_dft_c2c_3d`` plans —
@@ -416,19 +423,27 @@ def _run_dd(args, shape, ndev) -> None:
 
     if args.kind != "c2c":
         raise SystemExit("-precision dd supports c2c only")
-    for flag in ("bricks", "pencils", "grid", "ingrid", "outgrid",
-                 "a2av", "p2p_pl"):
+    for flag in ("bricks", "grid", "ingrid", "outgrid", "a2av", "p2p_pl"):
         if getattr(args, flag, None):
             raise SystemExit(f"-{flag} is not available at the dd tier")
 
-    mesh = dfft.make_mesh(ndev) if ndev > 1 else None
+    if args.pencils and ndev > 1:
+        # Same min-surface grid the c64 -pencils path benchmarks.
+        from distributedfft_tpu import native as _native
+
+        r, c = _native.pencil_grid(shape, ndev)
+        mesh = dfft.make_mesh((r, c))
+    else:
+        mesh = dfft.make_mesh(ndev) if ndev > 1 else None
     fwd = dfft.plan_dd_dft_c2c_3d(shape, mesh)
     bwd = dfft.plan_dd_dft_c2c_3d(shape, mesh, direction=dfft.BACKWARD)
     print(f"decomposition: {fwd.decomposition}")
     print("precision: dd (double-double over exact-sliced bf16 matmuls)")
 
     mk_kw = {}
-    if fwd.in_sharding is not None and shape[0] % ndev == 0:
+    if fwd.in_sharding is not None and all(
+            shape[d] % s == 0 for d, s in enumerate(
+                _spec_axis_sizes(fwd.in_sharding))):
         mk_kw["out_shardings"] = (fwd.in_sharding, fwd.in_sharding)
 
     @functools.partial(jax.jit, **mk_kw)
@@ -449,12 +464,17 @@ def _run_dd(args, shape, ndev) -> None:
     stage_times = None
     if args.staged:
         from distributedfft_tpu.parallel.ddslab import (
-            build_dd_single_stages, build_dd_slab_stages,
+            build_dd_pencil_stages, build_dd_single_stages,
+            build_dd_slab_stages,
         )
         from distributedfft_tpu.utils.timing import time_staged
 
         if mesh is None:
             stages = build_dd_single_stages(shape)
+        elif len(mesh.axis_names) > 1:
+            stages, _ = build_dd_pencil_stages(
+                mesh, shape, row_axis=mesh.axis_names[0],
+                col_axis=mesh.axis_names[1])
         else:
             stages, _ = build_dd_slab_stages(
                 mesh, shape, axis_name=mesh.axis_names[0])

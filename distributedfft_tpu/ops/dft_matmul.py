@@ -81,6 +81,14 @@ def _split_override(n: int) -> tuple[int, int] | None:
         except ValueError:
             raise ValueError(
                 f"DFFT_MM_SPLIT entry {part!r} is not N=AxB") from None
+        if int(key) <= DIRECT_MAX:
+            # Lengths at or under the dense bound never consult the
+            # split logic — an inert override would silently invalidate
+            # a whole sweep, the failure mode this raise exists for.
+            raise ValueError(
+                f"DFFT_MM_SPLIT {part!r}: length {key} <= DIRECT_MAX "
+                f"({DIRECT_MAX}) is transformed dense; the override "
+                f"can never apply")
         if int(key) == n:
             if a * b != n or a < 2 or b < 2:
                 raise ValueError(

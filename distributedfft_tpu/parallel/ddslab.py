@@ -450,3 +450,32 @@ def build_dd_slab_stages(
         ("t3_dd_fft_x", jax.jit(t3, in_shardings=(pair_y,))),
     ]
     return stages, spec
+
+
+def build_dd_pencil_stages(
+    mesh: Mesh,
+    shape: tuple[int, int, int],
+    *,
+    row_axis: str = "row",
+    col_axis: str = "col",
+    algorithm: str = "alltoall",
+):
+    """Forward dd pencil transform as the five timed t0/t2a/t1/t2b/t3
+    stages: the c64 pencil stage pipeline (``staged.build_pencil_stages``
+    — tree-generic) driven by a pair-aware dd executor. Completes the dd
+    staged matrix (single, slab, pencil)."""
+    from .staged import build_pencil_stages
+
+    shape = tuple(int(s) for s in shape)
+    for n in shape:
+        _check_dd_extent(n, shape)
+
+    def dd_ex(pair, axes, forward):
+        hi, lo = pair
+        for ax in axes:
+            hi, lo = ddfft.fft_axis_dd(hi, lo, ax, forward=forward)
+        return hi, lo
+
+    return build_pencil_stages(mesh, shape, row_axis=row_axis,
+                               col_axis=col_axis, executor=dd_ex,
+                               algorithm=algorithm)

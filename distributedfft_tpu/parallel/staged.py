@@ -67,6 +67,23 @@ def _pspec(mapping: dict[int, str]) -> P:
     return P(*[mapping.get(d) for d in range(3)])
 
 
+# Tree-aware stage primitives: the pencil pipeline below is generic over
+# the stage value — a single c64 array, or any pytree of same-shape
+# arrays (the dd tier's (hi, lo) pair rides through unchanged; specs and
+# shardings broadcast as pytree prefixes).
+def _tpad(x, ax: int, to: int):
+    return jax.tree_util.tree_map(lambda u: _pad_axis(u, ax, to), x)
+
+
+def _tcrop(x, ax: int, to: int):
+    return jax.tree_util.tree_map(lambda u: _crop_axis(u, ax, to), x)
+
+
+def _texchange(x, mesh_ax, **kw):
+    return jax.tree_util.tree_map(
+        lambda u: exchange(u, mesh_ax, **kw), x)
+
+
 def build_pencil_stages(
     mesh: Mesh,
     shape: tuple[int, int, int],
@@ -82,7 +99,12 @@ def build_pencil_stages(
     """Pencil c2c transform as five timed stages:
     t0 (first fft) | t2a (first exchange) | t1 (mid fft) | t2b (second
     exchange) | t3 (last fft) — the reference's taxonomy with the two
-    pencil exchanges split out as t2a/t2b."""
+    pencil exchanges split out as t2a/t2b.
+
+    Generic over the stage value: ``executor`` may be a callable taking
+    any pytree of same-shape arrays (the dd tier passes a (hi, lo) pair
+    through ``ddslab.build_dd_pencil_stages``); pads/crops/exchanges map
+    over leaves and specs broadcast as pytree prefixes."""
     if perm is None:
         perm = (0, 1, 2) if forward else (1, 2, 0)
     if order is None:
@@ -118,43 +140,45 @@ def build_pencil_stages(
                           out_specs=_pspec(lay_out))
 
     def t0(x):
-        x = _pad_axis(_pad_axis(x, a, pads[a]), b, pads[b])
+        x = _tpad(_tpad(x, a, pads[a]), b, pads[b])
         x = lax.with_sharding_constraint(x, in_sh)
         y = smap(lambda v: ex(v, (c,), forward), in_lay, in_lay)(x)
-        y = _pad_axis(y, seq[0][2], pads[seq[0][2]])
+        y = _tpad(y, seq[0][2], pads[seq[0][2]])
         return lax.with_sharding_constraint(y, in_sh)
 
     def t2a(x):
         x = lax.with_sharding_constraint(x, in_sh)
         mesh_ax, parts, split, concat = seq[0]
-        y = smap(lambda v: exchange(v, mesh_ax, split_axis=split,
-                                    concat_axis=concat, axis_size=parts,
-                                    algorithm=algorithm), in_lay, mid_lay)(x)
+        y = smap(lambda v: _texchange(v, mesh_ax, split_axis=split,
+                                      concat_axis=concat, axis_size=parts,
+                                      algorithm=algorithm),
+                 in_lay, mid_lay)(x)
         return lax.with_sharding_constraint(y, mid_sh)
 
     def t1(x):
         x = lax.with_sharding_constraint(x, mid_sh)
         concat0 = seq[0][3]
-        y = smap(lambda v: _pad_axis(
-            ex(_crop_axis(v, concat0, n[concat0]), (mid_fft,), forward),
+        y = smap(lambda v: _tpad(
+            ex(_tcrop(v, concat0, n[concat0]), (mid_fft,), forward),
             seq[1][2], mid_pad), mid_lay, mid_lay)(x)
         return lax.with_sharding_constraint(y, mid_sh)
 
     def t2b(x):
         x = lax.with_sharding_constraint(x, mid_sh)
         mesh_ax, parts, split, concat = seq[1]
-        y = smap(lambda v: exchange(v, mesh_ax, split_axis=split,
-                                    concat_axis=concat, axis_size=parts,
-                                    algorithm=algorithm), mid_lay, out_lay)(x)
+        y = smap(lambda v: _texchange(v, mesh_ax, split_axis=split,
+                                      concat_axis=concat, axis_size=parts,
+                                      algorithm=algorithm),
+                 mid_lay, out_lay)(x)
         return lax.with_sharding_constraint(y, out_sh)
 
     def t3(x):
         x = lax.with_sharding_constraint(x, out_sh)
         concat1 = seq[1][3]
-        y = smap(lambda v: ex(_crop_axis(v, concat1, n[concat1]),
+        y = smap(lambda v: ex(_tcrop(v, concat1, n[concat1]),
                               (last_fft,), forward), out_lay, out_lay)(x)
         for ax in op:
-            y = _crop_axis(y, ax, n[ax])
+            y = _tcrop(y, ax, n[ax])
         return y
 
     L = _AXIS_LETTER

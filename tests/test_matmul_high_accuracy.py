@@ -143,3 +143,11 @@ def test_mm_split_override_invalid_raises(monkeypatch):
     monkeypatch.setenv("DFFT_MM_SPLIT", "512:4x128")
     with pytest.raises(ValueError):
         dm._best_split(512)
+
+
+def test_mm_split_inert_key_raises(monkeypatch):
+    """Override keys at or under DIRECT_MAX can never apply (dense
+    path) — raising beats silently invalidating a sweep."""
+    monkeypatch.setenv("DFFT_MM_SPLIT", "128=2x64")
+    with pytest.raises(ValueError):
+        dm._best_split(512)

@@ -183,3 +183,37 @@ def test_campaign_configs_lower_for_tpu(shape, kind):
             col_axis=mesh.axis_names[1], executor="xla", forward=True)
     x = jax.ShapeDtypeStruct(shape, jnp.complex64)
     export.export(jax.jit(lambda v: fn(v)), platforms=["tpu"])(x)
+
+
+def test_brick_order_edge_lowers_for_tpu():
+    """The per-box storage-order edge (lax.switch over per-device
+    transposes inside shard_map) through the TPU pipeline — a
+    shuffled-order brick plan's full fn, orders on both sides."""
+    import distributedfft_tpu as dfft
+    from distributedfft_tpu.geometry import (
+        ceil_splits, make_pencils, make_slabs, world_box,
+    )
+
+    mesh = dfft.make_mesh(8)
+    shape = (16, 12, 8)
+    w = world_box(shape)
+    ins = [b.with_order(o) for b, o in zip(
+        make_pencils(w, (4, 2), 2),
+        [(2, 1, 0), (1, 0, 2), (0, 2, 1), (1, 2, 0),
+         (2, 0, 1), (0, 1, 2), (2, 1, 0), (1, 0, 2)])]
+    outs = [b.with_order((2, 0, 1)) for b in
+            make_slabs(w, 8, axis=1, rule=ceil_splits)]
+    plan = dfft.plan_brick_dft_c2c_3d(shape, mesh, ins, outs,
+                                      dtype=jnp.complex64)
+    x = jax.ShapeDtypeStruct(plan.in_shape, jnp.complex64)
+    export.export(jax.jit(plan.fn), platforms=["tpu"])(x)
+
+
+def test_xla_minor_lowers_for_tpu():
+    """The xla_minor layout-experiment executor through the TPU pipeline
+    (explicit moveaxis around each fft)."""
+    from distributedfft_tpu.ops.executors import get_executor
+
+    ex = get_executor("xla_minor")
+    x = jax.ShapeDtypeStruct((32, 32, 32), jnp.complex64)
+    _export_ok(lambda v: ex(v, (0, 1, 2), True), x)
