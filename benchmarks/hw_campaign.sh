@@ -53,6 +53,12 @@ if [ "$SHORT" != "--short" ]; then
         c2c dd $n $n $n -iters 3 \
         -csv benchmarks/csv/dd_tier_tpu.csv || true
   done
+  DFFT_SWEEP_TIMEOUT=900 timeout 900 python benchmarks/speed3d.py \
+      c2c dd 256 256 256 -staged -iters 3 \
+      -csv benchmarks/csv/dd_tier_tpu.csv || true
+  DFFT_SWEEP_TIMEOUT=900 timeout 900 python benchmarks/speed3d.py \
+      c2c dd 256 256 256 -bricks -iters 3 \
+      -csv benchmarks/csv/dd_tier_tpu.csv || true
 
   note "dd depth frontier @256^3 (accuracy vs matmul count)"
   for depth in 8,6,2 7,5,2 7,5,1; do

@@ -423,25 +423,50 @@ def _run_dd(args, shape, ndev) -> None:
 
     if args.kind != "c2c":
         raise SystemExit("-precision dd supports c2c only")
-    for flag in ("bricks", "grid", "ingrid", "outgrid", "a2av", "p2p_pl"):
+    for flag in ("grid", "ingrid", "outgrid", "a2av", "p2p_pl"):
         if getattr(args, flag, None):
             raise SystemExit(f"-{flag} is not available at the dd tier")
+    if args.bricks and args.staged:
+        print("note: -staged is not available for brick plans; ignoring",
+              file=sys.stderr)
+        args.staged = False
 
-    if args.pencils and ndev > 1:
-        # Same min-surface grid the c64 -pencils path benchmarks.
+    brick_in_boxes = None
+    if args.bricks:
+        if ndev < 2:
+            raise SystemExit("-bricks needs a multi-device mesh")
         from distributedfft_tpu import native as _native
+        from distributedfft_tpu.geometry import (
+            ceil_splits, make_pencils, make_slabs, world_box,
+        )
 
-        r, c = _native.pencil_grid(shape, ndev)
-        mesh = dfft.make_mesh((r, c))
+        mesh = dfft.make_mesh(ndev)
+        w = world_box(shape)
+        brick_in_boxes = make_slabs(w, ndev, axis=2, rule=ceil_splits)
+        out_boxes = make_pencils(w, _native.pencil_grid(shape, ndev), 0)
+        fwd = dfft.plan_dd_brick_dft_c2c_3d(
+            shape, mesh, brick_in_boxes, out_boxes)
+        bwd = dfft.plan_dd_brick_dft_c2c_3d(
+            shape, mesh, out_boxes, brick_in_boxes,
+            direction=dfft.BACKWARD)
     else:
-        mesh = dfft.make_mesh(ndev) if ndev > 1 else None
-    fwd = dfft.plan_dd_dft_c2c_3d(shape, mesh)
-    bwd = dfft.plan_dd_dft_c2c_3d(shape, mesh, direction=dfft.BACKWARD)
+        if args.pencils and ndev > 1:
+            # Same min-surface grid the c64 -pencils path benchmarks.
+            from distributedfft_tpu import native as _native
+
+            r, c = _native.pencil_grid(shape, ndev)
+            mesh = dfft.make_mesh((r, c))
+        else:
+            mesh = dfft.make_mesh(ndev) if ndev > 1 else None
+        fwd = dfft.plan_dd_dft_c2c_3d(shape, mesh)
+        bwd = dfft.plan_dd_dft_c2c_3d(shape, mesh, direction=dfft.BACKWARD)
     print(f"decomposition: {fwd.decomposition}")
     print("precision: dd (double-double over exact-sliced bf16 matmuls)")
 
     mk_kw = {}
-    if fwd.in_sharding is not None and all(
+    if brick_in_boxes is not None:
+        pass  # brick stacks always shard evenly (one brick per device)
+    elif fwd.in_sharding is not None and all(
             shape[d] % s == 0 for d, s in enumerate(
                 _spec_axis_sizes(fwd.in_sharding))):
         mk_kw["out_shardings"] = (fwd.in_sharding, fwd.in_sharding)
@@ -449,13 +474,39 @@ def _run_dd(args, shape, ndev) -> None:
     @functools.partial(jax.jit, **mk_kw)
     def make_input():
         k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(4242), 4)
-        hi = (jax.random.normal(k1, shape, jnp.float32)
-              + 1j * jax.random.normal(k2, shape, jnp.float32)
+        mk_shape = shape
+        if brick_in_boxes is not None:
+            from distributedfft_tpu.parallel.bricks import stack_pad_for
+
+            mk_shape = (ndev,) + stack_pad_for(brick_in_boxes)
+        hi = (jax.random.normal(k1, mk_shape, jnp.float32)
+              + 1j * jax.random.normal(k2, mk_shape, jnp.float32)
               ).astype(jnp.complex64)
         # A representative lo ~2^-25 below hi (the dd invariant scale).
-        lo = ((jax.random.normal(k3, shape, jnp.float32)
-               + 1j * jax.random.normal(k4, shape, jnp.float32)
+        lo = ((jax.random.normal(k3, mk_shape, jnp.float32)
+               + 1j * jax.random.normal(k4, mk_shape, jnp.float32)
                ) * jnp.float32(2.0 ** -25)).astype(jnp.complex64)
+        if brick_in_boxes is not None:
+            # Zero the per-brick pad regions (pads never travel the
+            # ring, but the stack-level roundtrip compare needs them
+            # zero on input), and pin one brick per device.
+            import numpy as _np
+            from jax import lax as jlax
+            from jax.sharding import (
+                NamedSharding as _NS, PartitionSpec as _P,
+            )
+
+            sizes = _np.array([b.storage_shape for b in brick_in_boxes],
+                              _np.int32)
+            mask = jnp.ones(mk_shape, bool)
+            for d in range(3):
+                idx = jlax.broadcasted_iota(jnp.int32, mk_shape, d + 1)
+                lim = jnp.asarray(sizes[:, d]).reshape(-1, 1, 1, 1)
+                mask &= idx < lim
+            hi, lo = hi * mask, lo * mask
+            sh = _NS(mesh, _P(tuple(mesh.axis_names), None, None, None))
+            hi = jlax.with_sharding_constraint(hi, sh)
+            lo = jlax.with_sharding_constraint(lo, sh)
         return hi, lo
 
     hi, lo = make_input()

@@ -25,6 +25,9 @@ from .api import (  # noqa: F401
     plan_brick_dft_c2c_3d,
     plan_brick_dft_c2r_3d,
     plan_brick_dft_r2c_3d,
+    plan_dd_brick_dft_c2c_3d,
+    plan_dd_brick_dft_c2r_3d,
+    plan_dd_brick_dft_r2c_3d,
     plan_dd_dft_c2c_3d,
     plan_dd_dft_c2r_3d,
     plan_dd_dft_r2c_3d,

@@ -605,6 +605,7 @@ def plan_brick_dft_r2c_3d(
     out_boxes: Sequence[Box3],
     *,
     direction: int = FORWARD,
+    r2c_axis: int = 2,
     decomposition: str | None = None,
     executor: str = "xla",
     dtype: Any = None,
@@ -617,14 +618,14 @@ def plan_brick_dft_r2c_3d(
     ``box3d::r2c``, ``heffte_geometry.h:94``).
 
     Forward: ``in_boxes`` partition the real-space world ``shape``,
-    ``out_boxes`` the shrunk complex world ``(n0, n1, n2//2+1)``; backward
-    swaps the roles. See :func:`plan_brick_dft_c2c_3d` for the stack I/O
-    convention."""
+    ``out_boxes`` the world shrunk to ``N//2+1`` along ``r2c_axis``
+    (heFFTe ``r2c_direction``, default 2); backward swaps the roles.
+    See :func:`plan_brick_dft_c2c_3d` for the stack I/O convention."""
     shape, _ = _check_direction(shape, direction)
     inner = plan_dft_r2c_3d(
-        shape, mesh, direction=direction, decomposition=decomposition,
-        executor=executor, dtype=dtype, donate=donate, algorithm=algorithm,
-        options=options,
+        shape, mesh, direction=direction, r2c_axis=r2c_axis,
+        decomposition=decomposition, executor=executor, dtype=dtype,
+        donate=donate, algorithm=algorithm, options=options,
     )
     return _wrap_brick_io(inner, in_boxes, out_boxes)
 
@@ -635,62 +636,83 @@ def plan_brick_dft_c2r_3d(shape, mesh, in_boxes, out_boxes, **kw) -> Plan3D:
     return plan_brick_dft_r2c_3d(shape, mesh, in_boxes, out_boxes, **kw)
 
 
+def _build_brick_edges(m, in_boxes, out_boxes, in_world, out_world,
+                       in_spec, out_spec, algorithm: str):
+    """Shared edge construction for every brick planner (c64 and dd):
+    validate world coverage, target the nearest *even* mesh layout, and
+    build the (edge_in, edge_out) stack<->canonical callables plus their
+    BrickSpec accounting pair.
+
+    The ring lands an even mesh layout; when the chain endpoint itself
+    is uneven (ceil-split), the chain's own sharding constraints move
+    data the rest of the way (one extra XLA reshard — the same
+    prepend/append reshape heFFTe's planner emits for non-matching
+    layouts, heffte_plan_logic.cpp:162-245). ``algorithm="alltoallv"``
+    selects the exact-count ragged transport for the brick edges (wire
+    == payload); other PlanOptions algorithms keep the padded ppermute
+    ring. Per-box storage orders (heFFTe box3d::order / use_reorder)
+    are honored: the caller's bricks arrive/leave in their declared
+    axis order; the order edge canonicalizes before the ring and
+    permutes back after."""
+    from .geometry import find_world
+    from .parallel.bricks import (
+        plan_bricks_to_spec, plan_spec_to_bricks, reorder_stack,
+    )
+
+    if algorithm not in ("alltoall", "alltoallv", "ppermute"):
+        raise ValueError(
+            f"unknown algorithm {algorithm!r} for a brick plan; "
+            f"expected alltoall|alltoallv|ppermute")
+    for label, boxes, want in (("in_boxes", in_boxes, in_world),
+                               ("out_boxes", out_boxes, out_world)):
+        got = find_world(boxes).shape
+        if got != tuple(want):
+            raise ValueError(
+                f"{label} cover a {got} world; this plan's side is "
+                f"{tuple(want)}")
+    in_target = _even_fallback_spec(m, in_spec, in_world)
+    out_target = _even_fallback_spec(m, out_spec, out_world)
+    brick_alg = "a2av" if algorithm == "alltoallv" else "ring"
+    to_canon, in_bspec = plan_bricks_to_spec(m, in_boxes, in_target,
+                                             algorithm=brick_alg)
+    from_canon, out_bspec = plan_spec_to_bricks(m, out_target, out_boxes,
+                                                algorithm=brick_alg)
+    in_reorder = reorder_stack(m, in_boxes, to_canonical=True)
+    out_reorder = reorder_stack(m, out_boxes, to_canonical=False)
+
+    def edge_in(stack):
+        if in_reorder is not None:
+            stack = in_reorder(stack)
+        return to_canon(stack)
+
+    def edge_out(y):
+        y = from_canon(y)
+        return out_reorder(y) if out_reorder is not None else y
+
+    return edge_in, edge_out, (in_bspec, out_bspec)
+
+
 def _wrap_brick_io(
     inner: Plan3D, in_boxes: Sequence[Box3], out_boxes: Sequence[Box3]
 ) -> Plan3D:
     """Bracket a canonical-chain plan with the overlap-map ring reshapes
     (shared by the c2c and r2c brick planners)."""
-    from .geometry import find_world
-    from .parallel.bricks import (
-        plan_bricks_to_spec, plan_spec_to_bricks, reorder_stack,
-        stack_pad_for,
-    )
+    from .parallel.bricks import stack_pad_for
 
     if inner.mesh is None or inner.in_sharding is None:
         raise ValueError("brick plans require a multi-device mesh")
     m = inner.mesh
-    for label, boxes, want in (("in_boxes", in_boxes, inner.in_shape),
-                               ("out_boxes", out_boxes, inner.out_shape)):
-        got = find_world(boxes).shape
-        if got != tuple(want):
-            raise ValueError(
-                f"{label} cover a {got} world; this plan's side is {want}"
-            )
-    # The ring lands an *even* mesh layout; when the chain endpoint itself
-    # is uneven (ceil-split), target the nearest even layout and let the
-    # chain's own sharding constraints move data the rest of the way (one
-    # extra XLA reshard — the same prepend/append reshape heFFTe's planner
-    # emits for non-matching layouts, heffte_plan_logic.cpp:162-245).
-    in_target = _even_fallback_spec(m, inner.in_sharding.spec,
-                                    inner.in_shape)
-    out_target = _even_fallback_spec(m, inner.out_sharding.spec,
-                                     inner.out_shape)
-    # algorithm="alltoallv" on the plan selects the exact-count ragged
-    # transport for the brick edges (wire == payload); other algorithms
-    # keep the padded ppermute ring.
-    brick_alg = ("a2av" if inner.options.algorithm == "alltoallv"
-                 else "ring")
-    to_canon, in_bspec = plan_bricks_to_spec(m, in_boxes, in_target,
-                                             algorithm=brick_alg)
-    from_canon, out_bspec = plan_spec_to_bricks(m, out_target, out_boxes,
-                                                algorithm=brick_alg)
-    # Per-box storage orders (heFFTe box3d::order / use_reorder): the
-    # caller's bricks arrive/leave in their declared axis order; the
-    # order edge canonicalizes before the ring and permutes back after.
-    in_reorder = reorder_stack(m, in_boxes, to_canonical=True)
-    out_reorder = reorder_stack(m, out_boxes, to_canonical=False)
+    edge_in, edge_out, edges = _build_brick_edges(
+        m, in_boxes, out_boxes, inner.in_shape, inner.out_shape,
+        inner.in_sharding.spec, inner.out_sharding.spec,
+        inner.options.algorithm)
     inner_fn = inner.fn
 
     jit_kw: dict = {"donate_argnums": 0} if inner.options.donate else {}
 
     @functools.partial(jax.jit, **jit_kw)
     def fn(stack):
-        if in_reorder is not None:
-            stack = in_reorder(stack)
-        out = from_canon(inner_fn(to_canon(stack)))
-        if out_reorder is not None:
-            out = out_reorder(out)
-        return out
+        return edge_out(inner_fn(edge_in(stack)))
 
     p = len(in_boxes)
     names = tuple(m.axis_names)
@@ -703,8 +725,9 @@ def _wrap_brick_io(
         in_shape=(p,) + stack_pad_for(in_boxes),
         out_shape=(p,) + stack_pad_for(out_boxes),
         in_dtype=inner.in_dtype, out_dtype=inner.out_dtype,
-        real=inner.real, options=inner.options, logic=inner.logic,
-        brick_edges=(in_bspec, out_bspec),
+        real=inner.real, r2c_axis=inner.r2c_axis,
+        options=inner.options, logic=inner.logic,
+        brick_edges=edges,
     )
 
 
@@ -1014,6 +1037,96 @@ def plan_dd_dft_c2c_3d(
             out_sharding=NamedSharding(mesh, spec.out_spec),
         )
     raise ValueError("dd plans support single-device, 1D, or 2D meshes")
+
+
+def plan_dd_brick_dft_c2c_3d(
+    shape: Sequence[int],
+    mesh: Mesh | int,
+    in_boxes: Sequence[Box3],
+    out_boxes: Sequence[Box3],
+    *,
+    direction: int = FORWARD,
+    algorithm: str = "alltoall",
+    donate: bool = False,
+) -> DDPlan3D:
+    """Arbitrary per-device brick I/O at the emulated-double tier —
+    heFFTe's double-precision arbitrary-box capability
+    (``heffte_fft3d.h:105-115`` at the f64 gate) on f32/bf16 hardware.
+
+    Both dd components travel the same overlap-map transports as the
+    c64 brick tier (each component is a complex64 stack), bracketing
+    the distributed dd chain; ``Box3.order`` storage orders are honored
+    on both sides. I/O is a pair of ``[P, *pad]`` stacks (use
+    ``scatter_bricks`` on the host hi/lo parts from ``dd_from_host``).
+    ``algorithm="alltoallv"`` selects the exact-count ragged transport
+    for the brick edges."""
+    shape, _ = _check_direction(shape, direction)
+    inner = plan_dd_dft_c2c_3d(shape, mesh, direction=direction)
+    return _dd_brick_wrap(inner, shape, shape, in_boxes, out_boxes,
+                          algorithm, donate)
+
+
+def plan_dd_brick_dft_r2c_3d(
+    shape: Sequence[int],
+    mesh: Mesh | int,
+    in_boxes: Sequence[Box3],
+    out_boxes: Sequence[Box3],
+    *,
+    direction: int = FORWARD,
+    algorithm: str = "alltoall",
+    donate: bool = False,
+) -> DDPlan3D:
+    """Real<->complex brick plan at the emulated double tier — heFFTe's
+    ``fft3d_r2c`` arbitrary-box double capability. Forward: ``in_boxes``
+    partition the real-space world ``shape`` (float32 dd stacks),
+    ``out_boxes`` the axis-2-halved complex world; backward swaps the
+    roles. Canonical ``r2c_axis=2`` only at this tier. ``donate`` is a
+    documented no-op, as on every r2c plan: the real float32 and
+    half-spectrum complex64 stacks can never alias."""
+    del donate  # r2c buffers never alias (same contract as the non-brick
+    #             dd r2c planner); donating would only warn per execute.
+    shape, forward = _check_direction(shape, direction)
+    half = tuple(shape[:2]) + (shape[2] // 2 + 1,)
+    inner = plan_dd_dft_r2c_3d(shape, mesh, direction=direction)
+    in_world, out_world = (shape, half) if forward else (half, shape)
+    return _dd_brick_wrap(inner, in_world, out_world, in_boxes, out_boxes,
+                          algorithm, donate=False)
+
+
+def plan_dd_brick_dft_c2r_3d(shape, mesh, in_boxes, out_boxes,
+                             **kw) -> DDPlan3D:
+    """Convenience alias: the inverse of
+    :func:`plan_dd_brick_dft_r2c_3d`."""
+    kw.setdefault("direction", BACKWARD)
+    return plan_dd_brick_dft_r2c_3d(shape, mesh, in_boxes, out_boxes, **kw)
+
+
+def _dd_brick_wrap(inner: DDPlan3D, in_world, out_world, in_boxes,
+                   out_boxes, algorithm: str, donate: bool) -> DDPlan3D:
+    """Bracket a distributed dd plan with the brick edges (shared by the
+    dd c2c and r2c brick planners; the dd analog of
+    :func:`_wrap_brick_io`, sharing its edge construction)."""
+    if inner.mesh is None or inner.in_sharding is None:
+        raise ValueError("brick plans require a multi-device mesh")
+    m = inner.mesh
+    edge_in, edge_out, _ = _build_brick_edges(
+        m, in_boxes, out_boxes, in_world, out_world,
+        inner.in_sharding.spec, inner.out_sharding.spec, algorithm)
+    inner_fn = inner.fn
+
+    @functools.partial(
+        jax.jit, donate_argnums=(0, 1) if donate else ())
+    def fn(hi, lo):
+        yh, yl = inner_fn(edge_in(hi), edge_in(lo))
+        return edge_out(yh), edge_out(yl)
+
+    names = tuple(m.axis_names)
+    stack_sh = NamedSharding(m, P(names, None, None, None))
+    return DDPlan3D(
+        shape=inner.shape, direction=inner.direction,
+        decomposition=f"bricks-{inner.decomposition}", mesh=m, fn=fn,
+        in_sharding=stack_sh, out_sharding=stack_sh,
+    )
 
 
 def plan_dd_dft_r2c_3d(

@@ -508,3 +508,47 @@ def test_brick_r2c_shuffled_orders_roundtrip():
     assert np.max(np.abs(got - ref)) / np.max(np.abs(ref)) < 1e-3
     back = gather_bricks(bwd(y), ins)
     np.testing.assert_allclose(back, x, atol=1e-4)
+
+
+@pytest.mark.parametrize("axis", [0, 1])
+def test_brick_r2c_axis_choice(axis):
+    """Brick r2c with a non-default halved axis (heFFTe r2c_direction
+    through the brick tier), plus storage orders on the complex side."""
+    shape = (8, 12, 16)
+    mesh = dfft.make_mesh(8)
+    w = world_box(shape)
+    half = list(shape)
+    half[axis] = shape[axis] // 2 + 1
+    cw = world_box(tuple(half))
+    ins = make_slabs(w, 8, axis=2, rule=ceil_splits)
+    outs = [b.with_order((1, 0, 2)) for b in
+            make_slabs(cw, 8, axis=2, rule=ceil_splits)]
+    rng = np.random.default_rng(19)
+    x = rng.standard_normal(shape).astype(np.float32)
+    fwd = dfft.plan_brick_dft_r2c_3d(shape, mesh, ins, outs,
+                                     r2c_axis=axis, dtype=np.complex64)
+    assert fwd.r2c_axis == axis
+    bwd = dfft.plan_brick_dft_c2r_3d(shape, mesh, outs, ins,
+                                     r2c_axis=axis, dtype=np.complex64)
+    stack = scatter_bricks(x, ins, mesh=mesh)
+    y = fwd(stack)
+    got = gather_bricks(y, outs)
+    ref = np.fft.rfftn(x.astype(np.float64), axes=(
+        [a for a in range(3) if a != axis] + [axis]))
+    # numpy rfftn halves the LAST axis of `axes`; transform order of the
+    # other two axes does not change the result
+    assert np.max(np.abs(got - ref)) / np.max(np.abs(ref)) < 1e-3
+    back = gather_bricks(bwd(y), ins)
+    np.testing.assert_allclose(back, x, atol=1e-4)
+
+
+def test_brick_bad_algorithm_rejected_dd_tier():
+    """dd brick planners validate algorithm like the c64 tier."""
+    shape = (8, 8, 8)
+    mesh = dfft.make_mesh(4)
+    w = world_box(shape)
+    ins = make_slabs(w, 4, axis=0)
+    outs = make_slabs(w, 4, axis=2)
+    with pytest.raises(ValueError, match="unknown algorithm"):
+        dfft.plan_dd_brick_dft_c2c_3d(shape, mesh, ins, outs,
+                                      algorithm="a2av")
