@@ -185,7 +185,7 @@ def main() -> int:
     ap.add_argument("--quick", action="store_true",
                     help="tiny shapes for CI smoke")
     ap.add_argument("--out", default=None, help="CSV path override")
-    ap.add_argument("--executors", default="xla,pallas,matmul")
+    ap.add_argument("--executors", default="xla,xla_minor,pallas,matmul")
     ap.add_argument("--big", type=int, nargs="*", default=None,
                     help="HBM-limit cubic sizes timed as donated fwd/bwd "
                          "pairs (e.g. --big 1024)")

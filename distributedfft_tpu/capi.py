@@ -195,19 +195,29 @@ def install_c_api(mesh=None) -> bool:
             ctypes.cast(ptr, ctypes.POINTER(base)), shape=(n,)).reshape(shape)
 
     def _to_device(entry, x_np):
-        """Host array -> the plan's device-side input value."""
+        """Host array -> the plan's device-side input value. The plan's
+        input sharding is a placement hint only: when it cannot apply
+        (e.g. an r2c half-spectrum extent that does not divide a pencil
+        mesh axis), the value is placed unsharded and the plan's own
+        sharding constraints reshard on first use."""
         import jax
 
         from .ops import ddfft as _dd
 
         sh = getattr(entry.plan, "in_sharding", None)
+
+        def put(a):
+            if sh is not None:
+                try:
+                    return jax.device_put(a, sh)
+                except ValueError:
+                    pass
+            return jax.device_put(a)
+
         if entry.kind in (_KIND_C2C_D, _KIND_R2C_D):
             hi, lo = _dd.dd_from_host(x_np)
-            if sh is not None:
-                hi, lo = jax.device_put(hi, sh), jax.device_put(lo, sh)
-            return (hi, lo)
-        return jax.device_put(x_np) if sh is None else jax.device_put(
-            x_np, sh)
+            return (put(hi), put(lo))
+        return put(x_np)
 
     def _run(entry, dev_in):
         if entry.kind in (_KIND_C2C_D, _KIND_R2C_D):

@@ -175,3 +175,15 @@ def test_resident_download_before_execute_errors():
     assert lib.dfft_upload(pid, x.ctypes.data_as(vp)) == 0
     assert lib.dfft_download(pid, out.ctypes.data_as(vp)) == 5
     lib.dfft_destroy_plan_c(pid)
+
+
+def test_c_api_on_pencil_mesh():
+    """The bridge carries 2D-mesh (pencil) plans for every tier."""
+    assert capi.install_c_api(mesh=dfft.make_mesh((2, 4)))
+    try:
+        assert 0 <= capi.c_selftest((16, 8, 8)) < 5e-4
+        assert 0 <= capi.c_selftest_r2c((16, 8, 8)) < 5e-4
+        assert 0 <= capi.c_selftest_z2z((8, 8, 8)) < 1e-11
+        assert 0 <= capi.c_selftest_resident((16, 8, 8), repeats=2) < 5e-4
+    finally:
+        capi.install_c_api(mesh=None)
