@@ -555,7 +555,7 @@ def _even_fallback_spec(mesh: Mesh, pref: P, shape) -> P:
 
 def plan_brick_dft_c2c_3d(
     shape: Sequence[int],
-    mesh: Mesh | int,
+    mesh: Mesh | int | None,
     in_boxes: Sequence[Box3],
     out_boxes: Sequence[Box3],
     *,
@@ -600,7 +600,7 @@ def plan_brick_dft_c2c_3d(
 
 def plan_brick_dft_r2c_3d(
     shape: Sequence[int],
-    mesh: Mesh | int,
+    mesh: Mesh | int | None,
     in_boxes: Sequence[Box3],
     out_boxes: Sequence[Box3],
     *,
@@ -654,22 +654,12 @@ def _build_brick_edges(m, in_boxes, out_boxes, in_world, out_world,
     are honored: the caller's bricks arrive/leave in their declared
     axis order; the order edge canonicalizes before the ring and
     permutes back after."""
-    from .geometry import find_world
     from .parallel.bricks import (
         plan_bricks_to_spec, plan_spec_to_bricks, reorder_stack,
     )
 
-    if algorithm not in ("alltoall", "alltoallv", "ppermute"):
-        raise ValueError(
-            f"unknown algorithm {algorithm!r} for a brick plan; "
-            f"expected alltoall|alltoallv|ppermute")
-    for label, boxes, want in (("in_boxes", in_boxes, in_world),
-                               ("out_boxes", out_boxes, out_world)):
-        got = find_world(boxes).shape
-        if got != tuple(want):
-            raise ValueError(
-                f"{label} cover a {got} world; this plan's side is "
-                f"{tuple(want)}")
+    _check_brick_algorithm(algorithm)
+    _check_world_coverage(in_boxes, out_boxes, in_world, out_world)
     in_target = _even_fallback_spec(m, in_spec, in_world)
     out_target = _even_fallback_spec(m, out_spec, out_world)
     brick_alg = "a2av" if algorithm == "alltoallv" else "ring"
@@ -692,6 +682,88 @@ def _build_brick_edges(m, in_boxes, out_boxes, in_world, out_world,
     return edge_in, edge_out, (in_bspec, out_bspec)
 
 
+def _check_brick_algorithm(algorithm: str) -> None:
+    if algorithm not in ("alltoall", "alltoallv", "ppermute"):
+        raise ValueError(
+            f"unknown algorithm {algorithm!r} for a brick plan; "
+            f"expected alltoall|alltoallv|ppermute")
+
+
+def _check_world_coverage(in_boxes, out_boxes, in_world, out_world):
+    """Both box lists must tile their side's world (shared by the
+    distributed and single-device brick edge builders)."""
+    from .geometry import find_world
+
+    for label, boxes, want in (("in_boxes", in_boxes, in_world),
+                               ("out_boxes", out_boxes, out_world)):
+        got = find_world(boxes).shape
+        if got != tuple(want):
+            raise ValueError(
+                f"{label} cover a {got} world; this plan's side is "
+                f"{tuple(want)}")
+
+
+def _single_brick_edges(in_boxes, out_boxes, in_world, out_world):
+    """Degenerate (1-device) brick edges: the world is ONE brick per side,
+    possibly order-permuted — heFFTe brick plans run fine on a single rank
+    (``fft3d(inbox, outbox, comm)`` with a self communicator). No
+    collectives; the edge is crop + storage-order permutation only."""
+    from .parallel.bricks import _inv_perm
+
+    for label, boxes in (("in_boxes", in_boxes), ("out_boxes", out_boxes)):
+        if len(boxes) != 1:
+            raise ValueError(
+                f"single-device brick plans take exactly one box per side; "
+                f"{label} has {len(boxes)}")
+    _check_world_coverage(in_boxes, out_boxes, in_world, out_world)
+    bi, bo = in_boxes[0], out_boxes[0]
+
+    def edge_in(stack):
+        x = stack[0]
+        if bi.order != (0, 1, 2):
+            x = jnp.transpose(x, _inv_perm(bi.order))
+        return x
+
+    def edge_out(y):
+        if bo.order != (0, 1, 2):
+            y = jnp.transpose(y, bo.order)
+        return y[None]
+
+    return edge_in, edge_out
+
+
+def _wrap_brick_io_single(
+    inner: Plan3D, in_boxes: Sequence[Box3], out_boxes: Sequence[Box3]
+) -> Plan3D:
+    """Single-device tier of :func:`_wrap_brick_io` (inner plan has no
+    mesh): same ``[1, *pad]`` stack I/O convention as the distributed
+    tier, so callers are decomposition-agnostic."""
+    from .parallel.bricks import stack_pad_for
+
+    edge_in, edge_out = _single_brick_edges(
+        in_boxes, out_boxes, inner.in_shape, inner.out_shape)
+    inner_fn = inner.fn
+
+    jit_kw: dict = {"donate_argnums": 0} if inner.options.donate else {}
+
+    @functools.partial(jax.jit, **jit_kw)
+    def fn(stack):
+        return edge_out(inner_fn(edge_in(stack)))
+
+    return Plan3D(
+        shape=inner.shape, direction=inner.direction, dtype=inner.dtype,
+        decomposition=inner.decomposition, executor=inner.executor,
+        mesh=None, fn=fn, spec=inner.spec, in_sharding=None,
+        out_sharding=None,
+        in_boxes=list(in_boxes), out_boxes=list(out_boxes),
+        in_shape=(1,) + stack_pad_for(in_boxes),
+        out_shape=(1,) + stack_pad_for(out_boxes),
+        in_dtype=inner.in_dtype, out_dtype=inner.out_dtype,
+        real=inner.real, r2c_axis=inner.r2c_axis,
+        options=inner.options, logic=inner.logic,
+    )
+
+
 def _wrap_brick_io(
     inner: Plan3D, in_boxes: Sequence[Box3], out_boxes: Sequence[Box3]
 ) -> Plan3D:
@@ -700,7 +772,7 @@ def _wrap_brick_io(
     from .parallel.bricks import stack_pad_for
 
     if inner.mesh is None or inner.in_sharding is None:
-        raise ValueError("brick plans require a multi-device mesh")
+        return _wrap_brick_io_single(inner, in_boxes, out_boxes)
     m = inner.mesh
     edge_in, edge_out, edges = _build_brick_edges(
         m, in_boxes, out_boxes, inner.in_shape, inner.out_shape,
@@ -1041,7 +1113,7 @@ def plan_dd_dft_c2c_3d(
 
 def plan_dd_brick_dft_c2c_3d(
     shape: Sequence[int],
-    mesh: Mesh | int,
+    mesh: Mesh | int | None,
     in_boxes: Sequence[Box3],
     out_boxes: Sequence[Box3],
     *,
@@ -1068,7 +1140,7 @@ def plan_dd_brick_dft_c2c_3d(
 
 def plan_dd_brick_dft_r2c_3d(
     shape: Sequence[int],
-    mesh: Mesh | int,
+    mesh: Mesh | int | None,
     in_boxes: Sequence[Box3],
     out_boxes: Sequence[Box3],
     *,
@@ -1107,7 +1179,22 @@ def _dd_brick_wrap(inner: DDPlan3D, in_world, out_world, in_boxes,
     dd c2c and r2c brick planners; the dd analog of
     :func:`_wrap_brick_io`, sharing its edge construction)."""
     if inner.mesh is None or inner.in_sharding is None:
-        raise ValueError("brick plans require a multi-device mesh")
+        _check_brick_algorithm(algorithm)
+        edge_in, edge_out = _single_brick_edges(
+            in_boxes, out_boxes, in_world, out_world)
+        inner_fn1 = inner.fn
+
+        @functools.partial(
+            jax.jit, donate_argnums=(0, 1) if donate else ())
+        def fn1(hi, lo):
+            yh, yl = inner_fn1(edge_in(hi), edge_in(lo))
+            return edge_out(yh), edge_out(yl)
+
+        return DDPlan3D(
+            shape=inner.shape, direction=inner.direction,
+            decomposition=f"bricks-{inner.decomposition}", mesh=None,
+            fn=fn1, in_sharding=None, out_sharding=None,
+        )
     m = inner.mesh
     edge_in, edge_out, _ = _build_brick_edges(
         m, in_boxes, out_boxes, in_world, out_world,

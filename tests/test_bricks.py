@@ -552,3 +552,75 @@ def test_brick_bad_algorithm_rejected_dd_tier():
     with pytest.raises(ValueError, match="unknown algorithm"):
         dfft.plan_dd_brick_dft_c2c_3d(shape, mesh, ins, outs,
                                       algorithm="a2av")
+
+
+# ------------------------------------------------ single-device degenerate
+
+def test_brick_plan_single_device_orders():
+    """heFFTe brick plans run on one rank (self communicator): the world is
+    one (possibly order-permuted) brick per side; no collectives. Same
+    ``[1, *pad]`` stack convention as the distributed tier."""
+    shape = (12, 10, 8)
+    w = world_box(shape)
+    ins = [w.with_order((2, 0, 1))]
+    outs = [w.with_order((1, 2, 0))]
+    rng = np.random.default_rng(23)
+    x = (rng.standard_normal(shape)
+         + 1j * rng.standard_normal(shape)).astype(np.complex64)
+    plan = dfft.plan_brick_dft_c2c_3d(shape, None, ins, outs,
+                                      dtype=np.complex64)
+    assert plan.mesh is None
+    assert plan.in_shape == (1,) + ins[0].storage_shape
+    stack = scatter_bricks(x, ins)
+    got = gather_bricks(plan(stack), outs)
+    ref = np.fft.fftn(x)
+    assert np.max(np.abs(got - ref)) / np.max(np.abs(ref)) < 1e-3
+    bwd = dfft.plan_brick_dft_c2c_3d(shape, None, outs, ins,
+                                     direction=dfft.BACKWARD,
+                                     dtype=np.complex64)
+    back = gather_bricks(bwd(plan(stack)), ins)
+    np.testing.assert_allclose(back, x, atol=1e-4)
+
+
+def test_brick_plan_single_device_r2c():
+    shape = (8, 12, 10)
+    w = world_box(shape)
+    cw = world_box((8, 12, 6))  # N//2+1 along axis 2
+    ins = [w.with_order((1, 0, 2))]
+    outs = [cw.with_order((2, 1, 0))]
+    rng = np.random.default_rng(29)
+    x = rng.standard_normal(shape).astype(np.float32)
+    fwd = dfft.plan_brick_dft_r2c_3d(shape, None, ins, outs,
+                                     dtype=np.complex64)
+    got = gather_bricks(fwd(scatter_bricks(x, ins)), outs)
+    ref = np.fft.rfftn(x.astype(np.float64))
+    assert np.max(np.abs(got - ref)) / np.max(np.abs(ref)) < 1e-3
+
+
+def test_brick_plan_single_device_dd():
+    from distributedfft_tpu.ops import ddfft
+
+    shape = (8, 8, 8)
+    w = world_box(shape)
+    ins = [w.with_order((2, 1, 0))]
+    outs = [w]
+    rng = np.random.default_rng(31)
+    x = rng.standard_normal(shape) + 1j * rng.standard_normal(shape)
+    plan = dfft.plan_dd_brick_dft_c2c_3d(shape, None, ins, outs)
+    assert plan.mesh is None
+    hi, lo = ddfft.dd_from_host(x)
+    sh = scatter_bricks(np.asarray(hi), ins)
+    sl = scatter_bricks(np.asarray(lo), ins)
+    yh, yl = plan(sh, sl)
+    got = gather_bricks(np.asarray(ddfft.dd_to_host(yh, yl)), outs)
+    ref = np.fft.fftn(x)
+    assert np.max(np.abs(got - ref)) / np.max(np.abs(ref)) < 1e-11
+
+
+def test_brick_plan_single_device_multiple_boxes_rejected():
+    shape = (8, 8, 8)
+    w = world_box(shape)
+    ins = make_slabs(w, 2, axis=0)
+    with pytest.raises(ValueError, match="one box per side"):
+        dfft.plan_brick_dft_c2c_3d(shape, None, ins, [w],
+                                   dtype=np.complex64)
