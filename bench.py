@@ -287,16 +287,17 @@ def _worker(shape_n: int) -> None:
     dtype = jnp.complex64  # TPU: no C128
 
     # Upgrade-phase menu: xla first (a line exists after one compile),
-    # then the fused Pallas path, the HIGH-precision MXU tiers (~2x the
-    # matmul rate of HIGHEST; kept only if they pass the roundtrip
-    # gate), and the un-fused matmul engine. matmul:high is the MXU
-    # four-step at 3-pass bf16 — the round-2 hardware rows had plain
-    # matmul already beating xla at 1D n=512 (113.3 vs 103.5 GFlops/s,
-    # csv/pallas_tune_tpu.csv), so its HIGH tier is a real candidate for
-    # the 512^3 flagship.
+    # then the HIGH-precision MXU four-step (kept only if it passes the
+    # roundtrip gate), plain matmul, and the fused Pallas tiers LAST —
+    # the round-5 campaign saw pallas compiles at 512^3 wedge the remote
+    # compile service for 20+ minutes (hw_campaign_r05.log), and a
+    # candidate that hangs must never starve the ones behind it in the
+    # menu. matmul:high is the MXU four-step at 3-pass bf16 — the
+    # round-2 hardware rows had plain matmul already beating xla at 1D
+    # n=512 (113.3 vs 103.5 GFlops/s, csv/pallas_tune_tpu.csv).
     default_execs = ("xla" if fast
-                     else "xla,xla_minor,pallas,pallas:high,"
-                          "matmul,matmul:high")
+                     else "xla,xla_minor,matmul:high,matmul,"
+                          "pallas,pallas:high")
     candidates = [
         e.strip()
         for e in os.environ.get(

@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Probe the tunnel; when LIVE, run (resume) hw_campaign2.sh. Repeat until
+# the campaign completes or the deadline passes. One log line per probe.
+set -u
+cd "$(dirname "$0")/.."
+LOG=benchmarks/results/campaign2_loop.log
+DEADLINE=$(( $(date +%s) + ${1:-36000} ))
+log() { echo "[$(date '+%F %T')] $*" | tee -a "$LOG"; }
+log "loop start (deadline in ${1:-36000}s)"
+n=0
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  n=$((n+1))
+  if timeout 75 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    log "probe[$n] LIVE -> campaign2"
+    bash benchmarks/hw_campaign2.sh >> benchmarks/results/hw_campaign2_r05.log 2>&1
+    rc=$?
+    log "campaign2 rc=$rc"
+    if [ $rc -eq 0 ]; then log "campaign2 COMPLETE"; exit 0; fi
+    sleep 60
+  else
+    log "probe[$n] down"
+    sleep 120
+  fi
+done
+log "deadline reached"
+exit 3

@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""On-chip r2c/c2r bisection — run on the real TPU when it is free.
+
+The round-5 campaign's first hardware rows showed the r2c tier failing
+its roundtrip gate ON TPU ONLY (speed3d_tpu1.csv: xla 3.4e-01 at 256^3,
+every executor 3.7e-01..8.3e-01 at 512^3) while the identical configs
+pass at 1e-6 on CPU. This driver isolates which primitive is wrong on
+the TPU backend:
+
+  1. native jnp.fft.rfft        vs host numpy        (fwd only)
+  2. native jnp.fft.irfft       vs host numpy        (inv only)
+  3. fft+slice r2c              vs host numpy        (no native rfft)
+  4. mirror+ifft c2r            vs host numpy        (no native irfft)
+  5. packed half-complex pair (matmul executor)      at n=256 and 512
+  6. full 3D plan roundtrips, per executor, 256^3 and 384^3 and 512^3
+
+Each step prints one line and appends to benchmarks/csv/diag_r2c_tpu.csv;
+a crash keeps earlier rows (record-as-you-go).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributedfft_tpu.utils.cache import enable_compile_cache
+
+    enable_compile_cache()
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(here, "csv",
+                        f"diag_r2c_{jax.default_backend()}.csv")
+    fresh = not os.path.exists(path)
+    f = open(path, "a")
+    if fresh:
+        f.write("step,n,err,status\n")
+
+    def rec(step, n, err, status="ok"):
+        f.write(f"{step},{n},{err:.3e},{status}\n")
+        f.flush()
+        print(f"[diag_r2c] {step} n={n}: {err:.3e} {status}", flush=True)
+
+    def dev_err(got, ref_np):
+        # On-device |got - ref| / max|ref| with the ref pushed as its real/
+        # imag planes (complex host->device transfers also ride the tunnel
+        # fine; complex device->host does not, so never np.asarray(got)).
+        ref = jnp.asarray(ref_np.astype(np.asarray(got).dtype
+                                        if not jnp.iscomplexobj(got)
+                                        else np.complex64))
+        e = jnp.max(jnp.abs(got - ref)) / jnp.max(jnp.abs(ref))
+        return float(jax.device_get(e))
+
+    rng = np.random.default_rng(5)
+
+    for n in (256, 512):
+        x = rng.standard_normal((64, n)).astype(np.float32)
+        xd = jnp.asarray(x)
+        ref_f = np.fft.rfft(x.astype(np.float64), axis=1)
+        ref_full = np.fft.fft(x.astype(np.float64), axis=1)
+
+        # 1. native rfft
+        try:
+            got = jax.jit(lambda a: jnp.fft.rfft(a, axis=1))(xd)
+            rec("native_rfft", n, dev_err(got, ref_f))
+        except Exception as e:  # noqa: BLE001
+            rec("native_rfft", n, -1.0, f"ERROR {type(e).__name__}")
+
+        # 2. native irfft (host-exact half-spectrum input)
+        try:
+            y = jnp.asarray(ref_f.astype(np.complex64))
+            got = jax.jit(lambda a: jnp.fft.irfft(a, n=n, axis=1))(y)
+            rec("native_irfft", n, dev_err(got, x))
+        except Exception as e:  # noqa: BLE001
+            rec("native_irfft", n, -1.0, f"ERROR {type(e).__name__}")
+
+        # 2b. native complex fft/ifft as control
+        try:
+            got = jax.jit(lambda a: jnp.fft.fft(a.astype(jnp.complex64),
+                                                axis=1))(xd)
+            rec("native_cfft", n, dev_err(got, ref_full))
+            yc = jnp.asarray(ref_full.astype(np.complex64))
+            got = jax.jit(lambda a: jnp.real(jnp.fft.ifft(a, axis=1)))(yc)
+            rec("native_cifft", n, dev_err(got, x))
+        except Exception as e:  # noqa: BLE001
+            rec("native_cfft", n, -1.0, f"ERROR {type(e).__name__}")
+
+        # 3. fft + slice r2c
+        try:
+            got = jax.jit(
+                lambda a: jax.lax.slice_in_dim(
+                    jnp.fft.fft(a.astype(jnp.complex64), axis=1),
+                    0, n // 2 + 1, axis=1))(xd)
+            rec("slice_r2c", n, dev_err(got, ref_f))
+        except Exception as e:  # noqa: BLE001
+            rec("slice_r2c", n, -1.0, f"ERROR {type(e).__name__}")
+
+        # 4. mirror + ifft c2r
+        try:
+            from distributedfft_tpu.ops.executors import mirror_c2r
+
+            y = jnp.asarray(ref_f.astype(np.complex64))
+            got = jax.jit(lambda a: mirror_c2r(a, n, 1))(y)
+            rec("mirror_c2r", n, dev_err(got, x))
+        except Exception as e:  # noqa: BLE001
+            rec("mirror_c2r", n, -1.0, f"ERROR {type(e).__name__}")
+
+        # 5. packed half-complex pair with the matmul engine
+        try:
+            from distributedfft_tpu.ops.executors import get_c2r, get_r2c
+
+            got = get_r2c("matmul")(xd, 1)
+            rec("packed_r2c_matmul", n, dev_err(got, ref_f))
+            y = jnp.asarray(ref_f.astype(np.complex64))
+            got = get_c2r("matmul")(y, n, 1)
+            rec("packed_c2r_matmul", n, dev_err(got, x))
+        except Exception as e:  # noqa: BLE001
+            rec("packed_matmul", n, -1.0, f"ERROR {type(e).__name__}")
+
+    # 6. full 3D plan roundtrips
+    import distributedfft_tpu as dfft
+
+    for n in (256, 384, 512):
+        shape = (n, n, n)
+        for ex in ("xla", "matmul"):
+            try:
+                fwd = dfft.plan_dft_r2c_3d(shape, None, dtype=jnp.complex64,
+                                           executor=ex)
+                bwd = dfft.plan_dft_c2r_3d(shape, None, dtype=jnp.complex64,
+                                           executor=ex)
+                key = jax.random.PRNGKey(7)
+                x3 = jax.jit(lambda k: jax.random.normal(k, shape,
+                                                         jnp.float32))(key)
+                back = bwd(fwd(x3))
+                e = jnp.max(jnp.abs(back - x3)) / jnp.max(jnp.abs(x3))
+                rec(f"plan3d_{ex}", n, float(jax.device_get(e)))
+                # fwd-only check against a host reference on a thin slab
+                # (full 3D f64 reference is too big to ship through the
+                # tunnel; one YZ plane suffices to catch wrongness).
+                xs = np.asarray(jax.device_get(jnp.real(x3[:1])))
+                ref = np.fft.rfftn(xs.astype(np.float64), axes=(1, 2))
+                got = fwd(x3)[:1]
+                # compare only the plane transform of axes 1,2 is NOT the
+                # 3d transform of plane 0 — skip unless n small; roundtrip
+                # already separates exec bugs from measurement bugs.
+                del ref, got, xs
+            except Exception as e:  # noqa: BLE001
+                rec(f"plan3d_{ex}", n, -1.0, f"ERROR {type(e).__name__}")
+
+    f.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

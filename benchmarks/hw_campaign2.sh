@@ -1,0 +1,109 @@
+#!/usr/bin/env bash
+# Round-5 revised hardware campaign: wedge-resistant, resumable.
+#
+# Lessons from the first r5 window (results/hw_campaign_r05.log):
+#   * a pallas compile at large shapes can crash the remote compile helper
+#     AND wedge the tunnel server — every later step then burns its full
+#     timeout producing zero rows. So: correctness + flagship first,
+#     pallas-heavy steps last, and a cheap liveness probe between steps
+#     aborts the run early (the driver loop re-fires when the tunnel
+#     returns, and completed steps are skipped via the state file).
+#   * concurrent TPU clients steal HBM (75% prealloc) and poison each
+#     other with UNIMPLEMENTED/RESOURCE_EXHAUSTED — never run two steps
+#     at once, never probe while a step runs.
+#
+#   bash benchmarks/hw_campaign2.sh           # resume from state
+#   rm benchmarks/results/campaign2_state     # start over
+
+set -u
+cd "$(dirname "$0")/.."
+
+STATE=benchmarks/results/campaign2_state
+touch "$STATE"
+
+note() { printf '\n=== %s (%s) ===\n' "$1" "$(date +%T)"; }
+
+alive() {
+  # Bounded backend-init probe; the tunnel hangs (never errors) when down.
+  timeout 75 python -c "import jax; jax.devices()" >/dev/null 2>&1
+}
+
+step() {
+  # step <name> <timeout_s> <cmd...>: skip if done, run bounded, mark done
+  # on rc==0; abort the whole campaign if the tunnel died mid-step.
+  local name=$1 tmo=$2; shift 2
+  if grep -qx "done:$name" "$STATE"; then
+    echo "[skip] $name (already done)"; return 0
+  fi
+  note "$name"
+  DFFT_SWEEP_TIMEOUT=$tmo DFFT_BENCH_DEADLINE=$tmo timeout "$tmo" "$@"
+  local rc=$?
+  if [ $rc -eq 0 ]; then
+    echo "done:$name" >> "$STATE"
+  else
+    echo "[step $name] rc=$rc"
+  fi
+  if ! alive; then
+    echo "[campaign2] tunnel died after step $name — aborting; rows so far kept"
+    exit 9
+  fi
+}
+
+# -- 1. r2c bisection: which real-transform primitive is wrong on TPU
+step diag_r2c 1200 python benchmarks/diag_r2c.py
+
+# -- 2. flagship bench (512^3 tournament, reordered menu, safe-real mode)
+step bench 1500 bash -c 'python bench.py | tee benchmarks/results/hw_bench_campaign2.json'
+
+# -- 3. matmul four-step split frontier @512 (the MXU-path 512^3 candidates)
+for split in 16x32 8x64 4x128 2x256; do
+  step mm_split_$split 700 env DFFT_MM_SPLIT=512=$split DFFT_MM_PRECISION=high \
+    python benchmarks/speed3d.py c2c single 512 512 512 \
+    -executor matmul -iters 3 -csv benchmarks/csv/mm_split_tpu.csv
+done
+
+# -- 4. precision-tier comparison @256^3 (matmul only; pallas deferred)
+for prec in highest high default; do
+  step precision_$prec 900 env DFFT_MM_PRECISION=$prec \
+    python benchmarks/record_baseline.py --sizes 256 \
+    --executors matmul --out benchmarks/csv/precision_${prec}_tpu.csv
+done
+
+# -- 5. dd (emulated double) tier: cost + accuracy on chip
+step dd_256 900 python benchmarks/speed3d.py c2c dd 256 256 256 -iters 3 \
+    -csv benchmarks/csv/dd_tier_tpu.csv
+step dd_256_staged 900 python benchmarks/speed3d.py c2c dd 256 256 256 \
+    -staged -iters 3 -csv benchmarks/csv/dd_tier_tpu.csv
+for depth in 8,6,2 7,5,2 7,5,1; do
+  step dd_depth_${depth//,/_} 900 env DFFT_DD_DEPTH=$depth \
+    python benchmarks/speed3d.py c2c dd 256 256 256 -iters 3 \
+    -csv benchmarks/csv/dd_depth_tpu.csv
+done
+step dd_512 1200 python benchmarks/speed3d.py c2c dd 512 512 512 -iters 3 \
+    -csv benchmarks/csv/dd_tier_tpu.csv
+
+# -- 6. clean correctness smoke (ragged a2av, brick orders now 1-dev-capable,
+#       dd rows, pallas kernels) — after the timing steps: it compiles pallas.
+step hw_smoke 1500 python benchmarks/hw_smoke.py
+
+# -- 7. pallas tile sweep, small tiles first (128+ OOM'd in r2 and r5;
+#       512 crashed the compile helper — keep it out).
+step tune_small 1200 python benchmarks/tune_pallas.py \
+    --n 512 --tiles 8 16 32 64 --plane 512 --tiles2d 1 2
+step tune_mid 1200 python benchmarks/tune_pallas.py \
+    --n 512 --tiles 128 --strided --full3d 512
+
+# -- 8. 1D batch corpus (manuscript-CSV parity); pow-5 first, each bounded.
+step batch_r5 900 python benchmarks/batch_bench.py 1d -radix 5 \
+    -total 48828125 -csv benchmarks/csv/batch_tpu_1d_r5.csv
+step batch_r2 900 python benchmarks/batch_bench.py 1d -radix 2 \
+    -total 48828125 -csv benchmarks/csv/batch_tpu_1d_r2.csv
+step batch_r3 900 python benchmarks/batch_bench.py 1d -radix 3 \
+    -total 48828125 -csv benchmarks/csv/batch_tpu_1d_r3.csv
+step batch_r7 900 python benchmarks/batch_bench.py 1d -radix 7 \
+    -total 48828125 -csv benchmarks/csv/batch_tpu_1d_r7.csv
+step batch_2d 900 python benchmarks/batch_bench.py 2d \
+    -csv benchmarks/csv/batch_tpu_2d.csv
+
+note "campaign2 complete"
+git status --short benchmarks/ | head -20

@@ -136,15 +136,65 @@ def register_real_executor(name: str, r2c: Callable, c2r: Callable) -> None:
     _C2R_REGISTRY[name] = c2r
 
 
+def slice_r2c(x: Array, axis: int) -> Array:
+    """r2c via full complex FFT + slice — no native RFFT HLO. Twice the
+    flops of a native rfft but immune to backend RFFT bugs."""
+    import jax.lax as lax
+
+    n = x.shape[axis]
+    y = jnp.fft.fft(x.astype(_ctype_for(x.dtype)), axis=axis)
+    return lax.slice_in_dim(y, 0, n // 2 + 1, axis=axis)
+
+
+def mirror_c2r(y: Array, n: int, axis: int) -> Array:
+    """c2r via Hermitian mirror + full complex inverse FFT — no native
+    IRFFT HLO. The index algebra lives in
+    :func:`.ddfft.mirror_half_spectrum` (one home, shared with the dd
+    tier and the odd-n executor branches); exact for Hermitian input,
+    twice the flops of a native irfft."""
+    from .ddfft import mirror_half_spectrum
+
+    return jnp.real(jnp.fft.ifft(mirror_half_spectrum(y, n, axis=axis),
+                                 axis=axis))
+
+
+def _ctype_for(rdtype):
+    return (jnp.complex128
+            if jnp.dtype(rdtype) == jnp.float64 else jnp.complex64)
+
+
+def _xla_real_mode() -> str:
+    """How the xla executor runs real transforms: ``native`` (RFFT/IRFFT
+    HLOs) or ``safe`` (fft+slice / mirror+ifft). ``auto`` (default)
+    resolves per backend — the round-5 hardware campaign measured the
+    native path failing its roundtrip gate on the TPU backend
+    (csv/speed3d_tpu1.csv: xla r2c 3.4e-01 at 256^3 vs 3.6e-07 for the
+    same config on CPU; benchmarks/diag_r2c.py is the per-primitive
+    bisection), so auto = safe on TPU, native elsewhere.
+    ``DFFT_XLA_REAL=native|safe`` overrides."""
+    import os
+
+    mode = os.environ.get("DFFT_XLA_REAL", "auto")
+    if mode in ("native", "safe"):
+        return mode
+    import jax
+
+    return "safe" if jax.default_backend() == "tpu" else "native"
+
+
 def _xla_r2c(x: Array, axis: int) -> Array:
     """Real-to-complex DFT along ``axis``: output extent n//2+1,
     unnormalized."""
+    if _xla_real_mode() == "safe":
+        return slice_r2c(x, axis)
     return jnp.fft.rfft(x, axis=axis)
 
 
 def _xla_c2r(y: Array, n: int, axis: int) -> Array:
     """Complex-to-real inverse DFT along ``axis`` back to true extent ``n``;
     scaled by 1/n (numpy convention)."""
+    if _xla_real_mode() == "safe":
+        return mirror_c2r(y, n, axis)
     return jnp.fft.irfft(y, n=n, axis=axis)
 
 
@@ -178,10 +228,9 @@ def _matmul_c2r(y: Array, n: int, axis: int) -> Array:
         return c2r_via_half_complex(y, n, axis, dft_matmul.fft_along_axis)
     # Odd n: rebuild the full hermitian spectrum from the non-redundant
     # half, then a plain complex inverse; imaginary residue is dropped.
-    h = y.shape[axis]
-    mirror = lax.slice_in_dim(y, 1, n - h + 1, axis=axis)
-    mirror = jnp.conj(jnp.flip(mirror, axis=axis))
-    full = jnp.concatenate([y, mirror], axis=axis)
+    from .ddfft import mirror_half_spectrum
+
+    full = mirror_half_spectrum(y, n, axis=axis)
     x = dft_matmul.fft_along_axis(full, axis, forward=False)
     return jnp.real(x)
 
@@ -243,10 +292,9 @@ def _pallas_c2r(y: Array, n: int, axis: int) -> Array:
 
     if n % 2 == 0 and n > 2:
         return c2r_via_half_complex(y, n, axis, pallas_fft.fft_along_axis)
-    h = y.shape[axis]
-    mirror = lax.slice_in_dim(y, 1, n - h + 1, axis=axis)
-    mirror = jnp.conj(jnp.flip(mirror, axis=axis))
-    full = jnp.concatenate([y, mirror], axis=axis)
+    from .ddfft import mirror_half_spectrum
+
+    full = mirror_half_spectrum(y, n, axis=axis)
     return jnp.real(pallas_fft.fft_along_axis(full, axis, forward=False))
 
 
