@@ -92,6 +92,12 @@ step tune_small 1200 python benchmarks/tune_pallas.py \
     --n 512 --tiles 8 16 32 64 --plane 512 --tiles2d 1 2
 step tune_mid 1200 python benchmarks/tune_pallas.py \
     --n 512 --tiles 128 --strided --full3d 512
+# MXU-edge splits: trade four-step flops for a 128-wide stage factor
+# (the balanced 16x32 runs ~idle MXU lanes when packing is rejected).
+for split in 4x128 2x256 8x64; do
+  step tune_split_$split 1200 env DFFT_PALLAS_SPLIT=512=$split \
+    python benchmarks/tune_pallas.py --n 512 --tiles 16 32 64
+done
 
 # -- 8. 1D batch corpus (manuscript-CSV parity); pow-5 first, each bounded.
 step batch_r5 900 python benchmarks/batch_bench.py 1d -radix 5 \

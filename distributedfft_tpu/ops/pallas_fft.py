@@ -67,14 +67,42 @@ _VMEM_LIMIT = 100 * 1024 * 1024
 
 
 def split_for(n: int) -> tuple[int, int] | None:
-    """Balanced (n1, n2) factor pair the kernel can run, or None.
+    """(n1, n2) factor pair the kernel runs, or None.
 
     The bounded-split decision comes from the native scheduler
     (``dfft_balanced_split`` with the kernel's MAX_FACTOR bound — the
     VMEM-bounded analog of the reference's shared-memory-bounded axis split,
-    ``templateFFT.cpp:3941-4100``)."""
+    ``templateFFT.cpp:3941-4100``). The balanced pair minimizes flops
+    (8N(n1+n2)) but runs tiny stage matmuls (16x32 at n=512 — a nearly
+    idle 128-lane MXU when the pack probe rejects widening);
+    ``DFFT_PALLAS_SPLIT`` (same ``N=AxB,...`` syntax as DFFT_MM_SPLIT)
+    overrides per length for the hardware sweeps, trading flops for a
+    stage factor at the 128 MXU edge (e.g. 512=4x128). Read at trace
+    time, like DFFT_MM_PRECISION: the tile jits capture the split, so
+    in-process sweepers must clear their caches (tune_pallas does)."""
+    import os
+
     from .. import native
 
+    spec = os.environ.get("DFFT_PALLAS_SPLIT", "").strip()
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            key, val = part.split("=")
+            key = int(key)
+            a, b = (int(v) for v in val.split("x"))
+        except ValueError:
+            raise ValueError(
+                f"DFFT_PALLAS_SPLIT entry {part!r} is not N=AxB") from None
+        if key == n:
+            if a * b != n or not (1 < a <= MAX_FACTOR) \
+                    or not (1 < b <= MAX_FACTOR):
+                raise ValueError(
+                    f"DFFT_PALLAS_SPLIT {part!r}: need A*B == {n} with "
+                    f"factors in (1, {MAX_FACTOR}]")
+            return a, b
     return native.balanced_split(n, MAX_FACTOR)
 
 
@@ -101,21 +129,28 @@ def batch_tile(n: int) -> int:
     return _tile_rows("DFFT_PALLAS_TILE", 4 * 4 * n, 8)
 
 
-@functools.lru_cache(maxsize=None)
 def _tables_np(n: int, forward: bool, g1: int = 1, g2: int = 1):
     """(W1, T, W2) float32 LUT triple for n = n1*n2, host-exact float64.
 
-    W1[j1, k1] is the n1-point DFT matrix, W2[j2, k2] the n2-point one, and
-    T[j2, k1] = w_n^{j2*k1} the inter-stage twiddle laid out to match the
-    first stage's [j2, k1] output. ``g1``/``g2`` > 1 widen the stage
+    The split is resolved HERE (so a DFFT_PALLAS_SPLIT change between
+    calls is honored) and passed into the cached builder — the cache key
+    carries (n1, n2), never a stale environment read."""
+    n1, n2 = split_for(n)
+    return _tables_np_cached(n, n1, n2, forward, g1, g2)
+
+
+@functools.lru_cache(maxsize=None)
+def _tables_np_cached(n: int, n1: int, n2: int, forward: bool,
+                      g1: int = 1, g2: int = 1):
+    """W1[j1, k1] is the n1-point DFT matrix, W2[j2, k2] the n2-point one,
+    and T[j2, k1] = w_n^{j2*k1} the inter-stage twiddle laid out to match
+    the first stage's [j2, k1] output. ``g1``/``g2`` > 1 widen the stage
     matrices to block-diagonal I_g (x) W — ``g`` independent DFTs as one
     MXU-width matmul (identical sums; the off-block zeros are exact), the
     packing that lifts a sub-128 factor's systolic-array utilization from
     (n/128)^2 to ~full (see ``dft_matmul.pack_factor``).
     """
     from .dft_matmul import _blockdiag_dft_np
-
-    n1, n2 = split_for(n)
     w1 = _blockdiag_dft_np(n1, g1, forward)
     w2 = _blockdiag_dft_np(n2, g2, forward)
     sign = -2j if forward else 2j

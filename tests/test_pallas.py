@@ -239,3 +239,32 @@ def test_fft_along_axis_middle_uses_vmapped_strided():
     back = np.asarray(pallas_fft.fft_along_axis(
         pallas_fft.fft_along_axis(jnp.asarray(x), 1), 1, forward=False))
     assert np.max(np.abs(back - x)) < 1e-5
+
+
+def test_pallas_split_override(monkeypatch):
+    """DFFT_PALLAS_SPLIT steers the kernel's four-step factor pair (the
+    MXU-edge experiment knob); numerics must be identical to the balanced
+    split. Tables are lru-cached per (n, g) AFTER the split resolves, so
+    each override runs in its own cache generation here."""
+    from distributedfft_tpu.ops import pallas_fft
+
+    x = (np.random.default_rng(3).standard_normal((16, 512))
+         + 1j * np.random.default_rng(4).standard_normal((16, 512))
+         ).astype(np.complex64)
+    ref = np.fft.fft(x, axis=1)
+    try:
+        for spec, want in (("512=4x128", (4, 128)), ("512=2x256", (2, 256))):
+            monkeypatch.setenv("DFFT_PALLAS_SPLIT", spec)
+            pallas_fft._fft_tiles.clear_cache()
+            assert pallas_fft.split_for(512) == want
+            got = np.asarray(pallas_fft.fft_along_axis(jnp.asarray(x), 1))
+            assert np.max(np.abs(got - ref)) / np.max(np.abs(ref)) < 1e-4
+        monkeypatch.setenv("DFFT_PALLAS_SPLIT", "512=3x170")
+        with pytest.raises(ValueError, match="PALLAS_SPLIT"):
+            pallas_fft.split_for(512)
+        monkeypatch.setenv("DFFT_PALLAS_SPLIT", "512=foox128")
+        with pytest.raises(ValueError, match="not N=AxB"):
+            pallas_fft.split_for(512)
+    finally:
+        monkeypatch.delenv("DFFT_PALLAS_SPLIT", raising=False)
+        pallas_fft._fft_tiles.clear_cache()
