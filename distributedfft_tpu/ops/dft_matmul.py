@@ -38,8 +38,37 @@ from jax import lax
 
 # Largest factor handled as a single dense DFT matmul. 128 matches the MXU
 # systolic-array edge, so each stage's matmul has a contraction dim that tiles
-# cleanly onto the hardware.
+# cleanly onto the hardware. On TPU the effective bound is larger — see
+# :func:`direct_max`.
 DIRECT_MAX = 128
+
+
+def direct_max() -> int:
+    """Trace-time dense-tier bound. The four-step split minimizes flops
+    but pays ~6 materialized HBM passes per axis (transposes, packed-row
+    regroups, twiddle stages) — on TPU that movement, not arithmetic,
+    dominates (docs/MFU_ANALYSIS.md: 99 ms measured vs ~25 ms of MXU
+    time at 512^3). A DENSE n-point DFT is ONE dot_general per axis —
+    n=512 is a [rows, 512] @ [512, 512] contraction, perfectly
+    MXU-shaped with no inter-stage traffic — so the TPU default covers
+    the flagship extent: 512. CPU keeps 128 (movement is cheap there;
+    the suite's f64 reference runs would pay the O(n^2) flops for
+    nothing). ``DFFT_MM_DIRECT_MAX`` overrides for sweeps."""
+    env = os.environ.get("DFFT_MM_DIRECT_MAX")
+    if env:
+        try:
+            bound = int(env)
+        except ValueError:
+            raise ValueError(
+                f"DFFT_MM_DIRECT_MAX={env!r} is not an integer") from None
+        if bound < 2:
+            raise ValueError(
+                f"DFFT_MM_DIRECT_MAX={env!r}: bound must be >= 2 (a "
+                f"sub-2 bound would silently disable the dense tier)")
+        return bound
+    import jax
+
+    return 512 if jax.default_backend() == "tpu" else DIRECT_MAX
 
 
 @functools.lru_cache(maxsize=None)
@@ -81,14 +110,17 @@ def _split_override(n: int) -> tuple[int, int] | None:
         except ValueError:
             raise ValueError(
                 f"DFFT_MM_SPLIT entry {part!r} is not N=AxB") from None
-        if int(key) <= DIRECT_MAX:
-            # Lengths at or under the dense bound never consult the
-            # split logic — an inert override would silently invalidate
-            # a whole sweep, the failure mode this raise exists for.
+        if int(key) <= min(DIRECT_MAX, direct_max()):
+            # Lengths at or under the effective dense bound never
+            # consult the split logic — an inert override would silently
+            # invalidate a whole sweep, the failure mode this raise
+            # exists for. (Keys ABOVE the bound are live even when the
+            # dense tier could cover them: an explicit split forces the
+            # four-step, see _fft_last.)
             raise ValueError(
-                f"DFFT_MM_SPLIT {part!r}: length {key} <= DIRECT_MAX "
-                f"({DIRECT_MAX}) is transformed dense; the override "
-                f"can never apply")
+                f"DFFT_MM_SPLIT {part!r}: length {key} <= the dense "
+                f"bound ({min(DIRECT_MAX, direct_max())}) is "
+                f"transformed dense; the override can never apply")
         if int(key) == n:
             if a * b != n or a < 2 or b < 2:
                 raise ValueError(
@@ -106,10 +138,11 @@ def _best_split(n: int) -> tuple[int, int] | None:
     ``native/dfft_native.cpp`` — the per-axis split decision of the
     reference's FFTScheduler, ``templateFFT.cpp:3941-4100``), with its
     Python mirror as the toolchain-less fallback. ``DFFT_MM_SPLIT``
-    overrides per length (see :func:`_split_override`)."""
+    overrides are consulted by the caller (``_fft_last``), the single
+    owner of split precedence."""
     from .. import native
 
-    return _split_override(n) or native.balanced_split(n, n)
+    return native.balanced_split(n, n)
 
 
 def mm_precision() -> "lax.Precision":
@@ -220,7 +253,13 @@ def _fft_last(x: jnp.ndarray, forward: bool) -> jnp.ndarray:
     n = x.shape[-1]
     if n == 1:
         return x
-    split = None if n <= DIRECT_MAX else _best_split(n)
+    # An explicit DFFT_MM_SPLIT for this length forces the four-step
+    # (sweep intent wins); otherwise the dense tier takes everything up
+    # to the backend's direct_max() in one MXU contraction. This is the
+    # ONLY consult site — _best_split is pure balanced-split.
+    split = _split_override(n)
+    if split is None and n > direct_max():
+        split = _best_split(n)
     if split is None:
         if n > BLUESTEIN_MIN:  # large prime: chirp-z beats the O(n^2) matmul
             return _bluestein(x, forward)
@@ -236,6 +275,21 @@ def _fft_last(x: jnp.ndarray, forward: bool) -> jnp.ndarray:
     return jnp.swapaxes(c, -1, -2).reshape(x.shape)
 
 
+def _direct_axis(x: jnp.ndarray, axis: int, forward: bool) -> jnp.ndarray:
+    """Dense DFT contracting ``axis`` IN PLACE — one dot_general, no
+    moveaxis round trip through HBM (XLA folds the operand/result
+    layouts into the contraction). Callers gate on the dense tier and
+    on pack_factor == 1 (packed sub-128 factors need the row-regroup
+    path)."""
+    n = x.shape[axis]
+    w = jnp.asarray(_dft_matrix_np(n, forward), dtype=x.dtype)
+    subs = "abcdefgh"[: x.ndim]
+    j = subs[axis]
+    out = subs.replace(j, "z")
+    return jnp.einsum(f"{subs},{j}z->{out}", x, w,
+                      precision=mm_precision())
+
+
 def fft_along_axis(x: jnp.ndarray, axis: int, forward: bool = True) -> jnp.ndarray:
     """C2C FFT along one axis via MXU matmuls. Forward unnormalized, inverse
     scaled by 1/n (numpy convention)."""
@@ -243,6 +297,17 @@ def fft_along_axis(x: jnp.ndarray, axis: int, forward: bool = True) -> jnp.ndarr
         wide = jnp.dtype(x.dtype).itemsize >= 8
         x = x.astype(jnp.complex128 if wide else jnp.complex64)
     n = x.shape[axis]
+    ax = axis % x.ndim
+    if (1 < n <= direct_max() and _split_override(n) is None
+            and ax != x.ndim - 1 and x.ndim <= 8
+            and pack_factor(n, math.prod(x.shape) // n) == 1):
+        # Dense middle/leading-axis transform without the two moveaxis
+        # materializations (the flagship 512^3 path on TPU: three such
+        # contractions IS the whole transform).
+        y = _direct_axis(x, ax, forward)
+        if not forward:
+            y = y * jnp.asarray(1.0 / n, dtype=y.real.dtype)
+        return y
     moved = axis not in (-1, x.ndim - 1)
     if moved:
         x = jnp.moveaxis(x, axis, -1)

@@ -139,15 +139,66 @@ def test_mm_split_override_correct(monkeypatch):
 def test_mm_split_override_invalid_raises(monkeypatch):
     monkeypatch.setenv("DFFT_MM_SPLIT", "512=5x100")
     with pytest.raises(ValueError):
-        dm._best_split(512)
+        dm._split_override(512)
     monkeypatch.setenv("DFFT_MM_SPLIT", "512:4x128")
     with pytest.raises(ValueError):
-        dm._best_split(512)
+        dm._split_override(512)
 
 
 def test_mm_split_inert_key_raises(monkeypatch):
-    """Override keys at or under DIRECT_MAX can never apply (dense
-    path) — raising beats silently invalidating a sweep."""
+    """Override keys at or under the effective dense bound can never
+    apply — raising beats silently invalidating a sweep. Keys between
+    a lowered bound and the default stay live (they force the
+    four-step, which _fft_last honors ahead of the dense tier)."""
     monkeypatch.setenv("DFFT_MM_SPLIT", "128=2x64")
     with pytest.raises(ValueError):
-        dm._best_split(512)
+        dm._split_override(512)
+    # A lowered dense bound legitimizes keys above it.
+    monkeypatch.setenv("DFFT_MM_DIRECT_MAX", "64")
+    monkeypatch.setenv("DFFT_MM_SPLIT", "100=10x10")
+    assert dm._split_override(100) == (10, 10)
+
+
+def test_dense_tier_512(monkeypatch):
+    """The TPU dense tier (direct_max()=512 on chip: ONE dot_general per
+    axis instead of the movement-heavy four-step, docs/MFU_ANALYSIS.md)
+    must be numerically interchangeable with the four-step. Forced here
+    via DFFT_MM_DIRECT_MAX on the CPU backend."""
+    rng = np.random.default_rng(9)
+    x = (rng.standard_normal((8, 512))
+         + 1j * rng.standard_normal((8, 512))).astype(np.complex64)
+    ref = np.fft.fft(x.astype(np.complex128), axis=1)
+
+    monkeypatch.setenv("DFFT_MM_DIRECT_MAX", "512")
+    assert dm.direct_max() == 512
+    dense = np.asarray(dm.fft_along_axis(jnp.asarray(x), 1))
+    assert np.max(np.abs(dense - ref)) / np.max(np.abs(ref)) < 1e-5
+
+    monkeypatch.setenv("DFFT_MM_DIRECT_MAX", "128")
+    four = np.asarray(dm.fft_along_axis(jnp.asarray(x), 1))
+    assert np.max(np.abs(four - ref)) / np.max(np.abs(ref)) < 1e-5
+    assert np.max(np.abs(dense - four)) / np.max(np.abs(ref)) < 2e-6
+
+    # An explicit split override forces the four-step even when the
+    # dense bound covers the length (keeps the mm_split sweeps live).
+    monkeypatch.setenv("DFFT_MM_DIRECT_MAX", "512")
+    monkeypatch.setenv("DFFT_MM_SPLIT", "512=4x128")
+    forced = np.asarray(dm.fft_along_axis(jnp.asarray(x), 1))
+    assert np.max(np.abs(forced - ref)) / np.max(np.abs(ref)) < 1e-5
+
+
+def test_dense_axis_in_place(monkeypatch):
+    """_direct_axis (dense contraction of a middle/leading axis with no
+    moveaxis round trip) matches numpy on every axis of a 3D array."""
+    monkeypatch.setenv("DFFT_MM_DIRECT_MAX", "512")
+    rng = np.random.default_rng(11)
+    x = (rng.standard_normal((130, 6, 256))
+         + 1j * rng.standard_normal((130, 6, 256))).astype(np.complex64)
+    for ax in range(3):
+        got = np.asarray(dm.fft_along_axis(jnp.asarray(x), ax))
+        ref = np.fft.fft(x.astype(np.complex128), axis=ax)
+        assert np.max(np.abs(got - ref)) / np.max(np.abs(ref)) < 1e-5, ax
+    # inverse + negative axis index
+    got = np.asarray(dm.fft_along_axis(jnp.asarray(x), -3, forward=False))
+    ref = np.fft.ifft(x.astype(np.complex128), axis=0)
+    assert np.max(np.abs(got - ref)) / np.max(np.abs(ref)) < 1e-5
