@@ -52,8 +52,13 @@ step() {
 # -- 1. r2c bisection: which real-transform primitive is wrong on TPU
 step diag_r2c 1200 python benchmarks/diag_r2c.py
 
-# -- 2. flagship bench (512^3 tournament, reordered menu, safe-real mode)
-step bench 1500 bash -c 'python bench.py | tee benchmarks/results/hw_bench_campaign2.json'
+# -- 2. flagship bench (512^3 tournament, safe-real mode) — WITHOUT the
+#       pallas candidates: a 512-sized pallas compile wedged the tunnel in
+#       the first r5 window and would starve every later step. The full
+#       menu (pallas included) re-runs as the LAST campaign step.
+step bench 1500 env DFFT_BENCH_EXECUTORS=xla,xla_minor,matmul:high,matmul \
+    bash -c 'set -o pipefail
+             python bench.py | tee benchmarks/results/hw_bench_campaign2.json'
 
 # -- 3. matmul four-step split frontier @512 (the MXU-path 512^3 candidates)
 for split in 16x32 8x64 4x128 2x256; do
@@ -110,6 +115,12 @@ step batch_r7 900 python benchmarks/batch_bench.py 1d -radix 7 \
     -total 48828125 -csv benchmarks/csv/batch_tpu_1d_r7.csv
 step batch_2d 900 python benchmarks/batch_bench.py 2d \
     -csv benchmarks/csv/batch_tpu_2d.csv
+
+# -- 9. full-menu flagship bench LAST (adds the pallas candidates; if one
+#       wedges the tunnel here, every other row is already on disk).
+step bench_full 1500 bash -c \
+    'set -o pipefail
+     python bench.py | tee benchmarks/results/hw_bench_campaign2_full.json'
 
 note "campaign2 complete"
 git status --short benchmarks/ | head -20

@@ -15,12 +15,15 @@ precision too, ``templateFFT.cpp:5063-5154``):
     C[k1, k2] = DFT_n2 over j2         (recurse)
     X[k2*n1 + k1] = C[k1, k2]          (transpose + reshape)
 
-Factors at or below :data:`DIRECT_MAX` are computed as a single dense matmul;
-everything is jit-traceable with static shapes, so XLA tiles the matmuls onto
-the MXU. Prime lengths in (DIRECT_MAX, BLUESTEIN_MIN] use the O(n^2) dense
-matmul (still MXU-friendly); larger primes switch to Bluestein's chirp-z
-transform — exceeding the reference's radix-2..13 coverage
-(``templateFFT.cpp:3956-3963``), which cannot handle large primes at all.
+Lengths at or below the backend-dependent :func:`direct_max` bound (128 on
+CPU, 512 on TPU — the flagship extent in one MXU contraction per axis) are
+computed as a single dense matmul; everything is jit-traceable with static
+shapes, so XLA tiles the matmuls onto the MXU. Lengths above the bound with
+no usable factorization — primes — use the O(n^2) dense matmul up to
+max(:func:`direct_max`, :data:`BLUESTEIN_MIN`) (still MXU-friendly); larger
+primes switch to Bluestein's chirp-z transform — exceeding the reference's
+radix-2..13 coverage (``templateFFT.cpp:3956-3963``), which cannot handle
+large primes at all.
 
 Like every executor in this framework the transform is unnormalized in the
 forward direction and scales by 1/n on the inverse (numpy convention).
@@ -111,16 +114,18 @@ def _split_override(n: int) -> tuple[int, int] | None:
             raise ValueError(
                 f"DFFT_MM_SPLIT entry {part!r} is not N=AxB") from None
         if int(key) <= min(DIRECT_MAX, direct_max()):
-            # Lengths at or under the effective dense bound never
-            # consult the split logic — an inert override would silently
-            # invalidate a whole sweep, the failure mode this raise
-            # exists for. (Keys ABOVE the bound are live even when the
-            # dense tier could cover them: an explicit split forces the
-            # four-step, see _fft_last.)
+            # Lengths at or under the every-backend dense floor (128, or
+            # a lowered DFFT_MM_DIRECT_MAX) are always transformed dense
+            # — rejecting the key loudly beats an override that silently
+            # invalidates a whole sweep. Keys ABOVE the floor are live
+            # even when this backend's dense tier could cover them: an
+            # explicit split forces the four-step (see _fft_last).
             raise ValueError(
-                f"DFFT_MM_SPLIT {part!r}: length {key} <= the dense "
-                f"bound ({min(DIRECT_MAX, direct_max())}) is "
-                f"transformed dense; the override can never apply")
+                f"DFFT_MM_SPLIT {part!r}: length {key} is at or under "
+                f"the always-dense floor "
+                f"({min(DIRECT_MAX, direct_max())}); the split is "
+                f"policy-blocked there, set DFFT_MM_DIRECT_MAX lower "
+                f"to unblock it")
         if int(key) == n:
             if a * b != n or a < 2 or b < 2:
                 raise ValueError(
@@ -261,7 +266,11 @@ def _fft_last(x: jnp.ndarray, forward: bool) -> jnp.ndarray:
     if split is None and n > direct_max():
         split = _best_split(n)
     if split is None:
-        if n > BLUESTEIN_MIN:  # large prime: chirp-z beats the O(n^2) matmul
+        # Chirp-z only above BOTH bounds: primes in (direct_max,
+        # BLUESTEIN_MIN] take the O(n^2) dense matmul (still MXU-friendly),
+        # and a raised DFFT_MM_DIRECT_MAX must mean dense on every axis —
+        # not dense on middle axes but Bluestein on the last.
+        if n > max(direct_max(), BLUESTEIN_MIN):
             return _bluestein(x, forward)
         return _direct(x, forward)
     n1, n2 = split

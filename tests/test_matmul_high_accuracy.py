@@ -187,6 +187,24 @@ def test_dense_tier_512(monkeypatch):
     assert np.max(np.abs(forced - ref)) / np.max(np.abs(ref)) < 1e-5
 
 
+def test_dense_bound_above_bluestein_min(monkeypatch):
+    """A DFFT_MM_DIRECT_MAX raised past BLUESTEIN_MIN (512) must mean
+    dense on EVERY axis — the last axis must not silently fall through
+    to the chirp-z path while middle axes contract densely (that would
+    make a 'dense @1024' sweep row measure two different algorithms)."""
+    monkeypatch.setenv("DFFT_MM_DIRECT_MAX", "1024")
+    rng = np.random.default_rng(13)
+    x = (rng.standard_normal((4, 1024))
+         + 1j * rng.standard_normal((4, 1024))).astype(np.complex64)
+    ref = np.fft.fft(x.astype(np.complex128), axis=1)
+    chirp_entries = dm._bluestein_tables.cache_info().currsize
+    got = np.asarray(dm.fft_along_axis(jnp.asarray(x), 1))
+    assert np.max(np.abs(got - ref)) / np.max(np.abs(ref)) < 1e-5
+    # Dense means dense: the chirp-z path would have built (and cached)
+    # Bluestein tables for n=1024.
+    assert dm._bluestein_tables.cache_info().currsize == chirp_entries
+
+
 def test_dense_axis_in_place(monkeypatch):
     """_direct_axis (dense contraction of a middle/leading axis with no
     moveaxis round trip) matches numpy on every axis of a 3D array."""
