@@ -287,16 +287,18 @@ def _worker(shape_n: int) -> None:
     dtype = jnp.complex64  # TPU: no C128
 
     # Upgrade-phase menu: xla first (a line exists after one compile),
-    # then the HIGH-precision MXU four-step (kept only if it passes the
-    # roundtrip gate), plain matmul, and the fused Pallas tiers LAST —
-    # the round-5 campaign saw pallas compiles at 512^3 wedge the remote
-    # compile service for 20+ minutes (hw_campaign_r05.log), and a
-    # candidate that hangs must never starve the ones behind it in the
-    # menu. matmul:high is the MXU four-step at 3-pass bf16 — the
-    # round-2 hardware rows had plain matmul already beating xla at 1D
-    # n=512 (113.3 vs 103.5 GFlops/s, csv/pallas_tune_tpu.csv).
+    # then the dense HIGH-precision MXU path (kept only if it passes the
+    # roundtrip gate), the layout/tier variants, and the fused Pallas
+    # tiers LAST — the round-5 campaign saw pallas compiles at 512^3
+    # wedge the remote compile service for 20+ minutes
+    # (hw_campaign_r05.log), and a candidate that hangs must never
+    # starve the ones behind it in the menu.
+    # matmul:high runs right after the xla insurance candidate: on TPU it
+    # is the dense one-contraction-per-axis path (ops/dft_matmul.py
+    # direct_max), the highest-expected-value candidate of the menu — a
+    # short tunnel window must measure it before the also-rans.
     default_execs = ("xla" if fast
-                     else "xla,xla_minor,matmul:high,matmul,"
+                     else "xla,matmul:high,xla_minor,matmul,"
                           "pallas,pallas:high")
     candidates = [
         e.strip()

@@ -56,7 +56,7 @@ step diag_r2c 1200 python benchmarks/diag_r2c.py
 #       pallas candidates: a 512-sized pallas compile wedged the tunnel in
 #       the first r5 window and would starve every later step. The full
 #       menu (pallas included) re-runs as the LAST campaign step.
-step bench 1500 env DFFT_BENCH_EXECUTORS=xla,xla_minor,matmul:high,matmul \
+step bench 1500 env DFFT_BENCH_EXECUTORS=xla,matmul:high,xla_minor,matmul \
     bash -c 'set -o pipefail
              python bench.py | tee benchmarks/results/hw_bench_campaign2.json'
 

@@ -35,31 +35,35 @@ C64_GATE = 1e-3   # complex64 tier (bench.py ERR_GATE)
 DD_GATE = 1e-11   # the double tier (test_common.h:138)
 
 
-def _csv_path() -> str:
-    import jax
-
+def _csv_path(backend: str) -> str:
     d = os.path.join(os.path.dirname(os.path.abspath(__file__)), "csv")
     os.makedirs(d, exist_ok=True)
-    return os.path.join(d, f"hw_smoke_{jax.default_backend()}.csv")
+    return os.path.join(d, f"hw_smoke_{backend}.csv")
 
 
 _FAILED: list[str] = []  # steps whose gate failed (drives the exit code)
 
 
-def _record(step: str, status: str, value, detail: str = "") -> None:
-    import jax
+def _record(step: str, status: str, value, detail: str = "",
+            backend: str | None = None) -> None:
+    # backend is passed explicitly by the jax-free parent orchestrator
+    # (a wedged PJRT init hangs on import, so the parent must never
+    # touch jax); workers let it default to the live backend.
+    if backend is None:
+        import jax
 
+        backend = jax.default_backend()
     # "rejected" is the pack probe's expected auto-fallback verdict (the
     # production path handles it gracefully) — informational, not a
     # failure; only numeric-gate FAILs and raised ERRORs gate the exit.
     if status in ("FAIL", "ERROR"):
         _FAILED.append(step)
-    path = _csv_path()
+    path = _csv_path(backend)
     fresh = not os.path.exists(path)
     with open(path, "a") as f:
         if fresh:
             f.write("step,backend,status,value,detail\n")
-        f.write(f"{step},{jax.default_backend()},{status},{value},{detail}\n")
+        f.write(f"{step},{backend},{status},{value},{detail}\n")
         f.flush()
     print(f"[hw_smoke] {step}: {status} (value={value}) {detail}", flush=True)
 
@@ -391,34 +395,8 @@ def main() -> int:
     ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--timeout", type=float, default=float(
         os.environ.get("DFFT_SWEEP_TIMEOUT", 1200)))
+    ap.add_argument("--step", default=None, help=argparse.SUPPRESS)
     args = ap.parse_args()
-
-    if not args.worker:
-        # Wedged PJRT init hangs rather than raising; only a subprocess
-        # deadline converts that into a recorded failure.
-        import subprocess
-
-        argv = [a for a in sys.argv[1:] if a != "--worker"]
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-u", os.path.abspath(__file__),
-                 "--worker", *argv],
-                timeout=args.timeout,
-            )
-            return proc.returncode
-        except subprocess.TimeoutExpired:
-            print(f"hw_smoke worker exceeded {int(args.timeout)}s "
-                  "(wedged backend?); killed — rows recorded so far kept",
-                  file=sys.stderr)
-            return 2
-
-    from distributedfft_tpu.utils.cache import enable_compile_cache
-
-    enable_compile_cache()
-    import jax
-
-    print(f"[hw_smoke] backend={jax.default_backend()} "
-          f"devices={len(jax.devices())}", flush=True)
 
     n = 128 if args.quick else 512
     batch = 256 if args.quick else 4096
@@ -440,6 +418,95 @@ def main() -> int:
         (step_dd_slab, ()),
         (step_dd_roundtrip, (64 if args.quick else 256,)),
     ]
+    if args.step is not None:
+        steps = [s for s in steps if s[0].__name__ == args.step]
+        if not steps:
+            print(f"[hw_smoke] unknown step {args.step!r}",
+                  file=sys.stderr)
+            return 2
+
+    if not args.worker:
+        # One subprocess PER STEP. The first r5 window proved why: the
+        # remote-compile-helper crash on step 1 poisoned the shared
+        # backend and turned the other eleven in-process steps into
+        # UNIMPLEMENTED noise (csv/hw_smoke_tpu.csv, 01:01 rows). A
+        # fresh PJRT client per step converts that into one bad row.
+        # The parent never imports jax (a wedged init hangs rather than
+        # raising); each child is bounded, and a child that wedges gets
+        # a TIMEOUT row written by the parent under the last backend
+        # name a child reported.
+        import re
+        import signal
+        import subprocess
+
+        deadline = time.time() + args.timeout
+        # A single explicit --step gets the whole budget; a full sweep
+        # splits it evenly with a 300 s floor per step (first-ever
+        # pallas compiles through the tunnel have taken 20+ min — the
+        # operator raises --timeout / DFFT_SWEEP_TIMEOUT for those).
+        step_cap = max(300.0, args.timeout / max(1, len(steps)))
+        passthru, skip = [], False
+        for a in sys.argv[1:]:
+            if skip or a == "--worker":
+                skip = False
+                continue
+            if a == "--step":  # parent pins its own per-child --step
+                skip = True
+                continue
+            passthru.append(a)
+        backend = "tpu"  # hw smoke target; children report the truth
+        worst = 0
+        for fn, _ in steps:
+            remaining = deadline - time.time()
+            if remaining < 30:
+                print(f"[hw_smoke] {fn.__name__}: deadline exhausted, "
+                      "not started (rows so far kept)", file=sys.stderr)
+                worst = max(worst, 2)
+                continue
+            per = min(step_cap, remaining - 5)
+            # Own process group so a timeout kills the whole tree: a
+            # surviving orphaned PJRT client would hold the chip's HBM
+            # prealloc and poison every later step — the cascade the
+            # per-step isolation exists to prevent.
+            proc = subprocess.Popen(
+                [sys.executable, "-u", os.path.abspath(__file__),
+                 "--worker", "--step", fn.__name__, *passthru],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, start_new_session=True,
+            )
+            timed_out = False
+            try:
+                out, err = proc.communicate(timeout=per)
+            except subprocess.TimeoutExpired:
+                timed_out = True
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                out, err = proc.communicate()
+            sys.stdout.write(out)
+            sys.stderr.write((err or "")[-2000:])
+            sys.stdout.flush()
+            m = re.search(r"backend=(\w+)", out)
+            if m:
+                backend = m.group(1)
+            if timed_out:
+                _record(fn.__name__, "TIMEOUT", 0,
+                        f"worker exceeded {int(per)}s (wedged backend?)",
+                        backend=backend)
+                worst = max(worst, 2)
+            else:
+                worst = max(worst, 1 if proc.returncode else 0)
+        return worst
+
+    from distributedfft_tpu.utils.cache import enable_compile_cache
+
+    enable_compile_cache()
+    import jax
+
+    print(f"[hw_smoke] backend={jax.default_backend()} "
+          f"devices={len(jax.devices())}", flush=True)
+
     for fn, fargs in steps:
         try:
             fn(*fargs)
