@@ -87,6 +87,15 @@ done
 step dd_512 1200 python benchmarks/speed3d.py c2c dd 512 512 512 -iters 3 \
     -csv benchmarks/csv/dd_tier_tpu.csv
 
+# -- 5b. big-grid single-chip rows: 768^3 c64 (3.6 GB in+out — the largest
+#        cubic c64 grid one 16 GB chip holds; 1024^3 needs r2c or a donated
+#        pair and RESOURCE_EXHAUSTED in the first window).
+step c2c_768_xla 900 python benchmarks/speed3d.py c2c single 768 768 768 \
+    -executor xla -iters 3 -csv benchmarks/csv/speed3d_tpu1.csv
+step c2c_768_mm 900 env DFFT_MM_PRECISION=high \
+    python benchmarks/speed3d.py c2c single 768 768 768 \
+    -executor matmul -iters 3 -csv benchmarks/csv/speed3d_tpu1.csv
+
 # -- 6. clean correctness smoke (ragged a2av, brick orders now 1-dev-capable,
 #       dd rows, pallas kernels) — after the timing steps: it compiles pallas.
 step hw_smoke 1500 python benchmarks/hw_smoke.py

@@ -36,7 +36,8 @@ DD_GATE = 1e-11   # the double tier (test_common.h:138)
 
 
 def _csv_path(backend: str) -> str:
-    d = os.path.join(os.path.dirname(os.path.abspath(__file__)), "csv")
+    d = os.environ.get("DFFT_SMOKE_CSV_DIR") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "csv")
     os.makedirs(d, exist_ok=True)
     return os.path.join(d, f"hw_smoke_{backend}.csv")
 
@@ -454,7 +455,12 @@ def main() -> int:
                 skip = True
                 continue
             passthru.append(a)
-        backend = "tpu"  # hw smoke target; children report the truth
+        # Jax-free backend guess for rows written before any child has
+        # reported (a child killed mid-init never prints backend=): an
+        # explicit cpu-platforms env must not stamp TIMEOUT rows into
+        # the committed TPU-evidence CSV.
+        backend = ("cpu" if os.environ.get("JAX_PLATFORMS", "").strip()
+                   == "cpu" else "tpu")
         worst = 0
         for fn, _ in steps:
             remaining = deadline - time.time()
@@ -483,7 +489,14 @@ def main() -> int:
                     os.killpg(proc.pid, signal.SIGKILL)
                 except (ProcessLookupError, PermissionError):
                     pass
-                out, err = proc.communicate()
+                proc.kill()  # direct child, in case killpg was denied
+                try:
+                    # Bounded: a grandchild that escaped the group and
+                    # holds the pipes must not wedge the parent whose
+                    # job is converting wedges into TIMEOUT rows.
+                    out, err = proc.communicate(timeout=30)
+                except subprocess.TimeoutExpired:
+                    out, err = "", ""
             sys.stdout.write(out)
             sys.stderr.write((err or "")[-2000:])
             sys.stdout.flush()
