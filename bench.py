@@ -52,31 +52,48 @@ ERR_GATE = 1e-3  # complex64 tier; double tier is gated in the test suite
 # --------------------------------------------------------------- worker
 
 class _precision_env:
-    """Candidate names may carry an MXU precision suffix — ``pallas:high``
-    plans the pallas executor with DFFT_MM_PRECISION=high for the span of
-    its planning/tracing (the measurable accuracy/speed knob of
-    ``ops/dft_matmul.py::mm_precision``; the reference likewise records
-    faster-but-less-accurate backend rows side by side,
+    """Candidate names may carry trace-time knob suffixes — ``pallas:high``
+    plans the pallas executor with DFFT_MM_PRECISION=high, and
+    ``matmul:high:gauss`` additionally sets DFFT_MM_COMPLEX=gauss (the
+    3-real-matmul dense complex product) — for the span of its
+    planning/tracing (the measurable accuracy/speed knobs of
+    ``ops/dft_matmul.py::mm_precision``/``complex_mode``; the reference
+    likewise records faster-but-less-accurate backend rows side by side,
     ``csv/batch_rocResult1D.csv``). The roundtrip gate still applies, so a
     tier that breaks the c64 accuracy bar is dropped, never reported."""
 
+    _VARS = {"default": "DFFT_MM_PRECISION", "high": "DFFT_MM_PRECISION",
+             "highest": "DFFT_MM_PRECISION",
+             "native": "DFFT_MM_COMPLEX", "gauss": "DFFT_MM_COMPLEX"}
+
     def __init__(self, executor: str):
-        self.base, _, tier = executor.partition(":")
-        self.tier = tier or None
-        self._saved = None
+        self.base, *suffixes = executor.split(":")
+        try:
+            self.env = {self._VARS[s]: s for s in suffixes}
+        except KeyError as e:
+            raise ValueError(
+                f"unknown executor suffix {e.args[0]!r} in {executor!r}; "
+                f"valid: {sorted(self._VARS)}") from None
+        if len(self.env) != len(suffixes):
+            # e.g. 'matmul:high:default' — the dict keeps only one value
+            # per knob, so the row label would lie about what ran.
+            raise ValueError(
+                f"conflicting suffixes in {executor!r}: at most one "
+                f"precision tier and one complex-product mode")
+        self._saved = {}
 
     def __enter__(self):
-        if self.tier is not None:
-            self._saved = os.environ.get("DFFT_MM_PRECISION")
-            os.environ["DFFT_MM_PRECISION"] = self.tier
+        for var, val in self.env.items():
+            self._saved[var] = os.environ.get(var)
+            os.environ[var] = val
         return self.base
 
     def __exit__(self, *exc):
-        if self.tier is not None:
-            if self._saved is None:
-                os.environ.pop("DFFT_MM_PRECISION", None)
+        for var, old in self._saved.items():
+            if old is None:
+                os.environ.pop(var, None)
             else:
-                os.environ["DFFT_MM_PRECISION"] = self._saved
+                os.environ[var] = old
         return False
 
 
@@ -298,8 +315,8 @@ def _worker(shape_n: int) -> None:
     # direct_max), the highest-expected-value candidate of the menu — a
     # short tunnel window must measure it before the also-rans.
     default_execs = ("xla" if fast
-                     else "xla,matmul:high,xla_minor,matmul,"
-                          "pallas,pallas:high")
+                     else "xla,matmul:high,matmul:high:gauss,"
+                          "xla_minor,matmul,pallas,pallas:high")
     candidates = [
         e.strip()
         for e in os.environ.get(

@@ -56,7 +56,8 @@ step diag_r2c 1200 python benchmarks/diag_r2c.py
 #       pallas candidates: a 512-sized pallas compile wedged the tunnel in
 #       the first r5 window and would starve every later step. The full
 #       menu (pallas included) re-runs as the LAST campaign step.
-step bench 1500 env DFFT_BENCH_EXECUTORS=xla,matmul:high,xla_minor,matmul \
+step bench 1500 env \
+    DFFT_BENCH_EXECUTORS=xla,matmul:high,matmul:high:gauss,xla_minor,matmul \
     bash -c 'set -o pipefail
              python bench.py | tee benchmarks/results/hw_bench_campaign2.json'
 
@@ -66,6 +67,16 @@ for split in 16x32 8x64 4x128 2x256; do
     python benchmarks/speed3d.py c2c single 512 512 512 \
     -executor matmul -iters 3 -csv benchmarks/csv/mm_split_tpu.csv
 done
+
+# -- 3b. Gauss 3-real-matmul complex product vs XLA's native complex
+#        decomposition, on the dense 512^3 path (25% fewer MXU matmuls
+#        if XLA lowers complex dots as 4 real ones).
+step mm_gauss_512 700 env DFFT_MM_COMPLEX=gauss DFFT_MM_PRECISION=high \
+    python benchmarks/speed3d.py c2c single 512 512 512 \
+    -executor matmul -iters 3 -csv benchmarks/csv/mm_complex_gauss_tpu.csv
+step mm_native_512 700 env DFFT_MM_PRECISION=high \
+    python benchmarks/speed3d.py c2c single 512 512 512 \
+    -executor matmul -iters 3 -csv benchmarks/csv/mm_complex_native_tpu.csv
 
 # -- 4. precision-tier comparison @256^3 (matmul only; pallas deferred)
 for prec in highest high default; do

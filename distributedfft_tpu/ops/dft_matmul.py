@@ -201,6 +201,43 @@ def pack_factor(n: int, rows: int) -> int:
     return 1
 
 
+def complex_mode() -> str:
+    """How the dense tier multiplies by the complex DFT matrix.
+
+    ``native`` (default): one complex einsum — XLA owns the
+    complex-to-real decomposition (typically 4 real matmuls).
+    ``gauss``: explicit 3-real-matmul Gauss/Karatsuba split,
+    m1=(xr+xi)@Wr, m2=xr@(Wi-Wr), m3=xi@(Wi+Wr), y=(m1-m3)+i(m1+m2) —
+    the combined matrices are trace-time constants, so this trades one
+    MXU matmul (~25% of the dense tier's compute) for two fused
+    elementwise passes, and pins the bf16 pass count to exactly
+    3 x mm_precision() passes instead of XLA's decomposition choice.
+    A hardware-sweep knob (campaign-swept at 512^3), like
+    DFFT_MM_PRECISION. Read at trace time."""
+    m = os.environ.get("DFFT_MM_COMPLEX", "native").strip().lower()
+    if m not in ("native", "gauss"):
+        raise ValueError(
+            f"DFFT_MM_COMPLEX={m!r} is not a complex-product mode; "
+            f"use 'native' or 'gauss'")
+    return m
+
+
+def _gauss_matmul(x: jnp.ndarray, w_np: np.ndarray,
+                  pat: str) -> jnp.ndarray:
+    """y = einsum(pat, x, W) for complex x and constant complex W via the
+    3-real-matmul Gauss split (see :func:`complex_mode`)."""
+    rdt = x.real.dtype
+    xr, xi = jnp.real(x), jnp.imag(x)
+    wr = jnp.asarray(np.real(w_np), dtype=rdt)
+    d1 = jnp.asarray(np.imag(w_np) - np.real(w_np), dtype=rdt)
+    d2 = jnp.asarray(np.imag(w_np) + np.real(w_np), dtype=rdt)
+    p = mm_precision()
+    m1 = jnp.einsum(pat, xr + xi, wr, precision=p)
+    m2 = jnp.einsum(pat, xr, d1, precision=p)
+    m3 = jnp.einsum(pat, xi, d2, precision=p)
+    return lax.complex(m1 - m3, m1 + m2)
+
+
 def _direct(x: jnp.ndarray, forward: bool) -> jnp.ndarray:
     """Dense DFT of the last axis: one batched matmul on the MXU; factors
     under the 128 MXU edge are block-diagonal-packed to full width."""
@@ -208,12 +245,21 @@ def _direct(x: jnp.ndarray, forward: bool) -> jnp.ndarray:
     rows = math.prod(x.shape[:-1]) if x.ndim > 1 else 1
     g = pack_factor(n, rows)
     if g > 1:
-        w = jnp.asarray(_blockdiag_dft_np(n, g, forward), dtype=x.dtype)
+        w_np = _blockdiag_dft_np(n, g, forward)
         x2 = x.reshape(rows // g, g * n)
-        y = jnp.einsum("...j,jk->...k", x2, w, precision=mm_precision())
+        if complex_mode() == "gauss":
+            y = _gauss_matmul(x2, w_np, "...j,jk->...k")
+        else:
+            y = jnp.einsum("...j,jk->...k", x2,
+                           jnp.asarray(w_np, dtype=x.dtype),
+                           precision=mm_precision())
         return y.reshape(x.shape)
-    w = jnp.asarray(_dft_matrix_np(n, forward), dtype=x.dtype)
-    return jnp.einsum("...j,jk->...k", x, w, precision=mm_precision())
+    w_np = _dft_matrix_np(n, forward)
+    if complex_mode() == "gauss":
+        return _gauss_matmul(x, w_np, "...j,jk->...k")
+    return jnp.einsum("...j,jk->...k", x,
+                      jnp.asarray(w_np, dtype=x.dtype),
+                      precision=mm_precision())
 
 
 # Prime lengths above this use Bluestein's chirp-z algorithm instead of the
@@ -291,11 +337,14 @@ def _direct_axis(x: jnp.ndarray, axis: int, forward: bool) -> jnp.ndarray:
     on pack_factor == 1 (packed sub-128 factors need the row-regroup
     path)."""
     n = x.shape[axis]
-    w = jnp.asarray(_dft_matrix_np(n, forward), dtype=x.dtype)
+    w_np = _dft_matrix_np(n, forward)
     subs = "abcdefgh"[: x.ndim]
     j = subs[axis]
     out = subs.replace(j, "z")
-    return jnp.einsum(f"{subs},{j}z->{out}", x, w,
+    pat = f"{subs},{j}z->{out}"
+    if complex_mode() == "gauss":
+        return _gauss_matmul(x, w_np, pat)
+    return jnp.einsum(pat, x, jnp.asarray(w_np, dtype=x.dtype),
                       precision=mm_precision())
 
 

@@ -153,6 +153,18 @@ def test_bench_executor_menu(tmp_path):
                                            jnp.complex64, "matmul:high")
     assert secs > 0 and err < 1e-3 and plan.executor == "matmul"
     assert os.environ.get("DFFT_MM_PRECISION") == before
+    # Multi-suffix candidates (tier + complex-product mode) compose;
+    # both env knobs are restored afterwards.
+    before_cm = os.environ.get("DFFT_MM_COMPLEX")
+    secs, err, plan = bench.bench_executor((16, 16, 16), mesh,
+                                           jnp.complex64,
+                                           "matmul:high:gauss")
+    assert secs > 0 and err < 1e-3 and plan.executor == "matmul"
+    assert os.environ.get("DFFT_MM_PRECISION") == before
+    assert os.environ.get("DFFT_MM_COMPLEX") == before_cm
+    with pytest.raises(ValueError, match="suffix"):
+        bench.bench_executor((16, 16, 16), mesh, jnp.complex64,
+                             "matmul:fast")
 
 
 def test_bench_last_recorded_tpu_line():

@@ -205,6 +205,45 @@ def test_dense_bound_above_bluestein_min(monkeypatch):
     assert dm._bluestein_tables.cache_info().currsize == chirp_entries
 
 
+def test_gauss_complex_mode(monkeypatch):
+    """DFFT_MM_COMPLEX=gauss (3-real-matmul Gauss split of the dense
+    complex product, a hardware-sweep knob) must match the native
+    complex einsum and numpy on every dense path: last axis, in-place
+    middle axis, and the block-diagonal packed tier."""
+    monkeypatch.setenv("DFFT_MM_DIRECT_MAX", "512")
+    rng = np.random.default_rng(23)
+
+    x = (rng.standard_normal((8, 512))
+         + 1j * rng.standard_normal((8, 512))).astype(np.complex64)
+    ref = np.fft.fft(x.astype(np.complex128), axis=1)
+    native = np.asarray(dm.fft_along_axis(jnp.asarray(x), 1))
+    monkeypatch.setenv("DFFT_MM_COMPLEX", "gauss")
+    gauss = np.asarray(dm.fft_along_axis(jnp.asarray(x), 1))
+    scale = np.max(np.abs(ref))
+    assert np.max(np.abs(gauss - ref)) / scale < 1e-5
+    assert np.max(np.abs(gauss - native)) / scale < 2e-6
+
+    # middle axis (the _direct_axis in-place contraction)
+    y = (rng.standard_normal((4, 256, 8))
+         + 1j * rng.standard_normal((4, 256, 8))).astype(np.complex64)
+    refy = np.fft.fft(y.astype(np.complex128), axis=1)
+    gy = np.asarray(dm.fft_along_axis(jnp.asarray(y), 1))
+    assert np.max(np.abs(gy - refy)) / np.max(np.abs(refy)) < 1e-5
+
+    # packed tier (n=16 -> pack_factor 8 at these rows) + inverse
+    z = (rng.standard_normal((64, 16))
+         + 1j * rng.standard_normal((64, 16))).astype(np.complex64)
+    gz = np.asarray(dm.fft_along_axis(jnp.asarray(z), 1))
+    refz = np.fft.fft(z.astype(np.complex128), axis=1)
+    assert np.max(np.abs(gz - refz)) / np.max(np.abs(refz)) < 1e-5
+    rt = np.asarray(dm.fft_along_axis(jnp.asarray(gz), 1, forward=False))
+    assert np.max(np.abs(rt - z)) / np.max(np.abs(z)) < 1e-5
+
+    monkeypatch.setenv("DFFT_MM_COMPLEX", "typo")
+    with pytest.raises(ValueError):
+        dm.complex_mode()
+
+
 def test_dense_axis_in_place(monkeypatch):
     """_direct_axis (dense contraction of a middle/leading axis with no
     moveaxis round trip) matches numpy on every axis of a 3D array."""
