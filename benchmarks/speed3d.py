@@ -392,10 +392,37 @@ def main(argv=None) -> None:
         kind = (f"r2c_axis{args.r2c_axis}"
                 if args.kind == "r2c" and args.r2c_axis != 2 else args.kind)
         rec.record(kind, args.precision, *shape, ndev, deco,
-                   algorithm, args.executor, f"{seconds:.6f}", f"{gf:.1f}",
-                   f"{max_err:.3e}")
+                   algorithm, _executor_label(args.executor),
+                   f"{seconds:.6f}", f"{gf:.1f}", f"{max_err:.3e}")
     if args.trace:
         print(f"trace written to {tr.finalize_tracing()}")
+
+
+def _executor_label(executor: str) -> str:
+    """Executor column label with any active trace-time MXU knobs
+    appended (e.g. ``matmul[high+gauss+split=4x128]`` — ``+``-joined:
+    a comma would split the CSV field) — sweep rows driven by env
+    (DFFT_MM_*) must be self-describing, not distinguishable only by
+    which campaign step appended them. Default rows keep the bare name
+    (schema unchanged)."""
+    import os
+
+    knobs = []
+    prec = os.environ.get("DFFT_MM_PRECISION", "").strip().lower()
+    if prec and prec != "highest":
+        knobs.append(prec)
+    if os.environ.get("DFFT_MM_COMPLEX", "").strip().lower() == "gauss":
+        knobs.append("gauss")
+    split = os.environ.get("DFFT_MM_SPLIT", "").strip()
+    if split:  # multi-entry values are comma-separated (512=4x128,...)
+        knobs.append(f"split={split.replace(',', ';')}")
+    dmax = os.environ.get("DFFT_MM_DIRECT_MAX", "").strip()
+    if dmax:
+        knobs.append(f"dmax={dmax}")
+    depth = os.environ.get("DFFT_DD_DEPTH", "").strip()
+    if depth:  # the dd tier's slice-depth knob (campaign-swept)
+        knobs.append(f"depth={depth.replace(',', ';')}")
+    return f"{executor}[{'+'.join(knobs)}]" if knobs else executor
 
 
 def _spec_axis_sizes(sharding):
@@ -550,8 +577,8 @@ def _run_dd(args, shape, ndev) -> None:
             "algorithm", "executor", "seconds", "gflops", "max_err",
         ))
         rec.record(args.kind, "dd", *shape, ndev, fwd.decomposition,
-                   "alltoall", "dd-mxu", f"{seconds:.6f}", f"{gf:.1f}",
-                   f"{max_err:.3e}")
+                   "alltoall", _executor_label("dd-mxu"),
+                   f"{seconds:.6f}", f"{gf:.1f}", f"{max_err:.3e}")
 
 
 if __name__ == "__main__":
