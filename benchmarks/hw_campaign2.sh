@@ -49,17 +49,19 @@ step() {
   fi
 }
 
-# -- 1. r2c bisection: which real-transform primitive is wrong on TPU
-step diag_r2c 1200 python benchmarks/diag_r2c.py
-
-# -- 2. flagship bench (512^3 tournament, safe-real mode) — WITHOUT the
-#       pallas candidates: a 512-sized pallas compile wedged the tunnel in
-#       the first r5 window and would starve every later step. The full
-#       menu (pallas included) re-runs as the LAST campaign step.
+# -- 1. flagship bench FIRST (512^3 tournament, safe-real mode) — the
+#       round's #1 deliverable must land before anything else can eat a
+#       short window. WITHOUT the pallas candidates: a 512-sized pallas
+#       compile wedged the tunnel in the first r5 window and would starve
+#       every later step. The full menu (pallas included) re-runs as the
+#       LAST campaign step.
 step bench 1500 env \
     DFFT_BENCH_EXECUTORS=xla,matmul:high,matmul:high:gauss,xla_minor,matmul \
     bash -c 'set -o pipefail
              python bench.py | tee benchmarks/results/hw_bench_campaign2.json'
+
+# -- 2. r2c bisection: which real-transform primitive is wrong on TPU
+step diag_r2c 1200 python benchmarks/diag_r2c.py
 
 # -- 3. matmul four-step split frontier @512 (the MXU-path 512^3 candidates)
 for split in 16x32 8x64 4x128 2x256; do
