@@ -15,6 +15,11 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     bash benchmarks/hw_campaign2.sh >> benchmarks/results/hw_campaign2_r05.log 2>&1
     rc=$?
     log "campaign2 rc=$rc"
+    # Belt: hardware rows must survive a builder-session crash — commit
+    # the benchmark artifacts the moment a campaign pass ends.
+    git add benchmarks/csv benchmarks/results >/dev/null 2>&1
+    git diff --cached --quiet 2>/dev/null || \
+      git commit -q -m "Hardware-window artifacts (auto-committed by campaign2_loop)"
     if [ $rc -eq 0 ]; then log "campaign2 COMPLETE"; exit 0; fi
     sleep 60
   else
