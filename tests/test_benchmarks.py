@@ -167,6 +167,35 @@ def test_bench_executor_menu(tmp_path):
                              "matmul:fast")
 
 
+@pytest.mark.parametrize("script", [
+    "bench.py", "speed3d.py", "batch_bench.py", "tune_pallas.py",
+    "record_baseline.py", "hw_smoke.py", "diag_r2c.py",
+    "hw_campaign.sh", "hw_campaign2.sh", "campaign2_loop.sh",
+])
+def test_campaign_scripts_importable(script):
+    """Every script the hardware campaign invokes must at least import /
+    parse — an import-time error discovered on a live tunnel burns that
+    step's slice of a rare window. Shell scripts get bash -n; Python
+    scripts get an import (none runs main at import: __main__-guarded)."""
+    import subprocess
+
+    d = REPO if script == "bench.py" else os.path.join(REPO, "benchmarks")
+    path = os.path.join(d, script)
+    if script.endswith(".sh"):
+        rc = subprocess.run(["bash", "-n", path],
+                            capture_output=True, text=True, timeout=30)
+        assert rc.returncode == 0, rc.stderr
+        return
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    rc = subprocess.run(
+        [sys.executable, "-c",
+         f"import sys; sys.path.insert(0, {os.path.dirname(path)!r}); "
+         f"import {script[:-3]}"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert rc.returncode == 0, rc.stderr[-800:]
+
+
 def test_bench_last_recorded_tpu_line():
     """The CPU-insurance line's interpretability metadata: the newest
     committed backend:"tpu" bench line from an earlier campaign window,
