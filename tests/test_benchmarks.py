@@ -254,6 +254,11 @@ def test_bench_donated_chain():
     mesh = dfft.make_mesh(4)
     secs = bench.bench_donated((16, 16, 16), mesh, jnp.complex64, "xla")
     assert secs > 0
+    # The winner's donation pass must also work for suffixed candidates
+    # (trace under the scoped env, donated ping-pong after).
+    secs = bench.bench_donated((16, 16, 16), mesh, jnp.complex64,
+                               "matmul:high:gauss")
+    assert secs > 0
 
 
 def test_speed3d_profile_flag(tmp_path):
