@@ -251,6 +251,7 @@ def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
           all_times, donated=False, stages=None):
     import jax
 
+    from distributedfft_tpu.utils.metrics import metrics_snapshot
     from distributedfft_tpu.utils.timing import gflops
 
     shape = (shape_n,) * 3
@@ -274,6 +275,10 @@ def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
         out.update(_roofline(shape, seconds, n_dev))
     if stages:
         out["stages"] = stages
+    # Structured telemetry block: the worker-process metrics registry
+    # (plan builds/cache, compile seconds, executes, exchange bytes) so
+    # every BENCH json line is self-describing without string-grepping.
+    out["telemetry"] = {"metrics": metrics_snapshot()}
     print(json.dumps(out), flush=True)
     return out
 
@@ -295,6 +300,8 @@ def _worker(shape_n: int) -> None:
 
     import distributedfft_tpu as dfft
     from distributedfft_tpu.utils.timing import time_staged
+
+    dfft.enable_metrics()  # the _emit telemetry block reads the registry
 
     fast = os.environ.get("DFFT_BENCH_FAST", "0") == "1"
     shape = (shape_n,) * 3
@@ -598,15 +605,25 @@ def main() -> None:
                        "DFFT_BENCH_EXECUTORS": "xla"},
         )
         if result is not None:
-            result["error"] = "tpu unavailable: " + (
-                " | ".join(errors)[-700:] or "no attempt fit the deadline")
             result["vs_baseline"] = 0.0  # CPU number; not comparable
             rec = _last_recorded_tpu_line()
+            # Structured status block (supersedes the ad-hoc string
+            # fields): attempt-by-attempt failure list, fallback marker,
+            # and the newest committed TPU line — NOT this run's
+            # measurement, attached so a transport-down insurance line
+            # stays interpretable.
+            tel = result.setdefault("telemetry", {})
+            tel["status"] = {
+                "tpu_available": False,
+                "fallback_backend": "cpu",
+                "failures": errors or ["no attempt fit the deadline"],
+                "last_recorded_tpu": rec,
+            }
+            # Deprecated duplicates of the status block, kept one release
+            # for downstream BENCH parsers.
+            result["error"] = "tpu unavailable: " + (
+                " | ".join(errors)[-700:] or "no attempt fit the deadline")
             if rec is not None:
-                # NOT this run's measurement — the newest committed
-                # backend:"tpu" line from an earlier campaign window,
-                # attached so a transport-down insurance line stays
-                # interpretable. Clearly labeled as recorded.
                 result["last_recorded_tpu"] = rec
             print(json.dumps(result), flush=True)
             return
@@ -619,6 +636,16 @@ def main() -> None:
                 "value": 0.0,
                 "unit": "GFlops/s",
                 "vs_baseline": 0.0,
+                "telemetry": {
+                    "status": {
+                        "tpu_available": False,
+                        "fallback_backend": None,
+                        "failures": errors,
+                        "last_recorded_tpu": None,
+                    }
+                },
+                # Deprecated duplicate of telemetry.status.failures, kept
+                # one release for downstream BENCH parsers.
                 "error": " | ".join(errors)[-1500:],
             }
         ),

@@ -77,6 +77,10 @@ def parse_args(argv):
                    help="run on (virtual) CPU devices instead of TPU")
     p.add_argument("-csv", default=None, help="append a result row to this CSV")
     p.add_argument("-trace", action="store_true", help="write a dfft trace log")
+    p.add_argument("-metrics", action="store_true",
+                   help="print the structured metrics snapshot (plan "
+                        "builds/cache, compile seconds, executes, exchange "
+                        "bytes) as one 'telemetry ...' JSON line")
     p.add_argument("-profile", default=None, metavar="DIR",
                    help="capture an XLA profiler trace of the timed section "
                         "into DIR (view with tensorboard/xprof)")
@@ -145,6 +149,7 @@ def main(argv=None) -> None:
 
     if args.trace:
         tr.init_tracing("dfft_speed3d")
+    dfft.enable_metrics()  # registry feeds the -metrics telemetry line
 
     shape = (args.nx, args.ny, args.nz)
     dtype = jnp.complex128 if args.precision == "double" else jnp.complex64
@@ -394,8 +399,22 @@ def main(argv=None) -> None:
         rec.record(kind, args.precision, *shape, ndev, deco,
                    algorithm, _executor_label(args.executor),
                    f"{seconds:.6f}", f"{gf:.1f}", f"{max_err:.3e}")
+    _print_telemetry(args)
     if args.trace:
         print(f"trace written to {tr.finalize_tracing()}")
+
+
+def _print_telemetry(args) -> None:
+    """One self-contained ``telemetry {...}`` JSON line (with -metrics):
+    the structured counterpart of the human-readable result block, for
+    campaign scripts that archive stdout."""
+    if not getattr(args, "metrics", False):
+        return
+    import json
+
+    import distributedfft_tpu as dfft
+
+    print("telemetry " + json.dumps(dfft.metrics_snapshot()))
 
 
 def _executor_label(executor: str) -> str:
@@ -579,6 +598,7 @@ def _run_dd(args, shape, ndev) -> None:
         rec.record(args.kind, "dd", *shape, ndev, fwd.decomposition,
                    "alltoall", _executor_label("dd-mxu"),
                    f"{seconds:.6f}", f"{gf:.1f}", f"{max_err:.3e}")
+    _print_telemetry(args)
 
 
 if __name__ == "__main__":

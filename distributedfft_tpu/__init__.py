@@ -20,6 +20,7 @@ from .api import (  # noqa: F401
     FORWARD,
     Plan3D,
     alloc_local,
+    clear_plan_cache,
     destroy_plan,
     execute,
     plan_brick_dft_c2c_3d,
@@ -65,11 +66,18 @@ from .plan_logic import (  # noqa: F401
     default_options,
     logic_plan3d,
 )
+from .utils.metrics import (  # noqa: F401
+    enable_metrics,
+    metrics_enabled,
+    metrics_reset,
+    metrics_snapshot,
+)
 from .utils.trace import (  # noqa: F401
     add_trace,
     finalize_tracing,
     init_tracing,
     plan_info,
+    tracing_enabled,
 )
 
 __version__ = "0.1.0"

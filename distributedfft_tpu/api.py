@@ -30,12 +30,17 @@ from __future__ import annotations
 
 import functools
 import math
+import os
+import time
 from dataclasses import dataclass, field
 from typing import Any, Sequence, Callable
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .utils import metrics as _metrics
+from .utils.trace import add_trace
 
 from . import geometry as geo
 from .geometry import Box3, world_box
@@ -134,7 +139,12 @@ class Plan3D:
         ``self`` for chaining."""
         from .utils.timing import sync
 
+        t0 = time.perf_counter()
         sync(self.fn(alloc_local(self)))
+        if _metrics._enabled:
+            _metrics.observe(
+                "compile_seconds", time.perf_counter() - t0,
+                decomposition=self.decomposition, executor=self.executor)
         return self
 
     def flops(self) -> float:
@@ -1036,10 +1046,14 @@ class DDPlan3D:
         return self.direction == FORWARD
 
     def __call__(self, hi, lo, *, scale: Scale = Scale.NONE):
-        yh, yl = self.fn(hi, lo)
-        if scale != Scale.NONE:
-            yh, yl = _jitted_dd_scale()(
-                yh, yl, scale_factor(scale, math.prod(self.shape)))
+        if _metrics._enabled:
+            _metrics.inc("executes", kind="dd",
+                         decomposition=self.decomposition, executor="dd")
+        with add_trace(f"execute_dd_{self.decomposition}"):
+            yh, yl = self.fn(hi, lo)
+            if scale != Scale.NONE:
+                yh, yl = _jitted_dd_scale()(
+                    yh, yl, scale_factor(scale, math.prod(self.shape)))
         return yh, yl
 
 
@@ -1320,12 +1334,136 @@ def _dd_r2c_axis_wrapped(shape, mesh, axis: int, *, direction) -> DDPlan3D:
     )
 
 
+# ---------------------------------------------------------------- plan cache
+# Plans are immutable (the reference's plan-owns-everything discipline) and
+# expensive to build, so the public planners memoize on their full argument
+# set. The key also carries every trace-time env knob that changes what a
+# plan would compile to (DFFT_MM_*, DFFT_PALLAS_*, ...) plus the x64 flag —
+# two calls that could compile different programs never share an entry.
+# DFFT_PLAN_CACHE=0 disables; unhashable arguments bypass silently.
+
+_PLAN_CACHE: dict = {}
+_PLAN_CACHE_MAX = 128  # plans hold compiled executables; bound the HBM/host
+_PLAN_ENV_KNOBS = (
+    "DFFT_AUTO_EXECUTORS", "DFFT_MM_PRECISION", "DFFT_MM_COMPLEX",
+    "DFFT_MM_SPLIT", "DFFT_MM_DIRECT_MAX", "DFFT_DD_DEPTH",
+    "DFFT_PALLAS_PACK", "DFFT_PALLAS_SPLIT", "DFFT_XLA_REAL",
+    "DFFT_FORCE_REAL_LOWERING",
+)
+
+
+def clear_plan_cache() -> None:
+    """Drop every memoized plan (tuning sweeps that mutate env knobs
+    outside ``_PLAN_ENV_KNOBS``, tests)."""
+    _PLAN_CACHE.clear()
+
+
+def _plan_cache_key(kind: str, shape, mesh, kw: dict):
+    """Hashable cache key, or None when caching is off / impossible."""
+    if os.environ.get("DFFT_PLAN_CACHE", "1") == "0":
+        return None
+    key = (
+        kind, shape, mesh, tuple(sorted(kw.items())),
+        bool(jax.config.jax_enable_x64),
+        tuple(os.environ.get(v, "") for v in _PLAN_ENV_KNOBS),
+    )
+    try:
+        hash(key)
+    except TypeError:
+        return None
+    return key
+
+
+def _timed_build(kind: str, build: Callable, shape, mesh, kw: dict):
+    t0 = time.perf_counter()
+    plan = build(shape, mesh, **kw)
+    if _metrics._enabled:
+        _metrics.observe(
+            "plan_build_seconds", time.perf_counter() - t0, kind=kind)
+        _metrics.inc(
+            "plan_builds", kind=kind, decomposition=plan.decomposition,
+            executor=getattr(plan, "executor", "dd"))
+    return plan
+
+
+def _plan_cached(kind: str, build: Callable) -> Callable:
+    """Memoizing wrapper applied to each public planner below."""
+
+    @functools.wraps(build)
+    def wrapper(shape, mesh=None, **kw):
+        shape = tuple(int(s) for s in shape)
+        key = _plan_cache_key(kind, shape, mesh, kw)
+        if key is None:
+            return _timed_build(kind, build, shape, mesh, kw)
+        plan = _PLAN_CACHE.get(key)
+        if plan is not None:
+            if _metrics._enabled:
+                _metrics.inc("plan_cache_hits", kind=kind)
+            return plan
+        if _metrics._enabled:
+            _metrics.inc("plan_cache_misses", kind=kind)
+        plan = _PLAN_CACHE[key] = _timed_build(kind, build, shape, mesh, kw)
+        while len(_PLAN_CACHE) > _PLAN_CACHE_MAX:
+            _PLAN_CACHE.pop(next(iter(_PLAN_CACHE)))
+        return plan
+
+    return wrapper
+
+
+plan_dft_c2c_3d = _plan_cached("c2c", plan_dft_c2c_3d)
+plan_dft_r2c_3d = _plan_cached("r2c", plan_dft_r2c_3d)
+plan_dd_dft_c2c_3d = _plan_cached("dd_c2c", plan_dd_dft_c2c_3d)
+plan_dd_dft_r2c_3d = _plan_cached("dd_r2c", plan_dd_dft_r2c_3d)
+
+
+def _plan_exchange_bytes(plan: Plan3D) -> tuple[int, int]:
+    """(true, wire) bytes one execution of ``plan`` moves between
+    devices: chain exchanges per ``plan_logic.exchange_payloads`` under
+    the plan's own algorithm, plus any brick-edge ring/a2av traffic.
+    Computed once and cached on the plan object, so the per-execute
+    metrics hook is a dict lookup."""
+    cached = getattr(plan, "_exchange_bytes", None)
+    if cached is not None:
+        return cached
+    import numpy as np
+
+    true_b = wire_b = 0
+    lp = plan.logic
+    if lp is not None and lp.mesh is not None:
+        from .plan_logic import exchange_payloads
+
+        shape_eff = plan.out_shape if (plan.real and plan.forward) else (
+            plan.in_shape if plan.real else plan.shape)
+        itemsize = np.dtype(plan.dtype).itemsize
+        wire_key = {
+            "alltoall": "alltoall_bytes",
+            "ppermute": "alltoall_bytes",  # the padded ring ships the pads
+            "alltoallv": "alltoallv_bytes",
+        }[plan.options.algorithm]
+        for e in exchange_payloads(lp, shape_eff, itemsize):
+            true_b += e["true_bytes"]
+            wire_b += e[wire_key]
+    if plan.brick_edges is not None:
+        itemsize = np.dtype(plan.dtype).itemsize
+        for bs in plan.brick_edges:
+            true_b += bs.payload_elems * itemsize
+            wire_b += bs.wire_elems * itemsize
+    plan._exchange_bytes = (true_b, wire_b)
+    return true_b, wire_b
+
+
 def execute(plan: Plan3D, x, *, scale: Scale = Scale.NONE):
     """Run a plan (``fft_mpi_execute_dft_3d_c2c``,
     ``fft_mpi_3d_api.cpp:181``). Accepts any array-like of the plan's global
-    input shape; device placement follows the plan's input sharding."""
-    from .utils.trace import add_trace
+    input shape; device placement follows the plan's input sharding.
 
+    Telemetry: with tracing on, the whole call is the ``execute_*`` span
+    and the chain's t0..t3 stage spans nest inside it (recorded when the
+    plan's jit first traces; device-side they ride the profiler
+    annotations). With metrics on, bumps the ``executes`` counter and the
+    exchange true/wire byte counters. Both disabled (the default) cost
+    one flag check each — no events, no allocations.
+    """
     x = jnp.asarray(x, dtype=plan.in_dtype)
     if x.shape != plan.in_shape:
         raise ValueError(f"plan input shape is {plan.in_shape}, got {x.shape}")
@@ -1333,6 +1471,13 @@ def execute(plan: Plan3D, x, *, scale: Scale = Scale.NONE):
         kind = "r2c" if plan.forward else "c2r"
     else:
         kind = "c2c"
+    if _metrics._enabled:
+        _metrics.inc("executes", kind=kind,
+                     decomposition=plan.decomposition, executor=plan.executor)
+        true_b, wire_b = _plan_exchange_bytes(plan)
+        if true_b or wire_b:
+            _metrics.inc("exchange_true_bytes", float(true_b))
+            _metrics.inc("exchange_wire_bytes", float(wire_b))
     with add_trace(f"execute_{kind}_{plan.decomposition}"):
         y = plan.fn(x)
         if scale != Scale.NONE:

@@ -35,6 +35,7 @@ except ImportError:  # pragma: no cover
 
 from ..geometry import pad_to  # noqa: F401 — used by the r2c chains
 from ..ops import ddfft
+from ..utils.trace import add_trace, trace_stages
 from .exchange import _crop_axis, _pad_axis, exchange_uneven
 from .pencil import PencilSpec, chain_geometry
 from .slab import SlabSpec
@@ -83,19 +84,22 @@ def build_dd_slab_fft3d(
 
     def local_fn(hi, lo):
         # t0: dd transforms of the device-local planes.
-        for ax in local_axes:
-            hi, lo = ddfft.fft_axis_dd(hi, lo, ax, forward=forward)
+        with add_trace("t0_dd_fft_planes"):
+            for ax in local_axes:
+                hi, lo = ddfft.fft_axis_dd(hi, lo, ax, forward=forward)
         # t1+t2: both dd components ride the same global transpose the
         # c64 pipeline uses (XLA schedules the two collectives back to
         # back on the ICI).
-        kw = dict(split_axis=out_axis, concat_axis=in_axis, axis_size=p,
-                  algorithm=algorithm, platform=platform)
-        hi = exchange_uneven(hi, axis_name, **kw)
-        lo = exchange_uneven(lo, axis_name, **kw)
-        hi = _crop_axis(hi, in_axis, n_in)
-        lo = _crop_axis(lo, in_axis, n_in)
-        # t3: dd transform of the now-local lines.
-        return ddfft.fft_axis_dd(hi, lo, in_axis, forward=forward)
+        with add_trace(f"t2_exchange_{axis_name}"):
+            kw = dict(split_axis=out_axis, concat_axis=in_axis, axis_size=p,
+                      algorithm=algorithm, platform=platform)
+            hi = exchange_uneven(hi, axis_name, **kw)
+            lo = exchange_uneven(lo, axis_name, **kw)
+        with add_trace("t3_dd_fft_lines"):
+            hi = _crop_axis(hi, in_axis, n_in)
+            lo = _crop_axis(lo, in_axis, n_in)
+            # t3: dd transform of the now-local lines.
+            return ddfft.fft_axis_dd(hi, lo, in_axis, forward=forward)
 
     in_spec, out_spec = spec.in_pspec, spec.out_pspec
     mapped = _shard_map(local_fn, mesh=mesh,
@@ -147,29 +151,34 @@ def build_dd_slab_rfft3d(
     if forward:
 
         def local_fn(hi, lo):  # real f32 [n0p/p, N1, N2] per device
-            chi = lax.complex(hi, jnp.zeros_like(hi))
-            clo = lax.complex(lo, jnp.zeros_like(lo))
-            chi, clo = ddfft.fft_axis_dd(chi, clo, 2)    # t0a: Z lines
-            chi, clo = chi[..., :h], clo[..., :h]        # r2c shrink
-            chi, clo = ddfft.fft_axis_dd(chi, clo, 1)    # t0b: Y lines
-            kw = dict(split_axis=1, concat_axis=0, axis_size=p,
-                      algorithm=algorithm, platform=platform)
-            chi = exchange_uneven(chi, axis_name, **kw)
-            clo = exchange_uneven(clo, axis_name, **kw)
-            chi = _crop_axis(chi, 0, n0)
-            clo = _crop_axis(clo, 0, n0)
-            return ddfft.fft_axis_dd(chi, clo, 0)        # t3: X lines
+            with add_trace("t0_dd_r2c_zy"):
+                chi = lax.complex(hi, jnp.zeros_like(hi))
+                clo = lax.complex(lo, jnp.zeros_like(lo))
+                chi, clo = ddfft.fft_axis_dd(chi, clo, 2)  # t0a: Z lines
+                chi, clo = chi[..., :h], clo[..., :h]      # r2c shrink
+                chi, clo = ddfft.fft_axis_dd(chi, clo, 1)  # t0b: Y lines
+            with add_trace(f"t2_exchange_{axis_name}"):
+                kw = dict(split_axis=1, concat_axis=0, axis_size=p,
+                          algorithm=algorithm, platform=platform)
+                chi = exchange_uneven(chi, axis_name, **kw)
+                clo = exchange_uneven(clo, axis_name, **kw)
+            with add_trace("t3_dd_fft_x"):
+                chi = _crop_axis(chi, 0, n0)
+                clo = _crop_axis(clo, 0, n0)
+                return ddfft.fft_axis_dd(chi, clo, 0)      # t3: X lines
 
         pre = lambda v: _pad_axis(v, 0, n0p)  # noqa: E731
         post = lambda v: _crop_axis(v, 1, n1)  # noqa: E731
     else:
 
         def local_fn(hi, lo):  # complex dd [N0, n1p/p, h] per device
-            hi, lo = ddfft.fft_axis_dd(hi, lo, 0, forward=False)
-            kw = dict(split_axis=0, concat_axis=1, axis_size=p,
-                      algorithm=algorithm, platform=platform)
-            hi = exchange_uneven(hi, axis_name, **kw)
-            lo = exchange_uneven(lo, axis_name, **kw)
+            with add_trace("t3_dd_ifft_x"):
+                hi, lo = ddfft.fft_axis_dd(hi, lo, 0, forward=False)
+            with add_trace(f"t2_exchange_{axis_name}"):
+                kw = dict(split_axis=0, concat_axis=1, axis_size=p,
+                          algorithm=algorithm, platform=platform)
+                hi = exchange_uneven(hi, axis_name, **kw)
+                lo = exchange_uneven(lo, axis_name, **kw)
             hi = _crop_axis(hi, 1, n1)
             lo = _crop_axis(lo, 1, n1)
             hi, lo = ddfft.fft_axis_dd(hi, lo, 1, forward=False)
@@ -318,17 +327,23 @@ def build_dd_pencil_fft3d(
         perm, order, rows, cols, row_axis, col_axis, n)
     platform = mesh.devices.flat[0].platform
 
+    fft_names = ("t0_dd_fft", "t1_dd_fft")
+    exch_names = (f"t2a_exchange_{seq[0][0]}", f"t2b_exchange_{seq[1][0]}")
+
     def local_fn(hi, lo):
-        for mesh_ax, parts, split, concat in seq:
-            hi, lo = ddfft.fft_axis_dd(hi, lo, split, forward=forward)
-            kw = dict(split_axis=split, concat_axis=concat,
-                      axis_size=parts, algorithm=algorithm,
-                      platform=platform)
-            hi = exchange_uneven(hi, mesh_ax, **kw)
-            lo = exchange_uneven(lo, mesh_ax, **kw)
-            hi = _crop_axis(hi, concat, n[concat])
-            lo = _crop_axis(lo, concat, n[concat])
-        return ddfft.fft_axis_dd(hi, lo, last_fft, forward=forward)
+        for i, (mesh_ax, parts, split, concat) in enumerate(seq):
+            with add_trace(fft_names[i]):
+                hi, lo = ddfft.fft_axis_dd(hi, lo, split, forward=forward)
+            with add_trace(exch_names[i]):
+                kw = dict(split_axis=split, concat_axis=concat,
+                          axis_size=parts, algorithm=algorithm,
+                          platform=platform)
+                hi = exchange_uneven(hi, mesh_ax, **kw)
+                lo = exchange_uneven(lo, mesh_ax, **kw)
+                hi = _crop_axis(hi, concat, n[concat])
+                lo = _crop_axis(lo, concat, n[concat])
+        with add_trace("t3_dd_fft"):
+            return ddfft.fft_axis_dd(hi, lo, last_fft, forward=forward)
 
     in_spec, out_spec = spec.in_spec, spec.out_spec
     mapped = _shard_map(local_fn, mesh=mesh,
@@ -380,8 +395,8 @@ def build_dd_single_stages(
     def x_line(pair):
         return ddfft.fft_axis_dd(*pair, 0, forward=forward)
 
-    return [("t0_dd_fft_yz", jax.jit(yz)),
-            ("t3_dd_fft_x", jax.jit(x_line))]
+    return trace_stages([("t0_dd_fft_yz", jax.jit(yz)),
+                         ("t3_dd_fft_x", jax.jit(x_line))])
 
 
 def build_dd_slab_stages(
@@ -449,7 +464,7 @@ def build_dd_slab_stages(
         # need not divide the mesh for uneven worlds.
         ("t3_dd_fft_x", jax.jit(t3, in_shardings=(pair_y,))),
     ]
-    return stages, spec
+    return trace_stages(stages), spec
 
 
 def build_dd_pencil_stages(

@@ -38,8 +38,9 @@ except ImportError:  # pragma: no cover
 
 from ..geometry import pad_to
 from ..ops.executors import get_c2r, get_executor, get_r2c
+from ..utils.trace import add_trace
 from .exchange import exchange_uneven
-from .slab import _crop_axis, _pad_axis
+from .slab import _L, _crop_axis, _pad_axis
 
 
 @dataclass(frozen=True)
@@ -157,14 +158,23 @@ def build_pencil_general(
     seq, last_fft, in_pads, out_crops = chain_geometry(
         perm, order, rows, cols, row_axis, col_axis, n)
 
+    # Stage spans: the reference taxonomy with the two pencil exchanges
+    # split out as t2a/t2b (the staged-pipeline naming of .staged).
+    fft_names = (f"t0_fft_{_L[seq[0][2]]}", f"t1_fft_{_L[seq[1][2]]}")
+    exch_names = (f"t2a_exchange_{seq[0][0]}", f"t2b_exchange_{seq[1][0]}")
+    t3_name = f"t3_fft_{_L[last_fft]}"
+
     def local_fn(x):
-        for mesh_ax, parts, split, concat in seq:
-            x = ex(x, (split,), forward)
-            x = exchange_uneven(x, mesh_ax, split_axis=split,
-                                concat_axis=concat, axis_size=parts,
-                                algorithm=algorithm)
-            x = _crop_axis(x, concat, n[concat])
-        return ex(x, (last_fft,), forward)
+        for i, (mesh_ax, parts, split, concat) in enumerate(seq):
+            with add_trace(fft_names[i]):
+                x = ex(x, (split,), forward)
+            with add_trace(exch_names[i]):
+                x = exchange_uneven(x, mesh_ax, split_axis=split,
+                                    concat_axis=concat, axis_size=parts,
+                                    algorithm=algorithm)
+                x = _crop_axis(x, concat, n[concat])
+        with add_trace(t3_name):
+            return ex(x, (last_fft,), forward)
 
     in_spec, out_spec = spec.in_spec, spec.out_spec
 
@@ -269,15 +279,20 @@ def build_pencil_rfft3d(
     if forward:
 
         def local_fn(x):  # real [n0p/rows, n1pc/cols, N2]
-            y = r2c(x, 2)                               # t0: real Z lines
-            y = exchange_uneven(y, col_axis, split_axis=2, concat_axis=1,
-                                axis_size=cols, algorithm=algorithm)
-            y = _crop_axis(y, 1, n1)
-            y = ex(y, (1,), True)                       # Y lines
-            y = exchange_uneven(y, row_axis, split_axis=1, concat_axis=0,
-                                axis_size=rows, algorithm=algorithm)
-            y = _crop_axis(y, 0, n0)
-            return ex(y, (0,), True)                    # t3: X lines
+            with add_trace("t0_r2c_z"):
+                y = r2c(x, 2)                           # t0: real Z lines
+            with add_trace(f"t2a_exchange_{col_axis}"):
+                y = exchange_uneven(y, col_axis, split_axis=2, concat_axis=1,
+                                    axis_size=cols, algorithm=algorithm)
+                y = _crop_axis(y, 1, n1)
+            with add_trace("t1_fft_y"):
+                y = ex(y, (1,), True)                   # Y lines
+            with add_trace(f"t2b_exchange_{row_axis}"):
+                y = exchange_uneven(y, row_axis, split_axis=1, concat_axis=0,
+                                    axis_size=rows, algorithm=algorithm)
+                y = _crop_axis(y, 0, n0)
+            with add_trace("t3_fft_x"):
+                return ex(y, (0,), True)                # t3: X lines
 
         in_spec, out_spec = spec.in_spec, spec.out_spec
         pre = lambda x: _pad_axis(_pad_axis(x, 0, n0p), 1, n1pc)
@@ -285,15 +300,20 @@ def build_pencil_rfft3d(
     else:
 
         def local_fn(y):  # complex [N0, n1pr/rows, n2hp/cols]
-            x = ex(y, (0,), False)                      # inverse X lines
-            x = exchange_uneven(x, row_axis, split_axis=0, concat_axis=1,
-                                axis_size=rows, algorithm=algorithm)
-            x = _crop_axis(x, 1, n1)
-            x = ex(x, (1,), False)                      # inverse Y lines
-            x = exchange_uneven(x, col_axis, split_axis=1, concat_axis=2,
-                                axis_size=cols, algorithm=algorithm)
-            x = _crop_axis(x, 2, n2h)
-            return c2r(x, n2, 2)                        # real Z lines
+            with add_trace("t3_ifft_x"):
+                x = ex(y, (0,), False)                  # inverse X lines
+            with add_trace(f"t2b_exchange_{row_axis}"):
+                x = exchange_uneven(x, row_axis, split_axis=0, concat_axis=1,
+                                    axis_size=rows, algorithm=algorithm)
+                x = _crop_axis(x, 1, n1)
+            with add_trace("t1_ifft_y"):
+                x = ex(x, (1,), False)                  # inverse Y lines
+            with add_trace(f"t2a_exchange_{col_axis}"):
+                x = exchange_uneven(x, col_axis, split_axis=1, concat_axis=2,
+                                    axis_size=cols, algorithm=algorithm)
+                x = _crop_axis(x, 2, n2h)
+            with add_trace("t0_c2r_z"):
+                return c2r(x, n2, 2)                    # real Z lines
 
         # Direction-true spec: perm (1,2,0) row_first makes spec.in_spec the
         # complex x-pencils and spec.out_spec the real z-pencils.

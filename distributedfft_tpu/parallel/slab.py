@@ -47,9 +47,12 @@ except ImportError:  # pragma: no cover
 
 from ..geometry import pad_to
 from ..ops.executors import get_c2r, get_executor, get_r2c
+from ..utils.trace import add_trace, trace_stages
 # _pad_axis/_crop_axis live in exchange.py (single definition shared with
 # the ragged path) and are re-exported here for the other chain builders.
 from .exchange import _crop_axis, _pad_axis, exchange, exchange_uneven
+
+_L = "xyz"  # axis index -> stage-name letter (t0_fft_yz taxonomy)
 
 
 @dataclass(frozen=True)
@@ -141,15 +144,29 @@ def build_slab_general(
     local_axes = tuple(a for a in range(3) if a != in_axis)
     platform = mesh.devices.flat[0].platform
 
+    # Stage spans of the reference taxonomy (fft_mpi_3d_api.cpp:184-201):
+    # recorded dispatch-side when the jit first traces, and passed through
+    # to the device timeline as profiler annotations.
+    t0_name = f"t0_fft_{''.join(_L[a] for a in local_axes)}"
+    t2_name = f"t2_exchange_{axis_name}"
+    t3_name = f"t3_fft_{_L[in_axis]}"
+
     def local_fn(x):  # in_axis extent n_inp/p per device, others full
-        y = ex(x, local_axes, forward)                   # t0: local planes
-        # t1 (exchange prep: dense algorithms ceil-pad the split axis;
-        # alltoallv ships the true slices) + t2 (global transpose).
-        y = exchange_uneven(y, axis_name, split_axis=out_axis,
-                            concat_axis=in_axis, axis_size=p,
-                            algorithm=algorithm, platform=platform)
-        y = _crop_axis(y, in_axis, n_in)                 # drop in-axis padding
-        return ex(y, (in_axis,), forward)                # t3: final lines
+        with add_trace(t0_name):
+            y = ex(x, local_axes, forward)               # t0: local planes
+        with add_trace("t1_pack"):
+            # exchange prep: dense algorithms ceil-pad the split axis
+            # (alltoallv ships the true slices; the pad below is then a
+            # no-op inside exchange_uneven, which skips it)
+            if algorithm != "alltoallv":
+                y = _pad_axis(y, out_axis, n_outp)
+        with add_trace(t2_name):                         # t2: global transpose
+            y = exchange_uneven(y, axis_name, split_axis=out_axis,
+                                concat_axis=in_axis, axis_size=p,
+                                algorithm=algorithm, platform=platform)
+        with add_trace(t3_name):
+            y = _crop_axis(y, in_axis, n_in)             # drop in-axis padding
+            return ex(y, (in_axis,), forward)            # t3: final lines
 
     in_spec, out_spec = spec.in_pspec, spec.out_pspec
     mapped = _shard_map(local_fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec)
@@ -239,24 +256,36 @@ def build_slab_rfft3d(
     if forward:
 
         def local_fn(x):  # real [n0p/p, N1, N2] per device
-            y = r2c(x, 2)                                # t0a: real Z lines
-            y = ex(y, (1,), True)                        # t0b: Y lines
-            y = exchange_uneven(y, axis_name, split_axis=1, concat_axis=0,
-                                axis_size=p, algorithm=algorithm)
-            y = _crop_axis(y, 0, n0)
-            return ex(y, (0,), True)                     # t3: X lines
+            with add_trace("t0_r2c_zy"):
+                y = r2c(x, 2)                            # t0a: real Z lines
+                y = ex(y, (1,), True)                    # t0b: Y lines
+            with add_trace("t1_pack"):
+                if algorithm != "alltoallv":
+                    y = _pad_axis(y, 1, n1p)
+            with add_trace(f"t2_exchange_{axis_name}"):
+                y = exchange_uneven(y, axis_name, split_axis=1, concat_axis=0,
+                                    axis_size=p, algorithm=algorithm)
+            with add_trace("t3_fft_x"):
+                y = _crop_axis(y, 0, n0)
+                return ex(y, (0,), True)                 # t3: X lines
 
         pre = lambda x: _pad_axis(x, 0, n0p)
         post = lambda y: _crop_axis(y, 1, n1)
     else:
 
         def local_fn(y):  # complex [N0, n1p/p, n2h] per device
-            x = ex(y, (0,), False)                       # inverse X lines
-            x = exchange_uneven(x, axis_name, split_axis=0, concat_axis=1,
-                                axis_size=p, algorithm=algorithm)
-            x = _crop_axis(x, 1, n1)
-            x = ex(x, (1,), False)                       # inverse Y lines
-            return c2r(x, n2, 2)                         # real Z lines
+            with add_trace("t3_ifft_x"):
+                x = ex(y, (0,), False)                   # inverse X lines
+            with add_trace("t1_pack"):
+                if algorithm != "alltoallv":
+                    x = _pad_axis(x, 0, n0p)
+            with add_trace(f"t2_exchange_{axis_name}"):
+                x = exchange_uneven(x, axis_name, split_axis=0, concat_axis=1,
+                                    axis_size=p, algorithm=algorithm)
+            with add_trace("t0_ifft_y_c2r"):
+                x = _crop_axis(x, 1, n1)
+                x = ex(x, (1,), False)                   # inverse Y lines
+                return c2r(x, n2, 2)                     # real Z lines
 
         pre = lambda y: _pad_axis(y, 1, n1p)
         post = lambda x: _crop_axis(x, 0, n0)
@@ -337,4 +366,4 @@ def build_slab_stages(
                     lambda u: ex(_crop_axis(u, 1, n1), (1, 2), False), xs, xs)(v), 0, n0),
                 in_shardings=x_slab, out_shardings=x_slab)),
         ]
-    return stages, spec
+    return trace_stages(stages), spec

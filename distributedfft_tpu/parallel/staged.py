@@ -31,6 +31,7 @@ except ImportError:  # pragma: no cover
 
 from ..geometry import pad_to
 from ..ops.executors import get_c2r, get_executor, get_r2c
+from ..utils.trace import trace_stages
 from .exchange import exchange
 from .pencil import PencilSpec
 from .slab import SlabSpec, _crop_axis, _pad_axis
@@ -55,10 +56,10 @@ def build_single_stages(
     without a transpose/exchange). With the pallas executor, t0 is the
     fused 2D plane kernel and t3 the strided axis-0 kernel."""
     ex = get_executor(executor) if isinstance(executor, str) else executor
-    return [
+    return trace_stages([
         ("t0_fft_yz", jax.jit(lambda x: ex(x, (1, 2), forward))),
         ("t3_fft_x", jax.jit(lambda y: ex(y, (0,), forward))),
-    ]
+    ])
 
 _AXIS_LETTER = "xyz"
 
@@ -189,7 +190,7 @@ def build_pencil_stages(
         (f"t2b_exchange_{seq[1][0]}", jax.jit(t2b)),
         (f"t3_fft_{L[last_fft]}", jax.jit(t3)),
     ]
-    return stages, spec
+    return trace_stages(stages), spec
 
 
 def build_slab_rfft_stages(
@@ -265,7 +266,7 @@ def build_slab_rfft_stages(
         stages = [("t3_ifft_x", jax.jit(t3i)),
                   ("t2_exchange", jax.jit(t2)),
                   ("t0_ifft_y_c2r", jax.jit(t0i))]
-    return stages, spec
+    return trace_stages(stages), spec
 
 
 def build_pencil_rfft_stages(
@@ -379,4 +380,4 @@ def build_pencil_rfft_stages(
                   ("t1_ifft_y", jax.jit(t1i)),
                   ("t2a_exchange_col", jax.jit(t2a)),
                   ("t0_c2r_z", jax.jit(t0i))]
-    return stages, spec
+    return trace_stages(stages), spec

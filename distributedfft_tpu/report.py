@@ -1,0 +1,207 @@
+"""Trace report CLI — merge per-process logs, print the stage summary.
+
+The reference writes one trace log per MPI rank and leaves correlation
+to the reader (``heffte_trace.h:98-118``); heFFTe's ``finalize_tracing``
+at least prints a per-event aggregate on shutdown. This module is both,
+offline::
+
+    python -m distributedfft_tpu.report dfft_trace_0.log dfft_trace_1.log
+    python -m distributedfft_tpu.report 'dfft_trace_*' -o merged.json
+
+It accepts any mix of the text log format and the Chrome-trace JSON
+format (``DFFT_TRACE_FORMAT=chrome``), merges every process's events
+onto one timeline, prints the per-stage aggregate table
+(count/total/mean/min/max — the heFFTe finalize summary), and with
+``-o`` writes a merged Chrome-trace JSON to load in ui.perfetto.dev.
+
+Timeline caveat: text logs store per-process *relative* start times
+(each process's first event is t=0), so merging text logs aligns the
+processes at their first event; chrome logs carry a shared wall-clock
+axis and merge exactly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as _glob
+import json
+import sys
+
+__all__ = [
+    "load_events",
+    "merge_files",
+    "aggregate",
+    "format_table",
+    "write_chrome",
+    "main",
+]
+
+
+def _parse_text_log(text: str, default_pid: int = 0) -> list[dict]:
+    """Parse the heFFTe-style per-rank text log: a ``process I of N``
+    banner, then ``start  duration  name`` rows (seconds, relative to the
+    process's first event)."""
+    events: list[dict] = []
+    pid = default_pid
+    for line in text.splitlines():
+        if line.startswith("process "):
+            parts = line.split()
+            if len(parts) >= 2 and parts[1].isdigit():
+                pid = int(parts[1])
+            continue
+        parts = line.split(None, 2)
+        if len(parts) < 3:
+            continue
+        try:
+            start, dur = float(parts[0]), float(parts[1])
+        except ValueError:
+            continue
+        events.append({"name": parts[2].strip(), "pid": pid,
+                       "ts": start * 1e6, "dur": dur * 1e6})
+    return events
+
+
+def _parse_chrome(obj) -> list[dict]:
+    """Flatten a Chrome-trace document to complete events. ``B``/``E``
+    pairs are matched per (pid, tid, name) LIFO — the nesting discipline
+    the writer guarantees; ``X`` events pass through."""
+    raw = obj.get("traceEvents", []) if isinstance(obj, dict) else obj
+    events: list[dict] = []
+    open_stacks: dict[tuple, list[float]] = {}
+    for e in sorted(raw, key=lambda ev: ev.get("ts", 0.0)):
+        ph = e.get("ph")
+        pid, tid = e.get("pid", 0), e.get("tid", 0)
+        name = e.get("name", "")
+        if ph == "X":
+            events.append({"name": name, "pid": pid,
+                           "ts": float(e.get("ts", 0.0)),
+                           "dur": float(e.get("dur", 0.0))})
+        elif ph == "B":
+            open_stacks.setdefault((pid, tid, name), []).append(
+                float(e.get("ts", 0.0)))
+        elif ph == "E":
+            stack = open_stacks.get((pid, tid, name))
+            if stack:
+                ts = stack.pop()
+                events.append({"name": name, "pid": pid, "ts": ts,
+                               "dur": float(e.get("ts", 0.0)) - ts})
+    return events
+
+
+def load_events(path: str) -> list[dict]:
+    """Events of one per-process trace file (either format), each as
+    ``{"name", "pid", "ts", "dur"}`` with ts/dur in microseconds."""
+    with open(path) as f:
+        text = f.read()
+    head = text.lstrip()[:1]
+    if head in ("{", "["):
+        return _parse_chrome(json.loads(text))
+    return _parse_text_log(text)
+
+
+def merge_files(paths: list[str]) -> list[dict]:
+    """One timeline from many per-process files, sorted by start time."""
+    events: list[dict] = []
+    for path in paths:
+        events.extend(load_events(path))
+    events.sort(key=lambda e: (e["ts"], e["pid"]))
+    return events
+
+
+def aggregate(events: list[dict]) -> dict[str, dict]:
+    """Per-stage statistics in seconds: name -> {count, total, mean,
+    min, max} (the heFFTe ``finalize_tracing`` summary)."""
+    agg: dict[str, dict] = {}
+    for e in events:
+        dur_s = e["dur"] / 1e6
+        a = agg.get(e["name"])
+        if a is None:
+            agg[e["name"]] = {"count": 1, "total": dur_s,
+                              "min": dur_s, "max": dur_s}
+        else:
+            a["count"] += 1
+            a["total"] += dur_s
+            a["min"] = min(a["min"], dur_s)
+            a["max"] = max(a["max"], dur_s)
+    for a in agg.values():
+        a["mean"] = a["total"] / a["count"]
+    return agg
+
+
+def format_table(agg: dict[str, dict], sort: str = "total") -> str:
+    """Fixed-width aggregate table, widest column first."""
+    if not agg:
+        return "(no events)"
+    if sort == "name":
+        rows = sorted(agg.items())
+    else:
+        rows = sorted(agg.items(), key=lambda kv: -kv[1][sort])
+    width = max(len("stage"), max(len(n) for n in agg))
+    lines = [
+        f"{'stage':<{width}}  {'count':>7}  {'total':>12}  {'mean':>12}  "
+        f"{'min':>12}  {'max':>12}"
+    ]
+    for name, a in rows:
+        lines.append(
+            f"{name:<{width}}  {a['count']:>7d}  {a['total']:>12.6f}  "
+            f"{a['mean']:>12.6f}  {a['min']:>12.6f}  {a['max']:>12.6f}"
+        )
+    return "\n".join(lines)
+
+
+def write_chrome(events: list[dict], path: str) -> None:
+    """Write a merged timeline as Chrome-trace JSON (``X`` complete
+    events, one ``pid`` lane per source process)."""
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "displayTimeUnit": "ms",
+                "traceEvents": [
+                    {"name": e["name"], "cat": "dfft", "ph": "X",
+                     "pid": e["pid"], "tid": 0, "ts": e["ts"],
+                     "dur": e["dur"]}
+                    for e in events
+                ],
+            },
+            f,
+        )
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m distributedfft_tpu.report",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("paths", nargs="+",
+                   help="per-process trace files (.log or .json); shell "
+                        "globs that reached us unexpanded are expanded")
+    p.add_argument("-o", "--out", default=None, metavar="MERGED.json",
+                   help="write the merged Chrome-trace JSON here "
+                        "(open in ui.perfetto.dev)")
+    p.add_argument("--sort", default="total",
+                   choices=("total", "count", "mean", "max", "name"),
+                   help="aggregate table sort key (default: total)")
+    args = p.parse_args(argv)
+
+    paths: list[str] = []
+    for pat in args.paths:
+        hits = sorted(_glob.glob(pat))
+        paths.extend(hits if hits else [pat])
+    try:
+        events = merge_files(paths)
+    except OSError as e:
+        print(f"report: {e}", file=sys.stderr)
+        return 2
+    pids = sorted({e["pid"] for e in events})
+    print(f"{len(events)} events from {len(paths)} file(s), "
+          f"{len(pids)} process(es)")
+    print(format_table(aggregate(events), sort=args.sort))
+    if args.out:
+        write_chrome(events, args.out)
+        print(f"merged timeline written to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

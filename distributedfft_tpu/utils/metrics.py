@@ -1,0 +1,156 @@
+"""Structured metrics registry — process-global counters/gauges/histograms.
+
+The reference surfaces its runtime health as printf noise (per-execute
+t0..t3 lines, ``fft_mpi_3d_api.cpp:184-201``) that callers string-grep;
+this module is the structured replacement: named series with labels,
+snapshot-able as one JSON document, so benchmark harnesses (``bench.py``,
+``benchmarks/speed3d.py``) can attach a telemetry block to every result
+line instead of ad-hoc string fields.
+
+Registered series (wired in :mod:`..api`):
+
+- ``plan_builds`` (counter; kind/decomposition/executor) — actual plan
+  constructions, cache misses included.
+- ``plan_cache_hits`` / ``plan_cache_misses`` (counter; kind) — the
+  plan-cache outcome of every public planner call.
+- ``plan_build_seconds`` / ``compile_seconds`` (histogram) — plan-time
+  cost, the hipRTC-compile-cost analog.
+- ``executes`` (counter; kind/decomposition/executor) — one per
+  ``execute()``.
+- ``exchange_true_bytes`` / ``exchange_wire_bytes`` (counter) — per
+  execute, the true information moved vs the bytes the plan's exchange
+  algorithm ships (``plan_logic.exchange_payloads`` accounting).
+
+Disabled-path discipline: everything is gated on one module-level flag
+(the ``tracing_enabled()`` pattern of :mod:`.trace`) — with metrics off
+(the default) every hook is a single attribute check and early return,
+no allocation, no lock. Enable with :func:`enable_metrics` or
+``DFFT_METRICS=1``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = [
+    "enable_metrics",
+    "metrics_enabled",
+    "inc",
+    "set_gauge",
+    "observe",
+    "counter_total",
+    "metrics_snapshot",
+    "metrics_reset",
+]
+
+_enabled = os.environ.get("DFFT_METRICS", "") not in ("", "0")
+_lock = threading.Lock()
+# Keyed (name, ((label, value), ...)) with label values stringified —
+# one flat series table per instrument family.
+_counters: dict[tuple, float] = {}
+_gauges: dict[tuple, float] = {}
+_histograms: dict[tuple, list] = {}  # [count, total, min, max]
+
+
+def metrics_enabled() -> bool:
+    return _enabled
+
+
+def enable_metrics(on: bool = True) -> None:
+    """Turn the registry on (or off with ``on=False``). Off is the
+    default; the recording hooks are single-check no-ops while off."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def _key(name: str, labels: dict) -> tuple:
+    return (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
+
+
+def inc(name: str, value: float = 1.0, **labels) -> None:
+    """Add ``value`` to the counter series ``name`` at ``labels``."""
+    if not _enabled:
+        return
+    k = _key(name, labels)
+    with _lock:
+        _counters[k] = _counters.get(k, 0.0) + value
+
+
+def set_gauge(name: str, value: float, **labels) -> None:
+    """Set the gauge series ``name`` at ``labels`` to ``value``."""
+    if not _enabled:
+        return
+    k = _key(name, labels)
+    with _lock:
+        _gauges[k] = float(value)
+
+
+def observe(name: str, value: float, **labels) -> None:
+    """Record one observation into the histogram series ``name`` —
+    aggregated as count/total/min/max (the heFFTe finalize-summary
+    statistics, not bucketed)."""
+    if not _enabled:
+        return
+    k = _key(name, labels)
+    value = float(value)
+    with _lock:
+        h = _histograms.get(k)
+        if h is None:
+            _histograms[k] = [1, value, value, value]
+        else:
+            h[0] += 1
+            h[1] += value
+            h[2] = min(h[2], value)
+            h[3] = max(h[3], value)
+
+
+def counter_total(name: str) -> float:
+    """Sum of the counter ``name`` across every label combination."""
+    with _lock:
+        return sum(v for (n, _), v in _counters.items() if n == name)
+
+
+def _label_str(labels: tuple) -> str:
+    return ",".join(f"{k}={v}" for k, v in labels)
+
+
+def metrics_snapshot() -> dict:
+    """One JSON-serializable document of every recorded series.
+
+    Shape: ``{"counters": {name: {"label=value,...": total}}, "gauges":
+    {...}, "histograms": {name: {labels: {count,total,mean,min,max}}}}``
+    (the empty string keys a label-less series). Reset with
+    :func:`metrics_reset`.
+    """
+    with _lock:
+        counters: dict = {}
+        for (name, labels), v in sorted(_counters.items()):
+            counters.setdefault(name, {})[_label_str(labels)] = v
+        gauges: dict = {}
+        for (name, labels), v in sorted(_gauges.items()):
+            gauges.setdefault(name, {})[_label_str(labels)] = v
+        hists: dict = {}
+        for (name, labels), (cnt, total, lo, hi) in sorted(
+                _histograms.items()):
+            hists.setdefault(name, {})[_label_str(labels)] = {
+                "count": cnt,
+                "total": total,
+                "mean": total / cnt,
+                "min": lo,
+                "max": hi,
+            }
+    return {
+        "enabled": _enabled,
+        "counters": counters,
+        "gauges": gauges,
+        "histograms": hists,
+    }
+
+
+def metrics_reset() -> None:
+    """Drop every recorded series (the enabled flag is left as is)."""
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+        _histograms.clear()

@@ -16,6 +16,7 @@ land in XLA profiler timelines too, and writes one log per process
 
 from __future__ import annotations
 
+import json
 import os
 import time
 import math
@@ -27,10 +28,17 @@ import numpy as np
 
 _MB = 1.0 / (1024 * 1024)
 
+TRACE_FORMATS = ("log", "chrome")
+
 _events: list[tuple[str, float, float]] | None = None
 _trace_root: str | None = None
 _native_rec = None  # native.NativeTrace when the C recorder is in use
 _session = 0  # bumped by init/finalize: stale in-flight events are dropped
+_format = "log"  # "log" (heFFTe per-rank text) | "chrome" (Perfetto JSON)
+# Wall-clock anchor of the current session: events are perf_counter
+# pairs; adding _epoch maps them onto the time.time() axis so Chrome
+# traces from different processes of one job share a timeline.
+_epoch = 0.0
 
 
 def tracing_enabled() -> bool:
@@ -57,36 +65,88 @@ def _try_native():
         return None
 
 
-def init_tracing(root: str = "") -> None:
+def init_tracing(root: str = "", format: str | None = None) -> None:
     """Start collecting events (``init_tracing``, ``heffte_trace.h:90``).
-    ``root`` prefixes the log filename written by :func:`finalize_tracing`."""
-    global _events, _trace_root, _native_rec, _session
+    ``root`` prefixes the log filename written by :func:`finalize_tracing`.
+
+    ``format`` (default: env ``DFFT_TRACE_FORMAT``, else ``"log"``) picks
+    the output: ``"log"`` is the heFFTe per-rank text log, ``"chrome"`` a
+    Chrome-trace/Perfetto JSON (load in ui.perfetto.dev, or merge across
+    processes with ``python -m distributedfft_tpu.report``).
+
+    Re-init while a session is open finalizes the open session first
+    (writing its log) — its events are never silently discarded, and a
+    native recorder is never dropped with events still buffered.
+    """
+    global _events, _trace_root, _native_rec, _session, _format, _epoch
+    if tracing_enabled():
+        finalize_tracing()
+    fmt = format or os.environ.get("DFFT_TRACE_FORMAT", "") or "log"
+    if fmt not in TRACE_FORMATS:
+        raise ValueError(
+            f"unknown trace format {fmt!r}; use one of {TRACE_FORMATS}")
     _session += 1
     _trace_root = root or "dfft_trace"
-    _native_rec = _try_native()
+    _format = fmt
+    _epoch = time.time() - time.perf_counter()
+    # The C recorder dumps the text format only; chrome sessions use the
+    # Python recorder (its event list is what the JSON writer serializes).
+    _native_rec = _try_native() if fmt == "log" else None
     _events = None if _native_rec is not None else []
 
 
+def _write_chrome(path: str, events, proc: int, nprocs: int) -> None:
+    """Serialize one session's events as Chrome-trace JSON: a ``B``/``E``
+    pair per event, ``pid`` = the process index (the MPI-rank role),
+    ``ts`` in wall-clock microseconds so per-process files merge onto one
+    timeline."""
+    trace_events = []
+    for name, start, stop in events:
+        b = {"name": name, "cat": "dfft", "ph": "B", "pid": proc, "tid": 0,
+             "ts": (start + _epoch) * 1e6}
+        e = dict(b, ph="E", ts=(stop + _epoch) * 1e6)
+        trace_events.extend((b, e))
+    # Chrome requires in-order begin/end nesting per (pid, tid). Events
+    # are appended at END time (inner before outer); a stable sort on ts
+    # with B before E at ties restores begin order and keeps zero-length
+    # inner pairs inside their enclosing span.
+    trace_events.sort(key=lambda ev: (ev["ts"], ev["ph"] != "B"))
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "displayTimeUnit": "ms",
+                "metadata": {"process": proc, "process_count": nprocs},
+                "traceEvents": trace_events,
+            },
+            f,
+        )
+
+
 def finalize_tracing() -> str | None:
-    """Write ``<root>_<process>.log`` and stop tracing
-    (``finalize_tracing``, ``heffte_trace.h:98-118``). Returns the path."""
+    """Write ``<root>_<process>.log`` (or ``.json`` for the chrome
+    format) and stop tracing (``finalize_tracing``,
+    ``heffte_trace.h:98-118``). Returns the path."""
     global _events, _trace_root, _native_rec, _session
     if not tracing_enabled():
         return None
     _session += 1
-    path = f"{_trace_root}_{jax.process_index()}.log"
+    proc, nprocs = jax.process_index(), jax.process_count()
     if _native_rec is not None:
-        ok = _native_rec.dump(path, jax.process_index(), jax.process_count())
+        path = f"{_trace_root}_{proc}.log"
+        ok = _native_rec.dump(path, proc, nprocs)
         if not ok:
             # Same contract as the Python recorder's open() raising: a
             # failed dump must not silently discard the events.
             raise OSError(f"native trace dump to {path!r} failed")
         _native_rec = None
+    elif _format == "chrome":
+        path = f"{_trace_root}_{proc}.json"
+        _write_chrome(path, _events, proc, nprocs)
     else:
+        path = f"{_trace_root}_{proc}.log"
         t0 = _events[0][1] if _events else 0.0
         with open(path, "w") as f:
-            f.write(
-                f"process {jax.process_index()} of {jax.process_count()}\n")
+            f.write(f"process {proc} of {nprocs}\n")
             for name, start, stop in _events:
                 f.write(f"{start - t0:14.6f}  {stop - start:12.6f}  {name}\n")
     _events, _trace_root = None, None
@@ -134,6 +194,24 @@ def add_trace(name: str):
             ev.append((name, start, time.perf_counter()))
 
 
+def traced_stage(name: str, fn):
+    """Wrap one staged-pipeline callable so every call records a named
+    event (the per-stage breakdown of ``fft_mpi_3d_api.cpp:184-201`` as
+    trace spans). Dispatch-side by the :func:`add_trace` contract — the
+    timing harness's sync bracketing still owns true device timings."""
+
+    def run(x):
+        with add_trace(name):
+            return fn(x)
+
+    return run
+
+
+def trace_stages(stages):
+    """Apply :func:`traced_stage` to a ``[(name, fn), ...]`` stage list."""
+    return [(name, traced_stage(name, fn)) for name, fn in stages]
+
+
 @dataclass
 class CsvRecorder:
     """Benchmark CSV writer, the batchTest recording pattern
@@ -149,6 +227,18 @@ class CsvRecorder:
             os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
             with open(self.path, "w") as f:
                 f.write(",".join(self.header) + "\n")
+            return
+        # Appending to an existing file: its header must match, or every
+        # appended row would be silently misaligned against the columns a
+        # downstream reader infers from line 1.
+        with open(self.path) as f:
+            existing = f.readline().rstrip("\n")
+        want = ",".join(self.header)
+        if existing != want:
+            raise ValueError(
+                f"CSV {self.path!r} has header {existing!r}, recorder "
+                f"expects {want!r}; refusing to append misaligned rows "
+                f"(use a fresh path or matching header)")
 
     def record(self, *row) -> None:
         if len(row) != len(self.header):

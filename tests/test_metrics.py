@@ -1,0 +1,145 @@
+"""Metrics registry + plan-cache telemetry tests.
+
+The structured counterpart of the tracing suite: the registry's
+instrument semantics, the api.py wiring (plan cache hit/miss, executes,
+exchange-byte accounting), and the disabled-path no-op contract (with
+telemetry off, ``execute()`` records nothing — one flag check only).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu.utils import metrics as m
+from distributedfft_tpu.utils import trace as tr
+
+
+@pytest.fixture
+def metrics_on():
+    """Fresh, enabled registry and an empty plan cache; both restored to
+    the disabled default afterwards."""
+    dfft.clear_plan_cache()
+    m.metrics_reset()
+    m.enable_metrics()
+    yield
+    m.enable_metrics(False)
+    m.metrics_reset()
+    dfft.clear_plan_cache()
+
+
+def test_plan_cache_miss_then_hit(metrics_on):
+    mesh = dfft.make_mesh(2)
+    p1 = dfft.plan_dft_c2c_3d((8, 6, 4), mesh)
+    p2 = dfft.plan_dft_c2c_3d((8, 6, 4), mesh)
+    assert p2 is p1  # identical call -> memoized plan
+    snap = dfft.metrics_snapshot()
+    assert snap["counters"]["plan_cache_misses"]["kind=c2c"] >= 1
+    assert snap["counters"]["plan_cache_hits"]["kind=c2c"] >= 1
+    assert snap["counters"]["plan_builds"]  # the miss built once
+    json.dumps(snap)  # the whole snapshot is JSON-serializable
+
+
+def test_plan_cache_distinguishes_arguments(metrics_on):
+    mesh = dfft.make_mesh(2)
+    p1 = dfft.plan_dft_c2c_3d((8, 6, 4), mesh)
+    p2 = dfft.plan_dft_c2c_3d((8, 6, 4), mesh, direction=dfft.BACKWARD)
+    p3 = dfft.plan_dft_c2c_3d((8, 6, 4), mesh, algorithm="ppermute")
+    assert p1 is not p2 and p1 is not p3 and p2 is not p3
+    assert m.counter_total("plan_cache_hits") == 0
+
+
+def test_plan_cache_env_kill_switch(metrics_on, monkeypatch):
+    monkeypatch.setenv("DFFT_PLAN_CACHE", "0")
+    p1 = dfft.plan_dft_c2c_3d((4, 4, 4))
+    p2 = dfft.plan_dft_c2c_3d((4, 4, 4))
+    assert p1 is not p2
+    assert m.counter_total("plan_cache_hits") == 0
+    assert m.counter_total("plan_cache_misses") == 0
+    assert m.counter_total("plan_builds") == 2
+
+
+def test_execute_metrics_and_exchange_bytes(metrics_on):
+    mesh = dfft.make_mesh(2)
+    plan = dfft.plan_dft_c2c_3d((8, 8, 8), mesh)
+    plan(np.zeros((8, 8, 8), np.complex128))
+    plan(np.zeros((8, 8, 8), np.complex128))
+    assert m.counter_total("executes") == 2
+    true_b = m.counter_total("exchange_true_bytes")
+    wire_b = m.counter_total("exchange_wire_bytes")
+    assert true_b > 0
+    assert wire_b >= true_b  # padding never shrinks the wire
+    # divisible extents + alltoall: one exchange of (p-1)/p of the world
+    itemsize = np.dtype(plan.dtype).itemsize
+    assert true_b == 2 * (8 * 8 * 8 // 2) * itemsize
+
+
+def test_single_device_plan_has_no_exchange_bytes(metrics_on):
+    plan = dfft.plan_dft_c2c_3d((4, 4, 4))
+    plan(np.zeros((4, 4, 4), np.complex128))
+    assert m.counter_total("executes") == 1
+    assert m.counter_total("exchange_true_bytes") == 0
+
+
+def test_compile_seconds_histogram(metrics_on):
+    # single-device plan: compile() wiring is decomposition-agnostic and
+    # the single chain dodges the suite's order-dependent distributed
+    # dispatch flake (see test_fft3d's multi-device failures at seed)
+    dfft.plan_dft_c2c_3d((8, 4, 4)).compile()
+    snap = dfft.metrics_snapshot()
+    series = snap["histograms"]["compile_seconds"]
+    (stats,) = series.values()
+    assert stats["count"] == 1 and stats["total"] > 0
+
+
+def test_disabled_fast_path_records_nothing():
+    """The acceptance no-op contract: with metrics and tracing both off
+    (the default), plan+execute records no events and no series."""
+    m.enable_metrics(False)
+    m.metrics_reset()
+    dfft.clear_plan_cache()
+    assert not tr.tracing_enabled()
+    plan = dfft.plan_dft_c2c_3d((4, 6, 4), dfft.make_mesh(2))
+    plan(np.zeros((4, 6, 4), np.complex128))
+    snap = dfft.metrics_snapshot()
+    assert snap["counters"] == {}
+    assert snap["gauges"] == {}
+    assert snap["histograms"] == {}
+    assert tr._events is None and tr._native_rec is None
+
+
+def test_registry_instruments():
+    m.enable_metrics()
+    try:
+        m.metrics_reset()
+        m.inc("c", 2.0, kind="x")
+        m.inc("c", 3.0, kind="x")
+        m.set_gauge("g", 3.5, role="r")
+        m.observe("h", 1.0)
+        m.observe("h", 3.0)
+        snap = m.metrics_snapshot()
+        assert snap["counters"]["c"]["kind=x"] == 5.0
+        assert snap["gauges"]["g"]["role=r"] == 3.5
+        h = snap["histograms"]["h"][""]
+        assert h == {"count": 2, "total": 4.0, "mean": 2.0,
+                     "min": 1.0, "max": 3.0}
+        assert m.counter_total("c") == 5.0
+        m.metrics_reset()
+        empty = m.metrics_snapshot()
+        assert (empty["counters"], empty["gauges"], empty["histograms"]) \
+            == ({}, {}, {})
+    finally:
+        m.enable_metrics(False)
+        m.metrics_reset()
+
+
+def test_dd_plan_cache_and_execute_counter(metrics_on):
+    p1 = dfft.plan_dd_dft_c2c_3d((8, 8, 8))
+    p2 = dfft.plan_dd_dft_c2c_3d((8, 8, 8))
+    assert p2 is p1
+    assert dfft.metrics_snapshot()["counters"][
+        "plan_cache_hits"]["kind=dd_c2c"] >= 1
+    hi = np.zeros((8, 8, 8), np.complex64)
+    p1(hi, hi)
+    assert m.counter_total("executes") == 1

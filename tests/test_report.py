@@ -1,0 +1,120 @@
+"""Report CLI + observability smoke tests.
+
+Covers the merge/aggregate tool (``python -m distributedfft_tpu.report``)
+on fake per-process logs, and the end-to-end tier-1 smoke: a slab
+execute with chrome tracing on, merged by the real CLI, must surface the
+t0..t3 stage taxonomy — keeps the observability path from silently
+rotting.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu import report
+from distributedfft_tpu.utils import trace as tr
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_report_merges_fake_process_logs(tmp_path, capsys):
+    """Two fake per-process text logs merge into one timeline with both
+    pid lanes and a correct aggregate table."""
+    log0 = tmp_path / "t_0.log"
+    log0.write_text(
+        "process 0 of 2\n"
+        "      0.000000      0.001000  t2_exchange\n"
+        "      0.002000      0.000500  t0_fft_yz\n")
+    log1 = tmp_path / "t_1.log"
+    log1.write_text(
+        "process 1 of 2\n"
+        "      0.000000      0.002000  t2_exchange\n")
+    out = tmp_path / "merged.json"
+    rc = report.main([str(log0), str(log1), "-o", str(out)])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "t2_exchange" in text and "2 process(es)" in text
+    with open(out) as f:
+        merged = json.load(f)
+    assert {e["pid"] for e in merged["traceEvents"]} == {0, 1}
+
+    agg = report.aggregate(report.merge_files([str(log0), str(log1)]))
+    assert agg["t2_exchange"]["count"] == 2
+    assert agg["t2_exchange"]["total"] == pytest.approx(0.003)
+    assert agg["t2_exchange"]["min"] == pytest.approx(0.001)
+    assert agg["t2_exchange"]["max"] == pytest.approx(0.002)
+    assert agg["t0_fft_yz"]["count"] == 1
+
+
+def test_report_reads_chrome_and_text_mixed(tmp_path, capsys):
+    """A chrome-format file and a text log merge into one aggregate."""
+    chrome = tmp_path / "c_1.json"
+    chrome.write_text(json.dumps({
+        "traceEvents": [
+            {"name": "t3_fft_x", "ph": "B", "pid": 1, "tid": 0, "ts": 10.0},
+            {"name": "t3_fft_x", "ph": "E", "pid": 1, "tid": 0, "ts": 60.0},
+            {"name": "t0_fft_yz", "ph": "X", "pid": 1, "tid": 0,
+             "ts": 0.0, "dur": 5.0},
+        ]
+    }))
+    log = tmp_path / "c_0.log"
+    log.write_text("process 0 of 2\n      0.0  0.000050  t3_fft_x\n")
+    agg = report.aggregate(report.merge_files([str(chrome), str(log)]))
+    assert agg["t3_fft_x"]["count"] == 2
+    assert agg["t3_fft_x"]["total"] == pytest.approx(100e-6)
+    assert agg["t0_fft_yz"]["count"] == 1
+
+
+def test_observability_smoke_slab_chrome(tmp_path):
+    """Tier-1 smoke, one run end to end: slab plan (cache miss), same
+    call again (hit), execute with chrome tracing + metrics on ->
+    ``python -m distributedfft_tpu.report`` merges the trace and surfaces
+    distinct t0..t3 stage events; the same run's snapshot shows the
+    cache miss+hit and nonzero exchange-byte counters."""
+    from distributedfft_tpu.utils import metrics as m
+
+    dfft.clear_plan_cache()  # the stage spans record when the jit traces
+    m.metrics_reset()
+    m.enable_metrics()
+    root = str(tmp_path / "smoke")
+    tr.init_tracing(root, format="chrome")
+    try:
+        mesh = dfft.make_mesh(2)
+        shape = (8, 6, 10)
+        plan = dfft.plan_dft_c2c_3d(shape, mesh)
+        plan = dfft.plan_dft_c2c_3d(shape, mesh)  # identical call: hit
+        plan(np.zeros(shape, np.complex128))
+        snap = dfft.metrics_snapshot()
+    finally:
+        path = tr.finalize_tracing()
+        m.enable_metrics(False)
+        m.metrics_reset()
+    assert snap["counters"]["plan_cache_misses"]["kind=c2c"] >= 1
+    assert snap["counters"]["plan_cache_hits"]["kind=c2c"] >= 1
+    assert snap["counters"]["exchange_true_bytes"][""] > 0
+    assert snap["counters"]["exchange_wire_bytes"][""] > 0
+
+    assert path.endswith(".json")
+    with open(path) as f:
+        obj = json.load(f)  # round-trips as JSON
+    stages = ("t0_fft_yz", "t1_pack", "t2_exchange_slab", "t3_fft_x")
+    names = {e["name"] for e in obj["traceEvents"]}
+    assert set(stages) | {"execute_c2c_slab"} <= names
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+    merged = str(tmp_path / "merged.json")
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributedfft_tpu.report", path,
+         "-o", merged],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=240)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    for stage in stages:
+        assert stage in proc.stdout
+    with open(merged) as f:
+        timeline = json.load(f)  # the merged chrome trace is valid JSON
+    assert set(stages) <= {e["name"] for e in timeline["traceEvents"]}

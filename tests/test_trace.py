@@ -1,9 +1,11 @@
 """Tracing smoke tests — the role of heFFTe's ``test_trace.cpp`` — plus
 plan-info dump and CSV recorder checks."""
 
+import json
 import os
 
 import numpy as np
+import pytest
 
 import distributedfft_tpu as dfft
 from distributedfft_tpu import testing as tu
@@ -34,6 +36,66 @@ def test_trace_disabled_is_noop():
     assert tr.finalize_tracing() is None
 
 
+def test_reinit_flushes_open_session(tmp_path, monkeypatch):
+    """Re-init while a session is open must finalize the old session —
+    its events land in its own log instead of being silently discarded
+    (and a native recorder is never dropped with events buffered). Both
+    recorder backends, alongside test_finalize_inside_open_block_is_safe."""
+    for flag in ("1", "0"):
+        monkeypatch.setenv("DFFT_TRACE_NATIVE", flag)
+        a = str(tmp_path / f"a{flag}")
+        b = str(tmp_path / f"b{flag}")
+        tr.init_tracing(a)
+        with tr.add_trace("first_session_event"):
+            pass
+        tr.init_tracing(b)  # re-init with the first session still open
+        with tr.add_trace("second_session_event"):
+            pass
+        path_a = f"{a}_0.log"
+        assert os.path.exists(path_a), "open session was dropped, not flushed"
+        assert "first_session_event" in open(path_a).read()
+        path_b = tr.finalize_tracing()
+        assert path_b == f"{b}_0.log"
+        text_b = open(path_b).read()
+        assert "second_session_event" in text_b
+        assert "first_session_event" not in text_b
+
+
+def test_chrome_export_roundtrip(tmp_path, monkeypatch):
+    """DFFT_TRACE_FORMAT=chrome writes Perfetto-loadable JSON: it
+    round-trips through json.load with one correctly ordered B/E pair
+    per event, pid = the process index."""
+    monkeypatch.setenv("DFFT_TRACE_FORMAT", "chrome")
+    root = str(tmp_path / "ct")
+    tr.init_tracing(root)
+    assert tr._native_rec is None  # chrome sessions use the Python recorder
+    with tr.add_trace("outer"):
+        with tr.add_trace("inner"):
+            pass
+    path = tr.finalize_tracing()
+    assert path == f"{root}_0.json"
+    with open(path) as f:
+        obj = json.load(f)
+    assert obj["metadata"]["process"] == 0
+    by_name: dict[str, list] = {}
+    for e in obj["traceEvents"]:
+        assert e["pid"] == 0
+        by_name.setdefault(e["name"], []).append(e)
+    for name in ("outer", "inner"):
+        begin, end = by_name[name]
+        assert [begin["ph"], end["ph"]] == ["B", "E"]
+        assert end["ts"] >= begin["ts"]
+    # nesting: inner opens after outer and closes before it
+    assert by_name["outer"][0]["ts"] <= by_name["inner"][0]["ts"]
+    assert by_name["inner"][1]["ts"] <= by_name["outer"][1]["ts"]
+
+
+def test_trace_format_rejects_unknown():
+    with pytest.raises(ValueError, match="format"):
+        tr.init_tracing("x", format="protobuf")
+    assert not tr.tracing_enabled()
+
+
 def test_csv_recorder(tmp_path):
     path = str(tmp_path / "out" / "bench.csv")
     rec = tr.CsvRecorder(path, ("n", "time", "gflops"))
@@ -46,6 +108,21 @@ def test_csv_recorder(tmp_path):
     rec2 = tr.CsvRecorder(path, ("n", "time", "gflops"))
     rec2.record(2048, 1.0, 400.0)
     assert len(open(path).read().splitlines()) == 4
+
+
+def test_csv_recorder_header_mismatch(tmp_path):
+    """Appending to a file whose header differs from the recorder's must
+    raise — silently writing misaligned rows corrupts every downstream
+    reader that infers columns from line 1."""
+    path = str(tmp_path / "bench.csv")
+    tr.CsvRecorder(path, ("n", "time")).record(512, 0.03)
+    with pytest.raises(ValueError, match="header"):
+        tr.CsvRecorder(path, ("n", "time", "gflops"))
+    # the mismatch attempt must not have touched the file
+    lines = open(path).read().splitlines()
+    assert lines == ["n,time", "512,0.03"]
+    tr.CsvRecorder(path, ("n", "time")).record(1024, 0.3)
+    assert len(open(path).read().splitlines()) == 3
 
 
 def test_plan_info_dump():
