@@ -201,6 +201,7 @@ def main() -> int:
 
     import jax
 
+    from distributedfft_tpu import regress
     from distributedfft_tpu.utils.cache import enable_compile_cache
     from distributedfft_tpu.utils.trace import CsvRecorder
 
@@ -208,6 +209,9 @@ def main() -> int:
 
     backend = jax.default_backend()
     n_dev = len(jax.devices())
+    device_kind = jax.devices()[0].device_kind
+    history = regress.default_history_path()
+    commit = regress.git_commit() if history else None
     here = os.path.dirname(os.path.abspath(__file__))
     out = args.out or os.path.join(
         here, "csv", f"speed3d_{backend}{n_dev}.csv")
@@ -258,6 +262,25 @@ def main() -> int:
                    f"{r['max_err']:.3e}", "ok")
         print(f"{shape} {kind} {dt} {ex}: "
               f"{r['gflops']:.1f} GFlops err={r['max_err']:.2e}", flush=True)
+        if not history:
+            return
+        # Append incrementally (a later wedged config keeps the rows so
+        # far) — one run record per ok row, grouped for regression
+        # tracking by (metric, dtype/devices/executor, device_kind).
+        try:
+            regress.append_records([regress.make_run_record(
+                metric=f"speed3d_{kind}_{'x'.join(str(v) for v in shape)}"
+                       "_gflops",
+                value=r["gflops"], seconds=r["seconds"],
+                config={"dtype": dt, "devices": n_dev, "executor": ex,
+                        "decomposition": r["decomposition"]},
+                backend=backend, device_kind=device_kind,
+                source="record_baseline.py", commit=commit,
+                recorded_at=run,
+            )], history)
+        except Exception as e:  # noqa: BLE001 — history is telemetry
+            print(f"history append failed: {type(e).__name__}: {e}",
+                  file=sys.stderr, flush=True)
 
     def record_error(shape, kind, dt, ex, e):
         msg = f"{type(e).__name__}: {e}".replace(",", ";")

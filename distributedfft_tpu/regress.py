@@ -1,0 +1,524 @@
+"""Benchmark regression tracking — run records, history store, compare.
+
+The reference judges itself by one-shot printf runs against heFFTe
+(``README.md:44-77``); this repo had grown the same problem at larger
+scale: rounds of ``BENCH_r*.json``, a ``benchmarks/results/`` campaign
+directory, and per-stage t0..t3 telemetry — every number interpreted by
+a human with no baseline and no gate. This module closes the loop:
+
+1. **Run records** — one normalized JSON object per benchmark run:
+   the bench/speed3d result line, the per-stage t0..t3 aggregates, the
+   roofline block, and the metrics snapshot, stamped with
+   commit/config/device-kind (:func:`normalize_bench_line`,
+   :func:`make_run_record`).
+2. **History store** — an append-only JSONL file
+   (``benchmarks/results/history.jsonl`` by default; ``DFFT_BENCH_HISTORY``
+   overrides, empty/``0`` disables). Existing artifacts — the driver's
+   ``BENCH_r*.json`` wrappers, raw bench-line JSONL — ingest via
+   :func:`records_from_artifact`.
+3. **Compare engine** — rolling-window baseline per (metric, config,
+   device_kind), median + MAD bounds (robust to the flaky-tunnel
+   CPU-fallback outliers, which are additionally flagged ``fallback``
+   and excluded from every baseline), verdicts of improved /
+   within-noise / regressed, and stage-level localization: when the
+   headline regresses, the report names which of t0..t3 moved
+   (:func:`compare_record`).
+
+CLI: ``python -m distributedfft_tpu.report {record,history,compare}``
+(see :mod:`.report`); ``compare --gate`` exits nonzero on a confirmed
+regression, for CI / round-driver use.
+
+Import discipline: stdlib only — ``bench.py``'s orchestrator loads this
+file directly (no package ``__init__``, no jax) so a sick TPU transport
+can never hang the history append.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import subprocess
+import sys
+import time
+
+__all__ = [
+    "SCHEMA",
+    "default_history_path",
+    "git_commit",
+    "make_run_record",
+    "normalize_bench_line",
+    "records_from_artifact",
+    "append_records",
+    "load_history",
+    "config_signature",
+    "group_key",
+    "robust_stats",
+    "metric_direction",
+    "compare_record",
+    "format_compare",
+    "summarize_history",
+]
+
+SCHEMA = 1
+
+# Compare-engine defaults (every knob has a CLI flag in report.py).
+DEFAULT_WINDOW = 8        # rolling baseline size per group
+DEFAULT_MADS = 3.0        # noise band half-width in scaled MADs
+DEFAULT_MIN_REL = 0.05    # noise-band floor as a fraction of the median
+DEFAULT_MIN_SAMPLES = 2   # baseline records required for a verdict
+
+_MAD_SCALE = 1.4826       # MAD -> sigma under a normal noise model
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def default_history_path() -> str | None:
+    """The history store path: ``DFFT_BENCH_HISTORY`` when set (empty or
+    ``0`` disables appends entirely -> None), else the repo's
+    ``benchmarks/results/history.jsonl``."""
+    env = os.environ.get("DFFT_BENCH_HISTORY")
+    if env is not None:
+        env = env.strip()
+        return None if env in ("", "0") else env
+    return os.path.join(_repo_root(), "benchmarks", "results",
+                        "history.jsonl")
+
+
+def git_commit() -> str | None:
+    """Best-effort short commit sha of the repo this module lives in;
+    None when git is unavailable. Never raises."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10, cwd=_repo_root(),
+        )
+        sha = out.stdout.strip()
+        return sha if out.returncode == 0 and sha else None
+    except Exception:  # noqa: BLE001 — metadata only, never fatal
+        return None
+
+
+def _now_iso() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S")
+
+
+# ------------------------------------------------------------- records
+
+def make_run_record(
+    *,
+    metric: str,
+    value: float,
+    unit: str = "GFlops/s",
+    seconds: float | None = None,
+    config: dict | None = None,
+    backend: str | None = None,
+    device_kind: str | None = None,
+    fallback: bool = False,
+    stages: dict | None = None,
+    roofline: dict | None = None,
+    metrics: dict | None = None,
+    source: str = "",
+    commit: str | None = None,
+    recorded_at: str | None = None,
+    extra: dict | None = None,
+) -> dict:
+    """One normalized run record. ``config`` holds the knobs that define
+    the baseline group (dtype, devices, ...); ``device_kind`` defaults to
+    ``backend`` so a CPU row can never enter a TPU baseline."""
+    rec = {
+        "schema": SCHEMA,
+        "recorded_at": recorded_at or _now_iso(),
+        "source": source,
+        "commit": commit,
+        "metric": metric,
+        "value": float(value),
+        "unit": unit,
+        "seconds": seconds,
+        "backend": backend,
+        "device_kind": device_kind or backend or "unknown",
+        "fallback": bool(fallback),
+        "ok": float(value) > 0.0,
+        "config": dict(config or {}),
+    }
+    if stages:
+        rec["stages"] = {str(k): float(v) for k, v in stages.items()}
+    if roofline:
+        rec["roofline"] = roofline
+    if metrics:
+        rec["metrics"] = metrics
+    if extra:
+        rec["extra"] = extra
+    return rec
+
+
+def _is_fallback_line(obj: dict) -> bool:
+    """A bench line produced because the TPU transport was down (the
+    flagged-so-excluded-from-TPU-baselines condition)."""
+    status = (obj.get("telemetry") or {}).get("status") or {}
+    if status:
+        return status.get("tpu_available") is False
+    err = obj.get("error")
+    return isinstance(err, str) and err.startswith("tpu unavailable")
+
+
+def normalize_bench_line(
+    obj: dict,
+    *,
+    source: str,
+    commit: str | None = None,
+    recorded_at: str | None = None,
+    extra: dict | None = None,
+) -> dict | None:
+    """A ``bench.py`` result line -> run record; None when ``obj`` is not
+    a bench line (no ``metric``/``value``)."""
+    if not isinstance(obj, dict) or "metric" not in obj:
+        return None
+    try:
+        value = float(obj.get("value", 0.0))
+    except (TypeError, ValueError):
+        return None
+    config = {}
+    for k in ("dtype", "devices", "decomposition"):
+        if obj.get(k) is not None:
+            config[k] = obj[k]
+    ex: dict = {}
+    for k in ("executor", "donated", "vs_baseline", "max_roundtrip_err",
+              "all"):
+        if obj.get(k) is not None:
+            ex[k] = obj[k]
+    if extra:
+        ex.update(extra)
+    telemetry = obj.get("telemetry") or {}
+    if telemetry.get("status"):
+        ex["status"] = telemetry["status"]
+    return make_run_record(
+        metric=obj["metric"],
+        value=value,
+        unit=obj.get("unit", "GFlops/s"),
+        seconds=obj.get("seconds"),
+        config=config,
+        backend=obj.get("backend"),
+        device_kind=obj.get("device_kind"),
+        fallback=_is_fallback_line(obj),
+        stages=obj.get("stages"),
+        roofline=obj.get("roofline"),
+        metrics=telemetry.get("metrics"),
+        source=source,
+        commit=commit,
+        recorded_at=recorded_at,
+        extra=ex or None,
+    )
+
+
+def records_from_artifact(
+    text: str, *, source: str, recorded_at: str | None = None,
+    commit: str | None = None,
+) -> tuple[list[dict], int]:
+    """Run records from one benchmark artifact, format auto-detected:
+
+    - run-record JSONL (a prior history file; records pass through),
+    - raw bench-line JSONL (``benchmarks/results/hw_bench*.json`` style),
+    - the round driver's ``BENCH_r*.json`` wrapper
+      (``{"n", "cmd", "rc", "tail", "parsed"}`` — the parsed line is the
+      record; a null parse yields no record, never an error).
+
+    Returns ``(records, skipped)`` where ``skipped`` counts JSON lines
+    that matched no format (a wrapper with ``"parsed": null`` counts as
+    skipped so ingest reports are honest about silent rounds).
+    """
+    stripped = text.strip()
+    if not stripped:
+        return [], 0
+    records: list[dict] = []
+    skipped = 0
+
+    def from_obj(obj) -> dict | None:
+        if not isinstance(obj, dict):
+            return None
+        if obj.get("schema") == SCHEMA and "metric" in obj \
+                and "device_kind" in obj:
+            return obj  # already a run record — pass through
+        if "parsed" in obj and "cmd" in obj:  # driver wrapper
+            parsed = obj.get("parsed")
+            if not isinstance(parsed, dict):
+                return None
+            x = {"round": obj.get("n")}
+            return normalize_bench_line(
+                parsed, source=source, recorded_at=recorded_at,
+                commit=commit, extra=x)
+        return normalize_bench_line(
+            obj, source=source, recorded_at=recorded_at, commit=commit)
+
+    # Whole-document JSON (the driver wrapper is one multi-line object).
+    try:
+        doc = json.loads(stripped)
+    except json.JSONDecodeError:
+        doc = None
+    if isinstance(doc, dict):
+        rec = from_obj(doc)
+        return ([rec], 0) if rec else ([], 1)
+    if isinstance(doc, list):
+        for entry in doc:
+            rec = from_obj(entry)
+            if rec is None:
+                skipped += 1
+            else:
+                records.append(rec)
+        return records, skipped
+
+    for line in stripped.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            skipped += 1
+            continue
+        rec = from_obj(obj)
+        if rec is None:
+            skipped += 1
+        else:
+            records.append(rec)
+    return records, skipped
+
+
+# ------------------------------------------------------------- storage
+
+def append_records(records: list[dict], path: str) -> None:
+    """Append run records to the JSONL history store (created, with
+    parent directory, on first use)."""
+    if not records:
+        return
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "a") as f:
+        for rec in records:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+
+
+def load_history(path: str) -> tuple[list[dict], int]:
+    """Load the JSONL history store leniently: ``(records, dropped)``
+    where malformed lines (truncated tail from a killed writer, non-JSON,
+    records without the baseline-key fields) are counted, not raised."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return [], 0
+    records: list[dict] = []
+    dropped = 0
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            dropped += 1
+            continue
+        if not isinstance(obj, dict) or "metric" not in obj \
+                or "value" not in obj:
+            dropped += 1
+            continue
+        records.append(obj)
+    return records, dropped
+
+
+# ------------------------------------------------------------- compare
+
+def config_signature(record: dict) -> str:
+    """Deterministic short signature of the record's config dict — the
+    config part of the baseline group key."""
+    cfg = record.get("config") or {}
+    return ",".join(f"{k}={cfg[k]}" for k in sorted(cfg))
+
+
+def group_key(record: dict) -> tuple[str, str, str]:
+    """Baseline group: (metric, config signature, device_kind). Records
+    from different device kinds never compare against each other."""
+    return (str(record.get("metric")), config_signature(record),
+            str(record.get("device_kind", "unknown")))
+
+
+def _baseline_eligible(rec: dict) -> bool:
+    """Fallback runs (TPU transport down) and failed runs (value<=0)
+    never poison a baseline."""
+    return not rec.get("fallback") and rec.get("ok", True) \
+        and float(rec.get("value", 0.0)) > 0.0
+
+
+def robust_stats(values: list[float]) -> tuple[float, float]:
+    """(median, MAD) of ``values`` — the noise model robust to the odd
+    flaky-transport outlier that mean/stddev is not."""
+    if not values:
+        return math.nan, math.nan
+    s = sorted(values)
+    n = len(s)
+    med = (s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2]))
+    dev = sorted(abs(v - med) for v in s)
+    mad = (dev[n // 2] if n % 2 else 0.5 * (dev[n // 2 - 1] + dev[n // 2]))
+    return med, mad
+
+
+def metric_direction(metric: str, unit: str | None = None) -> int:
+    """+1 when larger is better (throughput), -1 when smaller is better
+    (latency). Stage times always compare smaller-is-better."""
+    m, u = metric.lower(), (unit or "").lower()
+    if "seconds" in m or m.endswith("_s") or u in ("s", "seconds", "ms"):
+        return -1
+    return 1
+
+
+def _band(med: float, mad: float, mads: float, min_rel: float) -> float:
+    """Half-width of the within-noise band around the baseline median."""
+    return max(_MAD_SCALE * mads * mad, min_rel * abs(med))
+
+
+def compare_record(
+    record: dict,
+    history: list[dict],
+    *,
+    window: int = DEFAULT_WINDOW,
+    mads: float = DEFAULT_MADS,
+    min_rel: float = DEFAULT_MIN_REL,
+    min_samples: int = DEFAULT_MIN_SAMPLES,
+) -> dict:
+    """Verdict of one run record against its rolling-window baseline.
+
+    The baseline is the last ``window`` eligible records in ``history``
+    sharing the record's group key (same metric, config signature, and
+    device_kind — mixed device kinds never compare), excluding fallback
+    and failed runs. Bounds are median +/- max(``mads`` scaled MADs,
+    ``min_rel`` x median): inside is ``within-noise``, the good side is
+    ``improved``, the bad side is ``regressed``. Fewer than
+    ``min_samples`` baseline records -> ``no-baseline`` (never gates).
+
+    On a regression, per-stage t0..t3 localization runs the same noise
+    model over ``record["stages"]`` vs the baseline records' stages, so
+    the report can say *which* stage moved ("t2_exchange +31%").
+    """
+    key = group_key(record)
+    base = [r for r in history
+            if r is not record and group_key(r) == key
+            and _baseline_eligible(r)]
+    base = base[-window:]
+    value = float(record.get("value", 0.0))
+    out = {
+        "metric": record.get("metric"),
+        "device_kind": record.get("device_kind"),
+        "config": config_signature(record),
+        "unit": record.get("unit"),
+        "value": value,
+        "fallback": bool(record.get("fallback")),
+        "baseline": {"n": len(base), "window": window},
+        "verdict": "no-baseline",
+        "localization": [],
+    }
+    if len(base) < min_samples:
+        return out
+    med, mad = robust_stats([float(r["value"]) for r in base])
+    band = _band(med, mad, mads, min_rel)
+    out["baseline"].update(median=med, mad=mad, band=band)
+    out["delta_pct"] = 100.0 * (value - med) / med if med else math.inf
+    direction = metric_direction(str(record.get("metric")),
+                                 record.get("unit"))
+    if abs(value - med) <= band:
+        out["verdict"] = "within-noise"
+    elif (value - med) * direction > 0:
+        out["verdict"] = "improved"
+    else:
+        out["verdict"] = "regressed"
+        out["localization"] = _localize_stages(
+            record, base, mads=mads, min_rel=min_rel,
+            min_samples=min_samples)
+    return out
+
+
+def _localize_stages(
+    record: dict, base: list[dict], *, mads: float, min_rel: float,
+    min_samples: int,
+) -> list[dict]:
+    """Per-stage verdicts for a regressed headline: every stage of the
+    record with enough baseline samples, flagged ``regressed`` when its
+    time moved above the noise band, sorted worst-regression first."""
+    stages = record.get("stages") or {}
+    rows: list[dict] = []
+    for name, val in stages.items():
+        samples = [float(r["stages"][name]) for r in base
+                   if isinstance(r.get("stages"), dict)
+                   and name in r["stages"]]
+        if len(samples) < min_samples:
+            continue
+        med, mad = robust_stats(samples)
+        if not med:
+            continue
+        val = float(val)
+        band = _band(med, mad, mads, min_rel)
+        rows.append({
+            "stage": name,
+            "value": val,
+            "baseline_median": med,
+            "delta_pct": 100.0 * (val - med) / med,
+            # Stage times are latencies: regressed means slower.
+            "regressed": (val - med) > band,
+        })
+    rows.sort(key=lambda r: (-r["regressed"], -r["delta_pct"], r["stage"]))
+    return rows
+
+
+def format_compare(results: list[dict]) -> str:
+    """Human-readable compare report: one verdict line per record, with
+    the stage localization indented under a regression."""
+    if not results:
+        return "(no records to compare)"
+    lines: list[str] = []
+    for res in results:
+        head = (f"{res['verdict']:<12}  {res['metric']}  "
+                f"[{res['device_kind']}"
+                + (f"; {res['config']}" if res["config"] else "") + "]")
+        b = res.get("baseline", {})
+        if "median" in b:
+            head += (f"  value={res['value']:g} vs median={b['median']:g}"
+                     f" (n={b['n']}, band=+/-{b['band']:g})"
+                     f" {res.get('delta_pct', 0.0):+.1f}%")
+        else:
+            head += (f"  value={res['value']:g}"
+                     f" (baseline n={b.get('n', 0)} < min samples)")
+        if res.get("fallback"):
+            head += "  [fallback run; excluded from future baselines]"
+        lines.append(head)
+        for row in res.get("localization", []):
+            tag = "REGRESSED" if row["regressed"] else "within noise"
+            lines.append(
+                f"    {row['stage']:<20} {row['delta_pct']:+.1f}%  "
+                f"({row['value']:.6f}s vs {row['baseline_median']:.6f}s; "
+                f"{tag})")
+    return "\n".join(lines)
+
+
+def summarize_history(records: list[dict]) -> list[dict]:
+    """Per-group summary rows (newest-last ordering preserved within a
+    group): n, eligible n, last value, median of eligible values."""
+    groups: dict[tuple, list[dict]] = {}
+    for rec in records:
+        groups.setdefault(group_key(rec), []).append(rec)
+    rows = []
+    for (metric, sig, kind), recs in sorted(groups.items()):
+        eligible = [float(r["value"]) for r in recs if _baseline_eligible(r)]
+        med, _ = robust_stats(eligible)
+        rows.append({
+            "metric": metric,
+            "config": sig,
+            "device_kind": kind,
+            "n": len(recs),
+            "eligible": len(eligible),
+            "last_value": float(recs[-1].get("value", 0.0)),
+            "last_recorded_at": recs[-1].get("recorded_at"),
+            "median": None if math.isnan(med) else med,
+            "unit": recs[-1].get("unit"),
+        })
+    return rows

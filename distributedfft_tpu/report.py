@@ -1,23 +1,37 @@
-"""Trace report CLI — merge per-process logs, print the stage summary.
+"""Benchmark report CLI — trace merging and regression tracking.
 
-The reference writes one trace log per MPI rank and leaves correlation
-to the reader (``heffte_trace.h:98-118``); heFFTe's ``finalize_tracing``
-at least prints a per-event aggregate on shutdown. This module is both,
-offline::
+Subcommands (``merge`` is the default for backward compatibility: an
+argv whose first token is not a subcommand name is treated as ``merge``
+arguments)::
 
-    python -m distributedfft_tpu.report dfft_trace_0.log dfft_trace_1.log
-    python -m distributedfft_tpu.report 'dfft_trace_*' -o merged.json
+    python -m distributedfft_tpu.report merge dfft_trace_*.log -o out.json
+    python -m distributedfft_tpu.report record BENCH_r*.json
+    python -m distributedfft_tpu.report history
+    python -m distributedfft_tpu.report compare --gate
 
-It accepts any mix of the text log format and the Chrome-trace JSON
-format (``DFFT_TRACE_FORMAT=chrome``), merges every process's events
-onto one timeline, prints the per-stage aggregate table
+**merge** — the trace tool. The reference writes one trace log per MPI
+rank and leaves correlation to the reader (``heffte_trace.h:98-118``);
+``merge`` accepts any mix of the text log format and the Chrome-trace
+JSON format (``DFFT_TRACE_FORMAT=chrome``), merges every process's
+events onto one timeline, prints the per-stage aggregate table
 (count/total/mean/min/max — the heFFTe finalize summary), and with
 ``-o`` writes a merged Chrome-trace JSON to load in ui.perfetto.dev.
+Malformed events (missing ts/dur, the truncated tail of a
+watchdog-killed worker's log) are skipped and counted on stderr, never
+fatal. Timeline caveat: text logs store per-process *relative* start
+times, so merging text logs aligns processes at their first event;
+chrome logs carry a shared wall-clock axis and merge exactly.
 
-Timeline caveat: text logs store per-process *relative* start times
-(each process's first event is t=0), so merging text logs aligns the
-processes at their first event; chrome logs carry a shared wall-clock
-axis and merge exactly.
+**record / history / compare** — the regression-tracking loop over the
+append-only run-record store (``benchmarks/results/history.jsonl``; see
+:mod:`.regress` and docs/OBSERVABILITY.md). ``record`` normalizes and
+appends benchmark artifacts (bench.py lines, ``BENCH_r*.json`` driver
+wrappers, prior history files); ``history`` summarizes the store per
+(metric, config, device_kind) group; ``compare`` runs the noise-aware
+verdict of the newest record(s) against their rolling baselines, with
+per-stage t0..t3 localization on a regression. ``compare --gate`` exits
+1 on a confirmed regression (0 = clean, 2 = usage/IO error) so CI and
+the round driver can gate mechanically.
 """
 
 from __future__ import annotations
@@ -26,6 +40,8 @@ import argparse
 import glob as _glob
 import json
 import sys
+
+from . import regress
 
 __all__ = [
     "load_events",
@@ -37,13 +53,34 @@ __all__ = [
 ]
 
 
-def _parse_text_log(text: str, default_pid: int = 0) -> list[dict]:
+def _clean_events(raw: list[dict]) -> tuple[list[dict], int]:
+    """Keep events with a name and numeric ts/dur; count the rest."""
+    events: list[dict] = []
+    dropped = 0
+    for e in raw:
+        try:
+            events.append({
+                "name": str(e["name"]),
+                "pid": int(e.get("pid", 0)),
+                "ts": float(e["ts"]),
+                "dur": float(e["dur"]),
+            })
+        except (KeyError, TypeError, ValueError):
+            dropped += 1
+    return events, dropped
+
+
+def _parse_text_log(text: str, default_pid: int = 0) -> tuple[list[dict], int]:
     """Parse the heFFTe-style per-rank text log: a ``process I of N``
     banner, then ``start  duration  name`` rows (seconds, relative to the
-    process's first event)."""
+    process's first event). Rows that fail to parse — the truncated tail
+    a watchdog-killed worker leaves behind — are counted, not fatal."""
     events: list[dict] = []
+    dropped = 0
     pid = default_pid
     for line in text.splitlines():
+        if not line.strip():
+            continue
         if line.startswith("process "):
             parts = line.split()
             if len(parts) >= 2 and parts[1].isdigit():
@@ -51,59 +88,138 @@ def _parse_text_log(text: str, default_pid: int = 0) -> list[dict]:
             continue
         parts = line.split(None, 2)
         if len(parts) < 3:
+            dropped += 1  # truncated row: fields missing
             continue
         try:
             start, dur = float(parts[0]), float(parts[1])
         except ValueError:
+            dropped += 1
             continue
         events.append({"name": parts[2].strip(), "pid": pid,
                        "ts": start * 1e6, "dur": dur * 1e6})
-    return events
+    return events, dropped
 
 
-def _parse_chrome(obj) -> list[dict]:
+def _parse_chrome(obj) -> tuple[list[dict], int]:
     """Flatten a Chrome-trace document to complete events. ``B``/``E``
     pairs are matched per (pid, tid, name) LIFO — the nesting discipline
-    the writer guarantees; ``X`` events pass through."""
+    the writer guarantees; ``X`` events pass through. Events without a
+    usable ts (or non-dict entries) are counted as dropped; an unpaired
+    ``B`` at the tail of a truncated log counts too."""
     raw = obj.get("traceEvents", []) if isinstance(obj, dict) else obj
+    if not isinstance(raw, list):
+        return [], 1
     events: list[dict] = []
+    dropped = 0
     open_stacks: dict[tuple, list[float]] = {}
-    for e in sorted(raw, key=lambda ev: ev.get("ts", 0.0)):
+    entries = [e for e in raw if isinstance(e, dict)]
+    dropped += len(raw) - len(entries)
+
+    def ts_key(ev):
+        try:
+            return float(ev.get("ts") or 0.0)
+        except (TypeError, ValueError):
+            return 0.0  # dropped below; any position sorts consistently
+
+    for e in sorted(entries, key=ts_key):
         ph = e.get("ph")
         pid, tid = e.get("pid", 0), e.get("tid", 0)
         name = e.get("name", "")
+        try:
+            ts = float(e["ts"])
+        except (KeyError, TypeError, ValueError):
+            dropped += 1
+            continue
         if ph == "X":
-            events.append({"name": name, "pid": pid,
-                           "ts": float(e.get("ts", 0.0)),
-                           "dur": float(e.get("dur", 0.0))})
+            try:
+                dur = float(e["dur"])
+            except (KeyError, TypeError, ValueError):
+                dropped += 1
+                continue
+            events.append({"name": name, "pid": pid, "ts": ts, "dur": dur})
         elif ph == "B":
-            open_stacks.setdefault((pid, tid, name), []).append(
-                float(e.get("ts", 0.0)))
+            open_stacks.setdefault((pid, tid, name), []).append(ts)
         elif ph == "E":
             stack = open_stacks.get((pid, tid, name))
             if stack:
-                ts = stack.pop()
-                events.append({"name": name, "pid": pid, "ts": ts,
-                               "dur": float(e.get("ts", 0.0)) - ts})
-    return events
+                start = stack.pop()
+                events.append({"name": name, "pid": pid, "ts": start,
+                               "dur": ts - start})
+            else:
+                dropped += 1  # E without a matching B
+    dropped += sum(len(s) for s in open_stacks.values())  # unclosed B's
+    return events, dropped
 
 
-def load_events(path: str) -> list[dict]:
-    """Events of one per-process trace file (either format), each as
-    ``{"name", "pid", "ts", "dur"}`` with ts/dur in microseconds."""
+def _parse_chrome_text(text: str) -> tuple[list[dict], int]:
+    """Chrome-trace JSON, lenient: a complete document parses exactly;
+    a truncated one (killed mid-write) recovers every complete event
+    object before the cut and counts the tail as one dropped event."""
+    try:
+        return _parse_chrome(json.loads(text))
+    except json.JSONDecodeError:
+        pass
+    # Find the traceEvents array (or a bare top-level array) and decode
+    # object by object until the truncation point.
+    idx = text.find('"traceEvents"')
+    start = text.find("[", idx if idx >= 0 else 0)
+    if start < 0:
+        return [], 1
+    dec = json.JSONDecoder()
+    pos = start + 1
+    raw: list[dict] = []
+    n = len(text)
+    while True:
+        while pos < n and text[pos] in " \t\r\n,":
+            pos += 1
+        if pos >= n or text[pos] == "]":
+            break
+        try:
+            obj, end = dec.raw_decode(text, pos)
+        except json.JSONDecodeError:
+            break
+        raw.append(obj)
+        pos = end
+    events, dropped = _parse_chrome(raw)
+    return events, dropped + 1  # +1 for the truncated tail itself
+
+
+def _load_events(path: str) -> tuple[list[dict], int]:
     with open(path) as f:
         text = f.read()
     head = text.lstrip()[:1]
     if head in ("{", "["):
-        return _parse_chrome(json.loads(text))
-    return _parse_text_log(text)
+        events, dropped = _parse_chrome_text(text)
+    else:
+        events, dropped = _parse_text_log(text)
+    events, bad = _clean_events(events)
+    return events, dropped + bad
+
+
+def load_events(path: str) -> list[dict]:
+    """Events of one per-process trace file (either format), each as
+    ``{"name", "pid", "ts", "dur"}`` with ts/dur in microseconds.
+    Malformed events are skipped with a count on stderr."""
+    events, dropped = _load_events(path)
+    if dropped:
+        print(f"report: {path}: skipped {dropped} malformed event(s)",
+              file=sys.stderr)
+    return events
 
 
 def merge_files(paths: list[str]) -> list[dict]:
-    """One timeline from many per-process files, sorted by start time."""
+    """One timeline from many per-process files, sorted by start time.
+    Malformed events across all files are skipped with one total count
+    on stderr (partial logs from killed workers are a normal input)."""
     events: list[dict] = []
+    dropped = 0
     for path in paths:
-        events.extend(load_events(path))
+        evs, d = _load_events(path)
+        events.extend(evs)
+        dropped += d
+    if dropped:
+        print(f"report: skipped {dropped} malformed event(s) across "
+              f"{len(paths)} file(s)", file=sys.stderr)
     events.sort(key=lambda e: (e["ts"], e["pid"]))
     return events
 
@@ -129,13 +245,15 @@ def aggregate(events: list[dict]) -> dict[str, dict]:
 
 
 def format_table(agg: dict[str, dict], sort: str = "total") -> str:
-    """Fixed-width aggregate table, widest column first."""
+    """Fixed-width aggregate table, widest column first. Ties on the
+    sort column break by stage name, so the ordering is stable across
+    runs and dict insertion orders."""
     if not agg:
         return "(no events)"
     if sort == "name":
         rows = sorted(agg.items())
     else:
-        rows = sorted(agg.items(), key=lambda kv: -kv[1][sort])
+        rows = sorted(agg.items(), key=lambda kv: (-kv[1][sort], kv[0]))
     width = max(len("stage"), max(len(n) for n in agg))
     lines = [
         f"{'stage':<{width}}  {'count':>7}  {'total':>12}  {'mean':>12}  "
@@ -167,9 +285,11 @@ def write_chrome(events: list[dict], path: str) -> None:
         )
 
 
-def main(argv: list[str] | None = None) -> int:
+# ----------------------------------------------------------- merge CLI
+
+def _main_merge(argv: list[str]) -> int:
     p = argparse.ArgumentParser(
-        prog="python -m distributedfft_tpu.report",
+        prog="python -m distributedfft_tpu.report merge",
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
@@ -180,7 +300,7 @@ def main(argv: list[str] | None = None) -> int:
                    help="write the merged Chrome-trace JSON here "
                         "(open in ui.perfetto.dev)")
     p.add_argument("--sort", default="total",
-                   choices=("total", "count", "mean", "max", "name"),
+                   choices=("total", "count", "mean", "min", "max", "name"),
                    help="aggregate table sort key (default: total)")
     args = p.parse_args(argv)
 
@@ -201,6 +321,215 @@ def main(argv: list[str] | None = None) -> int:
         write_chrome(events, args.out)
         print(f"merged timeline written to {args.out}")
     return 0
+
+
+# ------------------------------------------------------ regression CLI
+
+def _history_arg(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--history", default=None, metavar="PATH",
+                   help="run-record JSONL store (default: "
+                        "DFFT_BENCH_HISTORY or "
+                        "benchmarks/results/history.jsonl)")
+
+
+def _resolve_history(args) -> str | None:
+    return args.history or regress.default_history_path()
+
+
+def _main_record(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m distributedfft_tpu.report record",
+        description="Normalize benchmark artifacts into run records and "
+                    "append them to the history store. Accepts bench.py "
+                    "result-line JSON(L), the round driver's BENCH_r*.json "
+                    "wrappers, and prior run-record JSONL; '-' reads one "
+                    "artifact from stdin.")
+    p.add_argument("paths", nargs="+",
+                   help="artifact files (globs expanded) or '-' for stdin")
+    _history_arg(p)
+    p.add_argument("--source", default=None,
+                   help="source label override (default: the file name)")
+    p.add_argument("--commit", default=None,
+                   help="commit sha to stamp (default: git rev-parse, "
+                        "best-effort)")
+    p.add_argument("--dry-run", action="store_true",
+                   help="print the normalized records as JSONL on stdout "
+                        "instead of appending to the store")
+    args = p.parse_args(argv)
+
+    history = _resolve_history(args)
+    if history is None and not args.dry_run:
+        print("report record: history store disabled "
+              "(DFFT_BENCH_HISTORY is empty)", file=sys.stderr)
+        return 2
+    commit = args.commit or regress.git_commit()
+
+    paths: list[str] = []
+    for pat in args.paths:
+        if pat == "-":
+            paths.append(pat)
+            continue
+        hits = sorted(_glob.glob(pat))
+        paths.extend(hits if hits else [pat])
+
+    records: list[dict] = []
+    skipped = 0
+    for path in paths:
+        try:
+            if path == "-":
+                text = sys.stdin.read()
+            else:
+                with open(path) as f:
+                    text = f.read()
+        except OSError as e:
+            print(f"report record: {e}", file=sys.stderr)
+            return 2
+        recs, skip = regress.records_from_artifact(
+            text, source=args.source or (path if path != "-" else "stdin"),
+            commit=commit)
+        records.extend(recs)
+        skipped += skip
+    if args.dry_run:
+        for rec in records:
+            print(json.dumps(rec, sort_keys=True))
+    else:
+        regress.append_records(records, history)
+    dest = "stdout (dry run)" if args.dry_run else history
+    print(f"recorded {len(records)} run record(s) from {len(paths)} "
+          f"artifact(s) to {dest}"
+          + (f"; {skipped} line(s) held no result" if skipped else ""),
+          file=sys.stderr)
+    return 0
+
+
+def _main_history(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m distributedfft_tpu.report history",
+        description="Summarize the run-record store per "
+                    "(metric, config, device_kind) baseline group.")
+    _history_arg(p)
+    p.add_argument("--metric", default=None,
+                   help="only groups whose metric contains this substring")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable JSON instead of the table")
+    args = p.parse_args(argv)
+
+    history = _resolve_history(args)
+    records, dropped = regress.load_history(history) if history else ([], 0)
+    if dropped:
+        print(f"report history: skipped {dropped} malformed line(s) in "
+              f"{history}", file=sys.stderr)
+    rows = regress.summarize_history(records)
+    if args.metric:
+        rows = [r for r in rows if args.metric in r["metric"]]
+    if args.json:
+        print(json.dumps(rows, sort_keys=True))
+        return 0
+    if not rows:
+        print("(empty history)")
+        return 0
+    wm = max(len("metric"), max(len(r["metric"]) for r in rows))
+    wk = max(len("device_kind"), max(len(r["device_kind"]) for r in rows))
+    wc = max(len("config"), max(len(r["config"]) for r in rows))
+    print(f"{'metric':<{wm}}  {'device_kind':<{wk}}  {'config':<{wc}}  "
+          f"{'n':>4}  {'ok':>4}  {'median':>10}  {'last':>10}")
+    for r in rows:
+        med = "-" if r["median"] is None else f"{r['median']:.1f}"
+        print(f"{r['metric']:<{wm}}  {r['device_kind']:<{wk}}  "
+              f"{r['config']:<{wc}}  {r['n']:>4d}  {r['eligible']:>4d}  "
+              f"{med:>10}  {r['last_value']:>10.1f}")
+    return 0
+
+
+def _main_compare(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m distributedfft_tpu.report compare",
+        description="Noise-aware verdict of the newest run record(s) "
+                    "against their rolling-window baselines (median + MAD "
+                    "bounds; per-stage t0..t3 localization on a "
+                    "regression). Exit codes: 0 clean, 1 confirmed "
+                    "regression (with --gate), 2 usage/IO error.")
+    _history_arg(p)
+    p.add_argument("--record", default=None, metavar="FILE",
+                   help="compare this artifact (bench line or run record) "
+                        "instead of the newest history record")
+    p.add_argument("--last", type=int, default=1, metavar="N",
+                   help="compare the N newest history records "
+                        "(default: 1)")
+    p.add_argument("--window", type=int, default=regress.DEFAULT_WINDOW,
+                   help="rolling baseline size per group (default: "
+                        f"{regress.DEFAULT_WINDOW})")
+    p.add_argument("--mads", type=float, default=regress.DEFAULT_MADS,
+                   help="noise band half-width in scaled MADs (default: "
+                        f"{regress.DEFAULT_MADS})")
+    p.add_argument("--min-rel", type=float, default=regress.DEFAULT_MIN_REL,
+                   help="noise band floor as a fraction of the median "
+                        f"(default: {regress.DEFAULT_MIN_REL})")
+    p.add_argument("--min-samples", type=int,
+                   default=regress.DEFAULT_MIN_SAMPLES,
+                   help="baseline records required for a verdict "
+                        f"(default: {regress.DEFAULT_MIN_SAMPLES})")
+    p.add_argument("--gate", action="store_true",
+                   help="exit 1 when any compared record regressed")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable JSON instead of the report")
+    args = p.parse_args(argv)
+
+    history = _resolve_history(args)
+    records, dropped = regress.load_history(history) if history else ([], 0)
+    if dropped:
+        print(f"report compare: skipped {dropped} malformed line(s) in "
+              f"{history}", file=sys.stderr)
+
+    if args.record:
+        try:
+            with open(args.record) as f:
+                text = f.read()
+        except OSError as e:
+            print(f"report compare: {e}", file=sys.stderr)
+            return 2
+        subjects, _ = regress.records_from_artifact(
+            text, source=args.record)
+        if not subjects:
+            print(f"report compare: no run record in {args.record}",
+                  file=sys.stderr)
+            return 2
+    else:
+        if not records:
+            print(f"report compare: empty history "
+                  f"({history or 'store disabled'})", file=sys.stderr)
+            return 2
+        subjects = records[-max(1, args.last):]
+
+    kw = dict(window=args.window, mads=args.mads, min_rel=args.min_rel,
+              min_samples=args.min_samples)
+    results = [regress.compare_record(rec, records, **kw)
+               for rec in subjects]
+    if args.json:
+        print(json.dumps(results, sort_keys=True))
+    else:
+        print(regress.format_compare(results))
+    regressed = [r for r in results if r["verdict"] == "regressed"]
+    if regressed and not args.json:
+        print(f"{len(regressed)} confirmed regression(s)", file=sys.stderr)
+    return 1 if (args.gate and regressed) else 0
+
+
+_SUBCOMMANDS = {
+    "merge": _main_merge,
+    "record": _main_record,
+    "history": _main_history,
+    "compare": _main_compare,
+}
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] in _SUBCOMMANDS:
+        return _SUBCOMMANDS[argv[0]](argv[1:])
+    # Backward compatibility: a bare file list is a merge (the original
+    # single-purpose CLI contract; the round scripts rely on it).
+    return _main_merge(argv)
 
 
 if __name__ == "__main__":

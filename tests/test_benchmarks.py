@@ -275,19 +275,32 @@ def test_record_baseline_quick(tmp_path):
     import sys
 
     out = tmp_path / "sweep.csv"
+    hist = tmp_path / "history.jsonl"
     proc = subprocess.run(
         [sys.executable, "benchmarks/record_baseline.py", "--quick",
          "--sizes", "16", "--out", str(out), "--executors", "xla"],
         capture_output=True, text=True, timeout=600,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        # History redirected: the repo store is hardware evidence and
+        # must never see test rows.
         env={**os.environ, "JAX_PLATFORMS": "cpu",
-             "PALLAS_AXON_POOL_IPS": ""},
+             "PALLAS_AXON_POOL_IPS": "",
+             "DFFT_BENCH_HISTORY": str(hist)},
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     rows = out.read_text().strip().splitlines()
     assert rows[0].startswith("run,nx,ny,nz,kind")
     assert len(rows) >= 3  # header + c2c + r2c
     assert all(r.endswith(",ok") for r in rows[1:]), rows
+    # Every ok row also appended a run record to the history store.
+    import json
+
+    recs = [json.loads(ln) for ln in
+            hist.read_text().strip().splitlines()]
+    assert len(recs) == len(rows) - 1
+    assert all(r["source"] == "record_baseline.py" for r in recs)
+    assert all(r["metric"].startswith("speed3d_") for r in recs)
+    assert all(r["config"]["executor"] == "xla" for r in recs)
 
 
 def test_speed3d_bricks(capsys, tmp_path):

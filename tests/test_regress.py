@@ -1,0 +1,300 @@
+"""Regression-tracking subsystem tests (``distributedfft_tpu/regress.py``
++ the ``record``/``history``/``compare`` report subcommands).
+
+Pure-python compare-engine proofs on synthetic histories (a within-noise
+wobble passes, a 20% headline regression gates, a t2-only regression is
+localized to t2, mixed device kinds never compare), ingestion of the
+repo's committed ``BENCH_r*.json`` rounds, and the tier-1-safe CLI smoke
+driving ``record`` -> ``history`` -> ``compare --gate`` end to end.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from distributedfft_tpu import regress
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DATA = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+
+CPU_ENV = {**os.environ, "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+
+
+def _rec(value, *, kind="TPU v5 lite", stages=None, fallback=False,
+         metric="fft3d_c2c_512_forward_gflops", seconds=None):
+    return regress.make_run_record(
+        metric=metric, value=value, seconds=seconds,
+        config={"dtype": "complex64", "devices": 1},
+        backend="cpu" if fallback else "tpu", device_kind=None if fallback
+        else kind, fallback=fallback, stages=stages, source="test",
+    )
+
+
+# ------------------------------------------------------- compare engine
+
+def test_within_noise_wobble_passes():
+    hist = [_rec(v) for v in (186.1, 187.1, 185.9, 186.8, 187.4, 186.5)]
+    res = regress.compare_record(_rec(185.2), hist)
+    assert res["verdict"] == "within-noise"
+    assert res["baseline"]["n"] == 6
+    # ... and a genuine improvement is called one, not noise.
+    res = regress.compare_record(_rec(230.0), hist)
+    assert res["verdict"] == "improved"
+
+
+def test_headline_regression_gates():
+    hist = [_rec(v) for v in (186.1, 187.1, 185.9, 186.8, 187.4, 186.5)]
+    res = regress.compare_record(_rec(149.3), hist)  # -20%
+    assert res["verdict"] == "regressed"
+    assert res["delta_pct"] < -15
+
+
+def test_t2_only_regression_localizes_to_t2():
+    base_stages = {"t0_fft_yz": 0.0298, "t1_pack": 0.0041,
+                   "t2_exchange": 0.0351, "t3_fft_x": 0.0279}
+    hist = []
+    for v in (186.1, 187.1, 185.9, 186.8, 187.4, 186.5):
+        s = {k: t * (1 + 0.01 * ((v % 1) - 0.5)) for k, t in
+             base_stages.items()}
+        hist.append(_rec(v, stages=s))
+    bad = dict(base_stages, t2_exchange=0.0473)  # +35%, others flat
+    res = regress.compare_record(_rec(150.1, stages=bad), hist)
+    assert res["verdict"] == "regressed"
+    loc = res["localization"]
+    assert loc and loc[0]["stage"] == "t2_exchange"
+    assert loc[0]["regressed"] and loc[0]["delta_pct"] > 25
+    assert all(not row["regressed"] for row in loc[1:])
+
+
+def test_mixed_device_kinds_never_compare():
+    hist = [_rec(v, kind="TPU v5 lite") for v in (186.0, 187.0, 186.5,
+                                                  187.2, 186.2, 186.9)]
+    # A CPU record with the same metric/config must not be judged
+    # against the TPU baseline (nor vice versa).
+    cpu = _rec(8.0)
+    cpu["device_kind"] = "cpu"
+    res = regress.compare_record(cpu, hist)
+    assert res["verdict"] == "no-baseline"
+    assert res["baseline"]["n"] == 0
+    v6 = _rec(400.0, kind="TPU v6 lite")
+    assert regress.compare_record(v6, hist)["verdict"] == "no-baseline"
+
+
+def test_fallback_runs_never_poison_the_baseline():
+    hist = [_rec(v) for v in (186.1, 187.1, 185.9)]
+    hist += [_rec(8.0, fallback=True) for _ in range(5)]  # sick tunnel
+    res = regress.compare_record(_rec(185.8), hist)
+    assert res["verdict"] == "within-noise"
+    assert res["baseline"]["n"] == 3  # the fallback records are excluded
+    assert res["baseline"]["median"] == pytest.approx(186.1)
+
+
+def test_rolling_window_drops_stale_records():
+    hist = [_rec(100.0) for _ in range(10)] + \
+           [_rec(v) for v in (186.1, 187.1, 185.9, 186.8, 187.4, 186.5,
+                              186.2, 187.0)]
+    res = regress.compare_record(_rec(186.0), hist, window=8)
+    assert res["verdict"] == "within-noise"
+    assert res["baseline"]["median"] > 180  # the 100.0 era aged out
+
+
+def test_robust_stats():
+    med, mad = regress.robust_stats([1.0, 2.0, 3.0, 4.0, 100.0])
+    assert med == 3.0 and mad == 1.0  # the outlier moves neither
+    med, mad = regress.robust_stats([2.0, 4.0])
+    assert med == 3.0 and mad == 1.0
+
+
+def test_metric_direction():
+    assert regress.metric_direction("fft3d_c2c_512_forward_gflops") == 1
+    assert regress.metric_direction("plan_build_seconds") == -1
+    # A latency metric regresses UPWARD.
+    hist = [_rec(0.0968, metric="fft3d_seconds", seconds=0.0968)
+            for _ in range(4)]
+    for r in hist:
+        r["unit"] = "s"
+    bad = _rec(0.130, metric="fft3d_seconds", seconds=0.130)
+    bad["unit"] = "s"
+    assert regress.compare_record(bad, hist)["verdict"] == "regressed"
+
+
+# ------------------------------------------------------------ ingestion
+
+def test_repo_bench_rounds_ingest_without_error():
+    """Acceptance: every committed BENCH_r*.json wrapper ingests; silent
+    rounds (parsed: null) skip, never raise."""
+    import glob
+
+    total = 0
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json"))):
+        with open(path) as f:
+            recs, _ = regress.records_from_artifact(
+                f.read(), source=os.path.basename(path))
+        for rec in recs:
+            assert rec["schema"] == regress.SCHEMA
+            assert rec["metric"].startswith("fft3d_")
+            # The committed rounds are all CPU-fallback lines: flagged so
+            # they can never enter a TPU baseline.
+            assert rec["fallback"] and rec["device_kind"] == "cpu"
+        total += len(recs)
+    assert total >= 3  # r03..r05 carry parsed lines
+
+
+def test_bench_line_jsonl_and_history_passthrough(tmp_path):
+    line = {"metric": "fft3d_c2c_512_forward_gflops", "value": 187.0,
+            "unit": "GFlops/s", "seconds": 0.0968, "backend": "tpu",
+            "device_kind": "TPU v5 lite", "dtype": "complex64",
+            "devices": 1, "decomposition": "single", "executor": "xla",
+            "stages": {"t2_exchange": 0.035},
+            "telemetry": {"metrics": {"enabled": True}}}
+    recs, skipped = regress.records_from_artifact(
+        json.dumps(line) + "\n" + json.dumps(line), source="s")
+    assert len(recs) == 2 and skipped == 0
+    assert recs[0]["stages"] == {"t2_exchange": 0.035}
+    assert recs[0]["metrics"] == {"enabled": True}
+    assert recs[0]["config"] == {"dtype": "complex64", "devices": 1,
+                                 "decomposition": "single"}
+    # Round-trip: an existing history file re-ingests as a passthrough.
+    p = tmp_path / "h.jsonl"
+    regress.append_records(recs, str(p))
+    again, skipped = regress.records_from_artifact(p.read_text(),
+                                                   source="other")
+    assert len(again) == 2 and skipped == 0
+    assert again[0]["source"] == "s"  # original stamp preserved
+
+
+def test_load_history_skips_malformed_lines(tmp_path):
+    p = tmp_path / "h.jsonl"
+    good = _rec(186.0)
+    p.write_text(json.dumps(good) + "\n"
+                 + "{\"metric\": \"x\"\n"            # truncated tail
+                 + "not json at all\n"
+                 + json.dumps({"value": 1.0}) + "\n"  # no metric
+                 + json.dumps(good) + "\n")
+    records, dropped = regress.load_history(str(p))
+    assert len(records) == 2 and dropped == 3
+
+
+# ------------------------------------------------------------ CLI smoke
+
+def _report(args, **kw):
+    return subprocess.run(
+        [sys.executable, "-m", "distributedfft_tpu.report", *args],
+        capture_output=True, text=True, cwd=REPO, env=CPU_ENV,
+        timeout=240, **kw)
+
+
+def test_cli_record_history_compare_gate_roundtrip(tmp_path):
+    """Tier-1 CPU-only smoke: a fresh run record appends via ``record``,
+    shows in ``history``, and ``compare --gate`` passes on the
+    within-noise fixture and fails (naming t2) on the regression one."""
+    hist = str(tmp_path / "history.jsonl")
+    shutil.copy(os.path.join(DATA, "history_tpu_ok.jsonl"), hist)
+
+    # record: append one new within-noise bench line.
+    line = tmp_path / "line.json"
+    line.write_text(json.dumps({
+        "metric": "fft3d_c2c_512_forward_gflops", "value": 186.3,
+        "unit": "GFlops/s", "seconds": 0.0967, "backend": "tpu",
+        "device_kind": "TPU v5 lite", "dtype": "complex64", "devices": 1,
+        "decomposition": "single",
+        "stages": {"t0_fft_yz": 0.0299, "t1_pack": 0.0041,
+                   "t2_exchange": 0.0352, "t3_fft_x": 0.0277}}))
+    proc = _report(["record", str(line), "--history", hist,
+                    "--commit", "deadbee"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "recorded 1 run record(s)" in proc.stderr
+    tail = json.loads(open(hist).read().strip().splitlines()[-1])
+    assert tail["value"] == 186.3 and tail["commit"] == "deadbee"
+
+    # history: the group summary names the metric and device kind.
+    proc = _report(["history", "--history", hist])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "fft3d_c2c_512_forward_gflops" in proc.stdout
+    assert "TPU v5 lite" in proc.stdout
+    proc = _report(["history", "--history", hist, "--json"])
+    rows = json.loads(proc.stdout)
+    tpu = [r for r in rows if r["device_kind"] == "TPU v5 lite"]
+    # 7 fixture records + the one just appended, all eligible.
+    assert tpu and tpu[0]["n"] == 8 and tpu[0]["eligible"] == 8
+
+    # compare --gate: the appended record is within noise -> exit 0.
+    proc = _report(["compare", "--history", hist, "--gate"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "within-noise" in proc.stdout
+
+    # ... and the synthetic 20% t2 regression fixture -> exit 1, t2 named.
+    bad = os.path.join(DATA, "history_tpu_regress.jsonl")
+    proc = _report(["compare", "--history", bad, "--gate"])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "regressed" in proc.stdout and "t2_exchange" in proc.stdout
+
+    # --json: machine-readable verdicts with the t2 localization.
+    proc = _report(["compare", "--history", bad, "--gate", "--json"])
+    assert proc.returncode == 1
+    results = json.loads(proc.stdout)
+    assert results[0]["verdict"] == "regressed"
+    loc = results[0]["localization"]
+    assert loc[0]["stage"] == "t2_exchange" and loc[0]["regressed"]
+    # Without --gate the regression is reported but does not gate.
+    proc = _report(["compare", "--history", bad])
+    assert proc.returncode == 0
+
+
+def test_cli_record_ingests_repo_rounds_dry_run():
+    """Acceptance: the committed BENCH_r*.json rounds ingest through the
+    CLI without error (dry run: nothing written)."""
+    proc = _report(["record", "BENCH_r01.json", "BENCH_r02.json",
+                    "BENCH_r03.json", "BENCH_r04.json", "BENCH_r05.json",
+                    "--dry-run"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    recs = [json.loads(ln) for ln in proc.stdout.strip().splitlines()]
+    assert len(recs) >= 3 and all(r["fallback"] for r in recs)
+
+
+def test_cli_compare_empty_history_errors(tmp_path):
+    proc = _report(["compare", "--history", str(tmp_path / "none.jsonl")])
+    assert proc.returncode == 2
+    assert "empty history" in proc.stderr
+
+
+def test_seeded_repo_history_loads():
+    """The committed store ingested from the r01..r05 era loads clean and
+    carries both the TPU evidence and the flagged fallback rounds."""
+    path = os.path.join(REPO, "benchmarks", "results", "history.jsonl")
+    records, dropped = regress.load_history(path)
+    assert dropped == 0 and len(records) >= 6
+    kinds = {r["device_kind"] for r in records}
+    assert any(k.lower().startswith("tpu") or "tpu" in k.lower()
+               for k in kinds)
+    assert any(r["fallback"] for r in records)
+
+
+def test_bench_orchestrator_appends_history(tmp_path):
+    """bench.py appends a valid run record on every invocation — here the
+    TPU-unavailable path end to end: the final line must land in the
+    store flagged as a fallback (excluded from TPU baselines)."""
+    hist = str(tmp_path / "bench_history.jsonl")
+    env = {**CPU_ENV, "DFFT_BENCH_HISTORY": hist,
+           # One fast CPU attempt: the insurance phase runs on the cpu
+           # backend, _guard_cpu zeroes vs_baseline, and the short
+           # deadline keeps the schedule from reaching the 512^3 phase.
+           "DFFT_BENCH_DEADLINE": "110",
+           "DFFT_BENCH_EXECUTORS": "xla"}
+    proc = subprocess.run(
+        [sys.executable, "bench.py"], capture_output=True, text=True,
+        cwd=REPO, env=env, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["metric"].startswith("fft3d_")
+    records, dropped = regress.load_history(hist)
+    assert dropped == 0 and len(records) == 1
+    rec = records[0]
+    assert rec["metric"] == line["metric"]
+    assert rec["value"] == line["value"]
+    assert rec["source"] == "bench.py"
+    assert rec["device_kind"] == "cpu"  # a CPU record, never TPU-keyed

@@ -70,6 +70,88 @@ def test_report_reads_chrome_and_text_mixed(tmp_path, capsys):
     assert agg["t0_fft_yz"]["count"] == 1
 
 
+def test_report_skips_malformed_text_rows(tmp_path, capsys):
+    """A watchdog-killed worker leaves a truncated text log: parseable
+    rows survive, the broken tail is counted on stderr, nothing raises."""
+    log = tmp_path / "t_0.log"
+    log.write_text(
+        "process 0 of 2\n"
+        "      0.000000      0.001000  t2_exchange\n"
+        "      0.002000      not_a_number  t0_fft_yz\n"
+        "      0.0030\n")  # cut mid-row by the kill
+    events = report.merge_files([str(log)])
+    assert [e["name"] for e in events] == ["t2_exchange"]
+    assert "skipped 2 malformed event(s)" in capsys.readouterr().err
+
+
+def test_report_recovers_truncated_chrome_json(tmp_path, capsys):
+    """A chrome trace cut mid-write (the partial-log case) recovers every
+    complete event before the cut instead of raising."""
+    doc = {"traceEvents": [
+        {"name": "t0_fft_yz", "ph": "X", "pid": 0, "tid": 0,
+         "ts": 0.0, "dur": 5.0},
+        {"name": "t2_exchange", "ph": "X", "pid": 0, "tid": 0,
+         "ts": 10.0, "dur": 7.0},
+        {"name": "t3_fft_x", "ph": "X", "pid": 0, "tid": 0,
+         "ts": 20.0, "dur": 9.0},
+    ]}
+    text = json.dumps(doc)
+    cut = text.index('{"name": "t3_fft_x"') - 2  # kill mid-array
+    trunc = tmp_path / "c_0.json"
+    trunc.write_text(text[:cut])
+    events = report.merge_files([str(trunc)])
+    assert {e["name"] for e in events} == {"t0_fft_yz", "t2_exchange"}
+    assert "malformed event(s)" in capsys.readouterr().err
+    agg = report.aggregate(events)
+    assert agg["t2_exchange"]["total"] == pytest.approx(7e-6)
+
+
+def test_report_drops_events_missing_ts_dur(tmp_path, capsys):
+    """Chrome events without usable ts/dur are dropped and counted, not
+    defaulted into the aggregate (and never a KeyError)."""
+    f = tmp_path / "c_0.json"
+    f.write_text(json.dumps({"traceEvents": [
+        {"name": "good", "ph": "X", "pid": 0, "tid": 0,
+         "ts": 0.0, "dur": 5.0},
+        {"name": "no_dur", "ph": "X", "pid": 0, "tid": 0, "ts": 1.0},
+        {"name": "no_ts", "ph": "X", "pid": 0, "tid": 0, "dur": 1.0},
+        {"name": "null_ts", "ph": "X", "pid": 0, "tid": 0,
+         "ts": None, "dur": 1.0},
+        {"name": "open_b", "ph": "B", "pid": 0, "tid": 0, "ts": 2.0},
+    ]}))
+    events = report.load_events(str(f))
+    assert [e["name"] for e in events] == ["good"]
+    assert "skipped 4 malformed event(s)" in capsys.readouterr().err
+
+
+def test_format_table_sort_min_and_stable_ties():
+    agg = report.aggregate([
+        {"name": "b_stage", "pid": 0, "ts": 0.0, "dur": 3e6},
+        {"name": "c_stage", "pid": 0, "ts": 0.0, "dur": 3e6},
+        {"name": "a_stage", "pid": 0, "ts": 0.0, "dur": 3e6},
+        {"name": "d_small", "pid": 0, "ts": 0.0, "dur": 1e6},
+    ])
+    # min is a sortable column now; ties order by name, not dict order.
+    rows = report.format_table(agg, sort="min").splitlines()[1:]
+    assert [r.split()[0] for r in rows] == [
+        "a_stage", "b_stage", "c_stage", "d_small"]
+    rows = report.format_table(agg, sort="total").splitlines()[1:]
+    assert [r.split()[0] for r in rows] == [
+        "a_stage", "b_stage", "c_stage", "d_small"]
+
+
+def test_report_cli_merge_subcommand_explicit(tmp_path, capsys):
+    """The subcommand spelling and the bare backward-compat spelling of
+    merge agree."""
+    log = tmp_path / "t_0.log"
+    log.write_text("process 0 of 1\n      0.0  0.001  t2_exchange\n")
+    assert report.main(["merge", str(log)]) == 0
+    explicit = capsys.readouterr().out
+    assert report.main([str(log)]) == 0
+    assert capsys.readouterr().out == explicit
+    assert "t2_exchange" in explicit
+
+
 def test_observability_smoke_slab_chrome(tmp_path):
     """Tier-1 smoke, one run end to end: slab plan (cache miss), same
     call again (hit), execute with chrome tracing + metrics on ->
