@@ -248,7 +248,7 @@ def _roofline(shape, seconds, n_dev):
 
 
 def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
-          all_times, donated=False, stages=None):
+          all_times, donated=False, stages=None, overlap=None):
     import jax
 
     from distributedfft_tpu.utils.metrics import metrics_snapshot
@@ -271,6 +271,12 @@ def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
         "donated": donated,
         "all": {e: round(t, 6) for e, t in all_times.items()},
     }
+    if overlap not in (None, 1):
+        # Pipelined t2/t3 overlap (DFFT_OVERLAP / PlanOptions.overlap_
+        # chunks). Stamped into the line so the run-record store keys
+        # overlapped and monolithic runs into different baselines; default
+        # rows keep the old schema.
+        out["overlap"] = overlap
     if jax.default_backend() == "tpu":
         out.update(_roofline(shape, seconds, n_dev))
     if stages:
@@ -350,12 +356,15 @@ def _worker(shape_n: int) -> None:
             best = new_best
             _emit(shape_n, results[best][0], results[best][1], best, n_dev,
                   results[best][2].decomposition,
-                  {e: r[0] for e, r in results.items()})
+                  {e: r[0] for e, r in results.items()},
+                  overlap=getattr(results[best][2].options,
+                                  "overlap_chunks", None))
 
     if not results:
         raise SystemExit("no benchmark executor succeeded")
     seconds, max_err, plan = results[best]
     all_times = {e: r[0] for e, r in results.items()}
+    overlap = getattr(plan.options, "overlap_chunks", None)
     if fast:
         return
 
@@ -368,7 +377,7 @@ def _worker(shape_n: int) -> None:
         if dsec < seconds:
             seconds, donated = dsec, True
         _emit(shape_n, seconds, max_err, best, n_dev, plan.decomposition,
-              all_times, donated=donated)
+              all_times, donated=donated, overlap=overlap)
     except Exception:  # noqa: BLE001 — donation is a best-effort extra
         traceback.print_exc(limit=3, file=sys.stderr)
 
@@ -389,7 +398,7 @@ def _worker(shape_n: int) -> None:
 
                 stage_fns, _ = build_slab_stages(
                     mesh, shape, axis_name=mesh.axis_names[0], executor=base,
-                    forward=True,
+                    forward=True, overlap_chunks=overlap or 1,
                 )
             elif mesh is None:
                 from distributedfft_tpu.parallel.staged import (
@@ -406,7 +415,7 @@ def _worker(shape_n: int) -> None:
 
     if stages:
         _emit(shape_n, seconds, max_err, best, n_dev, plan.decomposition,
-              all_times, donated=donated, stages=stages)
+              all_times, donated=donated, stages=stages, overlap=overlap)
 
 
 # ----------------------------------------------------------- orchestrator
@@ -470,8 +479,10 @@ def _run_attempt(shape_n: int, timeout: float, extra_env: dict | None = None):
 
 def _last_recorded_tpu_line() -> dict | None:
     """Newest committed ``backend: "tpu"`` bench line from an earlier
-    campaign window (``benchmarks/results/hw_bench_campaign*.json``),
-    for labeling a transport-down CPU insurance line with the hardware
+    campaign window (any ``benchmarks/results/*bench*.json`` — the wide
+    filter means pruning campaign files can't silently drop provenance
+    so long as ANY bench artifact with a TPU line survives), for
+    labeling a transport-down CPU insurance line with the hardware
     evidence that does exist. Returns None when no such line is on
     disk. Never raises — this is best-effort metadata."""
     here = os.path.dirname(os.path.abspath(__file__))
@@ -484,7 +495,7 @@ def _last_recorded_tpu_line() -> dict | None:
     except OSError:
         return None
     for name in names:
-        if not (name.startswith("hw_bench") and name.endswith(".json")):
+        if not ("bench" in name and name.endswith(".json")):
             continue
         path = os.path.join(rdir, name)
         try:

@@ -16,10 +16,13 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     rc=$?
     log "campaign2 rc=$rc"
     # Belt: hardware rows must survive a builder-session crash — commit
-    # the benchmark artifacts the moment a campaign pass ends.
+    # the benchmark artifacts the moment a campaign pass ends. Pathspec
+    # commit: a concurrent session's staged files (outside these two
+    # dirs) must never be swept into the artifact commit.
     git add benchmarks/csv benchmarks/results >/dev/null 2>&1
-    git diff --cached --quiet 2>/dev/null || \
-      git commit -q -m "Hardware-window artifacts (auto-committed by campaign2_loop)"
+    git diff --cached --quiet -- benchmarks/csv benchmarks/results 2>/dev/null || \
+      git commit -q -m "Hardware-window artifacts (auto-committed by campaign2_loop)" \
+        -- benchmarks/csv benchmarks/results
     if [ $rc -eq 0 ]; then log "campaign2 COMPLETE"; exit 0; fi
     sleep 60
   else

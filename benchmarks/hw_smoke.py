@@ -456,11 +456,13 @@ def main() -> int:
                 continue
             passthru.append(a)
         # Jax-free backend guess for rows written before any child has
-        # reported (a child killed mid-init never prints backend=): an
-        # explicit cpu-platforms env must not stamp TIMEOUT rows into
-        # the committed TPU-evidence CSV.
+        # reported (a child killed mid-init never prints backend=):
+        # default "unknown" (its own CSV) — guessing "tpu" on a CPU box
+        # whose children all wedge would stamp TIMEOUT rows into the
+        # committed TPU-evidence csv/hw_smoke_tpu.csv. The first child
+        # that prints backend= upgrades the guess to the real backend.
         backend = ("cpu" if os.environ.get("JAX_PLATFORMS", "").strip()
-                   == "cpu" else "tpu")
+                   == "cpu" else "unknown")
         worst = 0
         for fn, _ in steps:
             remaining = deadline - time.time()

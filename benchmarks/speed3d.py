@@ -57,6 +57,13 @@ def parse_args(argv):
                         "(MPI_Alltoallv analog; TPU backend only, the CPU "
                         "test backend mirrors the dense path)")
     p.add_argument("-executor", default="xla", help="local FFT backend (xla|matmul|...)")
+    p.add_argument("-overlap", default=None, metavar="K",
+                   help="pipelined t2/t3 exchange/compute overlap: chunk "
+                        "count K or 'auto' (block-bytes heuristic); "
+                        "default reads DFFT_OVERLAP, unset = 1 "
+                        "(monolithic). Overlapped rows label the CSV "
+                        "algorithm column '<alg>+ovK' so sweeps never "
+                        "mix with monolithic baselines")
     p.add_argument("-r2c_axis", type=int, default=2, choices=(0, 1, 2),
                    help="halved axis for r2c/c2r (heFFTe r2c_direction)")
     p.add_argument("-ndev", type=int, default=None, help="device count (default: all)")
@@ -156,6 +163,9 @@ def main(argv=None) -> None:
     ndev = args.ndev or len(jax.devices())
     algorithm = ("ppermute" if args.p2p_pl
                  else "alltoallv" if args.a2av else "alltoall")
+    if args.overlap is not None and args.bricks:
+        raise SystemExit("-overlap applies to the chain exchanges; "
+                         "brick-edge plans (-bricks) do not take it")
 
     if args.r2c_axis != 2 and (args.kind != "r2c"
                                or args.precision == "dd"):
@@ -226,6 +236,8 @@ def main(argv=None) -> None:
     plan_fn = dfft.plan_dft_r2c_3d if args.kind == "r2c" else dfft.plan_dft_c2c_3d
     kw = dict(decomposition=decomposition, executor=args.executor,
               dtype=dtype, algorithm=algorithm)
+    if args.overlap is not None:
+        kw["overlap_chunks"] = args.overlap
     if args.kind == "r2c" and args.r2c_axis != 2:
         kw["r2c_axis"] = args.r2c_axis
     if args.bricks:
@@ -254,6 +266,9 @@ def main(argv=None) -> None:
                if (in_spec is not None or out_spec is not None) else kw)
         bwd = plan_fn(shape, mesh, direction=dfft.BACKWARD, **bkw)
     print(dfft.plan_info(fwd))
+    # Resolved overlap chunk count (env/"auto" -> int at plan time) — the
+    # staged builders and the CSV row must describe the same schedule.
+    overlap = getattr(fwd.options, "overlap_chunks", None) or 1
 
     # On-device deterministic init (the reference inits on device too,
     # fftSpeed3d_c2c.cpp:61-72). Sharding hints need divisible extents;
@@ -345,6 +360,7 @@ def main(argv=None) -> None:
             stages, _ = build_slab_stages(
                 fwd.mesh, shape, axis_name=fwd.mesh.axis_names[0],
                 executor=args.executor, algorithm=algorithm,
+                overlap_chunks=overlap,
             )
         elif fwd.decomposition == "slab":
             from distributedfft_tpu.parallel.staged import build_slab_rfft_stages
@@ -352,6 +368,7 @@ def main(argv=None) -> None:
             stages, _ = build_slab_rfft_stages(
                 fwd.mesh, shape, axis_name=fwd.mesh.axis_names[0],
                 executor=args.executor, algorithm=algorithm,
+                overlap_chunks=overlap,
             )
         elif args.kind == "c2c":
             from distributedfft_tpu.parallel.staged import build_pencil_stages
@@ -359,7 +376,7 @@ def main(argv=None) -> None:
             stages, _ = build_pencil_stages(
                 fwd.mesh, shape, row_axis=fwd.mesh.axis_names[0],
                 col_axis=fwd.mesh.axis_names[1], executor=args.executor,
-                algorithm=algorithm,
+                algorithm=algorithm, overlap_chunks=overlap,
             )
         else:
             from distributedfft_tpu.parallel.staged import (
@@ -369,7 +386,7 @@ def main(argv=None) -> None:
             stages, _ = build_pencil_rfft_stages(
                 fwd.mesh, shape, row_axis=fwd.mesh.axis_names[0],
                 col_axis=fwd.mesh.axis_names[1], executor=args.executor,
-                algorithm=algorithm,
+                algorithm=algorithm, overlap_chunks=overlap,
             )
         if stages is not None:
             stage_times, _ = time_staged(stages, x, iters=args.iters)
@@ -397,7 +414,8 @@ def main(argv=None) -> None:
         kind = (f"r2c_axis{args.r2c_axis}"
                 if args.kind == "r2c" and args.r2c_axis != 2 else args.kind)
         rec.record(kind, args.precision, *shape, ndev, deco,
-                   algorithm, _executor_label(args.executor),
+                   _algorithm_label(algorithm, overlap),
+                   _executor_label(args.executor),
                    f"{seconds:.6f}", f"{gf:.1f}", f"{max_err:.3e}")
     _print_telemetry(args)
     if args.trace:
@@ -417,30 +435,53 @@ def _print_telemetry(args) -> None:
     print("telemetry " + json.dumps(dfft.metrics_snapshot()))
 
 
+def _algorithm_label(algorithm: str, overlap: int | None) -> str:
+    """Algorithm column label with the overlap chunk count appended
+    (``alltoall+ov4``) when the pipelined t2/t3 mode is on — overlapped
+    sweep rows must never be indistinguishable from monolithic baselines.
+    Default (K=1) rows keep the bare name (schema unchanged)."""
+    return (f"{algorithm}+ov{overlap}"
+            if overlap and overlap != 1 else algorithm)
+
+
+# Env knobs appended to the executor label, gated on the executor
+# families that actually consult them at trace time: the DFFT_MM_* tiers
+# are read by the matmul engine and the Pallas kernels
+# (ops/dft_matmul.py::mm_precision/complex_mode), DFFT_DD_DEPTH by the
+# dd slicing engine only. A leftover env var from an earlier sweep step
+# must not mislabel an 'xla' row as 'xla[gauss]'.
+_MM_EXECUTORS = ("matmul", "pallas")
+_DD_EXECUTORS = ("dd",)  # the dd tier records executor "dd-mxu"
+
+
 def _executor_label(executor: str) -> str:
-    """Executor column label with any active trace-time MXU knobs
-    appended (e.g. ``matmul[high+gauss+split=4x128]`` — ``+``-joined:
-    a comma would split the CSV field) — sweep rows driven by env
-    (DFFT_MM_*) must be self-describing, not distinguishable only by
-    which campaign step appended them. Default rows keep the bare name
-    (schema unchanged)."""
+    """Executor column label with the active trace-time knobs of THIS
+    executor family appended (e.g. ``matmul[high+gauss+split=4x128]`` —
+    ``+``-joined: a comma would split the CSV field) — sweep rows driven
+    by env (DFFT_MM_*, DFFT_DD_DEPTH) must be self-describing, not
+    distinguishable only by which campaign step appended them. Executors
+    that never consult a knob (xla, xla_minor) keep the bare name, and
+    default rows keep the old schema."""
     import os
 
+    base = executor.split(":", 1)[0]
     knobs = []
-    prec = os.environ.get("DFFT_MM_PRECISION", "").strip().lower()
-    if prec and prec != "highest":
-        knobs.append(prec)
-    if os.environ.get("DFFT_MM_COMPLEX", "").strip().lower() == "gauss":
-        knobs.append("gauss")
-    split = os.environ.get("DFFT_MM_SPLIT", "").strip()
-    if split:  # multi-entry values are comma-separated (512=4x128,...)
-        knobs.append(f"split={split.replace(',', ';')}")
-    dmax = os.environ.get("DFFT_MM_DIRECT_MAX", "").strip()
-    if dmax:
-        knobs.append(f"dmax={dmax}")
-    depth = os.environ.get("DFFT_DD_DEPTH", "").strip()
-    if depth:  # the dd tier's slice-depth knob (campaign-swept)
-        knobs.append(f"depth={depth.replace(',', ';')}")
+    if base.startswith(_MM_EXECUTORS):
+        prec = os.environ.get("DFFT_MM_PRECISION", "").strip().lower()
+        if prec and prec != "highest":
+            knobs.append(prec)
+        if os.environ.get("DFFT_MM_COMPLEX", "").strip().lower() == "gauss":
+            knobs.append("gauss")
+        split = os.environ.get("DFFT_MM_SPLIT", "").strip()
+        if split:  # multi-entry values are comma-separated (512=4x128,...)
+            knobs.append(f"split={split.replace(',', ';')}")
+        dmax = os.environ.get("DFFT_MM_DIRECT_MAX", "").strip()
+        if dmax:
+            knobs.append(f"dmax={dmax}")
+    if base.startswith(_DD_EXECUTORS):
+        depth = os.environ.get("DFFT_DD_DEPTH", "").strip()
+        if depth:  # the dd tier's slice-depth knob (campaign-swept)
+            knobs.append(f"depth={depth.replace(',', ';')}")
     return f"{executor}[{'+'.join(knobs)}]" if knobs else executor
 
 
@@ -472,6 +513,10 @@ def _run_dd(args, shape, ndev) -> None:
     for flag in ("grid", "ingrid", "outgrid", "a2av", "p2p_pl"):
         if getattr(args, flag, None):
             raise SystemExit(f"-{flag} is not available at the dd tier")
+    from distributedfft_tpu.plan_logic import resolve_overlap_chunks
+
+    overlap = (1 if args.bricks or ndev <= 1 else
+               resolve_overlap_chunks(args.overlap, shape=shape, ndev=ndev))
     if args.bricks and args.staged:
         print("note: -staged is not available for brick plans; ignoring",
               file=sys.stderr)
@@ -504,8 +549,9 @@ def _run_dd(args, shape, ndev) -> None:
             mesh = dfft.make_mesh((r, c))
         else:
             mesh = dfft.make_mesh(ndev) if ndev > 1 else None
-        fwd = dfft.plan_dd_dft_c2c_3d(shape, mesh)
-        bwd = dfft.plan_dd_dft_c2c_3d(shape, mesh, direction=dfft.BACKWARD)
+        fwd = dfft.plan_dd_dft_c2c_3d(shape, mesh, overlap_chunks=overlap)
+        bwd = dfft.plan_dd_dft_c2c_3d(shape, mesh, direction=dfft.BACKWARD,
+                                      overlap_chunks=overlap)
     print(f"decomposition: {fwd.decomposition}")
     print("precision: dd (double-double over exact-sliced bf16 matmuls)")
 
@@ -571,10 +617,11 @@ def _run_dd(args, shape, ndev) -> None:
         elif len(mesh.axis_names) > 1:
             stages, _ = build_dd_pencil_stages(
                 mesh, shape, row_axis=mesh.axis_names[0],
-                col_axis=mesh.axis_names[1])
+                col_axis=mesh.axis_names[1], overlap_chunks=overlap)
         else:
             stages, _ = build_dd_slab_stages(
-                mesh, shape, axis_name=mesh.axis_names[0])
+                mesh, shape, axis_name=mesh.axis_names[0],
+                overlap_chunks=overlap)
         stage_times, _ = time_staged(stages, (hi, lo), iters=args.iters)
 
     max_err = float("nan")
@@ -596,7 +643,8 @@ def _run_dd(args, shape, ndev) -> None:
             "algorithm", "executor", "seconds", "gflops", "max_err",
         ))
         rec.record(args.kind, "dd", *shape, ndev, fwd.decomposition,
-                   "alltoall", _executor_label("dd-mxu"),
+                   _algorithm_label("alltoall", overlap),
+                   _executor_label("dd-mxu"),
                    f"{seconds:.6f}", f"{gf:.1f}", f"{max_err:.3e}")
     _print_telemetry(args)
 

@@ -53,6 +53,7 @@ from .plan_logic import (
     PlanOptions,
     io_boxes,
     logic_plan3d,
+    resolve_overlap_chunks,
     spec_entries as _spec_entries_impl,
 )
 from .parallel.pencil import PencilSpec, build_pencil_fft3d, build_pencil_rfft3d
@@ -157,10 +158,11 @@ def _resolve_options(
     donate: bool,
     algorithm: str,
     options: PlanOptions | None,
+    overlap_chunks: int | str | None = None,
 ) -> PlanOptions:
     if options is not None:
         if (decomposition is not None or executor != "xla" or donate
-                or algorithm != "alltoall"):
+                or algorithm != "alltoall" or overlap_chunks is not None):
             raise ValueError(
                 "pass either options= or individual plan keywords, not both"
             )
@@ -170,6 +172,7 @@ def _resolve_options(
         algorithm=algorithm,
         executor=executor,
         donate=donate,
+        overlap_chunks=overlap_chunks,
     )
 
 
@@ -317,6 +320,7 @@ def plan_dft_c2c_3d(
     dtype: Any = None,
     donate: bool = False,
     algorithm: str = "alltoall",
+    overlap_chunks: int | str | None = None,
     options: PlanOptions | None = None,
     in_spec: P | None = None,
     out_spec: P | None = None,
@@ -345,9 +349,14 @@ def plan_dft_c2c_3d(
     the reference's bufferDev ping-pong, halving HBM footprint for big
     grids) at the cost of repeat-execution on the same array; the default
     keeps FFTW-style repeatable-execute semantics.
+
+    ``overlap_chunks`` enables the pipelined exchange/compute overlap
+    (int K, ``"auto"``, or None -> ``DFFT_OVERLAP`` env; see
+    :class:`~.plan_logic.PlanOptions`). K=1 is today's monolithic chain.
     """
     shape, forward = _check_direction(shape, direction)
-    opts = _resolve_options(decomposition, executor, donate, algorithm, options)
+    opts = _resolve_options(decomposition, executor, donate, algorithm,
+                            options, overlap_chunks)
     if opts.executor == "auto":
         return _auto_plan(
             functools.partial(plan_dft_c2c_3d, shape, mesh), opts,
@@ -372,6 +381,7 @@ def plan_dft_c2c_3d(
             executor=opts.executor, forward=forward, donate=opts.donate,
             algorithm=opts.algorithm,
             in_axis=lp.slab_axes[0], out_axis=lp.slab_axes[1],
+            overlap_chunks=lp.options.overlap_chunks,
         )
     else:
         row, col = lp.mesh.axis_names[:2]
@@ -380,6 +390,7 @@ def plan_dft_c2c_3d(
             executor=opts.executor, forward=forward, donate=opts.donate,
             algorithm=opts.algorithm,
             perm=lp.pencil_perm, order=lp.pencil_order,
+            overlap_chunks=lp.options.overlap_chunks,
         )
 
     in_sh, out_sh = _shardings(lp, spec)
@@ -823,6 +834,7 @@ def plan_dft_r2c_3d(
     dtype: Any = None,
     donate: bool = False,
     algorithm: str = "alltoall",
+    overlap_chunks: int | str | None = None,
     options: PlanOptions | None = None,
     in_spec: P | None = None,
     out_spec: P | None = None,
@@ -846,11 +858,13 @@ def plan_dft_r2c_3d(
         return _r2c_axis_wrapped(
             shape, mesh, r2c_axis, direction=direction,
             decomposition=decomposition, executor=executor, dtype=dtype,
-            donate=donate, algorithm=algorithm, options=options,
+            donate=donate, algorithm=algorithm,
+            overlap_chunks=overlap_chunks, options=options,
             in_spec=in_spec, out_spec=out_spec,
         )
     shape, forward = _check_direction(shape, direction)
-    opts = _resolve_options(decomposition, executor, donate, algorithm, options)
+    opts = _resolve_options(decomposition, executor, donate, algorithm,
+                            options, overlap_chunks)
     if opts.donate:
         # r2c/c2r buffers can never alias (real world vs complex
         # half-spectrum differ in dtype and size), so donation would
@@ -894,6 +908,7 @@ def plan_dft_r2c_3d(
             lp.mesh, shape, axis_name=lp.mesh.axis_names[0],
             executor=opts.executor, forward=forward, donate=opts.donate,
             algorithm=opts.algorithm,
+            overlap_chunks=lp.options.overlap_chunks,
         )
     else:
         row, col = lp.mesh.axis_names[:2]
@@ -901,6 +916,7 @@ def plan_dft_r2c_3d(
             lp.mesh, shape, row_axis=row, col_axis=col,
             executor=opts.executor, forward=forward, donate=opts.donate,
             algorithm=opts.algorithm,
+            overlap_chunks=lp.options.overlap_chunks,
         )
 
     if (in_spec is not None or out_spec is not None) and lp.mesh is None:
@@ -968,7 +984,7 @@ def _chain_convention_note(e: Exception, axis: int) -> ValueError:
 
 def _r2c_axis_wrapped(shape, mesh, axis: int, *, direction, decomposition,
                       executor, dtype, donate, algorithm, options, in_spec,
-                      out_spec) -> Plan3D:
+                      out_spec, overlap_chunks=None) -> Plan3D:
     """r2c/c2r with the halved axis != 2 (heFFTe ``r2c_direction`` 0/1):
     the canonical chain (real axis = 2) runs on a transposed view.
     Caller-facing metadata — shapes, shardings, boxes — is permuted back
@@ -986,7 +1002,8 @@ def _r2c_axis_wrapped(shape, mesh, axis: int, *, direction, decomposition,
         inner = plan_dft_r2c_3d(
             pshape, mesh, direction=direction, decomposition=decomposition,
             executor=executor, dtype=dtype, donate=donate,
-            algorithm=algorithm, options=options,
+            algorithm=algorithm, overlap_chunks=overlap_chunks,
+            options=options,
             in_spec=_permute_spec3(in_spec, perm),
             out_spec=_permute_spec3(out_spec, perm),
         )
@@ -1073,6 +1090,7 @@ def plan_dd_dft_c2c_3d(
     *,
     direction: int = FORWARD,
     donate: bool = False,
+    overlap_chunks: int | str | None = None,
 ) -> DDPlan3D:
     """Create a 3D C2C FFT plan at the emulated double-precision tier.
 
@@ -1081,7 +1099,9 @@ def plan_dd_dft_c2c_3d(
     (both dd components through the same collectives,
     :mod:`..parallel.ddslab`). The accuracy analog of the reference's
     f64 ``fft_mpi_plan_dft_c2c_3d`` on hardware without f64 (measured
-    ~1e-13 forward / <1e-11 roundtrip)."""
+    ~1e-13 forward / <1e-11 roundtrip). ``overlap_chunks`` pipelines
+    each exchange under the downstream dd FFT exactly like the c64 tier
+    (int K, ``"auto"``, or None -> ``DFFT_OVERLAP``)."""
     from .ops import ddfft
 
     shape, forward = _check_direction(shape, direction)
@@ -1097,12 +1117,15 @@ def plan_dd_dft_c2c_3d(
         from .parallel.mesh import make_mesh
 
         mesh = make_mesh(mesh)
+    overlap = resolve_overlap_chunks(
+        overlap_chunks, shape=shape, ndev=math.prod(mesh.devices.shape))
     if len(mesh.axis_names) == 1:
         from .parallel.ddslab import build_dd_slab_fft3d
 
         fn, spec = build_dd_slab_fft3d(mesh, shape, forward=forward,
                                        axis_name=mesh.axis_names[0],
-                                       donate=donate)
+                                       donate=donate,
+                                       overlap_chunks=overlap)
         return DDPlan3D(
             shape=shape, direction=direction, decomposition="slab",
             mesh=mesh, fn=fn,
@@ -1115,7 +1138,7 @@ def plan_dd_dft_c2c_3d(
         row, col = mesh.axis_names[:2]
         fn, spec = build_dd_pencil_fft3d(
             mesh, shape, row_axis=row, col_axis=col, forward=forward,
-            donate=donate)
+            donate=donate, overlap_chunks=overlap)
         return DDPlan3D(
             shape=shape, direction=direction, decomposition="pencil",
             mesh=mesh, fn=fn,
@@ -1237,6 +1260,7 @@ def plan_dd_dft_r2c_3d(
     direction: int = FORWARD,
     r2c_axis: int = 2,
     donate: bool = False,
+    overlap_chunks: int | str | None = None,
 ) -> DDPlan3D:
     """Real<->complex 3D plan at the emulated double tier — heFFTe's
     ``fft3d_r2c`` double gate on f32/bf16 hardware. ``shape`` is the
@@ -1254,7 +1278,8 @@ def plan_dd_dft_r2c_3d(
 
     if r2c_axis != 2:
         return _dd_r2c_axis_wrapped(shape, mesh, r2c_axis,
-                                    direction=direction)
+                                    direction=direction,
+                                    overlap_chunks=overlap_chunks)
     shape, forward = _check_direction(shape, direction)
     # r2c/c2r buffers can never alias (f32 real world vs complex64
     # half-spectrum differ in dtype and size on every decomposition), so
@@ -1273,11 +1298,14 @@ def plan_dd_dft_r2c_3d(
         from .parallel.mesh import make_mesh
 
         mesh = make_mesh(mesh)
+    overlap = resolve_overlap_chunks(
+        overlap_chunks, shape=shape, ndev=math.prod(mesh.devices.shape))
     if len(mesh.axis_names) == 1:
         from .parallel.ddslab import build_dd_slab_rfft3d
 
         fn, spec = build_dd_slab_rfft3d(mesh, shape, forward=forward,
-                                        axis_name=mesh.axis_names[0])
+                                        axis_name=mesh.axis_names[0],
+                                        overlap_chunks=overlap)
         return DDPlan3D(
             shape=shape, direction=direction, decomposition="slab",
             mesh=mesh, fn=fn,
@@ -1289,7 +1317,8 @@ def plan_dd_dft_r2c_3d(
 
         row, col = mesh.axis_names[:2]
         fn, spec = build_dd_pencil_rfft3d(
-            mesh, shape, row_axis=row, col_axis=col, forward=forward)
+            mesh, shape, row_axis=row, col_axis=col, forward=forward,
+            overlap_chunks=overlap)
         return DDPlan3D(
             shape=shape, direction=direction, decomposition="pencil",
             mesh=mesh, fn=fn,
@@ -1305,7 +1334,8 @@ def plan_dd_dft_c2r_3d(shape, mesh=None, **kw) -> DDPlan3D:
     return plan_dd_dft_r2c_3d(shape, mesh, **kw)
 
 
-def _dd_r2c_axis_wrapped(shape, mesh, axis: int, *, direction) -> DDPlan3D:
+def _dd_r2c_axis_wrapped(shape, mesh, axis: int, *, direction,
+                         overlap_chunks=None) -> DDPlan3D:
     """dd r2c/c2r with the halved axis != 2: the canonical chain runs on
     a transposed view of BOTH dd components; shapes and shardings are
     permuted back to the caller's convention (the
@@ -1316,7 +1346,8 @@ def _dd_r2c_axis_wrapped(shape, mesh, axis: int, *, direction) -> DDPlan3D:
     perm = _swap_perm(axis)
     pshape = tuple(shape[p] for p in perm)
     try:
-        inner = plan_dd_dft_r2c_3d(pshape, mesh, direction=direction)
+        inner = plan_dd_dft_r2c_3d(pshape, mesh, direction=direction,
+                                   overlap_chunks=overlap_chunks)
     except ValueError as e:
         raise _chain_convention_note(e, axis) from e
 
@@ -1348,7 +1379,7 @@ _PLAN_ENV_KNOBS = (
     "DFFT_AUTO_EXECUTORS", "DFFT_MM_PRECISION", "DFFT_MM_COMPLEX",
     "DFFT_MM_SPLIT", "DFFT_MM_DIRECT_MAX", "DFFT_DD_DEPTH",
     "DFFT_PALLAS_PACK", "DFFT_PALLAS_SPLIT", "DFFT_XLA_REAL",
-    "DFFT_FORCE_REAL_LOWERING",
+    "DFFT_FORCE_REAL_LOWERING", "DFFT_OVERLAP",
 )
 
 

@@ -36,7 +36,9 @@ except ImportError:  # pragma: no cover
 from ..geometry import pad_to  # noqa: F401 — used by the r2c chains
 from ..ops import ddfft
 from ..utils.trace import add_trace, trace_stages
-from .exchange import _crop_axis, _pad_axis, exchange_uneven
+from .exchange import (
+    _crop_axis, _pad_axis, exchange_chunked, exchange_overlapped,
+)
 from .pencil import PencilSpec, chain_geometry
 from .slab import SlabSpec
 
@@ -62,6 +64,7 @@ def build_dd_slab_fft3d(
     forward: bool = True,
     algorithm: str = "alltoall",
     donate: bool = False,
+    overlap_chunks: int = 1,
 ) -> tuple[Callable, SlabSpec]:
     """Jitted distributed dd 3D C2C transform over a 1D mesh.
 
@@ -82,6 +85,13 @@ def build_dd_slab_fft3d(
     local_axes = tuple(a for a in range(3) if a != in_axis)
     platform = mesh.devices.flat[0].platform
 
+    def t3_chunk(pair):
+        hi, lo = pair
+        hi = _crop_axis(hi, in_axis, n_in)
+        lo = _crop_axis(lo, in_axis, n_in)
+        # t3: dd transform of the now-local lines.
+        return ddfft.fft_axis_dd(hi, lo, in_axis, forward=forward)
+
     def local_fn(hi, lo):
         # t0: dd transforms of the device-local planes.
         with add_trace("t0_dd_fft_planes"):
@@ -89,17 +99,14 @@ def build_dd_slab_fft3d(
                 hi, lo = ddfft.fft_axis_dd(hi, lo, ax, forward=forward)
         # t1+t2: both dd components ride the same global transpose the
         # c64 pipeline uses (XLA schedules the two collectives back to
-        # back on the ICI).
-        with add_trace(f"t2_exchange_{axis_name}"):
-            kw = dict(split_axis=out_axis, concat_axis=in_axis, axis_size=p,
-                      algorithm=algorithm, platform=platform)
-            hi = exchange_uneven(hi, axis_name, **kw)
-            lo = exchange_uneven(lo, axis_name, **kw)
-        with add_trace("t3_dd_fft_lines"):
-            hi = _crop_axis(hi, in_axis, n_in)
-            lo = _crop_axis(lo, in_axis, n_in)
-            # t3: dd transform of the now-local lines.
-            return ddfft.fft_axis_dd(hi, lo, in_axis, forward=forward)
+        # back on the ICI); overlap_chunks > 1 pipelines each chunk's
+        # pair of collectives under the previous chunk's t3.
+        return exchange_overlapped(
+            (hi, lo), axis_name, split_axis=out_axis, concat_axis=in_axis,
+            axis_size=p, algorithm=algorithm, platform=platform,
+            compute=t3_chunk, overlap_chunks=overlap_chunks,
+            exchange_name=f"t2_exchange_{axis_name}",
+            compute_name="t3_dd_fft_lines")
 
     in_spec, out_spec = spec.in_pspec, spec.out_pspec
     mapped = _shard_map(local_fn, mesh=mesh,
@@ -128,6 +135,7 @@ def build_dd_slab_rfft3d(
     axis_name: str = "slab",
     forward: bool = True,
     algorithm: str = "alltoall",
+    overlap_chunks: int = 1,
 ) -> tuple[Callable, SlabSpec]:
     """Slab-distributed dd r2c (forward) / c2r (backward) — the double
     tier of heFFTe's distributed ``fft3d_r2c``. The real axis (2) is
@@ -150,6 +158,12 @@ def build_dd_slab_rfft3d(
 
     if forward:
 
+        def t3_chunk(pair):
+            chi, clo = pair
+            chi = _crop_axis(chi, 0, n0)
+            clo = _crop_axis(clo, 0, n0)
+            return ddfft.fft_axis_dd(chi, clo, 0)          # t3: X lines
+
         def local_fn(hi, lo):  # real f32 [n0p/p, N1, N2] per device
             with add_trace("t0_dd_r2c_zy"):
                 chi = lax.complex(hi, jnp.zeros_like(hi))
@@ -157,31 +171,34 @@ def build_dd_slab_rfft3d(
                 chi, clo = ddfft.fft_axis_dd(chi, clo, 2)  # t0a: Z lines
                 chi, clo = chi[..., :h], clo[..., :h]      # r2c shrink
                 chi, clo = ddfft.fft_axis_dd(chi, clo, 1)  # t0b: Y lines
-            with add_trace(f"t2_exchange_{axis_name}"):
-                kw = dict(split_axis=1, concat_axis=0, axis_size=p,
-                          algorithm=algorithm, platform=platform)
-                chi = exchange_uneven(chi, axis_name, **kw)
-                clo = exchange_uneven(clo, axis_name, **kw)
-            with add_trace("t3_dd_fft_x"):
-                chi = _crop_axis(chi, 0, n0)
-                clo = _crop_axis(clo, 0, n0)
-                return ddfft.fft_axis_dd(chi, clo, 0)      # t3: X lines
+            return exchange_overlapped(
+                (chi, clo), axis_name, split_axis=1, concat_axis=0,
+                axis_size=p, algorithm=algorithm, platform=platform,
+                compute=t3_chunk, overlap_chunks=overlap_chunks,
+                exchange_name=f"t2_exchange_{axis_name}",
+                compute_name="t3_dd_fft_x")
 
         pre = lambda v: _pad_axis(v, 0, n0p)  # noqa: E731
         post = lambda v: _crop_axis(v, 1, n1)  # noqa: E731
     else:
 
+        def t0_chunk(pair):
+            hi, lo = pair
+            hi = _crop_axis(hi, 1, n1)
+            lo = _crop_axis(lo, 1, n1)
+            return ddfft.fft_axis_dd(hi, lo, 1, forward=False)
+
         def local_fn(hi, lo):  # complex dd [N0, n1p/p, h] per device
             with add_trace("t3_dd_ifft_x"):
                 hi, lo = ddfft.fft_axis_dd(hi, lo, 0, forward=False)
-            with add_trace(f"t2_exchange_{axis_name}"):
-                kw = dict(split_axis=0, concat_axis=1, axis_size=p,
-                          algorithm=algorithm, platform=platform)
-                hi = exchange_uneven(hi, axis_name, **kw)
-                lo = exchange_uneven(lo, axis_name, **kw)
-            hi = _crop_axis(hi, 1, n1)
-            lo = _crop_axis(lo, 1, n1)
-            hi, lo = ddfft.fft_axis_dd(hi, lo, 1, forward=False)
+            # The half-spectrum mirror + inverse Z transform run along the
+            # bystander (chunk) axis, so they follow the chunked merge.
+            hi, lo = exchange_overlapped(
+                (hi, lo), axis_name, split_axis=0, concat_axis=1,
+                axis_size=p, algorithm=algorithm, platform=platform,
+                compute=t0_chunk, overlap_chunks=overlap_chunks,
+                exchange_name=f"t2_exchange_{axis_name}",
+                compute_name="t0_dd_ifft_y")
             hi, lo = ddfft.fft_axis_dd(
                 ddfft.mirror_half_spectrum(hi, n2, axis=2),
                 ddfft.mirror_half_spectrum(lo, n2, axis=2),
@@ -215,6 +232,7 @@ def build_dd_pencil_rfft3d(
     col_axis: str = "col",
     forward: bool = True,
     algorithm: str = "alltoall",
+    overlap_chunks: int = 1,
 ) -> tuple[Callable, PencilSpec]:
     """Pencil-distributed dd r2c (forward) / c2r (backward) — the last
     cell of the dd decomposition matrix (mirrors the c64
@@ -237,49 +255,71 @@ def build_dd_pencil_rfft3d(
 
     if forward:
 
+        def fft_y(pair):
+            chi, clo = pair
+            chi = _crop_axis(chi, 1, n1)
+            clo = _crop_axis(clo, 1, n1)
+            return ddfft.fft_axis_dd(chi, clo, 1)       # Y lines
+
+        def fft_x(pair):
+            chi, clo = pair
+            chi = _crop_axis(chi, 0, n0)
+            clo = _crop_axis(clo, 0, n0)
+            return ddfft.fft_axis_dd(chi, clo, 0)       # t3: X lines
+
         def local_fn(hi, lo):  # real f32 [n0p/rows, n1pc/cols, N2]
             chi = lax.complex(hi, jnp.zeros_like(hi))
             clo = lax.complex(lo, jnp.zeros_like(lo))
             chi, clo = ddfft.fft_axis_dd(chi, clo, 2)   # t0: real Z lines
             chi, clo = chi[..., :h], clo[..., :h]       # r2c shrink
-            kw = dict(split_axis=2, concat_axis=1, axis_size=cols,
-                      algorithm=algorithm, platform=platform)
-            chi = exchange_uneven(chi, col_axis, **kw)
-            clo = exchange_uneven(clo, col_axis, **kw)
-            chi = _crop_axis(chi, 1, n1)
-            clo = _crop_axis(clo, 1, n1)
-            chi, clo = ddfft.fft_axis_dd(chi, clo, 1)   # Y lines
-            kw = dict(split_axis=1, concat_axis=0, axis_size=rows,
-                      algorithm=algorithm, platform=platform)
-            chi = exchange_uneven(chi, row_axis, **kw)
-            clo = exchange_uneven(clo, row_axis, **kw)
-            chi = _crop_axis(chi, 0, n0)
-            clo = _crop_axis(clo, 0, n0)
-            return ddfft.fft_axis_dd(chi, clo, 0)       # t3: X lines
+            pair = exchange_overlapped(
+                (chi, clo), col_axis, split_axis=2, concat_axis=1,
+                axis_size=cols, algorithm=algorithm, platform=platform,
+                compute=fft_y, overlap_chunks=overlap_chunks,
+                exchange_name=f"t2a_exchange_{col_axis}",
+                compute_name="t1_dd_fft_y")
+            return exchange_overlapped(
+                pair, row_axis, split_axis=1, concat_axis=0,
+                axis_size=rows, algorithm=algorithm, platform=platform,
+                compute=fft_x, overlap_chunks=overlap_chunks,
+                exchange_name=f"t2b_exchange_{row_axis}",
+                compute_name="t3_dd_fft_x")
 
         pre = lambda v: _pad_axis(_pad_axis(v, 0, n0p), 1, n1pc)  # noqa: E731
         post = lambda v: _crop_axis(_crop_axis(v, 1, n1), 2, h)  # noqa: E731
     else:
 
-        def local_fn(hi, lo):  # complex dd [N0, n1pr/rows, n2hp/cols]
-            hi, lo = ddfft.fft_axis_dd(hi, lo, 0, forward=False)
-            kw = dict(split_axis=0, concat_axis=1, axis_size=rows,
-                      algorithm=algorithm, platform=platform)
-            hi = exchange_uneven(hi, row_axis, **kw)
-            lo = exchange_uneven(lo, row_axis, **kw)
+        def ifft_y(pair):
+            hi, lo = pair
             hi = _crop_axis(hi, 1, n1)
             lo = _crop_axis(lo, 1, n1)
-            hi, lo = ddfft.fft_axis_dd(hi, lo, 1, forward=False)
-            kw = dict(split_axis=1, concat_axis=2, axis_size=cols,
-                      algorithm=algorithm, platform=platform)
-            hi = exchange_uneven(hi, col_axis, **kw)
-            lo = exchange_uneven(lo, col_axis, **kw)
+            return ddfft.fft_axis_dd(hi, lo, 1, forward=False)
+
+        def c2r_z(pair):
+            # mirror + inverse Z transform axis 2 (fully local after this
+            # exchange); the chunk axis is 0, so per-chunk c2r is exact.
+            hi, lo = pair
             hi = _crop_axis(hi, 2, h)
             lo = _crop_axis(lo, 2, h)
-            hi, lo = ddfft.fft_axis_dd(
+            return ddfft.fft_axis_dd(
                 ddfft.mirror_half_spectrum(hi, n2, axis=2),
                 ddfft.mirror_half_spectrum(lo, n2, axis=2),
                 2, forward=False)
+
+        def local_fn(hi, lo):  # complex dd [N0, n1pr/rows, n2hp/cols]
+            hi, lo = ddfft.fft_axis_dd(hi, lo, 0, forward=False)
+            pair = exchange_overlapped(
+                (hi, lo), row_axis, split_axis=0, concat_axis=1,
+                axis_size=rows, algorithm=algorithm, platform=platform,
+                compute=ifft_y, overlap_chunks=overlap_chunks,
+                exchange_name=f"t2b_exchange_{row_axis}",
+                compute_name="t1_dd_ifft_y")
+            hi, lo = exchange_overlapped(
+                pair, col_axis, split_axis=1, concat_axis=2,
+                axis_size=cols, algorithm=algorithm, platform=platform,
+                compute=c2r_z, overlap_chunks=overlap_chunks,
+                exchange_name=f"t2a_exchange_{col_axis}",
+                compute_name="t0_dd_c2r_z")
             return jnp.real(hi), jnp.real(lo)
 
         pre = lambda v: _pad_axis(_pad_axis(v, 1, n1pr), 2, n2hp)  # noqa: E731
@@ -310,6 +350,7 @@ def build_dd_pencil_fft3d(
     forward: bool = True,
     algorithm: str = "alltoall",
     donate: bool = False,
+    overlap_chunks: int = 1,
 ) -> tuple[Callable, PencilSpec]:
     """Jitted distributed dd 3D C2C transform over a 2D (rows x cols)
     mesh — the canonical pencil chain (z-pencils -> x-pencils forward;
@@ -331,19 +372,25 @@ def build_dd_pencil_fft3d(
     exch_names = (f"t2a_exchange_{seq[0][0]}", f"t2b_exchange_{seq[1][0]}")
 
     def local_fn(hi, lo):
+        with add_trace(fft_names[0]):
+            hi, lo = ddfft.fft_axis_dd(hi, lo, seq[0][2], forward=forward)
+        pair = (hi, lo)
         for i, (mesh_ax, parts, split, concat) in enumerate(seq):
-            with add_trace(fft_names[i]):
-                hi, lo = ddfft.fft_axis_dd(hi, lo, split, forward=forward)
-            with add_trace(exch_names[i]):
-                kw = dict(split_axis=split, concat_axis=concat,
-                          axis_size=parts, algorithm=algorithm,
-                          platform=platform)
-                hi = exchange_uneven(hi, mesh_ax, **kw)
-                lo = exchange_uneven(lo, mesh_ax, **kw)
-                hi = _crop_axis(hi, concat, n[concat])
-                lo = _crop_axis(lo, concat, n[concat])
-        with add_trace("t3_dd_fft"):
-            return ddfft.fft_axis_dd(hi, lo, last_fft, forward=forward)
+            # Like the c64 pencil chain: each exchange pipelines under
+            # the dd FFT of its own concat axis (the next chain stage).
+            def post_fft(p_, concat=concat):
+                h, l = p_
+                h = _crop_axis(h, concat, n[concat])
+                l = _crop_axis(l, concat, n[concat])
+                return ddfft.fft_axis_dd(h, l, concat, forward=forward)
+
+            pair = exchange_overlapped(
+                pair, mesh_ax, split_axis=split, concat_axis=concat,
+                axis_size=parts, algorithm=algorithm, platform=platform,
+                compute=post_fft, overlap_chunks=overlap_chunks,
+                exchange_name=exch_names[i],
+                compute_name=fft_names[1] if i == 0 else "t3_dd_fft")
+        return pair
 
     in_spec, out_spec = spec.in_spec, spec.out_spec
     mapped = _shard_map(local_fn, mesh=mesh,
@@ -405,6 +452,7 @@ def build_dd_slab_stages(
     *,
     axis_name: str = "slab",
     algorithm: str = "alltoall",
+    overlap_chunks: int = 1,
 ) -> tuple[list, SlabSpec]:
     """Forward dd slab transform as separately-jitted t0/t2/t3 stages.
 
@@ -438,10 +486,11 @@ def build_dd_slab_stages(
         return smap(_dd_yz_planes, xs, xs)((hi, lo))
 
     def local_exchange(pair):
-        kw = dict(split_axis=1, concat_axis=0, axis_size=p,
-                  algorithm=algorithm, platform=platform)
-        return (exchange_uneven(pair[0], axis_name, **kw),
-                exchange_uneven(pair[1], axis_name, **kw))
+        return exchange_chunked(
+            pair, axis_name, split_axis=1, concat_axis=0, axis_size=p,
+            algorithm=algorithm, overlap_chunks=overlap_chunks,
+            uneven=True, platform=platform,
+            exchange_name="t2_all_to_all")
 
     def local_x(pair):
         hi, lo = pair
@@ -474,6 +523,7 @@ def build_dd_pencil_stages(
     row_axis: str = "row",
     col_axis: str = "col",
     algorithm: str = "alltoall",
+    overlap_chunks: int = 1,
 ):
     """Forward dd pencil transform as the five timed t0/t2a/t1/t2b/t3
     stages: the c64 pencil stage pipeline (``staged.build_pencil_stages``
@@ -493,4 +543,5 @@ def build_dd_pencil_stages(
 
     return build_pencil_stages(mesh, shape, row_axis=row_axis,
                                col_axis=col_axis, executor=dd_ex,
-                               algorithm=algorithm)
+                               algorithm=algorithm,
+                               overlap_chunks=overlap_chunks)

@@ -39,7 +39,7 @@ except ImportError:  # pragma: no cover
 from ..geometry import pad_to
 from ..ops.executors import get_c2r, get_executor, get_r2c
 from ..utils.trace import add_trace
-from .exchange import exchange_uneven
+from .exchange import exchange_overlapped
 from .slab import _L, _crop_axis, _pad_axis
 
 
@@ -139,12 +139,17 @@ def build_pencil_general(
     forward: bool = True,
     donate: bool = False,
     algorithm: str = "alltoall",
+    overlap_chunks: int = 1,
 ) -> tuple[Callable, PencilSpec]:
     """Build the jitted end-to-end pencil transform for ANY input layout
     permutation and exchange order (see :class:`PencilSpec` for the chain
     taxonomy). Uneven extents use the ceil-pad/crop scheme of :mod:`.slab`
     (pads only ever touch an axis while it is *not* being transformed at its
     true length).
+
+    ``overlap_chunks > 1`` pipelines each exchange under the FFT stage
+    that follows it, chunked along that exchange's bystander axis
+    (:func:`.exchange.exchange_overlapped`); both t2a and t2b overlap.
     """
     if sorted(perm) != [0, 1, 2]:
         raise ValueError(f"perm must be a permutation of (0, 1, 2), got {perm}")
@@ -165,16 +170,23 @@ def build_pencil_general(
     t3_name = f"t3_fft_{_L[last_fft]}"
 
     def local_fn(x):
+        with add_trace(fft_names[0]):
+            x = ex(x, (seq[0][2],), forward)             # t0: first fft
         for i, (mesh_ax, parts, split, concat) in enumerate(seq):
-            with add_trace(fft_names[i]):
-                x = ex(x, (split,), forward)
-            with add_trace(exch_names[i]):
-                x = exchange_uneven(x, mesh_ax, split_axis=split,
-                                    concat_axis=concat, axis_size=parts,
-                                    algorithm=algorithm)
-                x = _crop_axis(x, concat, n[concat])
-        with add_trace(t3_name):
-            return ex(x, (last_fft,), forward)
+            # The FFT following each exchange runs along that exchange's
+            # concat axis (the axis that just became local), so each
+            # exchange pipelines under its own downstream fft stage.
+            def post_fft(v, concat=concat):
+                v = _crop_axis(v, concat, n[concat])
+                return ex(v, (concat,), forward)
+
+            x = exchange_overlapped(
+                x, mesh_ax, split_axis=split, concat_axis=concat,
+                axis_size=parts, algorithm=algorithm, compute=post_fft,
+                overlap_chunks=overlap_chunks,
+                exchange_name=exch_names[i],
+                compute_name=fft_names[1] if i == 0 else t3_name)
+        return x
 
     in_spec, out_spec = spec.in_spec, spec.out_spec
 
@@ -221,6 +233,7 @@ def build_pencil_fft3d(
     algorithm: str = "alltoall",
     perm: tuple[int, int, int] | None = None,
     order: str | None = None,
+    overlap_chunks: int = 1,
 ) -> tuple[Callable, PencilSpec]:
     """Canonical-orientation wrapper over :func:`build_pencil_general`:
     forward maps z-pencils (``P(row, col, None)``) to x-pencils
@@ -234,7 +247,7 @@ def build_pencil_fft3d(
     return build_pencil_general(
         mesh, shape, perm=perm, order=order, row_axis=row_axis,
         col_axis=col_axis, executor=executor, forward=forward, donate=donate,
-        algorithm=algorithm,
+        algorithm=algorithm, overlap_chunks=overlap_chunks,
     )
 
 
@@ -248,6 +261,7 @@ def build_pencil_rfft3d(
     forward: bool = True,
     donate: bool = False,
     algorithm: str = "alltoall",
+    overlap_chunks: int = 1,
 ) -> tuple[Callable, PencilSpec]:
     """Pencil-decomposed r2c (forward) / c2r (backward) 3D transform.
 
@@ -278,40 +292,59 @@ def build_pencil_rfft3d(
 
     if forward:
 
+        def fft_y(v):
+            return ex(_crop_axis(v, 1, n1), (1,), True)   # Y lines
+
+        def fft_x(v):
+            return ex(_crop_axis(v, 0, n0), (0,), True)   # t3: X lines
+
         def local_fn(x):  # real [n0p/rows, n1pc/cols, N2]
             with add_trace("t0_r2c_z"):
                 y = r2c(x, 2)                           # t0: real Z lines
-            with add_trace(f"t2a_exchange_{col_axis}"):
-                y = exchange_uneven(y, col_axis, split_axis=2, concat_axis=1,
-                                    axis_size=cols, algorithm=algorithm)
-                y = _crop_axis(y, 1, n1)
-            with add_trace("t1_fft_y"):
-                y = ex(y, (1,), True)                   # Y lines
-            with add_trace(f"t2b_exchange_{row_axis}"):
-                y = exchange_uneven(y, row_axis, split_axis=1, concat_axis=0,
-                                    axis_size=rows, algorithm=algorithm)
-                y = _crop_axis(y, 0, n0)
-            with add_trace("t3_fft_x"):
-                return ex(y, (0,), True)                # t3: X lines
+            y = exchange_overlapped(
+                y, col_axis, split_axis=2, concat_axis=1, axis_size=cols,
+                algorithm=algorithm, compute=fft_y,
+                overlap_chunks=overlap_chunks,
+                exchange_name=f"t2a_exchange_{col_axis}",
+                compute_name="t1_fft_y")
+            return exchange_overlapped(
+                y, row_axis, split_axis=1, concat_axis=0, axis_size=rows,
+                algorithm=algorithm, compute=fft_x,
+                overlap_chunks=overlap_chunks,
+                exchange_name=f"t2b_exchange_{row_axis}",
+                compute_name="t3_fft_x")
 
         in_spec, out_spec = spec.in_spec, spec.out_spec
         pre = lambda x: _pad_axis(_pad_axis(x, 0, n0p), 1, n1pc)
         post = lambda y: _crop_axis(_crop_axis(y, 1, n1), 2, n2h)
     else:
 
+        def ifft_y(v):
+            return ex(_crop_axis(v, 1, n1), (1,), False)  # inverse Y lines
+
+        def crop_h(v):
+            # Per-chunk work after the last exchange is the crop only:
+            # chunking the c2r itself trips XLA:CPU's fft-thunk layout
+            # RET_CHECK (irfft on a sliced, non-dim0-major operand), so
+            # the real Z transform runs monolithically after the merge —
+            # the same structure as the slab c2r chain.
+            return _crop_axis(v, 2, n2h)
+
         def local_fn(y):  # complex [N0, n1pr/rows, n2hp/cols]
             with add_trace("t3_ifft_x"):
                 x = ex(y, (0,), False)                  # inverse X lines
-            with add_trace(f"t2b_exchange_{row_axis}"):
-                x = exchange_uneven(x, row_axis, split_axis=0, concat_axis=1,
-                                    axis_size=rows, algorithm=algorithm)
-                x = _crop_axis(x, 1, n1)
-            with add_trace("t1_ifft_y"):
-                x = ex(x, (1,), False)                  # inverse Y lines
-            with add_trace(f"t2a_exchange_{col_axis}"):
-                x = exchange_uneven(x, col_axis, split_axis=1, concat_axis=2,
-                                    axis_size=cols, algorithm=algorithm)
-                x = _crop_axis(x, 2, n2h)
+            x = exchange_overlapped(
+                x, row_axis, split_axis=0, concat_axis=1, axis_size=rows,
+                algorithm=algorithm, compute=ifft_y,
+                overlap_chunks=overlap_chunks,
+                exchange_name=f"t2b_exchange_{row_axis}",
+                compute_name="t1_ifft_y")
+            x = exchange_overlapped(
+                x, col_axis, split_axis=1, concat_axis=2, axis_size=cols,
+                algorithm=algorithm, compute=crop_h,
+                overlap_chunks=overlap_chunks,
+                exchange_name=f"t2a_exchange_{col_axis}",
+                compute_name="t1_crop")
             with add_trace("t0_c2r_z"):
                 return c2r(x, n2, 2)                    # real Z lines
 

@@ -50,7 +50,9 @@ from ..ops.executors import get_c2r, get_executor, get_r2c
 from ..utils.trace import add_trace, trace_stages
 # _pad_axis/_crop_axis live in exchange.py (single definition shared with
 # the ragged path) and are re-exported here for the other chain builders.
-from .exchange import _crop_axis, _pad_axis, exchange, exchange_uneven
+from .exchange import (
+    _crop_axis, _pad_axis, exchange_chunked, exchange_overlapped,
+)
 
 _L = "xyz"  # axis index -> stage-name letter (t0_fft_yz taxonomy)
 
@@ -122,6 +124,7 @@ def build_slab_general(
     forward: bool = True,
     donate: bool = False,
     algorithm: str = "alltoall",
+    overlap_chunks: int = 1,
 ) -> tuple[Callable, SlabSpec]:
     """Build the jitted end-to-end slab transform for ANY ordered axis pair.
 
@@ -132,6 +135,9 @@ def build_slab_general(
     ``heffte_plan_logic.cpp:265-408``). The canonical forward plan is
     ``(in_axis, out_axis) = (0, 1)`` (the reference engine's only mode,
     ``fft_mpi_3d_api.cpp:181-214``), backward is (1, 0).
+
+    ``overlap_chunks > 1`` pipelines t2 under t3 along the bystander axis
+    (:func:`.exchange.exchange_overlapped`); 1 is today's monolithic chain.
     """
     if in_axis == out_axis or not (0 <= in_axis < 3 and 0 <= out_axis < 3):
         raise ValueError(f"need distinct 3D axes, got {in_axis}, {out_axis}")
@@ -151,6 +157,10 @@ def build_slab_general(
     t2_name = f"t2_exchange_{axis_name}"
     t3_name = f"t3_fft_{_L[in_axis]}"
 
+    def t3_chunk(y):
+        y = _crop_axis(y, in_axis, n_in)                 # drop in-axis padding
+        return ex(y, (in_axis,), forward)                # t3: final lines
+
     def local_fn(x):  # in_axis extent n_inp/p per device, others full
         with add_trace(t0_name):
             y = ex(x, local_axes, forward)               # t0: local planes
@@ -160,13 +170,13 @@ def build_slab_general(
             # no-op inside exchange_uneven, which skips it)
             if algorithm != "alltoallv":
                 y = _pad_axis(y, out_axis, n_outp)
-        with add_trace(t2_name):                         # t2: global transpose
-            y = exchange_uneven(y, axis_name, split_axis=out_axis,
-                                concat_axis=in_axis, axis_size=p,
-                                algorithm=algorithm, platform=platform)
-        with add_trace(t3_name):
-            y = _crop_axis(y, in_axis, n_in)             # drop in-axis padding
-            return ex(y, (in_axis,), forward)            # t3: final lines
+        # t2 + t3: monolithic exchange-then-fft at overlap_chunks=1, the
+        # chunked pipelined interleave above it.
+        return exchange_overlapped(
+            y, axis_name, split_axis=out_axis, concat_axis=in_axis,
+            axis_size=p, algorithm=algorithm, platform=platform,
+            compute=t3_chunk, overlap_chunks=overlap_chunks,
+            exchange_name=t2_name, compute_name=t3_name)
 
     in_spec, out_spec = spec.in_pspec, spec.out_pspec
     mapped = _shard_map(local_fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec)
@@ -201,6 +211,7 @@ def build_slab_fft3d(
     algorithm: str = "alltoall",
     in_axis: int | None = None,
     out_axis: int | None = None,
+    overlap_chunks: int = 1,
 ) -> tuple[Callable, SlabSpec]:
     """Canonical-orientation wrapper over :func:`build_slab_general`:
     X-slabs -> Y-slabs forward, Y-slabs -> X-slabs backward (the reference
@@ -213,7 +224,7 @@ def build_slab_fft3d(
         in_axis=d_in if in_axis is None else in_axis,
         out_axis=d_out if out_axis is None else out_axis,
         axis_name=axis_name, executor=executor, forward=forward,
-        donate=donate, algorithm=algorithm,
+        donate=donate, algorithm=algorithm, overlap_chunks=overlap_chunks,
     )
 
 
@@ -226,6 +237,7 @@ def build_slab_rfft3d(
     forward: bool = True,
     donate: bool = False,
     algorithm: str = "alltoall",
+    overlap_chunks: int = 1,
 ) -> tuple[Callable, SlabSpec]:
     """Slab-decomposed real-to-complex (forward) / complex-to-real (backward)
     3D transform — the distributed analog of heFFTe's ``fft3d_r2c``
@@ -255,6 +267,10 @@ def build_slab_rfft3d(
 
     if forward:
 
+        def t3_chunk(y):
+            y = _crop_axis(y, 0, n0)
+            return ex(y, (0,), True)                     # t3: X lines
+
         def local_fn(x):  # real [n0p/p, N1, N2] per device
             with add_trace("t0_r2c_zy"):
                 y = r2c(x, 2)                            # t0a: real Z lines
@@ -262,16 +278,20 @@ def build_slab_rfft3d(
             with add_trace("t1_pack"):
                 if algorithm != "alltoallv":
                     y = _pad_axis(y, 1, n1p)
-            with add_trace(f"t2_exchange_{axis_name}"):
-                y = exchange_uneven(y, axis_name, split_axis=1, concat_axis=0,
-                                    axis_size=p, algorithm=algorithm)
-            with add_trace("t3_fft_x"):
-                y = _crop_axis(y, 0, n0)
-                return ex(y, (0,), True)                 # t3: X lines
+            return exchange_overlapped(
+                y, axis_name, split_axis=1, concat_axis=0, axis_size=p,
+                algorithm=algorithm, compute=t3_chunk,
+                overlap_chunks=overlap_chunks,
+                exchange_name=f"t2_exchange_{axis_name}",
+                compute_name="t3_fft_x")
 
         pre = lambda x: _pad_axis(x, 0, n0p)
         post = lambda y: _crop_axis(y, 1, n1)
     else:
+
+        def t0_chunk(x):
+            x = _crop_axis(x, 1, n1)
+            return ex(x, (1,), False)                    # inverse Y lines
 
         def local_fn(y):  # complex [N0, n1p/p, n2h] per device
             with add_trace("t3_ifft_x"):
@@ -279,12 +299,15 @@ def build_slab_rfft3d(
             with add_trace("t1_pack"):
                 if algorithm != "alltoallv":
                     x = _pad_axis(x, 0, n0p)
-            with add_trace(f"t2_exchange_{axis_name}"):
-                x = exchange_uneven(x, axis_name, split_axis=0, concat_axis=1,
-                                    axis_size=p, algorithm=algorithm)
-            with add_trace("t0_ifft_y_c2r"):
-                x = _crop_axis(x, 1, n1)
-                x = ex(x, (1,), False)                   # inverse Y lines
+            # The c2r (real Z lines) transforms the bystander axis, so it
+            # runs monolithically after the chunked exchange/ifft-Y merge.
+            x = exchange_overlapped(
+                x, axis_name, split_axis=0, concat_axis=1, axis_size=p,
+                algorithm=algorithm, compute=t0_chunk,
+                overlap_chunks=overlap_chunks,
+                exchange_name=f"t2_exchange_{axis_name}",
+                compute_name="t0_ifft_y")
+            with add_trace("t0_c2r_z"):
                 return c2r(x, n2, 2)                     # real Z lines
 
         pre = lambda y: _pad_axis(y, 1, n1p)
@@ -313,12 +336,15 @@ def build_slab_stages(
     executor: str | Callable = "xla",
     forward: bool = True,
     algorithm: str = "alltoall",
+    overlap_chunks: int = 1,
 ) -> tuple[list[tuple[str, Callable]], SlabSpec]:
     """The same transform split into separately-jitted t0..t3 stages for the
     per-stage timing breakdown the reference prints on every execute
     (``fft_mpi_3d_api.cpp:184-201``). Fusing everything under one jit hides
     the ICI cost (SURVEY.md §7 "hard parts"), so benchmarking keeps this
-    staged mode alongside the fused one.
+    staged mode alongside the fused one. ``overlap_chunks > 1`` keeps the
+    overlapped chains' K-collective transport shape inside the t2 stage
+    (:func:`.exchange.exchange_chunked`).
     """
     p = mesh.shape[axis_name]
     spec = SlabSpec(tuple(int(s) for s in shape), p, axis_name)
@@ -341,9 +367,10 @@ def build_slab_stages(
                     _pad_axis(x, 0, n0p)), 1, n1p),
                 in_shardings=x_slab, out_shardings=x_slab)),
             ("t2_all_to_all", jax.jit(
-                smap(lambda v: exchange(
+                smap(lambda v: exchange_chunked(
                     v, axis_name, split_axis=1, concat_axis=0, axis_size=p,
-                    algorithm=algorithm), xs, ys),
+                    algorithm=algorithm, overlap_chunks=overlap_chunks),
+                    xs, ys),
                 in_shardings=x_slab, out_shardings=y_slab)),
             ("t3_fft_x", jax.jit(
                 lambda v: _crop_axis(smap(
@@ -357,9 +384,10 @@ def build_slab_stages(
                     _pad_axis(v, 1, n1p)), 0, n0p),
                 in_shardings=y_slab, out_shardings=y_slab)),
             ("t2_all_to_all", jax.jit(
-                smap(lambda v: exchange(
+                smap(lambda v: exchange_chunked(
                     v, axis_name, split_axis=0, concat_axis=1, axis_size=p,
-                    algorithm=algorithm), ys, xs),
+                    algorithm=algorithm, overlap_chunks=overlap_chunks),
+                    ys, xs),
                 in_shardings=y_slab, out_shardings=x_slab)),
             ("t0_ifft_yz", jax.jit(
                 lambda v: _crop_axis(smap(

@@ -32,7 +32,7 @@ except ImportError:  # pragma: no cover
 from ..geometry import pad_to
 from ..ops.executors import get_c2r, get_executor, get_r2c
 from ..utils.trace import trace_stages
-from .exchange import exchange
+from .exchange import exchange_chunked
 from .pencil import PencilSpec
 from .slab import SlabSpec, _crop_axis, _pad_axis
 
@@ -71,18 +71,14 @@ def _pspec(mapping: dict[int, str]) -> P:
 # Tree-aware stage primitives: the pencil pipeline below is generic over
 # the stage value — a single c64 array, or any pytree of same-shape
 # arrays (the dd tier's (hi, lo) pair rides through unchanged; specs and
-# shardings broadcast as pytree prefixes).
+# shardings broadcast as pytree prefixes). The exchanges themselves go
+# through the tree-generic :func:`.exchange.exchange_chunked`.
 def _tpad(x, ax: int, to: int):
     return jax.tree_util.tree_map(lambda u: _pad_axis(u, ax, to), x)
 
 
 def _tcrop(x, ax: int, to: int):
     return jax.tree_util.tree_map(lambda u: _crop_axis(u, ax, to), x)
-
-
-def _texchange(x, mesh_ax, **kw):
-    return jax.tree_util.tree_map(
-        lambda u: exchange(u, mesh_ax, **kw), x)
 
 
 def build_pencil_stages(
@@ -96,11 +92,14 @@ def build_pencil_stages(
     algorithm: str = "alltoall",
     perm: tuple[int, int, int] | None = None,
     order: str | None = None,
+    overlap_chunks: int = 1,
 ) -> tuple[list[tuple[str, Callable]], PencilSpec]:
     """Pencil c2c transform as five timed stages:
     t0 (first fft) | t2a (first exchange) | t1 (mid fft) | t2b (second
     exchange) | t3 (last fft) — the reference's taxonomy with the two
-    pencil exchanges split out as t2a/t2b.
+    pencil exchanges split out as t2a/t2b. ``overlap_chunks > 1`` keeps
+    the overlapped chains' K-collective shape inside each exchange stage
+    (:func:`.exchange.exchange_chunked`).
 
     Generic over the stage value: ``executor`` may be a callable taking
     any pytree of same-shape arrays (the dd tier passes a (hi, lo) pair
@@ -150,9 +149,11 @@ def build_pencil_stages(
     def t2a(x):
         x = lax.with_sharding_constraint(x, in_sh)
         mesh_ax, parts, split, concat = seq[0]
-        y = smap(lambda v: _texchange(v, mesh_ax, split_axis=split,
-                                      concat_axis=concat, axis_size=parts,
-                                      algorithm=algorithm),
+        y = smap(lambda v: exchange_chunked(
+            v, mesh_ax, split_axis=split, concat_axis=concat,
+            axis_size=parts, algorithm=algorithm,
+            overlap_chunks=overlap_chunks,
+            exchange_name=f"t2a_exchange_{mesh_ax}"),
                  in_lay, mid_lay)(x)
         return lax.with_sharding_constraint(y, mid_sh)
 
@@ -167,9 +168,11 @@ def build_pencil_stages(
     def t2b(x):
         x = lax.with_sharding_constraint(x, mid_sh)
         mesh_ax, parts, split, concat = seq[1]
-        y = smap(lambda v: _texchange(v, mesh_ax, split_axis=split,
-                                      concat_axis=concat, axis_size=parts,
-                                      algorithm=algorithm),
+        y = smap(lambda v: exchange_chunked(
+            v, mesh_ax, split_axis=split, concat_axis=concat,
+            axis_size=parts, algorithm=algorithm,
+            overlap_chunks=overlap_chunks,
+            exchange_name=f"t2b_exchange_{mesh_ax}"),
                  mid_lay, out_lay)(x)
         return lax.with_sharding_constraint(y, out_sh)
 
@@ -201,6 +204,7 @@ def build_slab_rfft_stages(
     executor: str = "xla",
     forward: bool = True,
     algorithm: str = "alltoall",
+    overlap_chunks: int = 1,
 ) -> tuple[list[tuple[str, Callable]], SlabSpec]:
     """Slab r2c (forward) / c2r (backward) as three timed stages — the
     per-stage breakdown for every benchmarkable r2c config
@@ -228,9 +232,10 @@ def build_slab_rfft_stages(
 
         def t2(y):
             y = lax.with_sharding_constraint(y, x_sh)
-            z = smap(lambda v: exchange(v, axis_name, split_axis=1,
-                                        concat_axis=0, axis_size=p,
-                                        algorithm=algorithm), xs, ys)(y)
+            z = smap(lambda v: exchange_chunked(
+                v, axis_name, split_axis=1, concat_axis=0, axis_size=p,
+                algorithm=algorithm, overlap_chunks=overlap_chunks),
+                xs, ys)(y)
             return lax.with_sharding_constraint(z, y_sh)
 
         def t3(z):
@@ -252,9 +257,10 @@ def build_slab_rfft_stages(
 
         def t2(w):
             w = lax.with_sharding_constraint(w, y_sh)
-            u = smap(lambda v: exchange(v, axis_name, split_axis=0,
-                                        concat_axis=1, axis_size=p,
-                                        algorithm=algorithm), ys, xs)(w)
+            u = smap(lambda v: exchange_chunked(
+                v, axis_name, split_axis=0, concat_axis=1, axis_size=p,
+                algorithm=algorithm, overlap_chunks=overlap_chunks),
+                ys, xs)(w)
             return lax.with_sharding_constraint(u, x_sh)
 
         def t0i(u):
@@ -278,6 +284,7 @@ def build_pencil_rfft_stages(
     executor: str = "xla",
     forward: bool = True,
     algorithm: str = "alltoall",
+    overlap_chunks: int = 1,
 ) -> tuple[list[tuple[str, Callable]], PencilSpec]:
     """Pencil r2c/c2r as five timed stages with t2a/t2b exchange lines.
     Canonical chains only (the real axis must be device-local axis 2 on the
@@ -312,9 +319,10 @@ def build_pencil_rfft_stages(
 
         def t2a(y):
             y = lax.with_sharding_constraint(y, z_sh)
-            z = smap(lambda v: exchange(v, col_axis, split_axis=2,
-                                        concat_axis=1, axis_size=cols,
-                                        algorithm=algorithm), zs, ysp)(y)
+            z = smap(lambda v: exchange_chunked(
+                v, col_axis, split_axis=2, concat_axis=1, axis_size=cols,
+                algorithm=algorithm, overlap_chunks=overlap_chunks),
+                zs, ysp)(y)
             return lax.with_sharding_constraint(z, y_sh)
 
         def t1(z):
@@ -325,9 +333,10 @@ def build_pencil_rfft_stages(
 
         def t2b(w):
             w = lax.with_sharding_constraint(w, y_sh)
-            u = smap(lambda v: exchange(v, row_axis, split_axis=1,
-                                        concat_axis=0, axis_size=rows,
-                                        algorithm=algorithm), ysp, xs)(w)
+            u = smap(lambda v: exchange_chunked(
+                v, row_axis, split_axis=1, concat_axis=0, axis_size=rows,
+                algorithm=algorithm, overlap_chunks=overlap_chunks),
+                ysp, xs)(w)
             return lax.with_sharding_constraint(u, x_sh)
 
         def t3(u):
@@ -352,9 +361,10 @@ def build_pencil_rfft_stages(
 
         def t2b(w):
             w = lax.with_sharding_constraint(w, x_sh)
-            z = smap(lambda v: exchange(v, row_axis, split_axis=0,
-                                        concat_axis=1, axis_size=rows,
-                                        algorithm=algorithm), xs, ysp)(w)
+            z = smap(lambda v: exchange_chunked(
+                v, row_axis, split_axis=0, concat_axis=1, axis_size=rows,
+                algorithm=algorithm, overlap_chunks=overlap_chunks),
+                xs, ysp)(w)
             return lax.with_sharding_constraint(z, y_sh)
 
         def t1i(z):
@@ -365,9 +375,10 @@ def build_pencil_rfft_stages(
 
         def t2a(w):
             w = lax.with_sharding_constraint(w, y_sh)
-            z = smap(lambda v: exchange(v, col_axis, split_axis=1,
-                                        concat_axis=2, axis_size=cols,
-                                        algorithm=algorithm), ysp, zs)(w)
+            z = smap(lambda v: exchange_chunked(
+                v, col_axis, split_axis=1, concat_axis=2, axis_size=cols,
+                algorithm=algorithm, overlap_chunks=overlap_chunks),
+                ysp, zs)(w)
             return lax.with_sharding_constraint(z, z_sh)
 
         def t0i(z):

@@ -37,6 +37,7 @@ to a concrete decomposition + mesh + stage chain, the role of
 from __future__ import annotations
 
 import math
+import os
 from dataclasses import dataclass, field, replace
 from typing import Sequence
 
@@ -65,6 +66,15 @@ class PlanOptions:
     "auto" shrinks only when the negotiated count removes padding at equal
     per-device compute (a strict win); "force" always shrinks to the largest
     evenly-dividing count (the reference's rule); "never" keeps the request.
+    ``overlap_chunks``: pipelined t2/t3 exchange/compute overlap — the
+    local block is split into K chunks along the bystander axis and each
+    chunk's exchange issues before the previous chunk's downstream FFT
+    (the ``MPI_Waitany`` overlap of the reference's pipelined p2p path,
+    ``fft_mpi_3d_api.cpp:610-699``). ``None`` (the default) defers to the
+    ``DFFT_OVERLAP`` env var at plan time (unset -> 1 = today's
+    monolithic chain); an int >= 1 pins K; ``"auto"`` picks K from the
+    per-device block bytes vs the VMEM/ICI crossover
+    (:func:`auto_overlap_chunks`, model in ``docs/MFU_ANALYSIS.md``).
     """
 
     decomposition: str = "auto"
@@ -72,6 +82,7 @@ class PlanOptions:
     executor: str = "xla"
     donate: bool = False
     renegotiate: str = "auto"
+    overlap_chunks: int | str | None = None
 
     def __post_init__(self) -> None:
         if self.algorithm not in ALGORITHMS:
@@ -84,6 +95,21 @@ class PlanOptions:
             raise ValueError(
                 f"renegotiate must be auto|force|never, got {self.renegotiate!r}"
             )
+        oc = self.overlap_chunks
+        if isinstance(oc, str) and oc != "auto":
+            # Numeric strings (the DFFT_OVERLAP env form) normalize to int.
+            try:
+                oc = int(oc)
+            except ValueError:
+                raise ValueError(
+                    f"overlap_chunks must be an int >= 1, 'auto', or None, "
+                    f"got {self.overlap_chunks!r}") from None
+            object.__setattr__(self, "overlap_chunks", oc)
+        if oc is not None and oc != "auto" and (
+                not isinstance(oc, int) or isinstance(oc, bool) or oc < 1):
+            raise ValueError(
+                f"overlap_chunks must be an int >= 1, 'auto', or None, "
+                f"got {self.overlap_chunks!r}")
 
 
 DEFAULT_OPTIONS = PlanOptions()
@@ -92,6 +118,64 @@ DEFAULT_OPTIONS = PlanOptions()
 def default_options(decomposition: str = "auto", **kw) -> PlanOptions:
     """cf. ``default_options<backend>()`` (``heffte_plan_logic.h:95``)."""
     return PlanOptions(decomposition=decomposition, **kw)
+
+
+# Exchange/compute overlap auto-heuristic constants (crossover model in
+# docs/MFU_ANALYSIS.md "Exchange/compute overlap"): a chunk's exchange
+# payload must stay above the ICI packet-efficiency floor or the
+# per-collective latency exceeds the transfer it hides, and chunk count is
+# capped — each extra chunk adds one collective's fixed cost while the
+# hideable transfer per chunk shrinks as 1/K.
+OVERLAP_AUTO_MIN_CHUNK_BYTES = 4 << 20   # ~4 MiB/device per chunk floor
+OVERLAP_AUTO_MAX_CHUNKS = 8
+
+
+def auto_overlap_chunks(
+    shape: Sequence[int], ndev: int, itemsize: int = 8,
+) -> int:
+    """Pick the overlap chunk count K from the per-device block bytes.
+
+    K = clamp(block_bytes / OVERLAP_AUTO_MIN_CHUNK_BYTES, 1,
+    OVERLAP_AUTO_MAX_CHUNKS): small blocks stay monolithic (nothing worth
+    hiding; per-collective latency dominates), large blocks split until
+    the per-chunk payload reaches the ICI efficiency floor or the chunk
+    cap. ``itemsize`` defaults to complex64 (the on-chip tier — TPUs have
+    no c128). The bystander-axis extent clamps K again inside
+    :func:`..parallel.exchange.overlap_chunk_bounds`, so a coarse K here
+    is safe for any chain geometry."""
+    if ndev <= 1:
+        return 1
+    block = itemsize * math.prod(int(s) for s in shape) // ndev
+    return max(1, min(OVERLAP_AUTO_MAX_CHUNKS,
+                      block // OVERLAP_AUTO_MIN_CHUNK_BYTES))
+
+
+def resolve_overlap_chunks(
+    value: int | str | None,
+    shape: Sequence[int] | None = None,
+    ndev: int = 1,
+    itemsize: int = 8,
+) -> int:
+    """Resolve a ``PlanOptions.overlap_chunks`` value to a concrete K.
+
+    ``None`` reads the ``DFFT_OVERLAP`` env var at call time (unset ->
+    1, today's monolithic chain); ``"auto"`` (from either source) runs
+    :func:`auto_overlap_chunks`; ints pass through validated."""
+    if value is None:
+        raw = os.environ.get("DFFT_OVERLAP", "").strip()
+        value = raw if raw else 1
+    if isinstance(value, str):
+        if value == "auto":
+            return auto_overlap_chunks(shape, ndev, itemsize) if shape else 1
+        try:
+            value = int(value)
+        except ValueError:
+            raise ValueError(
+                f"overlap_chunks must be an int >= 1 or 'auto', got "
+                f"{value!r} (check DFFT_OVERLAP)") from None
+    if value < 1:
+        raise ValueError(f"overlap_chunks must be >= 1, got {value}")
+    return int(value)
 
 
 @dataclass(frozen=True)
@@ -414,9 +498,19 @@ def logic_plan3d(
         decomp, mesh, geo.world_box(shape),
         slab_axes=slab_axes, pencil_perm=perm, pencil_order=order,
     )
+    # Resolve the overlap knob (None -> DFFT_OVERLAP env, "auto" ->
+    # block-bytes heuristic) to a concrete K on the FINAL mesh, so the
+    # builders and plan_info see one int. Single-device chains have no
+    # exchange to overlap.
+    overlap = 1 if (decomp == "single" or mesh is None) else (
+        resolve_overlap_chunks(
+            options.overlap_chunks, shape=shape,
+            ndev=math.prod(mesh.devices.shape)))
     return LogicPlan(
         shape=shape, decomposition=decomp, mesh=mesh,
-        options=replace(options, decomposition=decomp), forward=forward,
+        options=replace(options, decomposition=decomp,
+                        overlap_chunks=overlap),
+        forward=forward,
         slab_axes=slab_axes, pencil_perm=perm, pencil_order=order,
         in_absorbed=in_absorbed, out_absorbed=out_absorbed,
         negotiated=negotiated, stages=stages,

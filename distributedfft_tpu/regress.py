@@ -181,7 +181,10 @@ def normalize_bench_line(
     except (TypeError, ValueError):
         return None
     config = {}
-    for k in ("dtype", "devices", "decomposition"):
+    # "overlap" (PlanOptions.overlap_chunks != 1) is part of the baseline
+    # group: an overlapped run must never be judged against a monolithic
+    # baseline or vice versa — they compile different exchange schedules.
+    for k in ("dtype", "devices", "decomposition", "overlap"):
         if obj.get(k) is not None:
             config[k] = obj[k]
     ex: dict = {}

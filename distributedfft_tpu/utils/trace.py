@@ -277,6 +277,11 @@ def plan_info(plan) -> str:
         f"algorithm: {plan.options.algorithm}",
         f"dtype: {plan.in_dtype} -> {plan.out_dtype}",
     ]
+    _oc = getattr(plan.options, "overlap_chunks", None)
+    if _oc not in (None, 1):
+        lines.append(
+            f"overlap: {_oc} chunks (pipelined t2/t3 exchange-compute "
+            f"interleave along the bystander axis)")
     if plan.mesh is not None:
         lines.append(
             "mesh: "
