@@ -248,7 +248,7 @@ def _roofline(shape, seconds, n_dev):
 
 
 def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
-          all_times, donated=False, stages=None, overlap=None):
+          all_times, donated=False, stages=None, overlap=None, tuned=None):
     import jax
 
     from distributedfft_tpu.utils.metrics import metrics_snapshot
@@ -277,6 +277,13 @@ def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
         # overlapped and monolithic runs into different baselines; default
         # rows keep the old schema.
         out["overlap"] = overlap
+    if tuned is not None:
+        # Measured-autotuner run (DFFT_BENCH_TUNE): the winner tuple
+        # "decomposition/transport/executor/ovK". The run-record store
+        # keys it into the baseline group, so tuned and untuned runs
+        # never share a compare baseline; untuned rows keep the old
+        # schema.
+        out["tuned"] = tuned
     if jax.default_backend() == "tpu":
         out.update(_roofline(shape, seconds, n_dev))
     if stages:
@@ -287,6 +294,54 @@ def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
     out["telemetry"] = {"metrics": metrics_snapshot()}
     print(json.dumps(out), flush=True)
     return out
+
+
+def _worker_tuned(shape_n, shape, mesh, dtype, n_dev, mode: str) -> None:
+    """The tune-mode measurement (``DFFT_BENCH_TUNE``): plan through the
+    measured autotuner (the multi-axis tournament of
+    ``distributedfft_tpu/tuner.py``, or its persisted wisdom) instead of
+    the manual executor menu, verify by roundtrip, and stamp the winner
+    tuple into the result line so the run-record store keys tuned and
+    untuned runs into different baselines."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    import distributedfft_tpu as dfft
+    from distributedfft_tpu.tuner import tuned_label
+    from distributedfft_tpu.utils.timing import (
+        max_rel_err, sync, time_fn_amortized,
+    )
+
+    plan = dfft.plan_dft_c2c_3d(
+        shape, mesh, direction=dfft.FORWARD, dtype=dtype, tune=mode)
+    iplan = dfft.plan_dft_c2c_3d(
+        shape, mesh, direction=dfft.BACKWARD, dtype=dtype, tune=mode)
+    label = tuned_label(plan)
+    print(f"tuned plan: {label}", file=sys.stderr)
+
+    mk_kw = {}
+    if plan.in_sharding is not None:
+        mk_kw["out_shardings"] = plan.in_sharding
+
+    @functools.partial(jax.jit, **mk_kw)
+    def make_input():
+        k1, k2 = jax.random.split(jax.random.PRNGKey(4242))
+        re = jax.random.normal(k1, shape, jnp.float32)
+        im = jax.random.normal(k2, shape, jnp.float32)
+        return (re + 1j * im).astype(dtype)
+
+    x = make_input()
+    sync(x)
+    max_err = max_rel_err(iplan(plan(x)), x)
+    if not max_err < ERR_GATE:
+        raise AssertionError(f"roundtrip error {max_err} exceeds {ERR_GATE}")
+    seconds, _ = time_fn_amortized(lambda: plan(x), iters=10, repeats=3)
+    _emit(shape_n, seconds, max_err, plan.executor, n_dev,
+          plan.decomposition, {label: round(seconds, 6)},
+          overlap=getattr(plan.options, "overlap_chunks", None),
+          tuned=label)
 
 
 def _worker(shape_n: int) -> None:
@@ -315,6 +370,14 @@ def _worker(shape_n: int) -> None:
     n_dev = len(devs)
     mesh = dfft.make_mesh(n_dev) if n_dev > 1 else None
     dtype = jnp.complex64  # TPU: no C128
+
+    # Tune mode: the measured autotuner replaces the manual executor
+    # menu ("1" = measure; "wisdom" consults the store only).
+    tune_mode = os.environ.get("DFFT_BENCH_TUNE", "").strip()
+    if tune_mode == "1":
+        tune_mode = "measure"
+    if tune_mode in ("wisdom", "measure"):
+        return _worker_tuned(shape_n, shape, mesh, dtype, n_dev, tune_mode)
 
     # Upgrade-phase menu: xla first (a line exists after one compile),
     # then the dense HIGH-precision MXU path (kept only if it passes the

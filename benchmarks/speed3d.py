@@ -64,6 +64,16 @@ def parse_args(argv):
                         "(monolithic). Overlapped rows label the CSV "
                         "algorithm column '<alg>+ovK' so sweeps never "
                         "mix with monolithic baselines")
+    p.add_argument("-tune", default=None, choices=("off", "wisdom", "measure"),
+                   help="measured plan selection (distributedfft_tpu/"
+                        "tuner.py): 'measure' runs the pruned multi-axis "
+                        "tournament (decomposition x transport x executor "
+                        "x overlap K) on a wisdom miss and records the "
+                        "winner; 'wisdom' only consults the persistent "
+                        "store (DFFT_WISDOM). The winner tuple is printed "
+                        "and stamped into the CSV row ('+tuned' algorithm "
+                        "suffix), so tuned sweeps never mix with untuned "
+                        "baselines")
     p.add_argument("-r2c_axis", type=int, default=2, choices=(0, 1, 2),
                    help="halved axis for r2c/c2r (heFFTe r2c_direction)")
     p.add_argument("-ndev", type=int, default=None, help="device count (default: all)")
@@ -166,6 +176,13 @@ def main(argv=None) -> None:
     if args.overlap is not None and args.bricks:
         raise SystemExit("-overlap applies to the chain exchanges; "
                          "brick-edge plans (-bricks) do not take it")
+    if args.tune and args.tune != "off":
+        if args.bricks or args.precision == "dd":
+            raise SystemExit("-tune applies to the c2c/r2c chain planners; "
+                             "brick and dd plans do not take it")
+        if args.a2av or args.p2p_pl:
+            raise SystemExit("-tune searches the transport axis; do not pin "
+                             "one with -a2av/-p2p_pl")
 
     if args.r2c_axis != 2 and (args.kind != "r2c"
                                or args.precision == "dd"):
@@ -238,6 +255,8 @@ def main(argv=None) -> None:
               dtype=dtype, algorithm=algorithm)
     if args.overlap is not None:
         kw["overlap_chunks"] = args.overlap
+    if args.tune is not None:
+        kw["tune"] = args.tune
     if args.kind == "r2c" and args.r2c_axis != 2:
         kw["r2c_axis"] = args.r2c_axis
     if args.bricks:
@@ -266,6 +285,17 @@ def main(argv=None) -> None:
                if (in_spec is not None or out_spec is not None) else kw)
         bwd = plan_fn(shape, mesh, direction=dfft.BACKWARD, **bkw)
     print(dfft.plan_info(fwd))
+    tuned_lbl = None
+    if args.tune and args.tune != "off":
+        # The tuner resolved decomposition/transport/executor/K: describe
+        # (and stage-time, and CSV-stamp) what actually won, not the CLI
+        # defaults the search started from.
+        from distributedfft_tpu.tuner import tuned_label
+
+        tuned_lbl = tuned_label(fwd)
+        algorithm = fwd.options.algorithm
+        args.executor = fwd.executor
+        print(f"tuned: {tuned_lbl}")
     # Resolved overlap chunk count (env/"auto" -> int at plan time) — the
     # staged builders and the CSV row must describe the same schedule.
     overlap = getattr(fwd.options, "overlap_chunks", None) or 1
@@ -413,8 +443,14 @@ def main(argv=None) -> None:
         # unchanged for default rows).
         kind = (f"r2c_axis{args.r2c_axis}"
                 if args.kind == "r2c" and args.r2c_axis != 2 else args.kind)
+        alg_label = _algorithm_label(algorithm, overlap)
+        if tuned_lbl is not None:
+            # Tuned rows must never be indistinguishable from rows that
+            # pinned the same knobs by hand (the tuple can move between
+            # re-tunes); same separation rule as '+ovK'.
+            alg_label += "+tuned"
         rec.record(kind, args.precision, *shape, ndev, deco,
-                   _algorithm_label(algorithm, overlap),
+                   alg_label,
                    _executor_label(args.executor),
                    f"{seconds:.6f}", f"{gf:.1f}", f"{max_err:.3e}")
     _print_telemetry(args)

@@ -54,6 +54,7 @@ from .plan_logic import (
     io_boxes,
     logic_plan3d,
     resolve_overlap_chunks,
+    resolve_tune_mode,
     spec_entries as _spec_entries_impl,
 )
 from .parallel.pencil import PencilSpec, build_pencil_fft3d, build_pencil_rfft3d
@@ -159,10 +160,12 @@ def _resolve_options(
     algorithm: str,
     options: PlanOptions | None,
     overlap_chunks: int | str | None = None,
+    tune: str | None = None,
 ) -> PlanOptions:
     if options is not None:
         if (decomposition is not None or executor != "xla" or donate
-                or algorithm != "alltoall" or overlap_chunks is not None):
+                or algorithm != "alltoall" or overlap_chunks is not None
+                or tune is not None):
             raise ValueError(
                 "pass either options= or individual plan keywords, not both"
             )
@@ -173,6 +176,7 @@ def _resolve_options(
         executor=executor,
         donate=donate,
         overlap_chunks=overlap_chunks,
+        tune=tune,
     )
 
 
@@ -321,6 +325,7 @@ def plan_dft_c2c_3d(
     donate: bool = False,
     algorithm: str = "alltoall",
     overlap_chunks: int | str | None = None,
+    tune: str | None = None,
     options: PlanOptions | None = None,
     in_spec: P | None = None,
     out_spec: P | None = None,
@@ -353,10 +358,24 @@ def plan_dft_c2c_3d(
     ``overlap_chunks`` enables the pipelined exchange/compute overlap
     (int K, ``"auto"``, or None -> ``DFFT_OVERLAP`` env; see
     :class:`~.plan_logic.PlanOptions`). K=1 is today's monolithic chain.
+
+    ``tune`` selects measured planning (:mod:`.tuner`): ``"measure"``
+    runs the pruned multi-axis tournament (decomposition x transport x
+    executor x overlap K) on a wisdom miss and records the winner;
+    ``"wisdom"`` only consults the persistent store and falls back to
+    these static heuristics on a miss; default ``"off"`` (or the
+    ``DFFT_TUNE`` env var) plans exactly as before.
     """
     shape, forward = _check_direction(shape, direction)
     opts = _resolve_options(decomposition, executor, donate, algorithm,
-                            options, overlap_chunks)
+                            options, overlap_chunks, tune)
+    if resolve_tune_mode(opts.tune) != "off":
+        from . import tuner
+
+        return tuner.tuned_plan(
+            "c2c", shape, mesh, opts,
+            dict(direction=direction, dtype=dtype, in_spec=in_spec,
+                 out_spec=out_spec))
     if opts.executor == "auto":
         return _auto_plan(
             functools.partial(plan_dft_c2c_3d, shape, mesh), opts,
@@ -435,96 +454,31 @@ def _autotune(make_plan: Callable[[str], Plan3D]) -> Plan3D:
     Timing uses a zero-filled input (FFT cost is data-independent) and
     pays one compile per candidate at plan time — the same cost profile
     as the reference's plan-time hipRTC compilation of every backend.
-
-    Multi-host: every process runs the tournament in lockstep (the timing
-    executions are themselves collective), but wall clocks differ per
-    process — the winner is therefore decided by process 0's times and
-    broadcast, so all processes build the same collective program.
+    The tournament itself (multi-host candidate-set agreement, lockstep
+    timing, winner decided from the allgathered time matrix so a
+    candidate that failed timing on any process can never win) is
+    :func:`.tuner.measured_select` — the same engine behind the
+    multi-axis ``tune="measure"`` tournament; timing budget via
+    ``DFFT_TUNE_ITERS`` (:func:`.tuner.tune_budget`).
     """
     import os
 
-    import numpy as np
-
+    from .tuner import measured_select, tune_budget
     from .utils.timing import time_fn_amortized
 
     names = [e.strip() for e in os.environ.get(
         "DFFT_AUTO_EXECUTORS", ",".join(_AUTO_CANDIDATES)).split(",")
         if e.strip() and e.strip() != "auto"]  # 'auto' itself would recurse
-    errors: list[str] = []
+    iters, repeats = tune_budget()
 
-    # Phase 1: build every candidate plan (no execution — jit is lazy, so
-    # building is host-local and never emits collectives).
-    plans: dict[str, Plan3D] = {}
-    for ex in names:
-        try:
-            plans[ex] = make_plan(ex)
-        except Exception as e:  # noqa: BLE001 — candidate skipped
-            errors.append(f"{ex}: {type(e).__name__}")
-    multi = jax.process_count() > 1
-    if not plans and not multi:
-        # Multi-host must NOT raise here: every process has to reach the
-        # reconciliation collective below even with an empty local set, or
-        # the others block in it forever — the joint raise happens after.
-        raise ValueError(
-            f"no auto executor candidate succeeded ({'; '.join(errors)})"
-        )
+    def measure(plan: Plan3D) -> float:
+        x = alloc_local(plan)
+        t, _ = time_fn_amortized(plan.fn, x, iters=iters, repeats=repeats)
+        return t
 
-    # Multi-host: agree on the candidate set BEFORE any timing execution —
-    # a candidate that built on only some processes must be timed on none,
-    # or the processes that have it enter collective executions the others
-    # never join (distributed hang).
-    candidates = [nm for nm in names if nm in plans]
-    if multi:
-        from jax.experimental import multihost_utils
-
-        flags = np.array([1.0 if nm in plans else 0.0 for nm in names])
-        allf = np.asarray(multihost_utils.process_allgather(flags))
-        allf = allf.reshape(-1, len(names))
-        common = allf.min(axis=0) > 0
-        candidates = [nm for i, nm in enumerate(names) if common[i]]
-        if not candidates:
-            raise ValueError(
-                "no auto executor candidate built on every process "
-                f"(local: {sorted(plans)}; errors: {'; '.join(errors)})"
-            )
-
-    # Phase 2: time the agreed candidates in lockstep (identical order and
-    # execution count on every process). Amortized timing (>=10 dispatches
-    # per sync) so a noisy transport's per-call latency cannot pick the
-    # wrong winner — the same methodology as the reference timing nt
-    # executes inside one MPI_Wtime pair (fftSpeed3d_c2c.cpp:94-98).
-    times: dict[str, float] = {}
-    for ex in candidates:
-        try:
-            x = alloc_local(plans[ex])
-            t, _ = time_fn_amortized(plans[ex].fn, x, iters=10, repeats=2)
-        except Exception as e:  # noqa: BLE001
-            errors.append(f"{ex}: {type(e).__name__}")
-            t = math.inf
-        times[ex] = t
-
-    # Wall clocks differ per process: the winner is process 0's choice,
-    # broadcast so every process builds the same collective program. The
-    # all-failed decision is made from the broadcast vector too — a local
-    # raise before the collective would strand the other processes in it.
-    if multi:
-        from jax.experimental import multihost_utils
-
-        vec = np.array([times[nm] for nm in candidates], np.float64)
-        vec = np.asarray(multihost_utils.broadcast_one_to_all(vec)).ravel()
-        if not np.isfinite(vec).any():
-            raise ValueError(
-                "every auto executor candidate failed on process 0"
-                + (f" (local diagnostics: {'; '.join(errors)})"
-                   if errors else "")
-            )
-        best = candidates[int(np.argmin(vec))]
-        return plans[best]
-    if not any(math.isfinite(t) for t in times.values()):
-        raise ValueError(
-            f"every auto executor candidate failed ({'; '.join(errors)})"
-        )
-    return plans[min(times, key=times.get)]
+    best, plans, _ = measured_select(
+        names, make_plan, measure, what="auto executor candidate")
+    return plans[best]
 
 
 def _auto_plan(plan_fn: Callable, opts: PlanOptions, **kw) -> Plan3D:
@@ -835,6 +789,7 @@ def plan_dft_r2c_3d(
     donate: bool = False,
     algorithm: str = "alltoall",
     overlap_chunks: int | str | None = None,
+    tune: str | None = None,
     options: PlanOptions | None = None,
     in_spec: P | None = None,
     out_spec: P | None = None,
@@ -859,12 +814,19 @@ def plan_dft_r2c_3d(
             shape, mesh, r2c_axis, direction=direction,
             decomposition=decomposition, executor=executor, dtype=dtype,
             donate=donate, algorithm=algorithm,
-            overlap_chunks=overlap_chunks, options=options,
+            overlap_chunks=overlap_chunks, tune=tune, options=options,
             in_spec=in_spec, out_spec=out_spec,
         )
     shape, forward = _check_direction(shape, direction)
     opts = _resolve_options(decomposition, executor, donate, algorithm,
-                            options, overlap_chunks)
+                            options, overlap_chunks, tune)
+    if resolve_tune_mode(opts.tune) != "off":
+        from . import tuner
+
+        return tuner.tuned_plan(
+            "r2c", shape, mesh, opts,
+            dict(direction=direction, dtype=dtype, in_spec=in_spec,
+                 out_spec=out_spec))
     if opts.donate:
         # r2c/c2r buffers can never alias (real world vs complex
         # half-spectrum differ in dtype and size), so donation would
@@ -984,7 +946,7 @@ def _chain_convention_note(e: Exception, axis: int) -> ValueError:
 
 def _r2c_axis_wrapped(shape, mesh, axis: int, *, direction, decomposition,
                       executor, dtype, donate, algorithm, options, in_spec,
-                      out_spec, overlap_chunks=None) -> Plan3D:
+                      out_spec, overlap_chunks=None, tune=None) -> Plan3D:
     """r2c/c2r with the halved axis != 2 (heFFTe ``r2c_direction`` 0/1):
     the canonical chain (real axis = 2) runs on a transposed view.
     Caller-facing metadata — shapes, shardings, boxes — is permuted back
@@ -1002,7 +964,7 @@ def _r2c_axis_wrapped(shape, mesh, axis: int, *, direction, decomposition,
         inner = plan_dft_r2c_3d(
             pshape, mesh, direction=direction, decomposition=decomposition,
             executor=executor, dtype=dtype, donate=donate,
-            algorithm=algorithm, overlap_chunks=overlap_chunks,
+            algorithm=algorithm, overlap_chunks=overlap_chunks, tune=tune,
             options=options,
             in_spec=_permute_spec3(in_spec, perm),
             out_spec=_permute_spec3(out_spec, perm),
@@ -1380,6 +1342,9 @@ _PLAN_ENV_KNOBS = (
     "DFFT_MM_SPLIT", "DFFT_MM_DIRECT_MAX", "DFFT_DD_DEPTH",
     "DFFT_PALLAS_PACK", "DFFT_PALLAS_SPLIT", "DFFT_XLA_REAL",
     "DFFT_FORCE_REAL_LOWERING", "DFFT_OVERLAP",
+    # Tuned planning: mode, wisdom store, budget, and survivor cap all
+    # change what a tuned planner call would build/measure.
+    "DFFT_TUNE", "DFFT_WISDOM", "DFFT_TUNE_ITERS", "DFFT_TUNE_MAX",
 )
 
 
@@ -1461,16 +1426,13 @@ def _plan_exchange_bytes(plan: Plan3D) -> tuple[int, int]:
     true_b = wire_b = 0
     lp = plan.logic
     if lp is not None and lp.mesh is not None:
+        from .parallel.exchange import WIRE_BYTE_KEYS
         from .plan_logic import exchange_payloads
 
         shape_eff = plan.out_shape if (plan.real and plan.forward) else (
             plan.in_shape if plan.real else plan.shape)
         itemsize = np.dtype(plan.dtype).itemsize
-        wire_key = {
-            "alltoall": "alltoall_bytes",
-            "ppermute": "alltoall_bytes",  # the padded ring ships the pads
-            "alltoallv": "alltoallv_bytes",
-        }[plan.options.algorithm]
+        wire_key = WIRE_BYTE_KEYS[plan.options.algorithm]
         for e in exchange_payloads(lp, shape_eff, itemsize):
             true_b += e["true_bytes"]
             wire_b += e[wire_key]
@@ -1518,12 +1480,19 @@ def execute(plan: Plan3D, x, *, scale: Scale = Scale.NONE):
 
 def alloc_local(plan: Plan3D, fill=None):
     """Allocate a global array laid out per the plan's input sharding
-    (``fft_mpi_alloc_local_memory``, ``fft_mpi_3d_api.h:73``)."""
+    (``fft_mpi_alloc_local_memory``, ``fft_mpi_3d_api.h:73``).
+
+    Uneven extents cannot be placed by ``device_put`` (equal-shard rule);
+    there the array is returned unplaced and the plan's own pad/crop
+    chain shards it on first execute — previously this raised, which
+    silently failed every measured-tournament candidate (and
+    ``executor="auto"``) on uneven shapes."""
     if fill is None:
         arr = jnp.zeros(plan.in_shape, plan.in_dtype)
     else:
         arr = jnp.asarray(fill, dtype=plan.in_dtype)
-    if plan.in_sharding is not None:
+    if plan.in_sharding is not None and _spec_divides(
+            plan.in_sharding.mesh, plan.in_sharding.spec, arr.shape):
         arr = jax.device_put(arr, plan.in_sharding)
     return arr
 

@@ -56,6 +56,24 @@ from ..utils.trace import add_trace
 
 ALGORITHMS = ("alltoall", "alltoallv", "ppermute")
 
+#: Which :func:`..plan_logic.exchange_payloads` byte entry each transport
+#: actually ships on the wire — shared by the per-execute byte counters
+#: (api) and the tuner's candidate-pruning model, so wire accounting can
+#: never disagree between the two.
+WIRE_BYTE_KEYS = {
+    "alltoall": "alltoall_bytes",
+    "ppermute": "alltoall_bytes",   # the padded ring ships the pads too
+    "alltoallv": "alltoallv_bytes",
+}
+
+
+def transport_steps(algorithm: str, parts: int) -> int:
+    """Sequential collective launches one exchange pays on ``parts``
+    devices: the fused transports are one launch; the explicit ring is
+    ``parts - 1`` neighbor shifts (each a dependent ppermute). The
+    latency term of the tuner's analytical cost model."""
+    return max(1, parts - 1) if algorithm == "ppermute" else 1
+
 
 def _pad_axis(x: jnp.ndarray, axis: int, to: int) -> jnp.ndarray:
     """Zero-pad ``axis`` up to extent ``to`` (no-op when already there).

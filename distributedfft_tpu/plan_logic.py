@@ -49,6 +49,10 @@ from .parallel.exchange import ALGORITHMS
 from .parallel.mesh import make_mesh
 
 
+#: Valid ``PlanOptions.tune`` values (None defers to the DFFT_TUNE env var).
+TUNE_MODES = (None, "off", "wisdom", "measure")
+
+
 @dataclass(frozen=True)
 class PlanOptions:
     """User-tunable plan knobs (``plan_options``,
@@ -75,6 +79,16 @@ class PlanOptions:
     monolithic chain); an int >= 1 pins K; ``"auto"`` picks K from the
     per-device block bytes vs the VMEM/ICI crossover
     (:func:`auto_overlap_chunks`, model in ``docs/MFU_ANALYSIS.md``).
+    ``tune``: measured plan selection (:mod:`.tuner`; the reference's
+    plan-and-pick discipline generalized across decomposition,
+    transport, executor, AND overlap K — heFFTe/AccFFT's finding that
+    the best combination is configuration-dependent and must be
+    searched). ``"off"`` keeps today's static heuristics byte-identical;
+    ``"wisdom"`` consults the persistent wisdom store and falls back to
+    the heuristics on a miss (never measures); ``"measure"`` runs the
+    pruned tournament on a miss and records the winner. ``None`` (the
+    default) defers to the ``DFFT_TUNE`` env var (unset -> ``"off"``).
+    See ``docs/TUNING.md``.
     """
 
     decomposition: str = "auto"
@@ -83,6 +97,7 @@ class PlanOptions:
     donate: bool = False
     renegotiate: str = "auto"
     overlap_chunks: int | str | None = None
+    tune: str | None = None
 
     def __post_init__(self) -> None:
         if self.algorithm not in ALGORITHMS:
@@ -110,6 +125,10 @@ class PlanOptions:
             raise ValueError(
                 f"overlap_chunks must be an int >= 1, 'auto', or None, "
                 f"got {self.overlap_chunks!r}")
+        if self.tune not in TUNE_MODES:
+            raise ValueError(
+                f"tune must be one of {tuple(m for m in TUNE_MODES if m)} "
+                f"or None, got {self.tune!r}")
 
 
 DEFAULT_OPTIONS = PlanOptions()
@@ -176,6 +195,41 @@ def resolve_overlap_chunks(
     if value < 1:
         raise ValueError(f"overlap_chunks must be >= 1, got {value}")
     return int(value)
+
+
+def resolve_tune_mode(value: str | None) -> str:
+    """Resolve a ``PlanOptions.tune`` value to a concrete mode.
+
+    ``None`` reads the ``DFFT_TUNE`` env var at plan time (unset ->
+    ``"off"``, today's static-heuristic planning); explicit strings pass
+    through validated. One resolution point so the planners and the
+    benchmark drivers agree on what a given environment plans."""
+    if value is None:
+        value = os.environ.get("DFFT_TUNE", "").strip() or "off"
+    if value not in TUNE_MODES or value is None:
+        raise ValueError(
+            f"tune mode must be one of {tuple(m for m in TUNE_MODES if m)}, "
+            f"got {value!r} (check DFFT_TUNE)")
+    return value
+
+
+def eligible_decompositions(shape: Sequence[int], ndev: int) -> tuple[str, ...]:
+    """Decompositions worth *measuring* for ``ndev`` devices — the search
+    axis the static :func:`choose_decomposition` collapses to one point.
+
+    Slab is eligible while every device owns at least one plane on both
+    exchange axes (past that the reference shrinks the device count,
+    ``getProperDeviceNum``); pencil is eligible on any multi-device count
+    (a prime count degrades to a 1xN grid, still a valid measurement).
+    Single-device has nothing to search."""
+    shape = tuple(int(s) for s in shape)
+    if ndev <= 1:
+        return ("single",)
+    out = []
+    if ndev <= min(shape[0], shape[1]):
+        out.append("slab")
+    out.append("pencil")
+    return tuple(out)
 
 
 @dataclass(frozen=True)

@@ -56,6 +56,7 @@ __all__ = [
     "robust_stats",
     "metric_direction",
     "compare_record",
+    "wisdom_verdict",
     "format_compare",
     "summarize_history",
 ]
@@ -181,10 +182,13 @@ def normalize_bench_line(
     except (TypeError, ValueError):
         return None
     config = {}
-    # "overlap" (PlanOptions.overlap_chunks != 1) is part of the baseline
-    # group: an overlapped run must never be judged against a monolithic
-    # baseline or vice versa — they compile different exchange schedules.
-    for k in ("dtype", "devices", "decomposition", "overlap"):
+    # "overlap" (PlanOptions.overlap_chunks != 1) and "tuned" (the
+    # autotuner's winner tuple) are part of the baseline group: an
+    # overlapped or tuned run must never be judged against a monolithic /
+    # heuristic baseline or vice versa — they compile different programs
+    # (the tuned tuple may even move between re-tunes, which the label
+    # then keys into separate baselines).
+    for k in ("dtype", "devices", "decomposition", "overlap", "tuned"):
         if obj.get(k) is not None:
             config[k] = obj[k]
     ex: dict = {}
@@ -471,6 +475,43 @@ def _localize_stages(
         })
     rows.sort(key=lambda r: (-r["regressed"], -r["delta_pct"], r["stage"]))
     return rows
+
+
+def wisdom_verdict(
+    stored_seconds: float,
+    fresh_seconds: list[float],
+    *,
+    mads: float = DEFAULT_MADS,
+    min_rel: float = DEFAULT_MIN_REL,
+    min_samples: int = DEFAULT_MIN_SAMPLES,
+) -> dict:
+    """Is a stored tuning winner still as fast as its recorded tournament
+    time? ``stored_seconds`` is the wisdom entry's measured per-execute
+    time; ``fresh_seconds`` are per-execute times of later benchmark runs
+    of that same winner tuple (from the history store). Same noise model
+    as :func:`compare_record` (median + MAD band; seconds are latencies,
+    larger = worse): ``regressed`` means the winner now runs slower than
+    when it won — stale wisdom that should be re-measured. Fewer than
+    ``min_samples`` fresh runs -> ``no-baseline`` (never gates)."""
+    out = {
+        "stored_seconds": float(stored_seconds),
+        "fresh": {"n": len(fresh_seconds)},
+        "verdict": "no-baseline",
+    }
+    if len(fresh_seconds) < min_samples:
+        return out
+    med, mad = robust_stats([float(v) for v in fresh_seconds])
+    band = _band(med, mad, mads, min_rel)
+    out["fresh"].update(median=med, mad=mad, band=band)
+    out["delta_pct"] = (100.0 * (med - stored_seconds) / stored_seconds
+                        if stored_seconds else math.inf)
+    if abs(med - stored_seconds) <= band:
+        out["verdict"] = "within-noise"
+    elif med < stored_seconds:
+        out["verdict"] = "improved"
+    else:
+        out["verdict"] = "regressed"
+    return out
 
 
 def format_compare(results: list[dict]) -> str:

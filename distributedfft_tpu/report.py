@@ -8,6 +8,7 @@ arguments)::
     python -m distributedfft_tpu.report record BENCH_r*.json
     python -m distributedfft_tpu.report history
     python -m distributedfft_tpu.report compare --gate
+    python -m distributedfft_tpu.report wisdom --gate
 
 **merge** — the trace tool. The reference writes one trace log per MPI
 rank and leaves correlation to the reader (``heffte_trace.h:98-118``);
@@ -21,6 +22,15 @@ watchdog-killed worker's log) are skipped and counted on stderr, never
 fatal. Timeline caveat: text logs store per-process *relative* start
 times, so merging text logs aligns processes at their first event;
 chrome logs carry a shared wall-clock axis and merge exactly.
+
+**wisdom** — inspect the tuner's persistent wisdom store
+(``DFFT_WISDOM``; see :mod:`.tuner` and docs/TUNING.md): one row per
+stored tournament winner. ``--gate`` cross-checks each stored winner
+against *fresh* history records of the same winner tuple (the
+``tuned=...`` baseline group bench.py/speed3d stamp) with the regress
+median+MAD noise model, and exits 1 when a stored winner now runs
+slower than its recorded tournament time beyond noise — stale wisdom
+that should be re-measured.
 
 **record / history / compare** — the regression-tracking loop over the
 append-only run-record store (``benchmarks/results/history.jsonl``; see
@@ -515,11 +525,153 @@ def _main_compare(argv: list[str]) -> int:
     return 1 if (args.gate and regressed) else 0
 
 
+# ---------------------------------------------------------- wisdom CLI
+
+def _kind_matches(a: str, b: str) -> bool:
+    """Lenient device-kind equality: run records may carry the backend
+    name ("tpu") where the wisdom key carries the device kind ("TPU v5
+    lite") — substring match either way, case-insensitive."""
+    a, b = a.lower(), b.lower()
+    return a == b or a in b or b in a
+
+
+def _wisdom_fresh_seconds(entry: dict, records: list[dict]) -> list[float]:
+    """Per-execute seconds of fresh history records matching one wisdom
+    entry's winner tuple (the ``tuned=<label>`` baseline group) on the
+    same hardware, eligible runs only."""
+    winner = entry.get("winner") or {}
+    label = (f"{winner.get('decomposition')}/{winner.get('algorithm')}"
+             f"/{winner.get('executor')}/ov{winner.get('overlap_chunks')}")
+    kind = str((entry.get("key") or {}).get("device_kind", ""))
+    out = []
+    for rec in records:
+        cfg = rec.get("config") or {}
+        if cfg.get("tuned") != label:
+            continue
+        if not _kind_matches(str(rec.get("device_kind", "")), kind):
+            continue
+        if rec.get("fallback") or not rec.get("ok", True):
+            continue
+        sec = rec.get("seconds")
+        if isinstance(sec, (int, float)) and sec > 0:
+            out.append(float(sec))
+    return out
+
+
+def _wisdom_summary(entry: dict) -> tuple[str, str]:
+    """(key summary, winner label) display columns of one entry."""
+    key = entry.get("key") or {}
+    shape = "x".join(str(s) for s in key.get("shape") or [])
+    mesh = key.get("mesh")
+    where = ("mesh " + "x".join(str(d) for d in mesh) if mesh
+             else f"{key.get('ndev', '?')}dev")
+    k = (f"{key.get('kind', '?')} {shape} {key.get('dtype', '?')} "
+         f"dir{key.get('direction', '?')} {where} "
+         f"[{key.get('device_kind', '?')}]")
+    w = entry.get("winner") or {}
+    label = (f"{w.get('decomposition')}/{w.get('algorithm')}"
+             f"/{w.get('executor')}/ov{w.get('overlap_chunks')}")
+    return k, label
+
+
+def _main_wisdom(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m distributedfft_tpu.report wisdom",
+        description="Inspect the tuner's persistent wisdom store; with "
+                    "--gate, cross-check each stored winner against fresh "
+                    "history records of the same winner tuple (median + "
+                    "MAD noise model) and exit 1 when a stored winner "
+                    "regressed beyond noise (stale wisdom). Exit codes: "
+                    "0 clean, 1 stale winner (with --gate), 2 usage/IO "
+                    "error.")
+    p.add_argument("--wisdom", default=None, metavar="PATH",
+                   help="wisdom store (default: DFFT_WISDOM or "
+                        "<compile cache dir>/wisdom.jsonl)")
+    _history_arg(p)
+    p.add_argument("--gate", action="store_true",
+                   help="exit 1 when any stored winner regressed vs fresh "
+                        "history records of its tuple")
+    p.add_argument("--mads", type=float, default=regress.DEFAULT_MADS,
+                   help="noise band half-width in scaled MADs (default: "
+                        f"{regress.DEFAULT_MADS})")
+    p.add_argument("--min-rel", type=float, default=regress.DEFAULT_MIN_REL,
+                   help="noise band floor as a fraction of the median "
+                        f"(default: {regress.DEFAULT_MIN_REL})")
+    p.add_argument("--min-samples", type=int,
+                   default=regress.DEFAULT_MIN_SAMPLES,
+                   help="fresh records required for a verdict "
+                        f"(default: {regress.DEFAULT_MIN_SAMPLES})")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable JSON instead of the table")
+    args = p.parse_args(argv)
+
+    from . import tuner
+
+    path = args.wisdom or tuner.default_wisdom_path()
+    if path is None:
+        print("report wisdom: store disabled (DFFT_WISDOM is empty)",
+              file=sys.stderr)
+        return 2
+    entries, dropped = tuner.load_wisdom(path)
+    if dropped:
+        print(f"report wisdom: skipped {dropped} malformed line(s) in "
+              f"{path}", file=sys.stderr)
+    records: list[dict] = []
+    if args.gate or args.history:
+        history = _resolve_history(args)
+        records, hdropped = (regress.load_history(history) if history
+                             else ([], 0))
+        if hdropped:
+            print(f"report wisdom: skipped {hdropped} malformed line(s) in "
+                  f"{history}", file=sys.stderr)
+
+    rows = []
+    for entry in entries.values():
+        key_s, label = _wisdom_summary(entry)
+        row = {
+            "key": entry.get("key"),
+            "winner": label,
+            "seconds": entry.get("seconds"),
+            "recorded_at": entry.get("recorded_at"),
+        }
+        if args.gate:
+            fresh = _wisdom_fresh_seconds(entry, records)
+            row["gate"] = regress.wisdom_verdict(
+                float(entry.get("seconds") or 0.0), fresh,
+                mads=args.mads, min_rel=args.min_rel,
+                min_samples=args.min_samples)
+        rows.append((key_s, row))
+
+    if args.json:
+        print(json.dumps([r for _, r in rows], sort_keys=True))
+    elif not rows:
+        print(f"(empty wisdom store: {path})")
+    else:
+        for key_s, row in rows:
+            sec = row["seconds"]
+            line = (f"{key_s}  ->  {row['winner']}  "
+                    f"{'' if sec is None else f'{sec:.6f}s  '}"
+                    f"({row['recorded_at']})")
+            gate = row.get("gate")
+            if gate is not None:
+                line += f"  [{gate['verdict']}"
+                if "delta_pct" in gate:
+                    line += f" {gate['delta_pct']:+.1f}%"
+                line += f", fresh n={gate['fresh']['n']}]"
+            print(line)
+    stale = [r for _, r in rows
+             if (r.get("gate") or {}).get("verdict") == "regressed"]
+    if stale and not args.json:
+        print(f"{len(stale)} stale wisdom winner(s)", file=sys.stderr)
+    return 1 if (args.gate and stale) else 0
+
+
 _SUBCOMMANDS = {
     "merge": _main_merge,
     "record": _main_record,
     "history": _main_history,
     "compare": _main_compare,
+    "wisdom": _main_wisdom,
 }
 
 

@@ -1,0 +1,694 @@
+"""Measured plan autotuner with a persistent wisdom cache.
+
+The reference's plan-and-pick discipline builds hipfft, rocfft, and
+templateFFT plans side by side and keeps the measured winner
+(``setFFTPlans``, ``fft_mpi_3d_api.cpp:318-429``) — but only over the
+*executor* axis. heFFTe's headline result (and AccFFT's before it) is
+that the best decomposition/communication combination is
+configuration-dependent and must be **searched, not modeled**; FFTW's
+wisdom mechanism shows the search cost can be paid once and persisted.
+This module generalizes the tournament across the full joint space
+
+    decomposition (slab | pencil) x transport (alltoall | alltoallv |
+    ppermute) x executor x overlap_chunks K
+
+with three tiers:
+
+1. **Candidate generation** (:func:`enumerate_candidates` +
+   :func:`prune_candidates`) — the joint space is enumerated, then
+   pruned to <= ~8 survivors by the analytical payload model
+   (:func:`..plan_logic.exchange_payloads` wire bytes under each
+   transport + the 3-pass HBM roofline of ``docs/MFU_ANALYSIS.md``)
+   *before any compile* — the model is trusted to rank, never to pick.
+2. **Lockstep tournament** (:func:`measured_select`) — the generic
+   measured-selection engine (also backing ``executor="auto"``):
+   multi-host processes agree on the candidate set before any timing
+   execution, time in identical order, allgather the full time matrix,
+   and decide the winner from process 0's row restricted to candidates
+   finite on EVERY process — a candidate that failed timing on any
+   process can never be broadcast as winner (the build-phase flag
+   discipline extended to the timing phase).
+3. **Persistent wisdom** — winners appended to a JSONL store
+   (``DFFT_WISDOM`` path; default ``<compile cache dir>/wisdom.jsonl``)
+   keyed by (plan family, shape, dtype, direction, mesh shape,
+   device_kind, library versions), consulted by
+   ``PlanOptions.tune="wisdom"|"measure"`` so an identically-keyed
+   planner call in a fresh process builds the winner with zero timing
+   executions. Inspect/gate via ``python -m distributedfft_tpu.report
+   wisdom``.
+
+Env knobs: ``DFFT_TUNE`` (default tune mode), ``DFFT_WISDOM`` (store
+path; empty/``0`` disables), ``DFFT_TUNE_ITERS`` (timing budget,
+``ITERS`` or ``ITERSxREPEATS``), ``DFFT_TUNE_MAX`` (survivor cap),
+``DFFT_AUTO_EXECUTORS`` (executor axis). Full schema: ``docs/TUNING.md``.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import time
+from dataclasses import dataclass, replace
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from .parallel.exchange import WIRE_BYTE_KEYS, transport_steps
+from .plan_logic import (
+    PlanOptions,
+    auto_overlap_chunks,
+    eligible_decompositions,
+    exchange_payloads,
+    logic_plan3d,
+    resolve_tune_mode,
+)
+from .utils import metrics as _metrics
+from .utils.cache import compile_cache_dir, enable_compile_cache
+from .utils.trace import timed_span
+
+__all__ = [
+    "Candidate",
+    "enumerate_candidates",
+    "prune_candidates",
+    "model_cost",
+    "tune_budget",
+    "agree_winner",
+    "measured_select",
+    "default_wisdom_path",
+    "wisdom_key",
+    "load_wisdom",
+    "lookup_wisdom",
+    "record_wisdom",
+    "tuned_plan",
+    "tuned_label",
+]
+
+WISDOM_SCHEMA = 1
+
+#: Survivor cap of the pruning stage (``DFFT_TUNE_MAX`` overrides): past
+#: ~8 candidates the tournament's compile bill outweighs what measuring
+#: also-rans can recover.
+DEFAULT_MAX_CANDIDATES = 8
+
+# Analytical-model constants — RANKING constants, not predictions: the
+# model orders candidates for pruning and is never trusted to pick a
+# winner (that is what the measurement is for), so rough cross-platform
+# magnitudes suffice.  Wire bandwidth ~ one v5e ICI link, HBM ~ v5e, and
+# a O(100us) fixed cost per collective launch (dispatch + barrier + DMA
+# setup; the same floor OVERLAP_AUTO_MIN_CHUNK_BYTES models).
+MODEL_WIRE_GBPS = 45.0
+MODEL_HBM_GBPS = 819.0
+MODEL_LAUNCH_SECONDS = 100e-6
+
+#: Executor preference order when the model cannot rank them (it models
+#: geometry only): the menu order of ``api._AUTO_CANDIDATES``.
+_EXECUTOR_RANK = ("xla", "xla_minor", "matmul", "pallas")
+
+
+@dataclass(frozen=True)
+class Candidate:
+    """One point of the joint search space (the tuple a wisdom entry
+    records and a tuned plan stamps into benchmark result lines)."""
+
+    decomposition: str
+    algorithm: str
+    executor: str
+    overlap_chunks: int
+
+    @property
+    def label(self) -> str:
+        return (f"{self.decomposition}/{self.algorithm}/{self.executor}"
+                f"/ov{self.overlap_chunks}")
+
+
+def tuned_label(plan) -> str:
+    """The winner tuple of a tuned plan as the compact
+    ``decomposition/transport/executor/ovK`` label benchmark result
+    lines stamp (and the regress store keys baselines by)."""
+    return Candidate(
+        decomposition=plan.decomposition,
+        algorithm=plan.options.algorithm,
+        executor=plan.executor,
+        overlap_chunks=int(plan.options.overlap_chunks or 1),
+    ).label
+
+
+# ------------------------------------------------------------ candidates
+
+def _default_executors() -> list[str]:
+    """Executor search axis: ``DFFT_AUTO_EXECUTORS`` (the same knob the
+    ``executor="auto"`` tournament honors) or the built-in menu, minus
+    ``auto`` itself (would recurse) and minus Pallas off-TPU (it runs in
+    the Python interpreter there — meaningless to measure, same rule as
+    bench.py's candidate menu)."""
+    from .api import _AUTO_CANDIDATES
+
+    names = [e.strip() for e in os.environ.get(
+        "DFFT_AUTO_EXECUTORS", ",".join(_AUTO_CANDIDATES)).split(",")
+        if e.strip() and e.strip() != "auto"]
+    import jax
+
+    if jax.default_backend() != "tpu":
+        names = [n for n in names if not n.startswith("pallas")] or ["xla"]
+    return names
+
+
+def _overlap_values(shape, ndev: int, itemsize: int) -> list[int]:
+    """The K axis: monolithic, the analytical auto model's pick, and
+    double it — the measurement brackets the model (docs/MFU_ANALYSIS.md
+    "measured vs model K")."""
+    k = auto_overlap_chunks(shape, ndev, itemsize)
+    return sorted({1, k, 2 * k}) if k > 1 else [1]
+
+
+def enumerate_candidates(
+    shape: Sequence[int],
+    ndev: int,
+    *,
+    mesh_dims: tuple[int, ...] | None = None,
+    executors: Sequence[str] | None = None,
+    itemsize: int = 8,
+) -> list[Candidate]:
+    """Enumerate the joint (decomposition x transport x executor x K)
+    space for one plan. ``mesh_dims`` (a caller-fixed Mesh) pins the
+    decomposition axis — a 1D mesh can only run slab chains, a 2D mesh
+    only pencil; an int device count leaves both in play."""
+    shape = tuple(int(s) for s in shape)
+    if mesh_dims is not None:
+        decomps: tuple[str, ...] = (
+            "slab" if len(mesh_dims) == 1 else "pencil",)
+    else:
+        decomps = tuple(d for d in eligible_decompositions(shape, ndev)
+                        if d != "single")
+    execs = list(executors) if executors is not None else _default_executors()
+    ks = _overlap_values(shape, ndev, itemsize)
+    out = []
+    for d in decomps:
+        for alg in WIRE_BYTE_KEYS:
+            for k in ks:
+                for ex in execs:
+                    out.append(Candidate(d, alg, ex, k))
+    return out
+
+
+def model_cost(
+    cand: Candidate,
+    shape: Sequence[int],
+    mesh,
+    *,
+    itemsize: int = 8,
+) -> float:
+    """Analytical seconds estimate of one candidate — the pruning model.
+
+    Compute is the 3-pass HBM stream bound of ``docs/MFU_ANALYSIS.md``;
+    each exchange's wire bytes come from
+    :func:`..plan_logic.exchange_payloads` under the candidate's
+    transport (dense ships split+concat padding, ragged strips the split
+    pads, the ring ships dense bytes over P-1 latency-serialized steps);
+    overlap at K chunks shrinks the exposed exchange to
+    ``t2/K + max(0, t2 - t3)(K-1)/K`` and adds K-1 extra launches per
+    exchange (the crossover model ``auto_overlap_chunks`` implements).
+    Used to *rank* candidates before any compile, never to pick a
+    winner.
+    """
+    shape = tuple(int(s) for s in shape)
+    lp = logic_plan3d(shape, mesh, PlanOptions(
+        decomposition=cand.decomposition, algorithm=cand.algorithm,
+        tune="off"))
+    ndev = (math.prod(lp.mesh.devices.shape) if lp.mesh is not None else 1)
+    world_bytes = itemsize * math.prod(shape)
+    t_fft = 3 * 2 * (world_bytes / ndev) / (MODEL_HBM_GBPS * 1e9)
+    payloads = exchange_payloads(lp, shape, itemsize)
+    # Downstream FFT time each exchange can hide under: one chain stage.
+    t_stage = t_fft / (len(payloads) + 1)
+    k = max(1, cand.overlap_chunks)
+    total = t_fft
+    for e in payloads:
+        wire = e[WIRE_BYTE_KEYS[cand.algorithm]] / ndev
+        steps = transport_steps(cand.algorithm, e["parts"])
+        t_ex = wire / (MODEL_WIRE_GBPS * 1e9) + steps * MODEL_LAUNCH_SECONDS
+        exposed = t_ex / k + max(0.0, t_ex - t_stage) * (k - 1) / k
+        total += exposed + (k - 1) * steps * MODEL_LAUNCH_SECONDS
+    return total
+
+
+def prune_candidates(
+    candidates: Sequence[Candidate],
+    shape: Sequence[int],
+    mesh,
+    *,
+    itemsize: int = 8,
+    limit: int | None = None,
+) -> list[Candidate]:
+    """Prune the enumerated space to <= ``limit`` survivors before any
+    compile: geometry tuples (decomposition, transport, K) are ranked by
+    :func:`model_cost`, then crossed with the executor axis (which the
+    payload model cannot rank — executors differ in compute kernels, not
+    wire bytes) best-geometry-first, so the survivor set always measures
+    every executor on the model's preferred geometry before spending
+    compiles on runner-up geometries."""
+    if limit is None:
+        limit = int(os.environ.get("DFFT_TUNE_MAX", DEFAULT_MAX_CANDIDATES))
+    limit = max(1, limit)
+    geos: dict[tuple, list[Candidate]] = {}
+    for c in candidates:
+        geos.setdefault(
+            (c.decomposition, c.algorithm, c.overlap_chunks), []).append(c)
+
+    def geo_cost(key) -> float:
+        d, alg, k = key
+        probe = geos[(d, alg, k)][0]
+        return model_cost(probe, shape, mesh, itemsize=itemsize)
+
+    ranked = sorted(geos, key=lambda g: (geo_cost(g), g))
+
+    def exec_rank(c: Candidate) -> tuple:
+        base = c.executor.split(":", 1)[0]
+        try:
+            return (_EXECUTOR_RANK.index(base), c.executor)
+        except ValueError:
+            return (len(_EXECUTOR_RANK), c.executor)
+
+    out: list[Candidate] = []
+    for g in ranked:
+        for c in sorted(geos[g], key=exec_rank):
+            out.append(c)
+            if len(out) >= limit:
+                return out
+    return out
+
+
+# ------------------------------------------------------------ tournament
+
+def tune_budget() -> tuple[int, int]:
+    """(iters, repeats) of each candidate's amortized timing —
+    ``DFFT_TUNE_ITERS`` as ``"ITERS"`` or ``"ITERSxREPEATS"`` (default
+    10x2). Amortized timing (>= iters dispatches per host sync) so a
+    noisy transport's per-call latency cannot pick the wrong winner —
+    the reference times ``nt`` executes inside one ``MPI_Wtime`` pair
+    (``fftSpeed3d_c2c.cpp:94-98``) for the same reason."""
+    raw = os.environ.get("DFFT_TUNE_ITERS", "").strip()
+    if not raw:
+        return 10, 2
+    parts = raw.lower().split("x")
+    try:
+        if len(parts) == 1:
+            it, rep = int(parts[0]), 2
+        elif len(parts) == 2:
+            it, rep = int(parts[0]), int(parts[1])
+        else:
+            raise ValueError
+        if it < 1 or rep < 1:
+            raise ValueError
+    except ValueError:
+        raise ValueError(
+            f"DFFT_TUNE_ITERS must be 'ITERS' or 'ITERSxREPEATS' "
+            f"(ints >= 1), got {raw!r}") from None
+    return it, rep
+
+
+def _process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def _allgather_rows(vec: np.ndarray) -> np.ndarray:
+    """Allgather one float row per process -> (nproc, len(vec)) matrix.
+    Module-level indirection so tests can simulate multi-host
+    reconciliation without a real distributed runtime."""
+    from jax.experimental import multihost_utils
+
+    out = np.asarray(multihost_utils.process_allgather(vec))
+    return out.reshape(-1, len(vec))
+
+
+def agree_winner(times: np.ndarray, names: Sequence[str]) -> str:
+    """The winner decision, as a pure function of the allgathered
+    (nproc, ncand) time matrix — every process computes it from the same
+    matrix, so the choice is deterministic across hosts with no separate
+    broadcast step.
+
+    Eligible candidates are those with a finite time on EVERY process (a
+    candidate that failed timing anywhere must be excluded everywhere,
+    or the processes would build different collective programs — the
+    timing-phase analog of the build-phase flag agreement); among those,
+    process 0's clock picks (wall clocks differ per process, so one
+    process's ordering must be authoritative)."""
+    times = np.asarray(times, np.float64).reshape(-1, len(names))
+    eligible = np.isfinite(times).all(axis=0)
+    if not eligible.any():
+        raise ValueError(
+            "no candidate was timed successfully on every process")
+    row0 = np.where(eligible, times[0], np.inf)
+    return list(names)[int(np.argmin(row0))]
+
+
+def measured_select(
+    names: Sequence[str],
+    build: Callable[[str], Any],
+    measure: Callable[[Any], float],
+    *,
+    what: str = "candidate",
+) -> tuple[str, dict[str, Any], dict[str, float]]:
+    """The generic measured-selection engine: build every candidate, time
+    the ones every process built, keep the fastest. Backs both the
+    multi-axis tuner and ``executor="auto"`` (``api._autotune``).
+
+    Returns ``(winner, built, times)``. Per-candidate build and measure
+    costs are emitted as ``tune_build_*``/``tune_measure_*`` trace spans
+    and metrics histograms. The persistent XLA compile cache is enabled
+    first (``DFFT_NO_COMPILE_CACHE=1`` opts out), so candidate compiles
+    are cached across re-tunes and process restarts — a replayed
+    tournament mostly just measures.
+
+    Multi-host discipline: (1) candidates that built on only some
+    processes are timed on none (build-flag allgather) — otherwise the
+    processes that have one enter collective executions the others never
+    join; (2) timing runs in identical order and execution count on
+    every process; (3) the winner comes from :func:`agree_winner` over
+    the allgathered time matrix — finite on every process, ranked by
+    process 0's clock. Failures are never fatal per candidate; only an
+    empty survivor set raises (jointly, after the collectives, so no
+    process is stranded mid-protocol).
+    """
+    enable_compile_cache()
+    names = list(names)
+    errors: list[str] = []
+
+    # Phase 1: build (jit is lazy, so building is host-local and never
+    # emits collectives).
+    built: dict[str, Any] = {}
+    for nm in names:
+        try:
+            with timed_span(f"tune_build_{nm}") as span:
+                obj = build(nm)
+        except Exception as e:  # noqa: BLE001 — candidate skipped
+            errors.append(f"{nm}: {type(e).__name__}")
+            continue
+        built[nm] = obj
+        _metrics.observe("tune_build_seconds", span["seconds"], candidate=nm)
+    multi = _process_count() > 1
+    if not built and not multi:
+        # Multi-host must NOT raise here: every process has to reach the
+        # reconciliation collectives below even with an empty local set,
+        # or the others block in them forever.
+        raise ValueError(
+            f"no {what} succeeded ({'; '.join(errors)})")
+
+    candidates = [nm for nm in names if nm in built]
+    if multi:
+        flags = np.array([1.0 if nm in built else 0.0 for nm in names])
+        common = _allgather_rows(flags).min(axis=0) > 0
+        candidates = [nm for i, nm in enumerate(names) if common[i]]
+        if not candidates:
+            raise ValueError(
+                f"no {what} built on every process "
+                f"(local: {sorted(built)}; errors: {'; '.join(errors)})")
+
+    # Phase 2: time the agreed candidates in lockstep.
+    times: dict[str, float] = {}
+    for nm in candidates:
+        try:
+            with timed_span(f"tune_measure_{nm}") as span:
+                t = float(measure(built[nm]))
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"{nm}: {type(e).__name__}")
+            t = math.inf
+        times[nm] = t
+        _metrics.inc("tune_timing_executions", candidate=nm)
+        _metrics.observe("tune_measure_seconds", span["seconds"],
+                         candidate=nm)
+
+    # Phase 3: reconcile and pick. The all-failed decision is made from
+    # the allgathered matrix on every process — a local raise before the
+    # collective would strand the other processes in it.
+    vec = np.array([times[nm] for nm in candidates], np.float64)
+    matrix = _allgather_rows(vec) if multi else vec.reshape(1, -1)
+    try:
+        winner = agree_winner(matrix, candidates)
+    except ValueError:
+        raise ValueError(
+            f"every {what} failed timing"
+            + (f" ({'; '.join(errors)})" if errors else "")) from None
+    return winner, built, times
+
+
+# ---------------------------------------------------------------- wisdom
+
+def default_wisdom_path() -> str | None:
+    """The wisdom store path: ``DFFT_WISDOM`` when set (empty or ``0``
+    disables the store entirely -> None), else ``wisdom.jsonl`` under
+    the persistent compile-cache directory (both artifacts are derived,
+    hardware-keyed, and safe to delete together)."""
+    env = os.environ.get("DFFT_WISDOM")
+    if env is not None:
+        env = env.strip()
+        return None if env in ("", "0") else env
+    return os.path.join(compile_cache_dir(), "wisdom.jsonl")
+
+
+def wisdom_key(
+    *,
+    kind: str,
+    shape: Sequence[int],
+    dtype,
+    direction: int,
+    ndev: int,
+    mesh_dims: Sequence[int] | None = None,
+    layouts: str | None = None,
+    device_kind: str | None = None,
+    platform: str | None = None,
+) -> dict:
+    """The identity a wisdom entry is valid for. A measured winner
+    transfers only within one (plan family, problem, mesh, hardware,
+    code version) tuple — FFTW's wisdom scoping, plus the library
+    versions because a new release may change what any candidate
+    compiles to."""
+    import jax
+
+    from . import __version__
+
+    if device_kind is None:
+        try:
+            device_kind = jax.devices()[0].device_kind
+        except Exception:  # noqa: BLE001 — backendless key (tests, CLI)
+            device_kind = "unknown"
+    return {
+        "kind": str(kind),
+        "shape": [int(s) for s in shape],
+        "dtype": str(np.dtype(dtype)),
+        "direction": int(direction),
+        "ndev": int(ndev),
+        "mesh": None if mesh_dims is None else [int(d) for d in mesh_dims],
+        "layouts": layouts,
+        "device_kind": str(device_kind),
+        "platform": platform or jax.default_backend(),
+        "x64": bool(jax.config.jax_enable_x64),
+        "version": __version__,
+        "jax": jax.__version__,
+    }
+
+
+def _key_id(key: dict) -> str:
+    return json.dumps(key, sort_keys=True)
+
+
+def load_wisdom(path: str | None) -> tuple[dict[str, dict], int]:
+    """Load the JSONL wisdom store leniently: ``({key_id: entry},
+    dropped)`` where malformed lines (the truncated tail of a killed
+    writer, non-JSON, entries without key/winner) are counted, never
+    raised — the report-merge discipline. Append-only store: the newest
+    entry per key wins."""
+    if path is None:
+        return {}, 0
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return {}, 0
+    entries: dict[str, dict] = {}
+    dropped = 0
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            dropped += 1
+            continue
+        if (not isinstance(obj, dict) or not isinstance(obj.get("key"), dict)
+                or not isinstance(obj.get("winner"), dict)):
+            dropped += 1
+            continue
+        entries[_key_id(obj["key"])] = obj
+    return entries, dropped
+
+
+def _read_wisdom(path: str | None) -> dict[str, dict]:
+    entries, dropped = load_wisdom(path)
+    if dropped:
+        print(f"tuner: {path}: skipped {dropped} malformed wisdom line(s)",
+              file=sys.stderr)
+    return entries
+
+
+def lookup_wisdom(key: dict, path: str | None = None) -> dict | None:
+    """The newest stored entry for ``key`` (exact identity match), or
+    None. Malformed lines are skipped with a count on stderr."""
+    if path is None:
+        path = default_wisdom_path()
+    return _read_wisdom(path).get(_key_id(key))
+
+
+def record_wisdom(
+    key: dict,
+    winner: Candidate,
+    seconds: float,
+    *,
+    path: str | None = None,
+    times: dict[str, float] | None = None,
+) -> dict | None:
+    """Append one tournament result to the wisdom store (created, with
+    parent directory, on first use). Returns the entry, or None when the
+    store is disabled."""
+    if path is None:
+        path = default_wisdom_path()
+    if path is None:
+        return None
+    it, rep = tune_budget()
+    entry = {
+        "schema": WISDOM_SCHEMA,
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "key": key,
+        "winner": {
+            "decomposition": winner.decomposition,
+            "algorithm": winner.algorithm,
+            "executor": winner.executor,
+            "overlap_chunks": int(winner.overlap_chunks),
+        },
+        "seconds": float(seconds),
+        "budget": [it, rep],
+    }
+    if times:
+        entry["times"] = {
+            nm: (None if not math.isfinite(t) else float(t))
+            for nm, t in times.items()}
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(entry, sort_keys=True) + "\n")
+    return entry
+
+
+# ------------------------------------------------------ planner dispatch
+
+def _mesh_context(mesh) -> tuple[int, tuple[int, ...] | None]:
+    """(device count, fixed mesh dims or None) of a planner mesh arg."""
+    if mesh is None:
+        return 1, None
+    if isinstance(mesh, int):
+        return mesh, None
+    return int(math.prod(mesh.devices.shape)), tuple(mesh.devices.shape)
+
+
+def _build_candidate(kind: str, shape, mesh, base: PlanOptions, plan_kw: dict,
+                     cand: Candidate, *, donate: bool):
+    """Build one concrete plan for a candidate tuple (always with
+    ``tune="off"`` — the recursion fence)."""
+    from . import api
+
+    opts = replace(
+        base, tune="off", decomposition=cand.decomposition,
+        algorithm=cand.algorithm, executor=cand.executor,
+        overlap_chunks=int(cand.overlap_chunks), donate=donate)
+    plan_fn = api.plan_dft_r2c_3d if kind == "r2c" else api.plan_dft_c2c_3d
+    return plan_fn(shape, mesh, options=opts, **plan_kw)
+
+
+def tuned_plan(kind: str, shape, mesh, options: PlanOptions,
+               plan_kw: dict):
+    """The tuned tier of the public planners (``tune="wisdom"`` /
+    ``"measure"``): consult wisdom first; on a miss either fall back to
+    the static heuristics (wisdom mode — never measures) or run the
+    pruned tournament and record the winner (measure mode). The caller's
+    ``donate`` is honored by rebuilding the winner (tournament plans are
+    built donation-free: a donated buffer cannot be re-executed for
+    timing)."""
+    from . import api
+
+    mode = resolve_tune_mode(options.tune)
+    shape = tuple(int(s) for s in shape)
+    base = replace(options, tune="off", donate=False)
+    ndev, mesh_dims = _mesh_context(mesh)
+    heuristic = replace(options, tune="off")
+    if ndev <= 1:
+        # Single device: no decomposition/transport/K to search, and the
+        # executor menu already has its own measured path (executor=
+        # "auto") — nothing a tournament could add.
+        plan_fn = (api.plan_dft_r2c_3d if kind == "r2c"
+                   else api.plan_dft_c2c_3d)
+        return plan_fn(shape, mesh, options=heuristic, **plan_kw)
+
+    dtype = api._default_cdtype(plan_kw.get("dtype"))
+    in_spec, out_spec = plan_kw.get("in_spec"), plan_kw.get("out_spec")
+    layouts = (f"{in_spec}|{out_spec}"
+               if (in_spec is not None or out_spec is not None) else None)
+    key = wisdom_key(
+        kind=kind, shape=shape, dtype=dtype,
+        direction=plan_kw.get("direction", -1),
+        ndev=ndev, mesh_dims=mesh_dims, layouts=layouts)
+    path = default_wisdom_path()
+
+    entry = lookup_wisdom(key, path) if path is not None else None
+    if entry is not None:
+        _metrics.inc("tune_wisdom_hits", kind=kind)
+        cand = Candidate(
+            decomposition=str(entry["winner"]["decomposition"]),
+            algorithm=str(entry["winner"]["algorithm"]),
+            executor=str(entry["winner"]["executor"]),
+            overlap_chunks=int(entry["winner"]["overlap_chunks"]),
+        )
+        return _build_candidate(kind, shape, mesh, base, plan_kw, cand,
+                                donate=options.donate)
+    _metrics.inc("tune_wisdom_misses", kind=kind)
+    if mode == "wisdom":
+        # Wisdom-only mode never pays a measurement: the static
+        # heuristics plan exactly as tune="off" would.
+        plan_fn = (api.plan_dft_r2c_3d if kind == "r2c"
+                   else api.plan_dft_c2c_3d)
+        return plan_fn(shape, mesh, options=heuristic, **plan_kw)
+
+    itemsize = np.dtype(dtype).itemsize
+    cands = prune_candidates(
+        enumerate_candidates(shape, ndev, mesh_dims=mesh_dims,
+                             itemsize=itemsize),
+        shape, mesh, itemsize=itemsize)
+    _metrics.set_gauge("tune_candidates", len(cands), kind=kind,
+                       stage="pruned")
+    by_label = {c.label: c for c in cands}
+    _metrics.inc("tune_tournaments", kind=kind)
+    iters, repeats = tune_budget()
+
+    def build(label: str):
+        return _build_candidate(kind, shape, mesh, base, plan_kw,
+                                by_label[label], donate=False)
+
+    def measure(plan) -> float:
+        from .utils.timing import time_fn_amortized
+
+        x = api.alloc_local(plan)
+        t, _ = time_fn_amortized(plan.fn, x, iters=iters, repeats=repeats)
+        return t
+
+    winner, built, times = measured_select(
+        list(by_label), build, measure, what=f"{kind} tune candidate")
+    record_wisdom(key, by_label[winner], times[winner], path=path,
+                  times=times)
+    if options.donate:
+        return _build_candidate(kind, shape, mesh, base, plan_kw,
+                                by_label[winner], donate=True)
+    return built[winner]

@@ -1,11 +1,21 @@
 """Persistent XLA compilation cache setup, shared by every benchmark
-driver: on the flaky TPU tunnel a retry must not pay the 20-40s compile
-again. One definition so the knob names, default directory, and threshold
+driver and the measured autotuner: on the flaky TPU tunnel a retry must
+not pay the 20-40s compile again, and a re-tune (or a restarted process
+replaying a tournament) must pay each candidate's compile at most once.
+One definition so the knob names, default directory, and threshold
 cannot drift between drivers."""
 
 from __future__ import annotations
 
 import os
+
+
+def compile_cache_dir() -> str:
+    """The persistent plan/compile cache directory (``DFFT_COMPILE_CACHE``
+    override). Also the default home of the tuner's wisdom store — both
+    artifacts have the same lifecycle: derived, hardware-keyed, safe to
+    delete."""
+    return os.environ.get("DFFT_COMPILE_CACHE", "/tmp/dfft_xla_cache")
 
 
 def enable_compile_cache() -> None:
@@ -17,9 +27,7 @@ def enable_compile_cache() -> None:
     import jax
 
     try:
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.environ.get("DFFT_COMPILE_CACHE", "/tmp/dfft_xla_cache"))
+        jax.config.update("jax_compilation_cache_dir", compile_cache_dir())
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:  # noqa: BLE001 — the cache is an optimization only
         pass

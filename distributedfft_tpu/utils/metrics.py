@@ -21,6 +21,19 @@ Registered series (wired in :mod:`..api`):
   execute, the true information moved vs the bytes the plan's exchange
   algorithm ships (``plan_logic.exchange_payloads`` accounting).
 
+Tuner series (wired in :mod:`..tuner`):
+
+- ``tune_tournaments`` (counter; kind) — measured-selection tournaments
+  actually run (wisdom hits skip these entirely).
+- ``tune_timing_executions`` (counter; candidate) — one per candidate
+  timed in a tournament; zero across a planner call proves the wisdom
+  path was taken.
+- ``tune_wisdom_hits`` / ``tune_wisdom_misses`` (counter; kind) — the
+  wisdom-store outcome of every tuned planner call.
+- ``tune_build_seconds`` / ``tune_measure_seconds`` (histogram;
+  candidate) — per-candidate plan-build/compile and timing cost, also
+  emitted as ``tune_build_*``/``tune_measure_*`` trace spans.
+
 Disabled-path discipline: everything is gated on one module-level flag
 (the ``tracing_enabled()`` pattern of :mod:`.trace`) — with metrics off
 (the default) every hook is a single attribute check and early return,

@@ -194,6 +194,22 @@ def add_trace(name: str):
             ev.append((name, start, time.perf_counter()))
 
 
+@contextmanager
+def timed_span(name: str):
+    """:func:`add_trace` plus wall-clock capture: yields a dict whose
+    ``"seconds"`` is filled on exit. For callers that feed the duration
+    to the metrics registry as well as the trace timeline (the tuner's
+    per-candidate compile/measure spans) — one clock read serves both,
+    so the two surfaces can never disagree about a span's length."""
+    out = {"seconds": 0.0}
+    with add_trace(name):
+        start = time.perf_counter()
+        try:
+            yield out
+        finally:
+            out["seconds"] = time.perf_counter() - start
+
+
 def traced_stage(name: str, fn):
     """Wrap one staged-pipeline callable so every call records a named
     event (the per-stage breakdown of ``fft_mpi_3d_api.cpp:184-201`` as

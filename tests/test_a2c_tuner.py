@@ -1,0 +1,466 @@
+"""Measured plan autotuner + persistent wisdom (``distributedfft_tpu/tuner.py``).
+
+The multi-axis generalization of the ``setFFTPlans`` plan-and-pick
+discipline: candidate generation and analytical pruning, the lockstep
+tournament engine (multi-host build- AND timing-flag agreement, winner
+from the allgathered time matrix), and the FFTW-style wisdom store
+(measure once, build winners from disk forever after). The contracts
+pinned here:
+
+1. **Round trip** — ``tune="measure"`` runs one pruned tournament; an
+   identically-keyed planner call afterwards builds the winner from
+   wisdom with ZERO timing executions (metrics registry asserted).
+2. **Key isolation** — a different device_kind / mesh / dtype never
+   reuses an entry.
+3. **Store robustness** — corrupt/truncated wisdom lines are skipped
+   with a stderr count (the report-merge discipline), never fatal.
+4. **Winner determinism** — the decision is a pure function of the
+   allgathered time matrix: every process computes the same winner, and
+   a candidate that failed timing on ANY process can never win (the
+   divergence the build-phase-only flag agreement used to allow).
+5. **Default off** — ``tune`` unset never dispatches to the tuner.
+
+NOTE on the filename: this module must collect BEFORE
+``test_alltoallv.py`` — the environment's XLA:CPU fft-thunk layout bug
+(see ``test_a2a_overlap.py``'s header) permanently poisons the
+process's sharded dispatch stream once tripped, and the tournament
+executions here need a clean backend. This file itself triggers no
+fft-layout fault (tournaments run c2c chains, and the r2c test pins a
+1D mesh — the bad geometry is the uneven r2c *pencil* chain).
+"""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+import jax
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu import report, tuner
+from distributedfft_tpu import testing as tu
+from distributedfft_tpu import regress
+from distributedfft_tpu.plan_logic import PlanOptions, resolve_tune_mode
+from distributedfft_tpu.utils import metrics as m
+
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the virtual 8-device mesh"
+)
+
+
+@pytest.fixture
+def wisdom_path(tmp_path, monkeypatch):
+    """Isolated wisdom store (and compile cache) for one test."""
+    monkeypatch.setenv("DFFT_WISDOM", str(tmp_path / "wisdom.jsonl"))
+    monkeypatch.setenv("DFFT_COMPILE_CACHE", str(tmp_path / "xla_cache"))
+    return str(tmp_path / "wisdom.jsonl")
+
+
+@pytest.fixture
+def fast_budget(monkeypatch):
+    """Smallest legal tournament: 1 iter x 1 repeat, 3 survivors."""
+    monkeypatch.setenv("DFFT_TUNE_ITERS", "1x1")
+    monkeypatch.setenv("DFFT_TUNE_MAX", "3")
+
+
+@pytest.fixture
+def metrics_on():
+    dfft.clear_plan_cache()
+    m.metrics_reset()
+    m.enable_metrics()
+    yield
+    m.enable_metrics(False)
+    m.metrics_reset()
+    dfft.clear_plan_cache()
+
+
+# ----------------------------------------------------- options plumbing
+
+def test_plan_options_validates_tune():
+    assert PlanOptions(tune="measure").tune == "measure"
+    assert PlanOptions().tune is None
+    with pytest.raises(ValueError, match="tune"):
+        PlanOptions(tune="bogus")
+
+
+def test_resolve_tune_mode_env(monkeypatch):
+    monkeypatch.delenv("DFFT_TUNE", raising=False)
+    assert resolve_tune_mode(None) == "off"
+    assert resolve_tune_mode("wisdom") == "wisdom"
+    monkeypatch.setenv("DFFT_TUNE", "measure")
+    assert resolve_tune_mode(None) == "measure"
+    monkeypatch.setenv("DFFT_TUNE", "nonsense")
+    with pytest.raises(ValueError, match="DFFT_TUNE"):
+        resolve_tune_mode(None)
+
+
+def test_default_off_never_dispatches_to_tuner(monkeypatch):
+    """tune unset (and DFFT_TUNE unset) must plan exactly the legacy
+    path — the tuner is never even consulted."""
+    monkeypatch.delenv("DFFT_TUNE", raising=False)
+
+    def boom(*a, **k):  # pragma: no cover - failure path
+        raise AssertionError("tuner dispatched on a default planner call")
+
+    monkeypatch.setattr(tuner, "tuned_plan", boom)
+    dfft.clear_plan_cache()
+    plan = dfft.plan_dft_c2c_3d((8, 6, 4), dfft.make_mesh(2),
+                                dtype=np.complex64)
+    assert plan.options.tune in (None, "off")
+    x = tu.make_world_data((8, 6, 4), dtype=np.complex64)
+    got = np.asarray(plan(x))
+    want = np.fft.fftn(x)
+    assert np.max(np.abs(got - want)) / np.abs(want).max() < 5e-4
+
+
+def test_tune_budget_parsing(monkeypatch):
+    monkeypatch.delenv("DFFT_TUNE_ITERS", raising=False)
+    assert tuner.tune_budget() == (10, 2)
+    monkeypatch.setenv("DFFT_TUNE_ITERS", "6")
+    assert tuner.tune_budget() == (6, 2)
+    monkeypatch.setenv("DFFT_TUNE_ITERS", "4x3")
+    assert tuner.tune_budget() == (4, 3)
+    for bad in ("0", "x", "3x0", "abc", "1x2x3"):
+        monkeypatch.setenv("DFFT_TUNE_ITERS", bad)
+        with pytest.raises(ValueError, match="DFFT_TUNE_ITERS"):
+            tuner.tune_budget()
+
+
+# ------------------------------------------------- candidates + pruning
+
+@needs_mesh
+def test_enumerate_and_prune_candidates():
+    shape = (64, 64, 64)
+    cands = tuner.enumerate_candidates(
+        shape, 8, executors=["xla", "matmul"])
+    # Joint space: both decompositions, all three transports, both
+    # executors, K in {1, K_auto, 2 K_auto}.
+    assert {c.decomposition for c in cands} == {"slab", "pencil"}
+    assert {c.algorithm for c in cands} == {
+        "alltoall", "alltoallv", "ppermute"}
+    assert {c.executor for c in cands} == {"xla", "matmul"}
+    survivors = tuner.prune_candidates(cands, shape, 8, limit=4)
+    assert len(survivors) == 4
+    assert all(s in cands for s in survivors)
+    # The executor axis is crossed onto the model's best geometry first:
+    # the leading survivors share one geometry and cover both executors.
+    g0 = (survivors[0].decomposition, survivors[0].algorithm,
+          survivors[0].overlap_chunks)
+    lead = [s for s in survivors
+            if (s.decomposition, s.algorithm, s.overlap_chunks) == g0]
+    assert {s.executor for s in lead} == {"xla", "matmul"}
+
+
+@needs_mesh
+def test_enumerate_respects_fixed_mesh_dims():
+    cands = tuner.enumerate_candidates(
+        (16, 16, 16), 8, mesh_dims=(8,), executors=["xla"])
+    assert {c.decomposition for c in cands} == {"slab"}
+    cands = tuner.enumerate_candidates(
+        (16, 16, 16), 8, mesh_dims=(2, 4), executors=["xla"])
+    assert {c.decomposition for c in cands} == {"pencil"}
+
+
+@needs_mesh
+def test_model_cost_prefers_fewer_exchanges_small_mesh():
+    """On a small mesh with slab-friendly extents the one-exchange slab
+    chain must model cheaper than the two-exchange ring pencil chain —
+    the ordering the pruning stage relies on."""
+    shape = (64, 64, 64)
+    slab = tuner.Candidate("slab", "alltoall", "xla", 1)
+    ring_pencil = tuner.Candidate("pencil", "ppermute", "xla", 1)
+    assert (tuner.model_cost(slab, shape, 8)
+            < tuner.model_cost(ring_pencil, shape, 8))
+
+
+# ------------------------------------------------------- winner picking
+
+def test_agree_winner_is_deterministic_and_uses_process0_clock():
+    names = ["a", "b"]
+    times = np.array([[2.0, 1.0],   # process 0: b faster
+                      [1.0, 2.0]])  # process 1 disagrees (its own clock)
+    # Every process computes from the same matrix -> same winner, ranked
+    # by process 0's row.
+    assert tuner.agree_winner(times, names) == "b"
+    assert tuner.agree_winner(times.copy(), names) == "b"
+
+
+def test_agree_winner_excludes_candidate_failing_anywhere():
+    """The satellite fix: a candidate that timed fastest on process 0
+    but failed (inf) on another process must NOT win — the old
+    broadcast-only reconciliation would have picked it and diverged."""
+    names = ["fast_but_broken", "steady"]
+    times = np.array([[0.001, 0.002],
+                      [np.inf, 0.002]])
+    assert tuner.agree_winner(times, names) == "steady"
+    with pytest.raises(ValueError, match="every process"):
+        tuner.agree_winner(np.array([[np.inf], [np.inf]]), ["only"])
+
+
+def test_measured_select_multihost_timing_divergence(monkeypatch):
+    """End-to-end through the engine: simulate two processes where one
+    candidate builds everywhere but fails timing on the OTHER process
+    only. The local (process-0) view times it fastest; the reconciled
+    winner must still be the candidate finite everywhere."""
+    monkeypatch.setattr(tuner, "_process_count", lambda: 2)
+    calls = []
+
+    def fake_allgather(vec):
+        calls.append(np.array(vec))
+        if len(calls) == 1:  # build flags: both processes built both
+            return np.stack([vec, vec])
+        other = np.array(vec)
+        other[0] = np.inf    # candidate 0 failed timing on process 1
+        return np.stack([vec, other])
+
+    monkeypatch.setattr(tuner, "_allgather_rows", fake_allgather)
+    local_times = {"quick": 0.001, "steady": 0.002}
+    winner, built, times = tuner.measured_select(
+        ["quick", "steady"], build=lambda nm: nm,
+        measure=lambda nm: local_times[nm])
+    assert winner == "steady"
+    assert built == {"quick": "quick", "steady": "steady"}
+    assert len(calls) == 2  # one flags round, one timing round
+
+
+def test_measured_select_skips_failed_builds():
+    def build(nm):
+        if nm == "broken":
+            raise RuntimeError("no such executor")
+        return nm
+
+    winner, built, _ = tuner.measured_select(
+        ["broken", "ok"], build=build, measure=lambda nm: 1.0)
+    assert winner == "ok"
+    assert "broken" not in built
+    with pytest.raises(ValueError, match="no thing succeeded"):
+        tuner.measured_select(
+            ["a"], build=lambda nm: 1 / 0, measure=lambda nm: 1.0,
+            what="thing")
+
+
+# --------------------------------------------------------------- wisdom
+
+def _fake_key(**over):
+    kw = dict(kind="c2c", shape=(16, 16, 16), dtype=np.complex64,
+              direction=-1, ndev=8, mesh_dims=None,
+              device_kind="cpu", platform="cpu")
+    kw.update(over)
+    return tuner.wisdom_key(**kw)
+
+
+def test_wisdom_key_isolation(wisdom_path):
+    cand = tuner.Candidate("slab", "alltoall", "xla", 1)
+    key = _fake_key()
+    tuner.record_wisdom(key, cand, 0.001, path=wisdom_path)
+    assert tuner.lookup_wisdom(key, wisdom_path) is not None
+    # A different device kind, mesh shape, device count, dtype, or
+    # direction must never reuse the entry.
+    for other in (
+        _fake_key(device_kind="TPU v5 lite"),
+        _fake_key(mesh_dims=(2, 4)),
+        _fake_key(ndev=4),
+        _fake_key(dtype=np.complex128),
+        _fake_key(direction=+1),
+        _fake_key(shape=(16, 16, 8)),
+        _fake_key(kind="r2c"),
+    ):
+        assert tuner.lookup_wisdom(other, wisdom_path) is None
+
+
+def test_wisdom_newest_entry_wins(wisdom_path):
+    key = _fake_key()
+    tuner.record_wisdom(key, tuner.Candidate("slab", "alltoall", "xla", 1),
+                        0.001, path=wisdom_path)
+    tuner.record_wisdom(key, tuner.Candidate("pencil", "ppermute", "matmul",
+                                             2), 0.0005, path=wisdom_path)
+    entry = tuner.lookup_wisdom(key, wisdom_path)
+    assert entry["winner"]["decomposition"] == "pencil"
+    assert entry["winner"]["overlap_chunks"] == 2
+
+
+def test_corrupt_wisdom_lines_skipped(wisdom_path, capsys):
+    key = _fake_key()
+    entry = tuner.record_wisdom(
+        key, tuner.Candidate("slab", "alltoall", "xla", 1), 0.001,
+        path=wisdom_path)
+    with open(wisdom_path, "a") as f:
+        f.write("not json at all\n")
+        f.write(json.dumps({"schema": 1, "no_key": True}) + "\n")
+        # The truncated tail a killed writer leaves behind.
+        f.write(json.dumps(entry)[: len(json.dumps(entry)) // 2] + "\n")
+    entries, dropped = tuner.load_wisdom(wisdom_path)
+    assert len(entries) == 1 and dropped == 3
+    # The lookup path reports the skip count on stderr, never raises.
+    assert tuner.lookup_wisdom(key, wisdom_path) is not None
+    err = capsys.readouterr().err
+    assert "skipped 3 malformed wisdom line" in err
+
+
+def test_load_wisdom_missing_or_disabled(tmp_path, monkeypatch):
+    assert tuner.load_wisdom(str(tmp_path / "absent.jsonl")) == ({}, 0)
+    assert tuner.load_wisdom(None) == ({}, 0)
+    monkeypatch.setenv("DFFT_WISDOM", "")
+    assert tuner.default_wisdom_path() is None
+    monkeypatch.setenv("DFFT_WISDOM", "0")
+    assert tuner.default_wisdom_path() is None
+    monkeypatch.delenv("DFFT_WISDOM", raising=False)
+    monkeypatch.setenv("DFFT_COMPILE_CACHE", str(tmp_path / "cc"))
+    assert tuner.default_wisdom_path() == str(tmp_path / "cc" /
+                                              "wisdom.jsonl")
+
+
+# --------------------------------------------- tuned planning (8-way)
+
+@needs_mesh
+def test_measure_round_trip_wisdom(wisdom_path, fast_budget, metrics_on):
+    """The acceptance loop: a pruned multi-axis tournament runs once;
+    the identically-keyed second planner call (fresh plan cache) builds
+    the winner from wisdom with zero timing executions."""
+    shape = (16, 12, 8)
+    plan = dfft.plan_dft_c2c_3d(shape, 8, dtype=np.complex64,
+                                tune="measure")
+    assert m.counter_total("tune_tournaments") == 1
+    assert m.counter_total("tune_timing_executions") >= 2
+    assert m.counter_total("tune_wisdom_misses") == 1
+    label = tuner.tuned_label(plan)
+
+    # Correctness of whatever won.
+    x = tu.make_world_data(shape, dtype=np.complex64)
+    got = np.asarray(plan(x))
+    want = np.fft.fftn(x)
+    assert np.max(np.abs(got - want)) / np.abs(want).max() < 5e-4
+
+    # Fresh process analog: drop the in-memory plan cache, keep the
+    # on-disk wisdom. The second call must not time anything.
+    dfft.clear_plan_cache()
+    m.metrics_reset()
+    plan2 = dfft.plan_dft_c2c_3d(shape, 8, dtype=np.complex64,
+                                 tune="measure")
+    assert m.counter_total("tune_timing_executions") == 0
+    assert m.counter_total("tune_tournaments") == 0
+    assert m.counter_total("tune_wisdom_hits") == 1
+    assert tuner.tuned_label(plan2) == label
+    got2 = np.asarray(plan2(x))
+    assert np.max(np.abs(got2 - want)) / np.abs(want).max() < 5e-4
+
+
+@needs_mesh
+def test_wisdom_mode_never_measures(wisdom_path, fast_budget, metrics_on):
+    """tune="wisdom" with an empty store: static-heuristic plan, zero
+    timing executions, miss counted."""
+    plan = dfft.plan_dft_c2c_3d((16, 16, 16), 8, dtype=np.complex64,
+                                tune="wisdom")
+    assert m.counter_total("tune_timing_executions") == 0
+    assert m.counter_total("tune_tournaments") == 0
+    assert m.counter_total("tune_wisdom_misses") == 1
+    # 8 devices <= min(16, 16): the static heuristic picks slab.
+    assert plan.decomposition == "slab"
+    assert plan.executor == "xla"
+
+
+@needs_mesh
+def test_measure_honors_donate_by_rebuilding(wisdom_path, monkeypatch,
+                                             metrics_on):
+    monkeypatch.setenv("DFFT_TUNE_ITERS", "1x1")
+    monkeypatch.setenv("DFFT_TUNE_MAX", "1")
+    plan = dfft.plan_dft_c2c_3d((8, 8, 8), 8, dtype=np.complex64,
+                                tune="measure", donate=True)
+    assert plan.options.donate is True
+    x = dfft.alloc_local(plan, fill=tu.make_world_data((8, 8, 8),
+                                                       dtype=np.complex64))
+    y = plan(x)  # consumes x
+    assert y.shape == (8, 8, 8)
+
+
+@needs_mesh
+def test_r2c_tuned_on_fixed_slab_mesh(wisdom_path, fast_budget, metrics_on):
+    """r2c through the tuner on a pinned 1D mesh (the mesh pins the
+    decomposition axis to slab — also keeps this file clear of the
+    environment's uneven-r2c-pencil fft-thunk fault)."""
+    shape = (8, 8, 16)
+    mesh = dfft.make_mesh(8)
+    plan = dfft.plan_dft_r2c_3d(shape, mesh, tune="measure")
+    assert plan.decomposition == "slab"
+    assert m.counter_total("tune_tournaments") == 1
+    x = tu.make_world_data(shape, dtype=np.float64)
+    got = np.asarray(plan(x))
+    want = np.fft.rfftn(x)
+    assert np.max(np.abs(got - want)) / np.abs(want).max() < 1e-10
+
+    dfft.clear_plan_cache()
+    m.metrics_reset()
+    plan2 = dfft.plan_dft_r2c_3d(shape, mesh, tune="measure")
+    assert m.counter_total("tune_timing_executions") == 0
+    assert tuner.tuned_label(plan2) == tuner.tuned_label(plan)
+
+
+def test_single_device_tune_short_circuits(wisdom_path, metrics_on):
+    """No mesh -> nothing to search: the tuned tier builds the plain
+    single-device plan without a tournament or a wisdom entry."""
+    plan = dfft.plan_dft_c2c_3d((8, 8, 8), None, dtype=np.complex64,
+                                tune="measure")
+    assert plan.decomposition == "single"
+    assert m.counter_total("tune_tournaments") == 0
+    assert tuner.load_wisdom(tuner.default_wisdom_path())[0] == {}
+
+
+# ------------------------------------------------- wisdom gate (report)
+
+def test_wisdom_verdict_math():
+    v = regress.wisdom_verdict(0.001, [0.002, 0.0021, 0.002, 0.0019])
+    assert v["verdict"] == "regressed"
+    v = regress.wisdom_verdict(0.001, [0.00101, 0.00099, 0.001])
+    assert v["verdict"] == "within-noise"
+    v = regress.wisdom_verdict(0.002, [0.001, 0.00101, 0.00099])
+    assert v["verdict"] == "improved"
+    assert regress.wisdom_verdict(0.001, [0.002])["verdict"] == "no-baseline"
+
+
+def _history_with(tmp_path, label, seconds_list):
+    path = tmp_path / "history.jsonl"
+    recs = [
+        regress.make_run_record(
+            metric="fft3d_c2c_16_forward_gflops", value=10.0,
+            seconds=s, config={"tuned": label}, backend="cpu",
+            device_kind="cpu", source="test")
+        for s in seconds_list
+    ]
+    regress.append_records(recs, str(path))
+    return str(path)
+
+
+def test_report_wisdom_gate(tmp_path, wisdom_path, capsys):
+    key = _fake_key()
+    cand = tuner.Candidate("slab", "alltoall", "xla", 1)
+    tuner.record_wisdom(key, cand, 0.001, path=wisdom_path)
+
+    # Fresh runs of the same winner tuple 2x slower -> stale, gate fires.
+    hist = _history_with(tmp_path, cand.label, [0.002, 0.0021, 0.002])
+    rc = report.main(["wisdom", "--gate", "--wisdom", wisdom_path,
+                      "--history", hist])
+    assert rc == 1
+    out = capsys.readouterr()
+    assert "regressed" in out.out and "stale" in out.err
+
+    # Fresh runs at the recorded speed -> clean.
+    hist2 = _history_with(tmp_path / "ok", cand.label,
+                          [0.001, 0.00101, 0.00099])
+    assert report.main(["wisdom", "--gate", "--wisdom", wisdom_path,
+                        "--history", hist2]) == 0
+    # Listing without --gate never gates.
+    assert report.main(["wisdom", "--wisdom", wisdom_path]) == 0
+
+
+def test_regress_tuned_keys_baseline_group():
+    """Tuned and untuned bench lines never share a compare baseline —
+    the same separation rule overlap established."""
+    base = {"metric": "m", "value": 1.0, "dtype": "complex64",
+            "devices": 8}
+    plain = regress.normalize_bench_line(dict(base), source="t")
+    tuned = regress.normalize_bench_line(
+        dict(base, tuned="slab/alltoall/xla/ov1"), source="t")
+    assert plain["config"].get("tuned") is None
+    assert tuned["config"]["tuned"] == "slab/alltoall/xla/ov1"
+    assert regress.group_key(plain) != regress.group_key(tuned)
