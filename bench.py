@@ -247,8 +247,28 @@ def _roofline(shape, seconds, n_dev):
     }
 
 
+def _plan_cost_block(plan) -> dict:
+    """The explain layer's compiled cost/memory block for the telemetry
+    line: peak-HBM and AOT compile-seconds gauges (plus flops / bytes
+    accessed), all-null when the plan cannot be analyzed — a CPU
+    fallback or an exotic executor must degrade to nulls, never crash
+    the measurement that is already in hand."""
+    null = {"peak_hbm_bytes": None, "compile_seconds": None,
+            "flops": None, "bytes_accessed": None, "temp_bytes": None}
+    try:
+        from distributedfft_tpu.explain import compiled_summary
+
+        res = compiled_summary(plan)
+        if res is None:
+            return null
+        return {k: res.get(k) for k in null}
+    except Exception:  # noqa: BLE001 — telemetry, not contract
+        return null
+
+
 def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
-          all_times, donated=False, stages=None, overlap=None, tuned=None):
+          all_times, donated=False, stages=None, overlap=None, tuned=None,
+          cost=None):
     import jax
 
     from distributedfft_tpu.utils.metrics import metrics_snapshot
@@ -291,7 +311,15 @@ def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
     # Structured telemetry block: the worker-process metrics registry
     # (plan builds/cache, compile seconds, executes, exchange bytes) so
     # every BENCH json line is self-describing without string-grepping.
-    out["telemetry"] = {"metrics": metrics_snapshot()}
+    # The cost sub-block is the explain layer's compiled view (peak-HBM
+    # / AOT compile seconds); the run-record store baselines it so
+    # compare --gate catches footprint regressions, not just wall time.
+    out["telemetry"] = {
+        "metrics": metrics_snapshot(),
+        "cost": cost if cost is not None else {
+            "peak_hbm_bytes": None, "compile_seconds": None,
+            "flops": None, "bytes_accessed": None, "temp_bytes": None},
+    }
     print(json.dumps(out), flush=True)
     return out
 
@@ -341,7 +369,7 @@ def _worker_tuned(shape_n, shape, mesh, dtype, n_dev, mode: str) -> None:
     _emit(shape_n, seconds, max_err, plan.executor, n_dev,
           plan.decomposition, {label: round(seconds, 6)},
           overlap=getattr(plan.options, "overlap_chunks", None),
-          tuned=label)
+          tuned=label, cost=_plan_cost_block(plan))
 
 
 def _worker(shape_n: int) -> None:
@@ -431,6 +459,12 @@ def _worker(shape_n: int) -> None:
     if fast:
         return
 
+    # Winner's compiled cost/memory block (explain layer) — once, after
+    # the tournament, so the insurance path never pays the AOT analysis.
+    cost = _plan_cost_block(plan)
+    _emit(shape_n, seconds, max_err, best, n_dev, plan.decomposition,
+          all_times, overlap=overlap, cost=cost)
+
     # Donated execution of the winner — halves HBM traffic headroom and is
     # how the big-grid campaign runs (bufferDev ping-pong discipline).
     donated = False
@@ -440,7 +474,7 @@ def _worker(shape_n: int) -> None:
         if dsec < seconds:
             seconds, donated = dsec, True
         _emit(shape_n, seconds, max_err, best, n_dev, plan.decomposition,
-              all_times, donated=donated, overlap=overlap)
+              all_times, donated=donated, overlap=overlap, cost=cost)
     except Exception:  # noqa: BLE001 — donation is a best-effort extra
         traceback.print_exc(limit=3, file=sys.stderr)
 
@@ -478,7 +512,8 @@ def _worker(shape_n: int) -> None:
 
     if stages:
         _emit(shape_n, seconds, max_err, best, n_dev, plan.decomposition,
-              all_times, donated=donated, stages=stages, overlap=overlap)
+              all_times, donated=donated, stages=stages, overlap=overlap,
+              cost=cost)
 
 
 # ----------------------------------------------------------- orchestrator

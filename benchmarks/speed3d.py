@@ -98,6 +98,15 @@ def parse_args(argv):
                    help="print the structured metrics snapshot (plan "
                         "builds/cache, compile seconds, executes, exchange "
                         "bytes) as one 'telemetry ...' JSON line")
+    p.add_argument("-explain", action="store_true",
+                   help="print the plan explain/attribution table "
+                        "(predicted vs compiled vs measured per t0..t3 "
+                        "stage, MFU/ICI ratios, divergence flags; "
+                        "docs/OBSERVABILITY.md) plus one 'explain {...}' "
+                        "JSON line; implies -metrics. CSV rows gain a "
+                        "t2_model_measured_ratio column (only when "
+                        "-explain ran, so default sweeps keep their "
+                        "header)")
     p.add_argument("-profile", default=None, metavar="DIR",
                    help="capture an XLA profiler trace of the timed section "
                         "into DIR (view with tensorboard/xprof)")
@@ -183,6 +192,11 @@ def main(argv=None) -> None:
         if args.a2av or args.p2p_pl:
             raise SystemExit("-tune searches the transport axis; do not pin "
                              "one with -a2av/-p2p_pl")
+    if args.explain:
+        if args.bricks or args.precision == "dd":
+            raise SystemExit("-explain applies to the c2c/r2c chain "
+                             "planners; brick and dd plans do not take it")
+        args.metrics = True  # the attribution join reads the registry
 
     if args.r2c_axis != 2 and (args.kind != "r2c"
                                or args.precision == "dd"):
@@ -432,11 +446,32 @@ def main(argv=None) -> None:
 
     print(result_block(shape, ndev, seconds, max_err, stage_times, real=is_real))
 
+    exp_rec = None
+    if args.explain:
+        import json as _json
+
+        from distributedfft_tpu.explain import format_explain
+
+        try:
+            exp_rec = dfft.explain(fwd, iters=max(2, min(args.iters, 5)))
+            print(format_explain(exp_rec))
+            # The machine-readable twin of the table (the 'telemetry'
+            # line pattern) for campaign scripts that archive stdout.
+            print("explain " + _json.dumps(exp_rec, sort_keys=True))
+        except Exception as e:  # noqa: BLE001 — explain is an extra
+            print(f"note: -explain failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+
     if args.csv:
-        rec = tr.CsvRecorder(args.csv, (
-            "kind", "precision", "nx", "ny", "nz", "ndev", "decomposition",
-            "algorithm", "executor", "seconds", "gflops", "max_err",
-        ))
+        header = ["kind", "precision", "nx", "ny", "nz", "ndev",
+                  "decomposition", "algorithm", "executor", "seconds",
+                  "gflops", "max_err"]
+        if args.explain:
+            # Predicted-vs-measured t2 column ONLY on explain runs: the
+            # CsvRecorder refuses mismatched headers, so default sweeps
+            # keep their schema and explain sweeps get their own file.
+            header.append("t2_model_measured_ratio")
+        rec = tr.CsvRecorder(args.csv, tuple(header))
         deco = f"bricks-{fwd.decomposition}" if args.bricks else fwd.decomposition
         # Non-default r2c_axis is the variable under study in an
         # r2c_direction sweep: encode it in the kind column (schema
@@ -449,10 +484,13 @@ def main(argv=None) -> None:
             # pinned the same knobs by hand (the tuple can move between
             # re-tunes); same separation rule as '+ovK'.
             alg_label += "+tuned"
-        rec.record(kind, args.precision, *shape, ndev, deco,
-                   alg_label,
-                   _executor_label(args.executor),
-                   f"{seconds:.6f}", f"{gf:.1f}", f"{max_err:.3e}")
+        row = [kind, args.precision, *shape, ndev, deco,
+               alg_label,
+               _executor_label(args.executor),
+               f"{seconds:.6f}", f"{gf:.1f}", f"{max_err:.3e}"]
+        if args.explain:
+            row.append(f"{_t2_ratio(exp_rec)}")
+        rec.record(*row)
     _print_telemetry(args)
     if args.trace:
         print(f"trace written to {tr.finalize_tracing()}")
@@ -469,6 +507,21 @@ def _print_telemetry(args) -> None:
     import distributedfft_tpu as dfft
 
     print("telemetry " + json.dumps(dfft.metrics_snapshot()))
+
+
+def _t2_ratio(exp_rec) -> str:
+    """Predicted/measured t2 ratio of one explain record ("nan" when
+    either side is unavailable — single-device plans have no t2, and a
+    failed explain must still leave a well-formed CSV row)."""
+    try:
+        t2 = exp_rec["stages"]["t2"]
+        model_s = t2["model"]["seconds"]
+        meas_s = t2["measured"]["seconds"]
+        if model_s and meas_s:
+            return f"{model_s / meas_s:.4f}"
+    except (TypeError, KeyError):
+        pass
+    return "nan"
 
 
 def _algorithm_label(algorithm: str, overlap: int | None) -> str:

@@ -14,6 +14,15 @@ Quick start::
     y = plan(x)                                    # X-slabs in, Y-slabs out
 """
 
+# The explain submodule is imported eagerly so its one-time package
+# attribute binding happens HERE, before the api import below rebinds
+# ``explain`` to the function — ``dfft.explain(plan)`` stays callable no
+# matter who imports ``distributedfft_tpu.explain`` later (a late
+# submodule import would otherwise clobber the function with the
+# module). Access the module via ``from distributedfft_tpu.explain
+# import ...`` direct-name imports.
+from . import explain as _explain_module  # noqa: F401
+
 from .api import (  # noqa: F401
     BACKWARD,
     DDPlan3D,
@@ -23,6 +32,7 @@ from .api import (  # noqa: F401
     clear_plan_cache,
     destroy_plan,
     execute,
+    explain,
     plan_brick_dft_c2c_3d,
     plan_brick_dft_c2r_3d,
     plan_brick_dft_r2c_3d,

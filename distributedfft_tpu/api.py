@@ -1497,6 +1497,18 @@ def alloc_local(plan: Plan3D, fill=None):
     return arr
 
 
+def explain(plan: Plan3D, **kw) -> dict:
+    """Plan attribution record: the model/compiled/measured join per
+    t0..t3 stage, with per-stage MFU, ICI utilization, whole-program
+    cost/memory, and divergence flags (:mod:`.explain`). ``iters``
+    controls the measured warm passes; ``measure=False`` skips every
+    execution. Render with :func:`.explain.format_explain`, or use the
+    ``report explain`` subcommand / ``speed3d -explain`` drivers."""
+    from .explain import explain as _explain_impl
+
+    return _explain_impl(plan, **kw)
+
+
 def destroy_plan(plan: Plan3D) -> None:
     """Parity shim for ``fft_mpi_destroy_plan`` — plans hold no manually
     managed device memory; XLA buffers are garbage collected."""

@@ -1,0 +1,647 @@
+"""Plan explain & attribution — predicted vs compiled vs measured.
+
+The reference's only self-description is a flat t0..t3 wall-clock table
+printed per execute (``fft_mpi_3d_api.cpp:184-201``); nothing in it can
+say *why* a configuration is fast or slow. This module closes that gap
+by joining, per ``t0..t3`` stage, the three views the repo already
+produces but never correlates:
+
+- **model** — the analytic prediction the tuner prunes with
+  (:func:`..plan_logic.model_stage_seconds`: 3-pass HBM roofline stage
+  times, wire bytes under the plan's transport via ``WIRE_BYTE_KEYS``,
+  the overlap-K exposure crossover);
+- **compiled** — what XLA actually built: per-stage
+  ``compiled.cost_analysis()`` FLOPs / bytes accessed and
+  ``memory_analysis()`` argument/output/temp HBM, plus AOT compile
+  seconds (the separately-jitted staged pipelines give this per stage;
+  the fused plan gives the whole-program view);
+- **measured** — warm per-stage wall-clock samples (the PR 1 trace-span
+  quantities, captured with the sync bracketing of the timing harness).
+
+plus per-stage MFU and ICI-utilization ratios, and **divergence flags**
+wherever the model's prediction falls outside the measured samples'
+median + MAD noise band (the PR 2 gate) — the audit loop AccFFT and the
+Collective-Optimized-FFTs work close with per-stage communication
+models, and the direct feedback signal for the tuner's prune quality.
+
+Surfaces: ``dfft.explain(plan)`` (this module's :func:`explain`),
+``python -m distributedfft_tpu.report explain`` (live plans or history
+records), ``benchmarks/speed3d.py -explain``, and the
+:func:`compiled_summary` cost/memory block that ``bench.py`` stamps
+into run records so ``regress.py`` can baseline peak-HBM and
+compile-time, not just wall time. See docs/OBSERVABILITY.md
+"Explain & attribution".
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Any, Sequence
+
+import numpy as np
+
+from . import regress
+from .utils import metrics as _metrics
+from .utils.timing import sync
+from .utils.trace import STAGE_KEYS, stage_key
+
+__all__ = [
+    "EXPLAIN_SCHEMA",
+    "DEVICE_SPECS",
+    "device_profile",
+    "explain",
+    "compiled_summary",
+    "model_stage_estimates",
+    "stage_divergence",
+    "format_explain",
+    "explain_from_record",
+]
+
+EXPLAIN_SCHEMA = 1
+
+#: Public per-chip specs for attribution ratios: device_kind substring ->
+#: (peak bf16 TFlop/s, HBM GB/s, per-link ICI GB/s estimate). The ICI
+#: numbers are usable-bandwidth estimates of one link (the same magnitude
+#: the tuner's ranking model assumes), not datasheet aggregates.
+DEVICE_SPECS = {
+    "v5 lite": (197.0, 819.0, 45.0),
+    "v5e": (197.0, 819.0, 45.0),
+    "v5p": (459.0, 2765.0, 90.0),
+    "v5": (459.0, 2765.0, 90.0),
+    "v4": (275.0, 1228.0, 45.0),
+    "v6 lite": (918.0, 1640.0, 90.0),
+    "v6e": (918.0, 1640.0, 90.0),
+}
+
+#: Divergence gate defaults — the PR 2 compare-engine noise model.
+DEFAULT_MADS = regress.DEFAULT_MADS
+DEFAULT_MIN_REL = regress.DEFAULT_MIN_REL
+DEFAULT_MIN_SAMPLES = regress.DEFAULT_MIN_SAMPLES
+
+_MB = 1.0 / (1024 * 1024)
+
+
+def device_profile() -> dict:
+    """The hardware constants the model side of the join runs on.
+
+    Known TPU kinds come from :data:`DEVICE_SPECS` (``source: "table"``);
+    anything else (the CPU test backend included) falls back to the
+    tuner's cross-platform ranking constants (``source: "default"``) —
+    still useful for *ordering* stages, but divergence flags on a
+    default profile say as much about the constants as about the code,
+    and the record carries the source so readers can tell."""
+    from .tuner import (
+        MODEL_HBM_GBPS, MODEL_LAUNCH_SECONDS, MODEL_WIRE_GBPS,
+    )
+
+    kind, backend = "unknown", "unknown"
+    try:
+        import jax
+
+        backend = jax.default_backend()
+        kind = jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001 — profile must work backendless
+        pass
+    spec = next((v for k, v in DEVICE_SPECS.items() if k in kind.lower()),
+                None)
+    if spec is None:
+        peak_tf, hbm, wire, source = (
+            197.0, MODEL_HBM_GBPS, MODEL_WIRE_GBPS, "default")
+    else:
+        peak_tf, hbm, wire = spec
+        source = "table"
+    return {
+        "device_kind": kind,
+        "backend": backend,
+        "peak_tflops": peak_tf,
+        "hbm_gbps": hbm,
+        "wire_gbps": wire,
+        "launch_seconds": MODEL_LAUNCH_SECONDS,
+        "source": source,
+    }
+
+
+# ---------------------------------------------------------------- model
+
+def _model_shape_itemsize(plan) -> tuple[tuple[int, int, int], int]:
+    """The complex-side shape and itemsize the exchange/roofline model
+    runs on — the same effective-shape rule the per-execute byte
+    counters use (``api._plan_exchange_bytes``)."""
+    shape = plan.out_shape if (plan.real and plan.forward) else (
+        plan.in_shape if plan.real else plan.shape)
+    return tuple(shape), int(np.dtype(plan.dtype).itemsize)
+
+
+def model_stage_estimates(plan, hw: dict | None = None) -> dict:
+    """Per-stage analytic predictions of one execution of ``plan``,
+    keyed exactly ``t0..t3`` (:func:`..plan_logic.model_stage_seconds`
+    on the plan's own logic skeleton and hardware profile)."""
+    from .plan_logic import model_stage_seconds
+
+    hw = hw or device_profile()
+    lp = plan.logic
+    if lp is None:
+        raise ValueError("plan carries no logic skeleton to model")
+    shape, itemsize = _model_shape_itemsize(plan)
+    oc = plan.options.overlap_chunks
+    return model_stage_seconds(
+        lp, shape, itemsize,
+        hbm_gbps=hw["hbm_gbps"], wire_gbps=hw["wire_gbps"],
+        launch_seconds=hw["launch_seconds"],
+        algorithm=plan.options.algorithm,
+        overlap_chunks=oc if isinstance(oc, int) else 1,
+    )
+
+
+# ------------------------------------------------------------- compiled
+
+def _cost_dict(compiled) -> dict:
+    """Flatten ``compiled.cost_analysis()`` (a dict, or the older
+    one-element list of dicts) to {flops, bytes_accessed}; absent keys
+    -> None, never a raise."""
+    out = {"flops": None, "bytes_accessed": None}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if isinstance(ca, dict):
+            if "flops" in ca:
+                out["flops"] = float(ca["flops"])
+            if "bytes accessed" in ca:
+                out["bytes_accessed"] = float(ca["bytes accessed"])
+    except Exception:  # noqa: BLE001 — analysis is best-effort
+        pass
+    return out
+
+
+def _memory_dict(compiled) -> dict:
+    """``compiled.memory_analysis()`` as plain numbers. ``peak_hbm
+    _bytes`` is the argument+output+temp sum — the live-buffer
+    footprint one execution holds at once (the ``getMaxDataCount``
+    sizing role), an estimate: XLA's true high-water mark can be lower
+    when buffers alias."""
+    out = {"argument_bytes": None, "output_bytes": None,
+           "temp_bytes": None, "generated_code_bytes": None,
+           "peak_hbm_bytes": None}
+    try:
+        ma = compiled.memory_analysis()
+        arg = int(ma.argument_size_in_bytes)
+        outb = int(ma.output_size_in_bytes)
+        tmp = int(ma.temp_size_in_bytes)
+        out.update(
+            argument_bytes=arg, output_bytes=outb, temp_bytes=tmp,
+            generated_code_bytes=int(ma.generated_code_size_in_bytes),
+            peak_hbm_bytes=arg + outb + tmp,
+        )
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
+def _compile_analysis(jitted, arg) -> dict | None:
+    """AOT-lower and compile one jitted callable at ``arg``'s aval and
+    return its cost/memory/compile-seconds view, or None when the
+    callable cannot be lowered (not a jit, tracing failure, ...)."""
+    lower = getattr(jitted, "lower", None)
+    if lower is None:
+        return None
+    try:
+        t0 = time.perf_counter()
+        compiled = lower(arg).compile()
+        dt = time.perf_counter() - t0
+    except Exception:  # noqa: BLE001 — explain must survive any plan
+        return None
+    out = {"available": True, "compile_seconds": dt}
+    out.update(_cost_dict(compiled))
+    out.update(_memory_dict(compiled))
+    return out
+
+
+_UNAVAILABLE = {"available": False}
+
+
+def compiled_summary(plan, x=None) -> dict | None:
+    """Whole-program compiled cost/memory block of ``plan`` — the
+    record-schema extension ``bench.py`` stamps into result lines and
+    ``regress.py`` baselines (peak-HBM / compile-seconds gates).
+
+    Returns ``{flops, bytes_accessed, peak_hbm_bytes, argument_bytes,
+    output_bytes, temp_bytes, compile_seconds}`` or None when the plan
+    cannot be AOT-analyzed (never raises). Cached on the plan object;
+    with metrics enabled the peak-HBM gauge and AOT compile-seconds
+    histogram are recorded once per plan."""
+    cached = getattr(plan, "_compiled_summary", None)
+    if cached is not None:
+        return cached or None  # False sentinel = known-unavailable
+    from .api import alloc_local
+
+    try:
+        if x is None:
+            x = alloc_local(plan)
+    except Exception:  # noqa: BLE001
+        plan._compiled_summary = False
+        return None
+    res = _compile_analysis(plan.fn, x)
+    if res is None:
+        plan._compiled_summary = False
+        return None
+    res.pop("available", None)
+    plan._compiled_summary = res
+    if _metrics._enabled:
+        if res.get("peak_hbm_bytes") is not None:
+            _metrics.set_gauge(
+                "plan_peak_hbm_bytes", res["peak_hbm_bytes"],
+                decomposition=plan.decomposition, executor=plan.executor)
+        _metrics.observe(
+            "aot_compile_seconds", res["compile_seconds"],
+            decomposition=plan.decomposition, executor=plan.executor)
+    return res
+
+
+# --------------------------------------------------------------- staged
+
+def _canonical_chain(plan) -> bool:
+    """True when the plan runs the canonical stage chain the staged
+    builders rebuild — re-axed (absorbed-layout) chains and transposed
+    r2c views execute a different program than the breakdown would
+    describe (the same refusal rule as ``speed3d -staged``)."""
+    lp = plan.logic
+    if lp is None or plan.brick_edges is not None:
+        return False
+    if getattr(plan, "r2c_axis", 2) != 2:
+        return False
+    if lp.decomposition == "slab":
+        want = (0, 1) if plan.forward else (1, 0)
+        return lp.slab_axes in (None, want)
+    if lp.decomposition == "pencil":
+        if plan.real:  # the rfft staged builders are canonical-only
+            want_perm = (0, 1, 2) if plan.forward else (1, 2, 0)
+            want_order = "col_first" if plan.forward else "row_first"
+            return (lp.pencil_perm in (None, want_perm)
+                    and lp.pencil_order in (None, want_order))
+    return True
+
+
+def _staged_for(plan):
+    """The separately-jitted t0..t3 pipeline matching ``plan`` (the
+    builders bench.py / speed3d -staged use), or None when no staged
+    equivalent exists for this plan family."""
+    if not _canonical_chain(plan):
+        return None
+    lp = plan.logic
+    oc = plan.options.overlap_chunks
+    overlap = oc if isinstance(oc, int) else 1
+    kw = dict(executor=plan.executor, forward=plan.forward)
+    try:
+        if lp.decomposition == "single" or plan.mesh is None:
+            if plan.real:
+                return None
+            from .parallel.staged import build_single_stages
+
+            return build_single_stages(plan.shape, **kw)
+        kw.update(algorithm=plan.options.algorithm, overlap_chunks=overlap)
+        if lp.decomposition == "slab":
+            if plan.real:
+                from .parallel.staged import build_slab_rfft_stages
+
+                return build_slab_rfft_stages(
+                    plan.mesh, plan.shape,
+                    axis_name=plan.mesh.axis_names[0], **kw)[0]
+            from .parallel.slab import build_slab_stages
+
+            return build_slab_stages(
+                plan.mesh, plan.shape,
+                axis_name=plan.mesh.axis_names[0], **kw)[0]
+        row, col = plan.mesh.axis_names[:2]
+        if plan.real:
+            from .parallel.staged import build_pencil_rfft_stages
+
+            return build_pencil_rfft_stages(
+                plan.mesh, plan.shape, row_axis=row, col_axis=col, **kw)[0]
+        from .parallel.staged import build_pencil_stages
+
+        return build_pencil_stages(
+            plan.mesh, plan.shape, row_axis=row, col_axis=col,
+            perm=lp.pencil_perm, order=lp.pencil_order, **kw)[0]
+    except Exception:  # noqa: BLE001 — no staged view is a soft miss
+        return None
+
+
+def _measure_stages(stages, x, iters: int) -> tuple[dict, dict]:
+    """Warm per-stage wall-clock samples: one compile/warmup pass, then
+    ``iters`` sync-bracketed passes. Returns ``(samples, compiled)``
+    where ``samples`` maps canonical stage key -> [seconds, ...] and
+    ``compiled`` maps stage key -> per-stage AOT analysis (summed over
+    a key's stages — the pencil chain has two t2 jits)."""
+    samples: dict[str, list[float]] = {}
+    compiled: dict[str, dict] = {}
+    for it in range(iters + 1):
+        cur = x
+        for name, fn in stages:
+            key = stage_key(name) or name
+            if it == 0:
+                inner = getattr(fn, "__wrapped__", fn)
+                res = _compile_analysis(inner, cur)
+                if res is not None:
+                    agg = compiled.get(key)
+                    if agg is None:
+                        compiled[key] = res
+                    else:
+                        for k2, v in res.items():
+                            if isinstance(v, (int, float)) and not isinstance(
+                                    v, bool):
+                                if agg.get(k2) is None:
+                                    agg[k2] = v
+                                elif v is not None:
+                                    agg[k2] += v
+            sync(cur)
+            t0 = time.perf_counter()
+            cur = fn(cur)
+            sync(cur)
+            dt = time.perf_counter() - t0
+            if it > 0:
+                samples.setdefault(key, []).append(dt)
+    # A key emitted by two stages (pencil t2a/t2b) must report the SUM
+    # of its per-pass stage times, not interleaved per-stage samples.
+    per_pass: dict[str, list[float]] = {}
+    counts = {}
+    for name, _ in stages:
+        key = stage_key(name) or name
+        counts[key] = counts.get(key, 0) + 1
+    for key, vals in samples.items():
+        n = counts.get(key, 1)
+        if n <= 1:
+            per_pass[key] = vals
+        else:
+            # Pass j appended this key's n stage times consecutively.
+            per_pass[key] = [sum(vals[j * n:(j + 1) * n])
+                             for j in range(len(vals) // n)]
+    return per_pass, compiled
+
+
+# ----------------------------------------------------------- divergence
+
+def stage_divergence(
+    model_seconds: float,
+    samples: Sequence[float],
+    *,
+    mads: float = DEFAULT_MADS,
+    min_rel: float = DEFAULT_MIN_REL,
+    min_samples: int = DEFAULT_MIN_SAMPLES,
+) -> dict:
+    """Does the model's prediction for one stage fall outside the
+    measured samples' noise band? Same robust model as the PR 2 compare
+    engine: band = median +/- max(``mads`` scaled MADs, ``min_rel`` x
+    median). ``diverged`` is None (not a verdict) with fewer than
+    ``min_samples`` samples or a zero/absent model prediction — a
+    stage the model prices at exactly 0 (slab t1) can never "diverge".
+    """
+    out = {
+        "model_seconds": float(model_seconds),
+        "n": len(samples),
+        "diverged": None,
+    }
+    if len(samples) < min_samples or not model_seconds > 0.0:
+        return out
+    med, mad = regress.robust_stats([float(s) for s in samples])
+    band = regress._band(med, mad, mads, min_rel)
+    out.update(
+        median=med, mad=mad, band=band,
+        ratio=(med / model_seconds) if model_seconds else math.inf,
+        diverged=abs(med - model_seconds) > band,
+    )
+    if out["diverged"]:
+        out["direction"] = "slower" if med > model_seconds else "faster"
+    return out
+
+
+def _median(samples: Sequence[float]) -> float | None:
+    if not samples:
+        return None
+    med, _ = regress.robust_stats([float(s) for s in samples])
+    return med
+
+
+# -------------------------------------------------------------- explain
+
+def explain(
+    plan,
+    *,
+    iters: int = 3,
+    measure: bool = True,
+    mads: float = DEFAULT_MADS,
+    min_rel: float = DEFAULT_MIN_REL,
+    min_samples: int = DEFAULT_MIN_SAMPLES,
+) -> dict:
+    """One structured attribution record for a built plan: the
+    model/compiled/measured join per ``t0..t3`` stage, per-stage MFU and
+    ICI-utilization, whole-program compiled cost/memory, and divergence
+    flags under the median+MAD gate.
+
+    ``measure=False`` skips every execution (model + compiled views
+    only — safe on a backend whose dispatch is known-sick); ``iters``
+    warm passes feed the measured samples (>= ``min_samples`` for
+    divergence verdicts). Never raises on analysis gaps: sections the
+    environment cannot produce carry ``available: False`` / None values
+    so the record shape is stable for the report CLI and the run-record
+    store."""
+    from .api import alloc_local
+
+    hw = device_profile()
+    model = model_stage_estimates(plan, hw)
+    lp = plan.logic
+    ndev = 1 if plan.mesh is None else int(plan.mesh.devices.size)
+
+    kind = ("r2c" if plan.real and plan.forward
+            else "c2r" if plan.real else "c2c")
+    oc = plan.options.overlap_chunks
+    record: dict[str, Any] = {
+        "schema": EXPLAIN_SCHEMA,
+        "generated_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "plan": {
+            "shape": list(plan.shape),
+            "kind": kind,
+            "forward": plan.forward,
+            "decomposition": plan.decomposition,
+            "executor": plan.executor,
+            "algorithm": plan.options.algorithm,
+            "overlap_chunks": oc if isinstance(oc, int) else 1,
+            "devices": ndev,
+            "mesh": (None if plan.mesh is None
+                     else list(plan.mesh.devices.shape)),
+            "dtype": str(np.dtype(plan.dtype)),
+            "donate": bool(plan.options.donate),
+        },
+        "hw": hw,
+        "gate": {"mads": mads, "min_rel": min_rel,
+                 "min_samples": min_samples},
+    }
+
+    x = None
+    try:
+        x = alloc_local(plan)
+    except Exception:  # noqa: BLE001
+        pass
+
+    # Whole-program compiled view (also the regress cost block).
+    whole = compiled_summary(plan, x) if x is not None else None
+    record["compiled"] = dict(whole) if whole else None
+
+    # Per-stage compiled + measured via the staged pipelines.
+    samples: dict[str, list[float]] = {}
+    stage_compiled: dict[str, dict] = {}
+    staged_available = False
+    if measure and x is not None and not plan.options.donate:
+        stages = _staged_for(plan)
+        if stages is not None:
+            try:
+                samples, stage_compiled = _measure_stages(stages, x, iters)
+                staged_available = True
+            except Exception:  # noqa: BLE001 — sick dispatch, keep going
+                samples, stage_compiled = {}, {}
+    record["staged_available"] = staged_available
+
+    peak_flops = hw["peak_tflops"] * 1e12
+    wire_bps = hw["wire_gbps"] * 1e9
+    stages_out: dict[str, dict] = {}
+    diverged: list[str] = []
+    for key in STAGE_KEYS:
+        m = model.get(key) or {}
+        s = samples.get(key, [])
+        med = _median(s)
+        comp = stage_compiled.get(key) or dict(_UNAVAILABLE)
+        div = stage_divergence(
+            m.get("seconds", 0.0), s, mads=mads, min_rel=min_rel,
+            min_samples=min_samples)
+        flops = comp.get("flops") or m.get("flops") or 0.0
+        entry = {
+            "model": m,
+            "compiled": comp,
+            "measured": {
+                "available": bool(s),
+                "seconds": med,
+                "best_seconds": min(s) if s else None,
+                "samples": [round(v, 9) for v in s],
+            },
+            "divergence": div,
+            "mfu": (flops / (med * peak_flops)
+                    if med and flops and peak_flops else None),
+        }
+        if key == "t2":
+            wire = m.get("wire_bytes", 0.0)
+            entry["ici_utilization"] = (
+                wire / (med * wire_bps) if med and wire else None)
+        stages_out[key] = entry
+        if div.get("diverged"):
+            diverged.append(key)
+    record["stages"] = stages_out
+
+    model_total = sum((model.get(k) or {}).get("seconds", 0.0)
+                      for k in STAGE_KEYS)
+    meds = [stages_out[k]["measured"]["seconds"] for k in STAGE_KEYS]
+    record["totals"] = {
+        "model_seconds": model_total,
+        "measured_stage_seconds": (sum(v for v in meds if v)
+                                   if any(meds) else None),
+    }
+    record["divergence"] = {"any": bool(diverged), "stages": diverged}
+    return record
+
+
+# ------------------------------------------------------------ rendering
+
+def _fmt(v, unit: str = "") -> str:
+    if v is None:
+        return "-"
+    if unit == "s":
+        return f"{v:.6f}"
+    if unit == "MB":
+        return f"{v * _MB:.2f}"
+    if unit == "%":
+        return f"{100.0 * v:.1f}%"
+    if isinstance(v, float) and (abs(v) >= 1e5 or (0 < abs(v) < 1e-3)):
+        return f"{v:.3e}"
+    return str(v)
+
+
+def format_explain(record: dict) -> str:
+    """Human-readable attribution table of one explain record — the
+    ``report explain`` / ``speed3d -explain`` rendering."""
+    p = record.get("plan") or {}
+    hw = record.get("hw") or {}
+    shape = "x".join(str(s) for s in p.get("shape") or [])
+    lines = [
+        f"plan: {shape} {p.get('kind')} "
+        f"{'forward' if p.get('forward', True) else 'backward'}  "
+        f"{p.get('decomposition')}/{p.get('algorithm')}"
+        f"/{p.get('executor')}/ov{p.get('overlap_chunks')}  "
+        f"{p.get('devices')} device(s)  [{p.get('dtype')}]",
+        f"hw: {hw.get('device_kind')} (hbm {hw.get('hbm_gbps')} GB/s, "
+        f"ici {hw.get('wire_gbps')} GB/s, peak {hw.get('peak_tflops')} "
+        f"TFlop/s; {hw.get('source')} profile)",
+    ]
+    header = (f"{'stage':<6} {'model(s)':>11} {'measured(s)':>12} "
+              f"{'flops':>11} {'peakHBM(MB)':>12} {'MFU':>7} "
+              f"{'ICI':>7}  divergence")
+    lines.append(header)
+    for key in STAGE_KEYS:
+        st = (record.get("stages") or {}).get(key) or {}
+        m = st.get("model") or {}
+        comp = st.get("compiled") or {}
+        meas = st.get("measured") or {}
+        div = st.get("divergence") or {}
+        if div.get("diverged"):
+            note = (f"DIVERGED {div.get('ratio', 0.0):.1f}x "
+                    f"{div.get('direction', '')}")
+        elif div.get("diverged") is False:
+            note = "within noise"
+        else:
+            note = "-"
+        lines.append(
+            f"{key:<6} {_fmt(m.get('seconds'), 's'):>11} "
+            f"{_fmt(meas.get('seconds'), 's'):>12} "
+            f"{_fmt(comp.get('flops')):>11} "
+            f"{_fmt(comp.get('peak_hbm_bytes'), 'MB'):>12} "
+            f"{_fmt(st.get('mfu'), '%'):>7} "
+            f"{_fmt(st.get('ici_utilization'), '%'):>7}  {note}")
+    tot = record.get("totals") or {}
+    lines.append(
+        f"totals: model {_fmt(tot.get('model_seconds'), 's')} s | "
+        f"measured stages "
+        f"{_fmt(tot.get('measured_stage_seconds'), 's')} s")
+    whole = record.get("compiled")
+    if whole:
+        lines.append(
+            f"compiled (whole plan): flops {_fmt(whole.get('flops'))} | "
+            f"bytes accessed {_fmt(whole.get('bytes_accessed'), 'MB')} MB"
+            f" | peak HBM {_fmt(whole.get('peak_hbm_bytes'), 'MB')} MB "
+            f"(arg {_fmt(whole.get('argument_bytes'), 'MB')}"
+            f" + out {_fmt(whole.get('output_bytes'), 'MB')}"
+            f" + temp {_fmt(whole.get('temp_bytes'), 'MB')})"
+            f" | compile {_fmt(whole.get('compile_seconds'), 's')} s")
+    else:
+        lines.append("compiled (whole plan): unavailable")
+    d = record.get("divergence") or {}
+    if d.get("any"):
+        lines.append(
+            f"divergence: model and measurement disagree beyond the "
+            f"noise gate on {', '.join(d['stages'])}"
+            + (" (default hw profile: constants, not calibration)"
+               if hw.get("source") == "default" else ""))
+    return "\n".join(lines)
+
+
+def explain_from_record(record: dict) -> dict | None:
+    """The explain block of a run record (or a bare explain record):
+    ``record["explain"]`` when present, else the record itself when it
+    IS an explain record (schema + stages). None otherwise."""
+    if not isinstance(record, dict):
+        return None
+    exp = record.get("explain")
+    if isinstance(exp, dict) and exp.get("stages"):
+        return exp
+    if record.get("schema") == EXPLAIN_SCHEMA and isinstance(
+            record.get("stages"), dict):
+        return record
+    return None

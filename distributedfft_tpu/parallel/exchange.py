@@ -75,6 +75,38 @@ def transport_steps(algorithm: str, parts: int) -> int:
     return max(1, parts - 1) if algorithm == "ppermute" else 1
 
 
+def exchange_model_seconds(
+    wire_bytes_per_dev: float,
+    parts: int,
+    algorithm: str,
+    *,
+    wire_gbps: float,
+    launch_seconds: float,
+    overlap_chunks: int = 1,
+    hide_seconds: float = 0.0,
+) -> dict:
+    """Analytical time model of ONE exchange under one transport — the
+    single source of truth shared by the tuner's candidate-pruning cost
+    (:func:`..tuner.model_cost`) and the explain layer's per-stage
+    prediction, so the two can never disagree about what the model says.
+
+    ``seconds`` is the raw exchange time (wire transfer at ``wire_gbps``
+    plus ``transport_steps`` launch latencies); ``exposed_seconds`` is
+    what remains on the critical path at ``overlap_chunks = K`` with
+    ``hide_seconds`` of downstream compute available to hide under:
+    ``t/K + max(0, t - hide) * (K-1)/K`` plus the K-1 extra launches each
+    additional chunk costs (the crossover model behind
+    ``auto_overlap_chunks``; docs/MFU_ANALYSIS.md "Exchange/compute
+    overlap")."""
+    steps = transport_steps(algorithm, parts)
+    t_ex = wire_bytes_per_dev / (wire_gbps * 1e9) + steps * launch_seconds
+    k = max(1, int(overlap_chunks))
+    exposed = (t_ex / k
+               + max(0.0, t_ex - hide_seconds) * (k - 1) / k
+               + (k - 1) * steps * launch_seconds)
+    return {"seconds": t_ex, "exposed_seconds": exposed, "steps": steps}
+
+
 def _pad_axis(x: jnp.ndarray, axis: int, to: int) -> jnp.ndarray:
     """Zero-pad ``axis`` up to extent ``to`` (no-op when already there).
     Single definition shared by every chain builder and exchange path — the

@@ -700,6 +700,92 @@ def exchange_payloads(lp: LogicPlan, shape, itemsize: int) -> list[dict]:
     return out
 
 
+def model_stage_seconds(
+    lp: LogicPlan,
+    shape: Sequence[int],
+    itemsize: int,
+    *,
+    hbm_gbps: float,
+    wire_gbps: float,
+    launch_seconds: float,
+    algorithm: str | None = None,
+    overlap_chunks: int | None = None,
+) -> dict:
+    """Per-stage analytical prediction of one execution, keyed exactly
+    ``t0..t3`` — the model side of the explain/attribution join.
+
+    FFT stages are the HBM-stream roofline (each axis pass reads and
+    writes the per-device block once — the 3-pass bound of
+    ``docs/MFU_ANALYSIS.md``); exchanges are wire bytes under the plan's
+    transport (:func:`exchange_payloads` +
+    :func:`..parallel.exchange.exchange_model_seconds`) with the
+    overlap-K exposure crossover, each exchange hiding under its own
+    downstream FFT stage. Stage taxonomy: ``t0`` = input-side FFT pass
+    (two local axes for slab, one for pencil), ``t1`` = the pencil
+    chain's mid FFT (zero for slab/single — the pack is fused into the
+    exchange by XLA), ``t2`` = every exchange's *exposed* time, ``t3`` =
+    the output-side FFT pass. Every entry carries ``seconds`` plus the
+    quantities it was derived from (``flops``, ``hbm_bytes``,
+    ``wire_bytes``) so MFU/utilization ratios need no re-derivation."""
+    shape = tuple(int(s) for s in shape)
+    ndev = 1 if lp.mesh is None else math.prod(lp.mesh.devices.shape)
+    n_total = math.prod(shape)
+    block_bytes = itemsize * n_total / ndev
+    alg = algorithm or lp.options.algorithm
+    k = overlap_chunks
+    if k is None:
+        oc = lp.options.overlap_chunks
+        k = oc if isinstance(oc, int) else 1
+
+    def fft_stage(axes) -> dict:
+        hbm = 2.0 * block_bytes * len(axes)  # read + write per axis pass
+        flops = sum(5.0 * n_total * math.log2(max(2, shape[a]))
+                    for a in axes) / ndev
+        return {"seconds": hbm / (hbm_gbps * 1e9), "flops": flops,
+                "hbm_bytes": hbm, "wire_bytes": 0.0}
+
+    zero = {"seconds": 0.0, "flops": 0.0, "hbm_bytes": 0.0,
+            "wire_bytes": 0.0}
+    if lp.decomposition == "single" or lp.mesh is None:
+        # The staged single pipeline splits the whole-cube transform into
+        # t0 (YZ planes) and t3 (X lines); no pack, no exchange.
+        out = {"t0": fft_stage((1, 2)), "t1": dict(zero),
+               "t2": dict(zero), "t3": fft_stage((0,))}
+    elif lp.decomposition == "slab":
+        fft_stages = [s[0] for s in lp.stages]
+        out = {"t0": fft_stage(fft_stages[0]), "t1": dict(zero),
+               "t2": dict(zero), "t3": fft_stage(fft_stages[1])}
+    else:
+        fft_stages = [s[0] for s in lp.stages]
+        out = {"t0": fft_stage(fft_stages[0]),
+               "t1": fft_stage(fft_stages[1]),
+               "t2": dict(zero), "t3": fft_stage(fft_stages[2])}
+
+    from .parallel.exchange import (
+        WIRE_BYTE_KEYS, exchange_model_seconds,
+    )
+
+    # Each exchange hides under the FFT stage that consumes its output:
+    # slab t2 -> t3; pencil t2a -> t1, t2b -> t3.
+    payloads = exchange_payloads(lp, shape, itemsize)
+    hide = {"t2": out["t3"]["seconds"], "t2a": out["t1"]["seconds"],
+            "t2b": out["t3"]["seconds"]}
+    t2 = out["t2"]
+    for e in payloads:
+        wire = e[WIRE_BYTE_KEYS[alg]] / ndev
+        m = exchange_model_seconds(
+            wire, e["parts"], alg, wire_gbps=wire_gbps,
+            launch_seconds=launch_seconds, overlap_chunks=k,
+            hide_seconds=hide.get(e["stage"], 0.0))
+        t2["seconds"] += m["exposed_seconds"]
+        t2["wire_bytes"] += wire
+        t2.setdefault("raw_seconds", 0.0)
+        t2["raw_seconds"] += m["seconds"]
+        t2.setdefault("steps", 0)
+        t2["steps"] += m["steps"]
+    return out
+
+
 def io_boxes(lp: LogicPlan, world_in: geo.Box3, world_out: geo.Box3) -> tuple:
     """Per-device input/output boxes of the plan's own orientation; r2c
     plans pass a shrunk complex-side world."""

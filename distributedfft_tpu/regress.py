@@ -56,6 +56,7 @@ __all__ = [
     "robust_stats",
     "metric_direction",
     "compare_record",
+    "regressed_metrics",
     "wisdom_verdict",
     "format_compare",
     "summarize_history",
@@ -68,6 +69,13 @@ DEFAULT_WINDOW = 8        # rolling baseline size per group
 DEFAULT_MADS = 3.0        # noise band half-width in scaled MADs
 DEFAULT_MIN_REL = 0.05    # noise-band floor as a fraction of the median
 DEFAULT_MIN_SAMPLES = 2   # baseline records required for a verdict
+
+#: Auxiliary metrics of the record's ``cost`` block (the explain-layer
+#: compiled cost/memory view) that compare/gate alongside the headline:
+#: a change can hold wall time steady while regressing its HBM
+#: footprint or compile bill, and the gate must still catch it. Both
+#: are smaller-is-better; both use the same median+MAD noise model.
+AUX_COST_METRICS = ("peak_hbm_bytes", "compile_seconds")
 
 _MAD_SCALE = 1.4826       # MAD -> sigma under a normal noise model
 
@@ -121,6 +129,8 @@ def make_run_record(
     stages: dict | None = None,
     roofline: dict | None = None,
     metrics: dict | None = None,
+    cost: dict | None = None,
+    explain: dict | None = None,
     source: str = "",
     commit: str | None = None,
     recorded_at: str | None = None,
@@ -128,7 +138,13 @@ def make_run_record(
 ) -> dict:
     """One normalized run record. ``config`` holds the knobs that define
     the baseline group (dtype, devices, ...); ``device_kind`` defaults to
-    ``backend`` so a CPU row can never enter a TPU baseline."""
+    ``backend`` so a CPU row can never enter a TPU baseline. ``cost`` is
+    the explain layer's compiled cost/memory block (peak-HBM /
+    compile-seconds, baselined by :func:`compare_record` alongside the
+    headline); ``explain`` the full attribution record for ``report
+    explain``. A metrics snapshot's own schema version is lifted to
+    ``metrics_schema`` so registry drift is detectable without parsing
+    the block."""
     rec = {
         "schema": SCHEMA,
         "recorded_at": recorded_at or _now_iso(),
@@ -150,6 +166,12 @@ def make_run_record(
         rec["roofline"] = roofline
     if metrics:
         rec["metrics"] = metrics
+        if isinstance(metrics, dict) and metrics.get("schema") is not None:
+            rec["metrics_schema"] = metrics["schema"]
+    if cost:
+        rec["cost"] = cost
+    if explain:
+        rec["explain"] = explain
     if extra:
         rec["extra"] = extra
     return rec
@@ -201,6 +223,16 @@ def normalize_bench_line(
     telemetry = obj.get("telemetry") or {}
     if telemetry.get("status"):
         ex["status"] = telemetry["status"]
+    # The explain layer's compiled cost/memory block rides either at the
+    # line's top level or inside the telemetry block; only keep it when
+    # at least one value is non-null (a CPU-fallback line stamps nulls).
+    cost = obj.get("cost") or telemetry.get("cost")
+    if not (isinstance(cost, dict)
+            and any(v is not None for v in cost.values())):
+        cost = None
+    explain = obj.get("explain")
+    if not isinstance(explain, dict):
+        explain = None
     return make_run_record(
         metric=obj["metric"],
         value=value,
@@ -213,6 +245,8 @@ def normalize_bench_line(
         stages=obj.get("stages"),
         roofline=obj.get("roofline"),
         metrics=telemetry.get("metrics"),
+        cost=cost,
+        explain=explain,
         source=source,
         commit=commit,
         recorded_at=recorded_at,
@@ -373,9 +407,13 @@ def robust_stats(values: list[float]) -> tuple[float, float]:
 
 def metric_direction(metric: str, unit: str | None = None) -> int:
     """+1 when larger is better (throughput), -1 when smaller is better
-    (latency). Stage times always compare smaller-is-better."""
+    (latency, byte footprints). Stage times and the cost-block metrics
+    (``peak_hbm_bytes``, ``compile_seconds``) always compare
+    smaller-is-better."""
     m, u = metric.lower(), (unit or "").lower()
     if "seconds" in m or m.endswith("_s") or u in ("s", "seconds", "ms"):
+        return -1
+    if m.endswith("_bytes") or u in ("b", "bytes"):
         return -1
     return 1
 
@@ -442,6 +480,65 @@ def compare_record(
         out["localization"] = _localize_stages(
             record, base, mads=mads, min_rel=min_rel,
             min_samples=min_samples)
+    aux = _compare_cost(record, base, mads=mads, min_rel=min_rel,
+                        min_samples=min_samples)
+    if aux:
+        out["aux"] = aux
+    return out
+
+
+def _compare_cost(
+    record: dict, base: list[dict], *, mads: float, min_rel: float,
+    min_samples: int,
+) -> list[dict]:
+    """Verdicts of the record's ``cost`` block metrics (peak-HBM,
+    compile seconds) against the baseline records' cost blocks — the
+    explain-layer extension of the gate: a wall-time-neutral change
+    that doubles the HBM footprint or the compile bill must still trip
+    ``compare --gate``. Same noise model; both metrics are
+    smaller-is-better (:func:`metric_direction`)."""
+    cost = record.get("cost")
+    if not isinstance(cost, dict):
+        return []
+    rows: list[dict] = []
+    for name in AUX_COST_METRICS:
+        val = cost.get(name)
+        if not isinstance(val, (int, float)):
+            continue
+        samples = []
+        for r in base:
+            c = r.get("cost")
+            if isinstance(c, dict) and isinstance(c.get(name),
+                                                  (int, float)):
+                samples.append(float(c[name]))
+        row = {"metric": name, "value": float(val),
+               "baseline": {"n": len(samples)}, "verdict": "no-baseline"}
+        if len(samples) >= min_samples:
+            med, mad = robust_stats(samples)
+            band = _band(med, mad, mads, min_rel)
+            row["baseline"].update(median=med, mad=mad, band=band)
+            row["delta_pct"] = (100.0 * (val - med) / med if med
+                                else math.inf)
+            if abs(val - med) <= band:
+                row["verdict"] = "within-noise"
+            elif (val - med) * metric_direction(name) > 0:
+                row["verdict"] = "improved"
+            else:
+                row["verdict"] = "regressed"
+        rows.append(row)
+    return rows
+
+
+def regressed_metrics(result: dict) -> list[str]:
+    """Every regressed metric of one :func:`compare_record` result —
+    the headline plus any aux cost metric. The gate trips when this is
+    non-empty (one shared rule for the CLI and any caller)."""
+    out = []
+    if result.get("verdict") == "regressed":
+        out.append(str(result.get("metric")))
+    for row in result.get("aux") or []:
+        if row.get("verdict") == "regressed":
+            out.append(f"{result.get('metric')}:{row['metric']}")
     return out
 
 
@@ -541,6 +638,18 @@ def format_compare(results: list[dict]) -> str:
                 f"    {row['stage']:<20} {row['delta_pct']:+.1f}%  "
                 f"({row['value']:.6f}s vs {row['baseline_median']:.6f}s; "
                 f"{tag})")
+        for row in res.get("aux", []):
+            b = row.get("baseline", {})
+            if "median" in b:
+                lines.append(
+                    f"    cost.{row['metric']:<17} "
+                    f"{row.get('delta_pct', 0.0):+.1f}%  "
+                    f"({row['value']:g} vs {b['median']:g}; "
+                    f"{row['verdict']})")
+            else:
+                lines.append(
+                    f"    cost.{row['metric']:<17} value={row['value']:g} "
+                    f"(baseline n={b.get('n', 0)} < min samples)")
     return "\n".join(lines)
 
 

@@ -6,9 +6,11 @@ arguments)::
 
     python -m distributedfft_tpu.report merge dfft_trace_*.log -o out.json
     python -m distributedfft_tpu.report record BENCH_r*.json
-    python -m distributedfft_tpu.report history
+    python -m distributedfft_tpu.report history [--config SUBSTR]
     python -m distributedfft_tpu.report compare --gate
     python -m distributedfft_tpu.report wisdom --gate
+    python -m distributedfft_tpu.report explain [--json]
+    python -m distributedfft_tpu.report explain --plan 256,256,256 -n 8
 
 **merge** — the trace tool. The reference writes one trace log per MPI
 rank and leaves correlation to the reader (``heffte_trace.h:98-118``);
@@ -31,6 +33,14 @@ against *fresh* history records of the same winner tuple (the
 median+MAD noise model, and exits 1 when a stored winner now runs
 slower than its recorded tournament time beyond noise — stale wisdom
 that should be re-measured.
+
+**explain** — the plan explain & attribution view (:mod:`.explain`;
+docs/OBSERVABILITY.md "Explain & attribution"): the per-stage
+model/compiled/measured join with MFU, ICI utilization, and divergence
+flags. Reads the explain block of a history record (newest by default,
+``--record FILE`` for an artifact, a bare ``--json`` dump of a prior
+explain also parses), or builds and explains a LIVE plan with
+``--plan NX,NY,NZ`` (imports jax; every plan knob has a flag).
 
 **record / history / compare** — the regression-tracking loop over the
 append-only run-record store (``benchmarks/results/history.jsonl``; see
@@ -420,6 +430,12 @@ def _main_history(argv: list[str]) -> int:
     _history_arg(p)
     p.add_argument("--metric", default=None,
                    help="only groups whose metric contains this substring")
+    p.add_argument("--config", default=None,
+                   help="only groups whose config signature contains this "
+                        "substring (e.g. 'tuned=' or "
+                        "'overlap=4,tuned=slab/alltoall/xla/ov4') — lists "
+                        "one (shape, decomp, transport, overlap, tuned) "
+                        "group without running a compare")
     p.add_argument("--json", action="store_true",
                    help="machine-readable JSON instead of the table")
     args = p.parse_args(argv)
@@ -432,6 +448,8 @@ def _main_history(argv: list[str]) -> int:
     rows = regress.summarize_history(records)
     if args.metric:
         rows = [r for r in rows if args.metric in r["metric"]]
+    if args.config:
+        rows = [r for r in rows if args.config in r["config"]]
     if args.json:
         print(json.dumps(rows, sort_keys=True))
         return 0
@@ -519,10 +537,163 @@ def _main_compare(argv: list[str]) -> int:
         print(json.dumps(results, sort_keys=True))
     else:
         print(regress.format_compare(results))
-    regressed = [r for r in results if r["verdict"] == "regressed"]
+    regressed = [m for r in results for m in regress.regressed_metrics(r)]
     if regressed and not args.json:
-        print(f"{len(regressed)} confirmed regression(s)", file=sys.stderr)
+        print(f"{len(regressed)} confirmed regression(s): "
+              f"{', '.join(regressed)}", file=sys.stderr)
     return 1 if (args.gate and regressed) else 0
+
+
+# --------------------------------------------------------- explain CLI
+
+def _explain_blocks_from_text(text: str) -> list[dict]:
+    """Every explain block found in one artifact: a bare explain JSON
+    document (a prior ``explain --json`` dump), a run record carrying
+    an ``explain`` field, or JSONL of either — oldest first."""
+    from .explain import explain_from_record
+
+    stripped = text.strip()
+    if not stripped:
+        return []
+    out: list[dict] = []
+    try:
+        doc = json.loads(stripped)
+    except json.JSONDecodeError:
+        doc = None
+    entries = (doc if isinstance(doc, list)
+               else [doc] if isinstance(doc, dict) else None)
+    if entries is None:
+        entries = []
+        for line in stripped.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                entries.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    for obj in entries:
+        blk = explain_from_record(obj)
+        if blk is not None:
+            out.append(blk)
+    return out
+
+
+def _explain_live(args) -> dict | int:
+    """Build a plan from the CLI knobs and explain it (imports jax)."""
+    import distributedfft_tpu as dfft
+
+    try:
+        shape = tuple(int(s) for s in args.plan.replace("x", ",").split(","))
+        if len(shape) != 3:
+            raise ValueError
+    except ValueError:
+        print(f"report explain: --plan wants NX,NY,NZ, got {args.plan!r}",
+              file=sys.stderr)
+        return 2
+    import jax
+
+    ndev = args.ndev if args.ndev is not None else len(jax.devices())
+    direction = dfft.FORWARD if args.direction == "forward" else dfft.BACKWARD
+    plan_fn = (dfft.plan_dft_r2c_3d if args.kind in ("r2c", "c2r")
+               else dfft.plan_dft_c2c_3d)
+    kw: dict = dict(direction=direction, executor=args.executor,
+                    algorithm=args.algorithm,
+                    decomposition=args.decomposition)
+    if args.kind == "c2r":
+        kw["direction"] = dfft.BACKWARD
+    if args.overlap is not None:
+        kw["overlap_chunks"] = args.overlap
+    try:
+        plan = plan_fn(shape, ndev if ndev > 1 else None, **kw)
+        return dfft.explain(plan, iters=args.iters,
+                            measure=not args.no_measure)
+    except Exception as e:  # noqa: BLE001 — CLI boundary
+        print(f"report explain: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+
+
+def _main_explain(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m distributedfft_tpu.report explain",
+        description="Plan explain & attribution: the per-stage t0..t3 "
+                    "model/compiled/measured join with MFU, ICI "
+                    "utilization, and model-vs-measured divergence "
+                    "flags. Default: render the newest history record "
+                    "that carries an explain block; --record FILE reads "
+                    "an artifact (run record or a prior --json dump); "
+                    "--plan NX,NY,NZ builds and explains a live plan "
+                    "(imports jax). Exit codes: 0 ok, 2 usage/IO error "
+                    "or no explain block found.")
+    _history_arg(p)
+    p.add_argument("--record", default=None, metavar="FILE",
+                   help="read the explain block from this artifact "
+                        "instead of the history store")
+    p.add_argument("--plan", default=None, metavar="NX,NY,NZ",
+                   help="build and explain a live plan of this shape")
+    p.add_argument("--ndev", "-n", type=int, default=None,
+                   help="device count for --plan (default: all)")
+    p.add_argument("--kind", default="c2c", choices=("c2c", "r2c", "c2r"),
+                   help="plan family for --plan (default c2c)")
+    p.add_argument("--direction", default="forward",
+                   choices=("forward", "backward"))
+    p.add_argument("--executor", default="xla")
+    p.add_argument("--algorithm", default="alltoall",
+                   choices=("alltoall", "alltoallv", "ppermute"))
+    p.add_argument("--decomposition", default=None,
+                   help="auto|single|slab|pencil for --plan")
+    p.add_argument("--overlap", default=None, metavar="K",
+                   help="overlap_chunks for --plan (int or 'auto')")
+    p.add_argument("--iters", type=int, default=3,
+                   help="measured warm passes for --plan (default 3)")
+    p.add_argument("--no-measure", action="store_true",
+                   help="model + compiled views only; skip every "
+                        "execution (for --plan)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable JSON instead of the table")
+    args = p.parse_args(argv)
+
+    from .explain import explain_from_record, format_explain
+
+    if args.plan:
+        rec = _explain_live(args)
+        if isinstance(rec, int):
+            return rec
+    elif args.record:
+        try:
+            with open(args.record) as f:
+                text = f.read()
+        except OSError as e:
+            print(f"report explain: {e}", file=sys.stderr)
+            return 2
+        blocks = _explain_blocks_from_text(text)
+        if not blocks:
+            print(f"report explain: no explain block in {args.record}",
+                  file=sys.stderr)
+            return 2
+        rec = blocks[-1]
+    else:
+        history = _resolve_history(args)
+        records, dropped = (regress.load_history(history) if history
+                            else ([], 0))
+        if dropped:
+            print(f"report explain: skipped {dropped} malformed line(s) "
+                  f"in {history}", file=sys.stderr)
+        blocks = [b for b in (explain_from_record(r)
+                              for r in records) if b is not None]
+        if not blocks:
+            print(f"report explain: no history record carries an explain "
+                  f"block ({history or 'store disabled'}); run "
+                  f"'report explain --plan ...' or 'speed3d -explain'",
+                  file=sys.stderr)
+            return 2
+        rec = blocks[-1]
+
+    if args.json:
+        print(json.dumps(rec, sort_keys=True))
+    else:
+        print(format_explain(rec))
+    return 0
 
 
 # ---------------------------------------------------------- wisdom CLI
@@ -672,6 +843,7 @@ _SUBCOMMANDS = {
     "history": _main_history,
     "compare": _main_compare,
     "wisdom": _main_wisdom,
+    "explain": _main_explain,
 }
 
 

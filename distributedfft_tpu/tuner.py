@@ -55,7 +55,7 @@ from typing import Any, Callable, Sequence
 
 import numpy as np
 
-from .parallel.exchange import WIRE_BYTE_KEYS, transport_steps
+from .parallel.exchange import WIRE_BYTE_KEYS
 from .plan_logic import (
     PlanOptions,
     auto_overlap_chunks,
@@ -213,6 +213,8 @@ def model_cost(
     Used to *rank* candidates before any compile, never to pick a
     winner.
     """
+    from .parallel.exchange import exchange_model_seconds
+
     shape = tuple(int(s) for s in shape)
     lp = logic_plan3d(shape, mesh, PlanOptions(
         decomposition=cand.decomposition, algorithm=cand.algorithm,
@@ -223,14 +225,15 @@ def model_cost(
     payloads = exchange_payloads(lp, shape, itemsize)
     # Downstream FFT time each exchange can hide under: one chain stage.
     t_stage = t_fft / (len(payloads) + 1)
-    k = max(1, cand.overlap_chunks)
     total = t_fft
     for e in payloads:
         wire = e[WIRE_BYTE_KEYS[cand.algorithm]] / ndev
-        steps = transport_steps(cand.algorithm, e["parts"])
-        t_ex = wire / (MODEL_WIRE_GBPS * 1e9) + steps * MODEL_LAUNCH_SECONDS
-        exposed = t_ex / k + max(0.0, t_ex - t_stage) * (k - 1) / k
-        total += exposed + (k - 1) * steps * MODEL_LAUNCH_SECONDS
+        total += exchange_model_seconds(
+            wire, e["parts"], cand.algorithm,
+            wire_gbps=MODEL_WIRE_GBPS,
+            launch_seconds=MODEL_LAUNCH_SECONDS,
+            overlap_chunks=cand.overlap_chunks,
+            hide_seconds=t_stage)["exposed_seconds"]
     return total
 
 
@@ -584,6 +587,47 @@ def record_wisdom(
     return entry
 
 
+def _log_model_divergence(
+    by_label: dict[str, Candidate],
+    times: dict[str, float],
+    winner: str,
+    shape,
+    mesh,
+    *,
+    itemsize: int = 8,
+) -> None:
+    """Audit the pruning model against the tournament it pruned for:
+    per candidate, the measured/predicted ratio goes into the
+    ``tune_model_measured_ratio`` gauge (fuel for ``dfft.explain`` /
+    prune-quality analysis), and when the model's own favorite is not
+    the measured winner one stderr line names the disagreement — the
+    signal that the ranking constants are mis-ordering THIS
+    configuration's candidates. Best-effort: never fatal, never changes
+    the winner."""
+    try:
+        model = {label: model_cost(c, shape, mesh, itemsize=itemsize)
+                 for label, c in by_label.items()
+                 if label in times and math.isfinite(times[label])}
+        for label, m in model.items():
+            if m > 0:
+                _metrics.set_gauge("tune_model_measured_ratio",
+                                   times[label] / m, candidate=label)
+        if not model:
+            return
+        model_pick = min(model, key=model.__getitem__)
+        if model_pick != winner and model_pick in times:
+            print(
+                f"tuner: model/measured divergence: model ranked "
+                f"{model_pick!r} first "
+                f"({model[model_pick]:.6f}s predicted, "
+                f"{times[model_pick]:.6f}s measured) but "
+                f"{winner!r} won ({model.get(winner, math.nan):.6f}s "
+                f"predicted, {times[winner]:.6f}s measured)",
+                file=sys.stderr)
+    except Exception:  # noqa: BLE001 — audit trail only
+        pass
+
+
 # ------------------------------------------------------ planner dispatch
 
 def _mesh_context(mesh) -> tuple[int, tuple[int, ...] | None]:
@@ -686,6 +730,8 @@ def tuned_plan(kind: str, shape, mesh, options: PlanOptions,
 
     winner, built, times = measured_select(
         list(by_label), build, measure, what=f"{kind} tune candidate")
+    _log_model_divergence(by_label, times, winner, shape, mesh,
+                          itemsize=itemsize)
     record_wisdom(key, by_label[winner], times[winner], path=path,
                   times=times)
     if options.donate:

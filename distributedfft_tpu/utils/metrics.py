@@ -45,8 +45,10 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
 __all__ = [
+    "METRICS_SCHEMA",
     "enable_metrics",
     "metrics_enabled",
     "inc",
@@ -56,6 +58,12 @@ __all__ = [
     "metrics_snapshot",
     "metrics_reset",
 ]
+
+#: Snapshot document format version — bumped whenever the snapshot's
+#: shape changes, and stamped into every snapshot (and from there into
+#: the regress run records that embed one) so schema drift across
+#: releases is detectable offline instead of silently misparsed.
+METRICS_SCHEMA = 1
 
 _enabled = os.environ.get("DFFT_METRICS", "") not in ("", "0")
 _lock = threading.Lock()
@@ -131,10 +139,14 @@ def _label_str(labels: tuple) -> str:
 def metrics_snapshot() -> dict:
     """One JSON-serializable document of every recorded series.
 
-    Shape: ``{"counters": {name: {"label=value,...": total}}, "gauges":
-    {...}, "histograms": {name: {labels: {count,total,mean,min,max}}}}``
-    (the empty string keys a label-less series). Reset with
-    :func:`metrics_reset`.
+    Shape: ``{"schema", "captured_at_monotonic", "enabled", "counters":
+    {name: {"label=value,...": total}}, "gauges": {...}, "histograms":
+    {name: {labels: {count,total,mean,min,max}}}}`` (the empty string
+    keys a label-less series). ``schema`` is :data:`METRICS_SCHEMA`;
+    ``captured_at_monotonic`` is ``time.monotonic()`` at capture — a
+    per-process ordering stamp (two snapshots from one process order by
+    it; it is NOT wall clock and never compares across processes).
+    Reset with :func:`metrics_reset`.
     """
     with _lock:
         counters: dict = {}
@@ -154,6 +166,8 @@ def metrics_snapshot() -> dict:
                 "max": hi,
             }
     return {
+        "schema": METRICS_SCHEMA,
+        "captured_at_monotonic": time.monotonic(),
         "enabled": _enabled,
         "counters": counters,
         "gauges": gauges,

@@ -210,16 +210,41 @@ def timed_span(name: str):
             out["seconds"] = time.perf_counter() - start
 
 
+#: Canonical stage keys of the reference's per-execute breakdown
+#: (``fft_mpi_3d_api.cpp:184-201``) — the join axis of the explain layer.
+STAGE_KEYS = ("t0", "t1", "t2", "t3")
+
+
+def stage_key(name: str) -> str | None:
+    """Canonical ``t0..t3`` key of a stage/span name, or None.
+
+    Normalizes every variant the chain builders emit — ``t0_fft_yz``,
+    ``t2_all_to_all``, ``t2a_exchange_x``/``t2b_exchange_y`` (both map
+    to ``t2``), per-chunk overlap spans ``t3_fft_x[4]`` — so the
+    explain/attribution layer and the regress localization agree on one
+    stage taxonomy regardless of which builder produced the span."""
+    if len(name) >= 2 and name[0] == "t" and name[1] in "0123":
+        key = name[:2]
+        rest = name[2:]
+        if not rest or rest[0] in "_[" or rest[:1] in ("a", "b"):
+            return key
+    return None
+
+
 def traced_stage(name: str, fn):
     """Wrap one staged-pipeline callable so every call records a named
     event (the per-stage breakdown of ``fft_mpi_3d_api.cpp:184-201`` as
     trace spans). Dispatch-side by the :func:`add_trace` contract — the
-    timing harness's sync bracketing still owns true device timings."""
+    timing harness's sync bracketing still owns true device timings.
+    The wrapped callable (usually a jit) stays reachable via
+    ``__wrapped__`` so the explain layer can lower/compile individual
+    stages for cost analysis."""
 
     def run(x):
         with add_trace(name):
             return fn(x)
 
+    run.__wrapped__ = fn
     return run
 
 

@@ -1,0 +1,266 @@
+"""Explain/attribution layer — the pure-python tier (no 8-device
+executions, so this file is safe to collect after ``test_alltoallv``'s
+backend poisoning; the execution tier lives in ``test_a2d_explain.py``).
+
+Covers: the divergence gate on synthetic fixtures, the ``report
+explain`` CLI against the committed history fixture, the regress
+cost-block gating (peak-HBM / compile-seconds), the metrics snapshot
+schema stamp, the ``history --config`` filter, and the collection-order
+guard protecting the tier-1 suite from a rename of the
+must-collect-early test files.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from distributedfft_tpu import regress
+from distributedfft_tpu.explain import (
+    EXPLAIN_SCHEMA,
+    explain_from_record,
+    format_explain,
+    stage_divergence,
+)
+from distributedfft_tpu.utils.metrics import METRICS_SCHEMA
+from distributedfft_tpu.utils.trace import STAGE_KEYS, stage_key
+
+TESTS = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TESTS)
+DATA = os.path.join(TESTS, "data")
+FIXTURE = os.path.join(DATA, "history_explain.jsonl")
+
+CPU_ENV = {**os.environ, "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+
+
+def _report(*argv, env=None):
+    return subprocess.run(
+        [sys.executable, "-m", "distributedfft_tpu.report", *argv],
+        capture_output=True, text=True, cwd=REPO, env=env or CPU_ENV,
+        timeout=240)
+
+
+# -------------------------------------------------------- divergence
+
+def test_divergence_fires_on_inflated_measured_t2():
+    """The synthetic-fixture acceptance case: the model prices t2 at
+    1 ms, the measurement says ~2 ms with tight noise — flagged."""
+    div = stage_divergence(0.001, [0.00201, 0.00199, 0.00200])
+    assert div["diverged"] is True
+    assert div["direction"] == "slower"
+    assert div["ratio"] > 1.5
+
+
+def test_divergence_quiet_when_model_inside_noise_band():
+    div = stage_divergence(0.00200, [0.00203, 0.00198, 0.00201])
+    assert div["diverged"] is False
+
+
+def test_divergence_never_verdicts_without_samples_or_model():
+    assert stage_divergence(0.001, [0.002])["diverged"] is None  # n < 2
+    assert stage_divergence(0.0, [0.002, 0.002])["diverged"] is None
+
+
+def test_stage_key_normalization():
+    assert stage_key("t0_fft_yz") == "t0"
+    assert stage_key("t2_all_to_all") == "t2"
+    assert stage_key("t2a_exchange_x") == "t2"
+    assert stage_key("t2b_exchange_y") == "t2"
+    assert stage_key("t3_fft_x[4]") == "t3"
+    assert stage_key("t1") == "t1"
+    assert stage_key("tune_build_xla") is None
+    assert stage_key("execute_c2c_slab") is None
+
+
+# ----------------------------------------------------------- fixture
+
+def _fixture_record():
+    with open(FIXTURE) as f:
+        return json.loads(f.readline())
+
+
+def test_fixture_record_carries_full_explain_block():
+    rec = _fixture_record()
+    exp = explain_from_record(rec)
+    assert exp is not None and exp["schema"] == EXPLAIN_SCHEMA
+    assert tuple(sorted(exp["stages"])) == tuple(sorted(STAGE_KEYS))
+    for key in STAGE_KEYS:
+        st = exp["stages"][key]
+        assert {"model", "compiled", "measured"} <= set(st)
+    # A bare explain record resolves too; arbitrary dicts do not.
+    assert explain_from_record(exp) is exp
+    assert explain_from_record({"metric": "x"}) is None
+    text = format_explain(exp)
+    assert "compiled (whole plan)" in text
+
+
+def test_report_explain_json_reproduces_history_record():
+    """``report explain --json`` must reproduce the record's explain
+    block byte-for-byte (modulo key ordering) — the acceptance check."""
+    rec = _fixture_record()
+    out = _report("explain", "--record", FIXTURE, "--json")
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout) == rec["explain"]
+    # The default (history) path reads the same store.
+    out2 = _report("explain", "--history", FIXTURE, "--json")
+    assert out2.returncode == 0, out2.stderr
+    assert json.loads(out2.stdout) == rec["explain"]
+
+
+def test_report_explain_table_renders_from_history():
+    out = _report("explain", "--history", FIXTURE)
+    assert out.returncode == 0, out.stderr
+    assert "divergence" in out.stdout and "t2" in out.stdout
+
+
+def test_report_explain_errors_cleanly_without_blocks(tmp_path):
+    empty = tmp_path / "h.jsonl"
+    empty.write_text(json.dumps({"metric": "m", "value": 1.0,
+                                 "schema": 1, "device_kind": "cpu"}) + "\n")
+    out = _report("explain", "--history", str(empty))
+    assert out.returncode == 2
+    assert "no history record carries an explain block" in out.stderr
+
+
+# ------------------------------------------------- regress cost gate
+
+def _cost_rec(value, peak, compile_s, kind="TPU v5 lite"):
+    return regress.make_run_record(
+        metric="fft3d_c2c_512_forward_gflops", value=value,
+        config={"dtype": "complex64", "devices": 8}, backend="tpu",
+        device_kind=kind,
+        cost={"peak_hbm_bytes": peak, "compile_seconds": compile_s},
+        source="test")
+
+
+def test_compare_gates_on_fabricated_peak_hbm_jump():
+    """Wall time steady, HBM footprint doubled: the headline stays
+    within noise but the aux cost verdict regresses and the shared
+    gate rule trips."""
+    hist = [_cost_rec(v, 1_000_000_000, 10.0)
+            for v in (186.1, 187.1, 185.9, 186.8)]
+    subj = _cost_rec(186.5, 2_000_000_000, 10.05)
+    res = regress.compare_record(subj, hist)
+    assert res["verdict"] == "within-noise"
+    by = {a["metric"]: a for a in res["aux"]}
+    assert by["peak_hbm_bytes"]["verdict"] == "regressed"
+    assert by["compile_seconds"]["verdict"] == "within-noise"
+    assert regress.regressed_metrics(res) == [
+        "fft3d_c2c_512_forward_gflops:peak_hbm_bytes"]
+    # ... and a footprint improvement is called one.
+    res2 = regress.compare_record(
+        _cost_rec(186.5, 500_000_000, 10.0), hist)
+    assert {a["metric"]: a["verdict"] for a in res2["aux"]}[
+        "peak_hbm_bytes"] == "improved"
+
+
+def test_compare_gates_on_compile_seconds_jump():
+    hist = [_cost_rec(v, 10 ** 9, 10.0) for v in (186.1, 187.1, 186.4)]
+    res = regress.compare_record(_cost_rec(186.3, 10 ** 9, 25.0), hist)
+    assert regress.regressed_metrics(res) == [
+        "fft3d_c2c_512_forward_gflops:compile_seconds"]
+
+
+def test_cost_block_never_compares_without_baseline_samples():
+    hist = [_cost_rec(v, None, None) for v in (186.1, 187.1, 186.4)]
+    res = regress.compare_record(_cost_rec(186.3, 10 ** 9, 5.0), hist)
+    assert all(a["verdict"] == "no-baseline" for a in res["aux"])
+    assert regress.regressed_metrics(res) == []
+
+
+def test_cli_compare_gate_trips_on_peak_hbm_regression(tmp_path):
+    """The acceptance CLI path: ``compare --gate`` exits 1 on a
+    cost-block regression even though the headline is clean."""
+    hist = tmp_path / "history.jsonl"
+    with open(hist, "w") as f:
+        for v in (186.1, 187.1, 185.9, 186.8):
+            f.write(json.dumps(_cost_rec(v, 10 ** 9, 10.0)) + "\n")
+        f.write(json.dumps(_cost_rec(186.5, 2 * 10 ** 9, 10.0)) + "\n")
+    out = _report("compare", "--history", str(hist), "--gate")
+    assert out.returncode == 1, (out.stdout, out.stderr)
+    assert "peak_hbm_bytes" in out.stdout
+    assert "confirmed regression" in out.stderr
+
+
+def test_metric_direction_bytes_are_smaller_is_better():
+    assert regress.metric_direction("peak_hbm_bytes") == -1
+    assert regress.metric_direction("compile_seconds") == -1
+    assert regress.metric_direction("fft3d_c2c_512_forward_gflops") == 1
+
+
+def test_normalize_bench_line_lifts_cost_and_explain():
+    line = {"metric": "m", "value": 5.0, "backend": "cpu",
+            "telemetry": {"cost": {"peak_hbm_bytes": 123,
+                                   "compile_seconds": 0.5}},
+            "explain": {"schema": EXPLAIN_SCHEMA, "stages": {"t0": {}}}}
+    rec = regress.normalize_bench_line(line, source="t")
+    assert rec["cost"]["peak_hbm_bytes"] == 123
+    assert rec["explain"]["schema"] == EXPLAIN_SCHEMA
+    # An all-null cost block (CPU fallback) is dropped, not stored.
+    line2 = {"metric": "m", "value": 5.0, "backend": "cpu",
+             "telemetry": {"cost": {"peak_hbm_bytes": None,
+                                    "compile_seconds": None}}}
+    assert "cost" not in regress.normalize_bench_line(line2, source="t")
+
+
+# ------------------------------------------------- metrics schema stamp
+
+def test_metrics_snapshot_carries_schema_and_monotonic_stamp():
+    from distributedfft_tpu.utils.metrics import metrics_snapshot
+
+    a = metrics_snapshot()
+    b = metrics_snapshot()
+    assert a["schema"] == METRICS_SCHEMA
+    assert isinstance(a["captured_at_monotonic"], float)
+    assert b["captured_at_monotonic"] >= a["captured_at_monotonic"]
+
+
+def test_run_record_stamps_metrics_schema():
+    rec = regress.make_run_record(
+        metric="m", value=1.0, source="t",
+        metrics={"schema": METRICS_SCHEMA, "captured_at_monotonic": 1.0,
+                 "counters": {}})
+    assert rec["metrics_schema"] == METRICS_SCHEMA
+
+
+# --------------------------------------------------- history --config
+
+def test_report_history_config_filter(tmp_path):
+    hist = tmp_path / "history.jsonl"
+    recs = [
+        regress.make_run_record(
+            metric="m", value=10.0, config={"devices": 8, "tuned": "x"},
+            backend="tpu", device_kind="tpu", source="t"),
+        regress.make_run_record(
+            metric="m", value=11.0, config={"devices": 8},
+            backend="tpu", device_kind="tpu", source="t"),
+    ]
+    with open(hist, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    out = _report("history", "--history", str(hist), "--config",
+                  "tuned=", "--json")
+    assert out.returncode == 0, out.stderr
+    rows = json.loads(out.stdout)
+    assert len(rows) == 1 and "tuned=x" in rows[0]["config"]
+    # No filter: both groups list.
+    out2 = _report("history", "--history", str(hist), "--json")
+    assert len(json.loads(out2.stdout)) == 2
+
+
+# ----------------------------------------------- collection-order guard
+
+def test_poison_ordering_guard():
+    """The XLA:CPU fft-thunk poisoning rule from PRs 3-5: the files
+    that execute 8-device plans with a clean-backend requirement must
+    collect BEFORE ``test_alltoallv.py`` (alphabetical collection). A
+    rename that silently broke this would resurface as hundreds of
+    mysterious tier-1 failures, so the names themselves are pinned."""
+    names = sorted(n for n in os.listdir(TESTS)
+                   if n.startswith("test_") and n.endswith(".py"))
+    poison = names.index("test_alltoallv.py")
+    for early in ("test_a2a_overlap.py", "test_a2c_tuner.py",
+                  "test_a2d_explain.py"):
+        assert early in names, early
+        assert names.index(early) < poison, (
+            f"{early} must collect before test_alltoallv.py")
