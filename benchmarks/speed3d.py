@@ -57,6 +57,15 @@ def parse_args(argv):
                         "(MPI_Alltoallv analog; TPU backend only, the CPU "
                         "test backend mirrors the dense path)")
     p.add_argument("-executor", default="xla", help="local FFT backend (xla|matmul|...)")
+    p.add_argument("-batch", type=int, default=None, metavar="B",
+                   help="coalesced multi-request batch: one batch=B plan "
+                        "computes B independent transforms per execution "
+                        "(one shared exchange per t2 stage — the serving "
+                        "tier's throughput play). GFlops and the printed "
+                        "transforms/s count all B. Batched rows label "
+                        "the CSV algorithm column '<alg>+bB' (mirroring "
+                        "-overlap's '+ovK'), so batched and unbatched "
+                        "sweeps never share a regress compare baseline")
     p.add_argument("-overlap", default=None, metavar="K",
                    help="pipelined t2/t3 exchange/compute overlap: chunk "
                         "count K or 'auto' (block-bytes heuristic); "
@@ -197,6 +206,15 @@ def main(argv=None) -> None:
             raise SystemExit("-explain applies to the c2c/r2c chain "
                              "planners; brick and dd plans do not take it")
         args.metrics = True  # the attribution join reads the registry
+    if args.batch is not None:
+        if args.batch < 1:
+            raise SystemExit(f"-batch must be >= 1, got {args.batch}")
+        if (args.bricks or args.precision == "dd" or args.ingrid
+                or args.outgrid or args.r2c_axis != 2):
+            raise SystemExit("-batch applies to the canonical c2c/r2c "
+                             "chain planners; brick, dd, layout "
+                             "(-ingrid/-outgrid), and r2c_axis!=2 plans "
+                             "do not take it")
 
     if args.r2c_axis != 2 and (args.kind != "r2c"
                                or args.precision == "dd"):
@@ -267,6 +285,11 @@ def main(argv=None) -> None:
     plan_fn = dfft.plan_dft_r2c_3d if args.kind == "r2c" else dfft.plan_dft_c2c_3d
     kw = dict(decomposition=decomposition, executor=args.executor,
               dtype=dtype, algorithm=algorithm)
+    # batch=1 normalizes to the unbatched plan; bsz drives input shapes,
+    # GFlops scaling, and the CSV '+bB' label only when a real batch runs.
+    bsz = args.batch if (args.batch or 0) > 1 else None
+    if args.batch is not None:
+        kw["batch"] = args.batch
     if args.overlap is not None:
         kw["overlap_chunks"] = args.overlap
     if args.tune is not None:
@@ -324,8 +347,9 @@ def main(argv=None) -> None:
         from distributedfft_tpu.plan_logic import spec_entries
 
         divides = all(
-            e is None or shape[d] % mesh_prod(fwd.mesh, e) == 0
-            for d, e in enumerate(spec_entries(fwd.mesh, fwd.in_sharding.spec, 3))
+            e is None or fwd.in_shape[d] % mesh_prod(fwd.mesh, e) == 0
+            for d, e in enumerate(spec_entries(
+                fwd.mesh, fwd.in_sharding.spec, len(fwd.in_shape)))
         )
         if divides:
             mk_kw["out_shardings"] = fwd.in_sharding
@@ -354,10 +378,11 @@ def main(argv=None) -> None:
             if fwd.in_sharding is not None:
                 z = jlax.with_sharding_constraint(z, fwd.in_sharding)
             return z
-        re = jax.random.normal(k1, shape, rdt)
+        mk_shape = shape if bsz is None else (bsz,) + shape
+        re = jax.random.normal(k1, mk_shape, rdt)
         if args.kind == "r2c":
             return re
-        im = jax.random.normal(k2, shape, rdt)
+        im = jax.random.normal(k2, mk_shape, rdt)
         return (re + 1j * im).astype(dtype)
 
     x = make_input()
@@ -394,7 +419,8 @@ def main(argv=None) -> None:
                     build_single_stages,
                 )
 
-                stages = build_single_stages(shape, executor=args.executor)
+                stages = build_single_stages(shape, executor=args.executor,
+                                             batch=bsz)
             else:
                 print("note: single-device -staged supports c2c only; "
                       "ignoring", file=sys.stderr)
@@ -404,7 +430,7 @@ def main(argv=None) -> None:
             stages, _ = build_slab_stages(
                 fwd.mesh, shape, axis_name=fwd.mesh.axis_names[0],
                 executor=args.executor, algorithm=algorithm,
-                overlap_chunks=overlap,
+                overlap_chunks=overlap, batch=bsz,
             )
         elif fwd.decomposition == "slab":
             from distributedfft_tpu.parallel.staged import build_slab_rfft_stages
@@ -412,7 +438,7 @@ def main(argv=None) -> None:
             stages, _ = build_slab_rfft_stages(
                 fwd.mesh, shape, axis_name=fwd.mesh.axis_names[0],
                 executor=args.executor, algorithm=algorithm,
-                overlap_chunks=overlap,
+                overlap_chunks=overlap, batch=bsz,
             )
         elif args.kind == "c2c":
             from distributedfft_tpu.parallel.staged import build_pencil_stages
@@ -420,7 +446,7 @@ def main(argv=None) -> None:
             stages, _ = build_pencil_stages(
                 fwd.mesh, shape, row_axis=fwd.mesh.axis_names[0],
                 col_axis=fwd.mesh.axis_names[1], executor=args.executor,
-                algorithm=algorithm, overlap_chunks=overlap,
+                algorithm=algorithm, overlap_chunks=overlap, batch=bsz,
             )
         else:
             from distributedfft_tpu.parallel.staged import (
@@ -430,7 +456,7 @@ def main(argv=None) -> None:
             stages, _ = build_pencil_rfft_stages(
                 fwd.mesh, shape, row_axis=fwd.mesh.axis_names[0],
                 col_axis=fwd.mesh.axis_names[1], executor=args.executor,
-                algorithm=algorithm, overlap_chunks=overlap,
+                algorithm=algorithm, overlap_chunks=overlap, batch=bsz,
             )
         if stages is not None:
             stage_times, _ = time_staged(stages, x, iters=args.iters)
@@ -442,9 +468,14 @@ def main(argv=None) -> None:
         seconds, _ = time_fn_amortized(lambda: fwd(x), iters=args.iters,
                                        repeats=2)
     is_real = args.kind == "r2c"
-    gf = gflops(shape, seconds, real=is_real)
+    # One batched execution computes bsz transforms: GFlops and the
+    # throughput line count all of them.
+    gf = gflops(shape, seconds, real=is_real) * (bsz or 1)
 
     print(result_block(shape, ndev, seconds, max_err, stage_times, real=is_real))
+    if bsz is not None:
+        print(f"batch: {bsz} coalesced transforms -> "
+              f"{bsz / seconds:.2f} transforms/s")
 
     exp_rec = None
     if args.explain:
@@ -478,7 +509,7 @@ def main(argv=None) -> None:
         # unchanged for default rows).
         kind = (f"r2c_axis{args.r2c_axis}"
                 if args.kind == "r2c" and args.r2c_axis != 2 else args.kind)
-        alg_label = _algorithm_label(algorithm, overlap)
+        alg_label = _algorithm_label(algorithm, overlap, batch=bsz)
         if tuned_lbl is not None:
             # Tuned rows must never be indistinguishable from rows that
             # pinned the same knobs by hand (the tuple can move between
@@ -524,13 +555,20 @@ def _t2_ratio(exp_rec) -> str:
     return "nan"
 
 
-def _algorithm_label(algorithm: str, overlap: int | None) -> str:
-    """Algorithm column label with the overlap chunk count appended
-    (``alltoall+ov4``) when the pipelined t2/t3 mode is on — overlapped
-    sweep rows must never be indistinguishable from monolithic baselines.
-    Default (K=1) rows keep the bare name (schema unchanged)."""
-    return (f"{algorithm}+ov{overlap}"
-            if overlap and overlap != 1 else algorithm)
+def _algorithm_label(algorithm: str, overlap: int | None,
+                     batch: int | None = None) -> str:
+    """Algorithm column label with the overlap chunk count
+    (``alltoall+ov4``) and/or coalesced batch size (``alltoall+b8``)
+    appended — overlapped/batched sweep rows must never be
+    indistinguishable from monolithic single-transform baselines (the
+    regress store keys the label into the baseline config group).
+    Default (K=1, unbatched) rows keep the bare name (schema
+    unchanged)."""
+    label = (f"{algorithm}+ov{overlap}"
+             if overlap and overlap != 1 else algorithm)
+    if batch and batch > 1:
+        label += f"+b{batch}"
+    return label
 
 
 # Env knobs appended to the executor label, gated on the executor

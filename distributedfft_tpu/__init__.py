@@ -47,6 +47,12 @@ from .api import (  # noqa: F401
     plan_dft_r2c_3d,
 )
 from .ops.ddfft import dd_from_host, dd_to_host  # noqa: F401
+from .serving import (  # noqa: F401
+    CoalescingQueue,
+    Handle,
+    submit,
+    warm_pool,
+)
 from .geometry import Box3, world_box  # noqa: F401
 from .local import (  # noqa: F401
     LocalPlan,

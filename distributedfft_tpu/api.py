@@ -60,9 +60,11 @@ from .plan_logic import (
 from .parallel.pencil import PencilSpec, build_pencil_fft3d, build_pencil_rfft3d
 from .parallel.slab import (
     SlabSpec,
+    batch_pspec,
     build_slab_fft3d,
     build_slab_rfft3d,
     build_slab_stages,
+    check_batch,
 )
 
 # FFTW sign convention (FFTW_FORWARD = -1, FFTW_BACKWARD = +1); single
@@ -102,6 +104,12 @@ class Plan3D:
     # Stored explicitly because shape inference is ambiguous when the
     # halved extent is 1 or 2 (N//2+1 == N there).
     r2c_axis: int = 2
+    # Leading batch axis of a coalesced multi-request plan: B independent
+    # same-shape transforms executed as ONE device program with one
+    # shared exchange per t2 stage (in/out shapes carry the [B, ...]
+    # prefix; boxes stay per-transform). None = unbatched (batch=1 plans
+    # normalize here — byte-identical HLO to an unadorned plan).
+    batch: int | None = None
     options: PlanOptions = DEFAULT_OPTIONS
     # The resolved plan skeleton (axis assignment, stage chain, device-count
     # negotiation record) — surfaced by plan_info.
@@ -197,17 +205,29 @@ def _default_cdtype(dtype):
     return jnp.dtype(dtype)
 
 
-def _shardings(lp: LogicPlan, spec):
+def _norm_batch(batch) -> int | None:
+    """Planner ``batch`` argument -> None (unbatched) or an int >= 2.
+
+    ``batch=1`` IS the unbatched plan: same chain, same plan-cache entry
+    family, byte-identical HLO to an unadorned call (the acceptance
+    pin) — the serving tier executes singleton groups through the plain
+    plan instead of a [1, ...] program."""
+    batch = check_batch(batch)
+    return None if batch == 1 else batch
+
+
+def _shardings(lp: LogicPlan, spec, batch: int | None = None):
     """Input/output NamedShardings of the built chain — taken from the
     builder's own spec object (direction-true), so they reflect generalized
-    axis assignments."""
+    axis assignments. ``batch`` prepends the replicated leading batch
+    entry of a batched chain."""
     if lp.mesh is None or spec is None:
         return None, None
     if hasattr(spec, "in_pspec"):  # SlabSpec
-        return (NamedSharding(lp.mesh, spec.in_pspec),
-                NamedSharding(lp.mesh, spec.out_pspec))
-    return (NamedSharding(lp.mesh, spec.in_spec),
-            NamedSharding(lp.mesh, spec.out_spec))
+        return (NamedSharding(lp.mesh, batch_pspec(spec.in_pspec, batch)),
+                NamedSharding(lp.mesh, batch_pspec(spec.out_pspec, batch)))
+    return (NamedSharding(lp.mesh, batch_pspec(spec.in_spec, batch)),
+            NamedSharding(lp.mesh, batch_pspec(spec.out_spec, batch)))
 
 
 def _boxes(lp: LogicPlan, world_in: Box3, world_out: Box3):
@@ -329,6 +349,7 @@ def plan_dft_c2c_3d(
     options: PlanOptions | None = None,
     in_spec: P | None = None,
     out_spec: P | None = None,
+    batch: int | None = None,
 ) -> Plan3D:
     """Create a distributed 3D complex-to-complex FFT plan.
 
@@ -365,8 +386,21 @@ def plan_dft_c2c_3d(
     ``"wisdom"`` only consults the persistent store and falls back to
     these static heuristics on a miss; default ``"off"`` (or the
     ``DFFT_TUNE`` env var) plans exactly as before.
+
+    ``batch=B`` coalesces B independent same-shape transforms into ONE
+    device program: I/O is ``[B, N0, N1, N2]`` (``plan.in_shape``), the
+    chain runs batched FFT stages, and every exchange is one shared
+    collective with the batch riding as a bystander dim — B transforms
+    pay one collective latency, the whole throughput play of the serving
+    tier (:mod:`.serving`). ``batch=1``/``None`` is the unbatched plan
+    (byte-identical HLO). Batched plans are plan-cache- and wisdom-keyed
+    by B; ``in_spec``/``out_spec`` layouts take the unbatched path only.
     """
     shape, forward = _check_direction(shape, direction)
+    batch = _norm_batch(batch)
+    if batch is not None and (in_spec is not None or out_spec is not None):
+        raise ValueError("batched plans take the canonical chain layouts; "
+                         "in_spec/out_spec require batch=None (or 1)")
     opts = _resolve_options(decomposition, executor, donate, algorithm,
                             options, overlap_chunks, tune)
     if resolve_tune_mode(opts.tune) != "off":
@@ -375,16 +409,17 @@ def plan_dft_c2c_3d(
         return tuner.tuned_plan(
             "c2c", shape, mesh, opts,
             dict(direction=direction, dtype=dtype, in_spec=in_spec,
-                 out_spec=out_spec))
+                 out_spec=out_spec, batch=batch))
     if opts.executor == "auto":
         return _auto_plan(
             functools.partial(plan_dft_c2c_3d, shape, mesh), opts,
             direction=direction, dtype=dtype, in_spec=in_spec,
-            out_spec=out_spec,
+            out_spec=out_spec, batch=batch,
         )
     dtype = _default_cdtype(dtype)
     lp = logic_plan3d(
-        shape, mesh, opts, forward=forward, in_spec=in_spec, out_spec=out_spec
+        shape, mesh, opts, forward=forward, in_spec=in_spec,
+        out_spec=out_spec, batch=batch,
     )
     world = world_box(shape)
     if (in_spec is not None or out_spec is not None) and lp.mesh is None:
@@ -392,7 +427,8 @@ def plan_dft_c2c_3d(
 
     if lp.decomposition == "single":
         ex = get_executor(opts.executor)
-        fn = jax.jit(lambda x: ex(x, (0, 1, 2), forward))
+        fft_axes = (0, 1, 2) if batch is None else (1, 2, 3)
+        fn = jax.jit(lambda x: ex(x, fft_axes, forward))
         spec = None
     elif lp.decomposition == "slab":
         fn, spec = build_slab_fft3d(
@@ -400,7 +436,7 @@ def plan_dft_c2c_3d(
             executor=opts.executor, forward=forward, donate=opts.donate,
             algorithm=opts.algorithm,
             in_axis=lp.slab_axes[0], out_axis=lp.slab_axes[1],
-            overlap_chunks=lp.options.overlap_chunks,
+            overlap_chunks=lp.options.overlap_chunks, batch=batch,
         )
     else:
         row, col = lp.mesh.axis_names[:2]
@@ -409,10 +445,10 @@ def plan_dft_c2c_3d(
             executor=opts.executor, forward=forward, donate=opts.donate,
             algorithm=opts.algorithm,
             perm=lp.pencil_perm, order=lp.pencil_order,
-            overlap_chunks=lp.options.overlap_chunks,
+            overlap_chunks=lp.options.overlap_chunks, batch=batch,
         )
 
-    in_sh, out_sh = _shardings(lp, spec)
+    in_sh, out_sh = _shardings(lp, spec, batch)
     in_boxes, out_boxes = _boxes(lp, world, world)
     # Edge reshards only for layouts the chain could not absorb — absorbed
     # layouts ARE the chain's own endpoints (heFFTe's reshape minimization,
@@ -431,11 +467,14 @@ def plan_dft_c2c_3d(
         in_boxes = _layout_boxes(lp.mesh, in_spec, world)
     if wrap_out is not None:
         out_boxes = _layout_boxes(lp.mesh, out_spec, world)
+    io_shape = shape if batch is None else (batch,) + shape
     return Plan3D(
         shape=shape, direction=direction, dtype=dtype,
         decomposition=lp.decomposition, executor=opts.executor, mesh=lp.mesh,
         fn=fn, spec=spec, in_sharding=in_sh, out_sharding=out_sh,
-        in_boxes=in_boxes, out_boxes=out_boxes, options=lp.options, logic=lp,
+        in_boxes=in_boxes, out_boxes=out_boxes,
+        in_shape=io_shape, out_shape=io_shape, batch=batch,
+        options=lp.options, logic=lp,
     )
 
 
@@ -794,6 +833,7 @@ def plan_dft_r2c_3d(
     in_spec: P | None = None,
     out_spec: P | None = None,
     r2c_axis: int = 2,
+    batch: int | None = None,
 ) -> Plan3D:
     """Create a distributed real-to-complex (forward) / complex-to-real
     (backward) 3D FFT plan — heFFTe ``fft3d_r2c`` parity
@@ -808,8 +848,17 @@ def plan_dft_r2c_3d(
     collectives are unchanged). ``donate`` is accepted for API symmetry
     but is a no-op on r2c/c2r plans: real and half-spectrum buffers
     differ in dtype and size, so XLA can never alias them.
+
+    ``batch=B`` coalesces B same-shape transforms into one device program
+    with one shared exchange per batch (the :func:`plan_dft_c2c_3d`
+    convention); canonical ``r2c_axis=2`` chains only.
     """
+    batch = _norm_batch(batch)
     if r2c_axis != 2:
+        if batch is not None:
+            raise ValueError(
+                "batched r2c plans run the canonical r2c_axis=2 chain; "
+                "transpose the batch's world instead of passing r2c_axis")
         return _r2c_axis_wrapped(
             shape, mesh, r2c_axis, direction=direction,
             decomposition=decomposition, executor=executor, dtype=dtype,
@@ -817,6 +866,9 @@ def plan_dft_r2c_3d(
             overlap_chunks=overlap_chunks, tune=tune, options=options,
             in_spec=in_spec, out_spec=out_spec,
         )
+    if batch is not None and (in_spec is not None or out_spec is not None):
+        raise ValueError("batched plans take the canonical chain layouts; "
+                         "in_spec/out_spec require batch=None (or 1)")
     shape, forward = _check_direction(shape, direction)
     opts = _resolve_options(decomposition, executor, donate, algorithm,
                             options, overlap_chunks, tune)
@@ -826,7 +878,7 @@ def plan_dft_r2c_3d(
         return tuner.tuned_plan(
             "r2c", shape, mesh, opts,
             dict(direction=direction, dtype=dtype, in_spec=in_spec,
-                 out_spec=out_spec))
+                 out_spec=out_spec, batch=batch))
     if opts.donate:
         # r2c/c2r buffers can never alias (real world vs complex
         # half-spectrum differ in dtype and size), so donation would
@@ -840,7 +892,7 @@ def plan_dft_r2c_3d(
         return _auto_plan(
             functools.partial(plan_dft_r2c_3d, shape, mesh), opts,
             direction=direction, dtype=dtype, in_spec=in_spec,
-            out_spec=out_spec,
+            out_spec=out_spec, batch=batch,
         )
     dtype = _default_cdtype(dtype)
     if not jnp.issubdtype(dtype, jnp.complexfloating):
@@ -854,23 +906,25 @@ def plan_dft_r2c_3d(
     # r2c chains keep the canonical axis assignment (the real axis must be
     # axis 2, device-local on the real side); user layouts go through edge
     # reshards below rather than chain re-axing.
-    lp = logic_plan3d(shape, mesh, opts, forward=forward)
+    lp = logic_plan3d(shape, mesh, opts, forward=forward, batch=batch)
     world, cworld = world_box(shape), world_box(cshape)
+    bo = 0 if batch is None else 1
 
     if lp.decomposition == "single":
         ex = get_executor(opts.executor)
         r2c, c2r = get_r2c(opts.executor), get_c2r(opts.executor)
         if forward:
-            fn = jax.jit(lambda x: ex(r2c(x, 2), (0, 1), True))
+            fn = jax.jit(lambda x: ex(r2c(x, 2 + bo), (bo, 1 + bo), True))
         else:
-            fn = jax.jit(lambda y: c2r(ex(y, (0, 1), False), n2, 2))
+            fn = jax.jit(
+                lambda y: c2r(ex(y, (bo, 1 + bo), False), n2, 2 + bo))
         spec = None
     elif lp.decomposition == "slab":
         fn, spec = build_slab_rfft3d(
             lp.mesh, shape, axis_name=lp.mesh.axis_names[0],
             executor=opts.executor, forward=forward, donate=opts.donate,
             algorithm=opts.algorithm,
-            overlap_chunks=lp.options.overlap_chunks,
+            overlap_chunks=lp.options.overlap_chunks, batch=batch,
         )
     else:
         row, col = lp.mesh.axis_names[:2]
@@ -878,12 +932,12 @@ def plan_dft_r2c_3d(
             lp.mesh, shape, row_axis=row, col_axis=col,
             executor=opts.executor, forward=forward, donate=opts.donate,
             algorithm=opts.algorithm,
-            overlap_chunks=lp.options.overlap_chunks,
+            overlap_chunks=lp.options.overlap_chunks, batch=batch,
         )
 
     if (in_spec is not None or out_spec is not None) and lp.mesh is None:
         raise ValueError("in_spec/out_spec require a mesh")
-    in_sh, out_sh = _shardings(lp, spec)
+    in_sh, out_sh = _shardings(lp, spec, batch)
     in_world = world if forward else cworld
     out_world = cworld if forward else world
     in_boxes, out_boxes = _boxes(lp, in_world, out_world)
@@ -896,16 +950,17 @@ def plan_dft_r2c_3d(
             in_boxes = _layout_boxes(lp.mesh, in_spec, in_world)
         if out_spec is not None:
             out_boxes = _layout_boxes(lp.mesh, out_spec, out_world)
+    bpfx = () if batch is None else (batch,)
     return Plan3D(
         shape=shape, direction=direction, dtype=dtype,
         decomposition=lp.decomposition, executor=opts.executor, mesh=lp.mesh,
         fn=fn, spec=spec, in_sharding=in_sh, out_sharding=out_sh,
         in_boxes=in_boxes, out_boxes=out_boxes,
-        in_shape=shape if forward else cshape,
-        out_shape=cshape if forward else shape,
+        in_shape=bpfx + (shape if forward else cshape),
+        out_shape=bpfx + (cshape if forward else shape),
         in_dtype=rdtype if forward else dtype,
         out_dtype=dtype if forward else rdtype,
-        real=True, options=lp.options, logic=lp,
+        real=True, batch=batch, options=lp.options, logic=lp,
     )
 
 
@@ -1019,6 +1074,8 @@ class DDPlan3D:
     fn: Callable
     in_sharding: NamedSharding | None
     out_sharding: NamedSharding | None
+    # Leading batch axis (both dd components carry it); None = unbatched.
+    batch: int | None = None
 
     @property
     def forward(self) -> bool:
@@ -1053,6 +1110,7 @@ def plan_dd_dft_c2c_3d(
     direction: int = FORWARD,
     donate: bool = False,
     overlap_chunks: int | str | None = None,
+    batch: int | None = None,
 ) -> DDPlan3D:
     """Create a 3D C2C FFT plan at the emulated double-precision tier.
 
@@ -1063,36 +1121,44 @@ def plan_dd_dft_c2c_3d(
     f64 ``fft_mpi_plan_dft_c2c_3d`` on hardware without f64 (measured
     ~1e-13 forward / <1e-11 roundtrip). ``overlap_chunks`` pipelines
     each exchange under the downstream dd FFT exactly like the c64 tier
-    (int K, ``"auto"``, or None -> ``DFFT_OVERLAP``)."""
+    (int K, ``"auto"``, or None -> ``DFFT_OVERLAP``). ``batch=B``
+    coalesces B transforms into one device program with one shared pair
+    of collectives per exchange (the :func:`plan_dft_c2c_3d` convention;
+    both dd components carry the leading batch axis)."""
     from .ops import ddfft
+    from .parallel.slab import batch_pspec as _bp
 
     shape, forward = _check_direction(shape, direction)
+    batch = _norm_batch(batch)
+    bo = 0 if batch is None else 1
     dn = (0, 1) if donate else ()
     if mesh is None:
         fn = jax.jit(
-            functools.partial(ddfft.fftn_dd, axes=(0, 1, 2),
+            functools.partial(ddfft.fftn_dd, axes=(bo, 1 + bo, 2 + bo),
                               forward=forward), donate_argnums=dn)
         return DDPlan3D(shape=shape, direction=direction,
                         decomposition="single", mesh=None, fn=fn,
-                        in_sharding=None, out_sharding=None)
+                        in_sharding=None, out_sharding=None, batch=batch)
     if isinstance(mesh, int):
         from .parallel.mesh import make_mesh
 
         mesh = make_mesh(mesh)
     overlap = resolve_overlap_chunks(
-        overlap_chunks, shape=shape, ndev=math.prod(mesh.devices.shape))
+        overlap_chunks, shape=shape, ndev=math.prod(mesh.devices.shape),
+        itemsize=8 * (batch or 1))
     if len(mesh.axis_names) == 1:
         from .parallel.ddslab import build_dd_slab_fft3d
 
         fn, spec = build_dd_slab_fft3d(mesh, shape, forward=forward,
                                        axis_name=mesh.axis_names[0],
                                        donate=donate,
-                                       overlap_chunks=overlap)
+                                       overlap_chunks=overlap, batch=batch)
         return DDPlan3D(
             shape=shape, direction=direction, decomposition="slab",
             mesh=mesh, fn=fn,
-            in_sharding=NamedSharding(mesh, spec.in_pspec),
-            out_sharding=NamedSharding(mesh, spec.out_pspec),
+            in_sharding=NamedSharding(mesh, _bp(spec.in_pspec, batch)),
+            out_sharding=NamedSharding(mesh, _bp(spec.out_pspec, batch)),
+            batch=batch,
         )
     if len(mesh.axis_names) == 2:
         from .parallel.ddslab import build_dd_pencil_fft3d
@@ -1100,12 +1166,13 @@ def plan_dd_dft_c2c_3d(
         row, col = mesh.axis_names[:2]
         fn, spec = build_dd_pencil_fft3d(
             mesh, shape, row_axis=row, col_axis=col, forward=forward,
-            donate=donate, overlap_chunks=overlap)
+            donate=donate, overlap_chunks=overlap, batch=batch)
         return DDPlan3D(
             shape=shape, direction=direction, decomposition="pencil",
             mesh=mesh, fn=fn,
-            in_sharding=NamedSharding(mesh, spec.in_spec),
-            out_sharding=NamedSharding(mesh, spec.out_spec),
+            in_sharding=NamedSharding(mesh, _bp(spec.in_spec, batch)),
+            out_sharding=NamedSharding(mesh, _bp(spec.out_spec, batch)),
+            batch=batch,
         )
     raise ValueError("dd plans support single-device, 1D, or 2D meshes")
 
@@ -1431,6 +1498,10 @@ def _plan_exchange_bytes(plan: Plan3D) -> tuple[int, int]:
 
         shape_eff = plan.out_shape if (plan.real and plan.forward) else (
             plan.in_shape if plan.real else plan.shape)
+        if plan.batch is not None and len(shape_eff) == 4:
+            # exchange_payloads takes the per-transform 3D shape; the
+            # B-fold scaling rides on lp.batch inside it.
+            shape_eff = shape_eff[1:]
         itemsize = np.dtype(plan.dtype).itemsize
         wire_key = WIRE_BYTE_KEYS[plan.options.algorithm]
         for e in exchange_payloads(lp, shape_eff, itemsize):

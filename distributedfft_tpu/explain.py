@@ -130,6 +130,10 @@ def _model_shape_itemsize(plan) -> tuple[tuple[int, int, int], int]:
     counters use (``api._plan_exchange_bytes``)."""
     shape = plan.out_shape if (plan.real and plan.forward) else (
         plan.in_shape if plan.real else plan.shape)
+    if getattr(plan, "batch", None) is not None and len(shape) == 4:
+        # The model takes the per-transform 3D shape; the B-fold scaling
+        # rides on the plan's LogicPlan.batch inside model_stage_seconds.
+        shape = shape[1:]
     return tuple(shape), int(np.dtype(plan.dtype).itemsize)
 
 
@@ -292,7 +296,8 @@ def _staged_for(plan):
     lp = plan.logic
     oc = plan.options.overlap_chunks
     overlap = oc if isinstance(oc, int) else 1
-    kw = dict(executor=plan.executor, forward=plan.forward)
+    kw = dict(executor=plan.executor, forward=plan.forward,
+              batch=getattr(plan, "batch", None))
     try:
         if lp.decomposition == "single" or plan.mesh is None:
             if plan.real:

@@ -40,7 +40,7 @@ from .exchange import (
     _crop_axis, _pad_axis, exchange_chunked, exchange_overlapped,
 )
 from .pencil import PencilSpec, chain_geometry
-from .slab import SlabSpec
+from .slab import SlabSpec, batch_pspec, check_batch
 
 
 def _check_dd_extent(n: int, shape) -> None:
@@ -65,6 +65,7 @@ def build_dd_slab_fft3d(
     algorithm: str = "alltoall",
     donate: bool = False,
     overlap_chunks: int = 1,
+    batch: int | None = None,
 ) -> tuple[Callable, SlabSpec]:
     """Jitted distributed dd 3D C2C transform over a 1D mesh.
 
@@ -73,10 +74,15 @@ def build_dd_slab_fft3d(
     sharded along axis 0 forward (axis 1 backward) exactly like the c64
     slab plan. Forward is unnormalized; backward applies the numpy 1/n
     per axis (inside the dd engine, exact power-of-two post-scales).
+    ``batch=B`` prepends a leading batch axis to BOTH dd components with
+    one shared pair of collectives per batch — the
+    :func:`..slab.build_slab_general` convention at the accuracy tier.
     """
     shape = tuple(int(s) for s in shape)
     for n in shape:
         _check_dd_extent(n, shape)
+    check_batch(batch)
+    bo = 0 if batch is None else 1  # leading-batch axis offset
     p = mesh.shape[axis_name]
     in_axis, out_axis = (0, 1) if forward else (1, 0)
     spec = SlabSpec(shape, p, axis_name, in_axis, out_axis)
@@ -84,31 +90,34 @@ def build_dd_slab_fft3d(
     n_inp = pad_to(n_in, p)
     local_axes = tuple(a for a in range(3) if a != in_axis)
     platform = mesh.devices.flat[0].platform
+    ax_in, ax_out = in_axis + bo, out_axis + bo
 
     def t3_chunk(pair):
         hi, lo = pair
-        hi = _crop_axis(hi, in_axis, n_in)
-        lo = _crop_axis(lo, in_axis, n_in)
+        hi = _crop_axis(hi, ax_in, n_in)
+        lo = _crop_axis(lo, ax_in, n_in)
         # t3: dd transform of the now-local lines.
-        return ddfft.fft_axis_dd(hi, lo, in_axis, forward=forward)
+        return ddfft.fft_axis_dd(hi, lo, ax_in, forward=forward)
 
     def local_fn(hi, lo):
         # t0: dd transforms of the device-local planes.
         with add_trace("t0_dd_fft_planes"):
             for ax in local_axes:
-                hi, lo = ddfft.fft_axis_dd(hi, lo, ax, forward=forward)
+                hi, lo = ddfft.fft_axis_dd(hi, lo, ax + bo, forward=forward)
         # t1+t2: both dd components ride the same global transpose the
         # c64 pipeline uses (XLA schedules the two collectives back to
         # back on the ICI); overlap_chunks > 1 pipelines each chunk's
         # pair of collectives under the previous chunk's t3.
         return exchange_overlapped(
-            (hi, lo), axis_name, split_axis=out_axis, concat_axis=in_axis,
+            (hi, lo), axis_name, split_axis=ax_out, concat_axis=ax_in,
             axis_size=p, algorithm=algorithm, platform=platform,
             compute=t3_chunk, overlap_chunks=overlap_chunks,
+            chunk_axis=3 - in_axis - out_axis + bo,
             exchange_name=f"t2_exchange_{axis_name}",
             compute_name="t3_dd_fft_lines")
 
-    in_spec, out_spec = spec.in_pspec, spec.out_pspec
+    in_spec = batch_pspec(spec.in_pspec, batch)
+    out_spec = batch_pspec(spec.out_pspec, batch)
     mapped = _shard_map(local_fn, mesh=mesh,
                         in_specs=(in_spec, in_spec),
                         out_specs=(out_spec, out_spec))
@@ -117,13 +126,13 @@ def build_dd_slab_fft3d(
     @functools.partial(
         jax.jit, donate_argnums=(0, 1) if donate else ())
     def fn(hi, lo):
-        hi = _pad_axis(hi, in_axis, n_inp)
-        lo = _pad_axis(lo, in_axis, n_inp)
+        hi = _pad_axis(hi, ax_in, n_inp)
+        lo = _pad_axis(lo, ax_in, n_inp)
         hi = lax.with_sharding_constraint(hi, in_sh)
         lo = lax.with_sharding_constraint(lo, in_sh)
         hi, lo = mapped(hi, lo)
-        return (_crop_axis(hi, out_axis, n_out),
-                _crop_axis(lo, out_axis, n_out))
+        return (_crop_axis(hi, ax_out, n_out),
+                _crop_axis(lo, ax_out, n_out))
 
     return fn, spec
 
@@ -351,14 +360,19 @@ def build_dd_pencil_fft3d(
     algorithm: str = "alltoall",
     donate: bool = False,
     overlap_chunks: int = 1,
+    batch: int | None = None,
 ) -> tuple[Callable, PencilSpec]:
     """Jitted distributed dd 3D C2C transform over a 2D (rows x cols)
     mesh — the canonical pencil chain (z-pencils -> x-pencils forward;
     see :mod:`.pencil`) with every stage at the dd tier and both dd
-    components through each exchange."""
+    components through each exchange. ``batch=B`` prepends a leading
+    batch axis to both dd components with one shared pair of collectives
+    per (chunk, exchange)."""
     shape = tuple(int(s) for s in shape)
     for n in shape:
         _check_dd_extent(n, shape)
+    check_batch(batch)
+    bo = 0 if batch is None else 1  # leading-batch axis offset
     perm = (0, 1, 2) if forward else (1, 2, 0)
     order = "col_first" if forward else "row_first"
     rows, cols = mesh.shape[row_axis], mesh.shape[col_axis]
@@ -373,26 +387,29 @@ def build_dd_pencil_fft3d(
 
     def local_fn(hi, lo):
         with add_trace(fft_names[0]):
-            hi, lo = ddfft.fft_axis_dd(hi, lo, seq[0][2], forward=forward)
+            hi, lo = ddfft.fft_axis_dd(hi, lo, seq[0][2] + bo,
+                                       forward=forward)
         pair = (hi, lo)
         for i, (mesh_ax, parts, split, concat) in enumerate(seq):
             # Like the c64 pencil chain: each exchange pipelines under
             # the dd FFT of its own concat axis (the next chain stage).
             def post_fft(p_, concat=concat):
                 h, l = p_
-                h = _crop_axis(h, concat, n[concat])
-                l = _crop_axis(l, concat, n[concat])
-                return ddfft.fft_axis_dd(h, l, concat, forward=forward)
+                h = _crop_axis(h, concat + bo, n[concat])
+                l = _crop_axis(l, concat + bo, n[concat])
+                return ddfft.fft_axis_dd(h, l, concat + bo, forward=forward)
 
             pair = exchange_overlapped(
-                pair, mesh_ax, split_axis=split, concat_axis=concat,
+                pair, mesh_ax, split_axis=split + bo, concat_axis=concat + bo,
                 axis_size=parts, algorithm=algorithm, platform=platform,
                 compute=post_fft, overlap_chunks=overlap_chunks,
+                chunk_axis=3 - split - concat + bo,
                 exchange_name=exch_names[i],
                 compute_name=fft_names[1] if i == 0 else "t3_dd_fft")
         return pair
 
-    in_spec, out_spec = spec.in_spec, spec.out_spec
+    in_spec = batch_pspec(spec.in_spec, batch)
+    out_spec = batch_pspec(spec.out_spec, batch)
     mapped = _shard_map(local_fn, mesh=mesh,
                         in_specs=(in_spec, in_spec),
                         out_specs=(out_spec, out_spec))
@@ -402,14 +419,14 @@ def build_dd_pencil_fft3d(
         jax.jit, donate_argnums=(0, 1) if donate else ())
     def fn(hi, lo):
         for ax, to in in_pads:
-            hi = _pad_axis(hi, ax, to)
-            lo = _pad_axis(lo, ax, to)
+            hi = _pad_axis(hi, ax + bo, to)
+            lo = _pad_axis(lo, ax + bo, to)
         hi = lax.with_sharding_constraint(hi, in_sh)
         lo = lax.with_sharding_constraint(lo, in_sh)
         hi, lo = mapped(hi, lo)
         for ax, to in out_crops:
-            hi = _crop_axis(hi, ax, to)
-            lo = _crop_axis(lo, ax, to)
+            hi = _crop_axis(hi, ax + bo, to)
+            lo = _crop_axis(lo, ax + bo, to)
         return hi, lo
 
     return fn, spec
@@ -524,6 +541,7 @@ def build_dd_pencil_stages(
     col_axis: str = "col",
     algorithm: str = "alltoall",
     overlap_chunks: int = 1,
+    batch: int | None = None,
 ):
     """Forward dd pencil transform as the five timed t0/t2a/t1/t2b/t3
     stages: the c64 pencil stage pipeline (``staged.build_pencil_stages``
@@ -544,4 +562,4 @@ def build_dd_pencil_stages(
     return build_pencil_stages(mesh, shape, row_axis=row_axis,
                                col_axis=col_axis, executor=dd_ex,
                                algorithm=algorithm,
-                               overlap_chunks=overlap_chunks)
+                               overlap_chunks=overlap_chunks, batch=batch)

@@ -84,6 +84,7 @@ def exchange_model_seconds(
     launch_seconds: float,
     overlap_chunks: int = 1,
     hide_seconds: float = 0.0,
+    batch: int = 1,
 ) -> dict:
     """Analytical time model of ONE exchange under one transport — the
     single source of truth shared by the tuner's candidate-pruning cost
@@ -97,9 +98,17 @@ def exchange_model_seconds(
     ``t/K + max(0, t - hide) * (K-1)/K`` plus the K-1 extra launches each
     additional chunk costs (the crossover model behind
     ``auto_overlap_chunks``; docs/MFU_ANALYSIS.md "Exchange/compute
-    overlap")."""
+    overlap").
+
+    ``batch`` scales the wire transfer for a batched chain: B coalesced
+    transforms ride ONE collective as a bystander dim, so the payload
+    grows B-fold while the ``transport_steps`` launch latencies are paid
+    once — the whole point of batching the exchange. Callers passing
+    bytes already scaled by B (``exchange_payloads`` of a batched
+    LogicPlan) keep the default 1."""
     steps = transport_steps(algorithm, parts)
-    t_ex = wire_bytes_per_dev / (wire_gbps * 1e9) + steps * launch_seconds
+    t_ex = (max(1, int(batch)) * wire_bytes_per_dev / (wire_gbps * 1e9)
+            + steps * launch_seconds)
     k = max(1, int(overlap_chunks))
     exposed = (t_ex / k
                + max(0.0, t_ex - hide_seconds) * (k - 1) / k

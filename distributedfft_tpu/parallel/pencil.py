@@ -40,7 +40,7 @@ from ..geometry import pad_to
 from ..ops.executors import get_c2r, get_executor, get_r2c
 from ..utils.trace import add_trace
 from .exchange import exchange_overlapped
-from .slab import _L, _crop_axis, _pad_axis
+from .slab import _L, _crop_axis, _pad_axis, batch_pspec, check_batch
 
 
 @dataclass(frozen=True)
@@ -140,6 +140,7 @@ def build_pencil_general(
     donate: bool = False,
     algorithm: str = "alltoall",
     overlap_chunks: int = 1,
+    batch: int | None = None,
 ) -> tuple[Callable, PencilSpec]:
     """Build the jitted end-to-end pencil transform for ANY input layout
     permutation and exchange order (see :class:`PencilSpec` for the chain
@@ -150,11 +151,17 @@ def build_pencil_general(
     ``overlap_chunks > 1`` pipelines each exchange under the FFT stage
     that follows it, chunked along that exchange's bystander axis
     (:func:`.exchange.exchange_overlapped`); both t2a and t2b overlap.
+
+    ``batch=B`` prepends a leading batch axis (``[B, N0, N1, N2]`` of B
+    independent transforms): batched FFT stages and ONE shared collective
+    per (chunk, exchange) with the batch riding as a bystander dim —
+    exactly the :func:`..slab.build_slab_general` convention.
     """
     if sorted(perm) != [0, 1, 2]:
         raise ValueError(f"perm must be a permutation of (0, 1, 2), got {perm}")
     if order not in ("col_first", "row_first"):
         raise ValueError(f"order must be col_first|row_first, got {order!r}")
+    check_batch(batch)
     rows, cols = mesh.shape[row_axis], mesh.shape[col_axis]
     spec = PencilSpec(tuple(int(s) for s in shape), rows, cols,
                       row_axis, col_axis, tuple(perm), order)
@@ -162,6 +169,7 @@ def build_pencil_general(
     n = spec.shape
     seq, last_fft, in_pads, out_crops = chain_geometry(
         perm, order, rows, cols, row_axis, col_axis, n)
+    bo = 0 if batch is None else 1  # leading-batch axis offset
 
     # Stage spans: the reference taxonomy with the two pencil exchanges
     # split out as t2a/t2b (the staged-pipeline naming of .staged).
@@ -171,33 +179,35 @@ def build_pencil_general(
 
     def local_fn(x):
         with add_trace(fft_names[0]):
-            x = ex(x, (seq[0][2],), forward)             # t0: first fft
+            x = ex(x, (seq[0][2] + bo,), forward)        # t0: first fft
         for i, (mesh_ax, parts, split, concat) in enumerate(seq):
             # The FFT following each exchange runs along that exchange's
             # concat axis (the axis that just became local), so each
             # exchange pipelines under its own downstream fft stage.
             def post_fft(v, concat=concat):
-                v = _crop_axis(v, concat, n[concat])
-                return ex(v, (concat,), forward)
+                v = _crop_axis(v, concat + bo, n[concat])
+                return ex(v, (concat + bo,), forward)
 
             x = exchange_overlapped(
-                x, mesh_ax, split_axis=split, concat_axis=concat,
+                x, mesh_ax, split_axis=split + bo, concat_axis=concat + bo,
                 axis_size=parts, algorithm=algorithm, compute=post_fft,
                 overlap_chunks=overlap_chunks,
+                chunk_axis=3 - split - concat + bo,
                 exchange_name=exch_names[i],
                 compute_name=fft_names[1] if i == 0 else t3_name)
         return x
 
-    in_spec, out_spec = spec.in_spec, spec.out_spec
+    in_spec = batch_pspec(spec.in_spec, batch)
+    out_spec = batch_pspec(spec.out_spec, batch)
 
     def pre(x):
         for ax, to in in_pads:
-            x = _pad_axis(x, ax, to)
+            x = _pad_axis(x, ax + bo, to)
         return x
 
     def post(y):
         for ax, to in out_crops:
-            y = _crop_axis(y, ax, to)
+            y = _crop_axis(y, ax + bo, to)
         return y
 
     mapped = _shard_map(local_fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec)
@@ -234,6 +244,7 @@ def build_pencil_fft3d(
     perm: tuple[int, int, int] | None = None,
     order: str | None = None,
     overlap_chunks: int = 1,
+    batch: int | None = None,
 ) -> tuple[Callable, PencilSpec]:
     """Canonical-orientation wrapper over :func:`build_pencil_general`:
     forward maps z-pencils (``P(row, col, None)``) to x-pencils
@@ -247,7 +258,7 @@ def build_pencil_fft3d(
     return build_pencil_general(
         mesh, shape, perm=perm, order=order, row_axis=row_axis,
         col_axis=col_axis, executor=executor, forward=forward, donate=donate,
-        algorithm=algorithm, overlap_chunks=overlap_chunks,
+        algorithm=algorithm, overlap_chunks=overlap_chunks, batch=batch,
     )
 
 
@@ -262,6 +273,7 @@ def build_pencil_rfft3d(
     donate: bool = False,
     algorithm: str = "alltoall",
     overlap_chunks: int = 1,
+    batch: int | None = None,
 ) -> tuple[Callable, PencilSpec]:
     """Pencil-decomposed r2c (forward) / c2r (backward) 3D transform.
 
@@ -270,9 +282,12 @@ def build_pencil_rfft3d(
     heFFTe's rule that the r2c reduction runs on the first pencil stage
     (``src/heffte_fft3d.cpp:202-304``). Forward maps real z-pencils
     ``[N0, N1, N2]`` to complex x-pencils ``[N0, N1, N2//2+1]``.
+    ``batch=B`` prepends a leading batch axis with one shared exchange per
+    batch, the :func:`build_pencil_general` convention.
     """
     if not isinstance(executor, str):
         raise TypeError("r2c builders take a registered executor name")
+    check_batch(batch)
     rows, cols = mesh.shape[row_axis], mesh.shape[col_axis]
     # Direction-true spec: the canonical r2c chain is perm (0,1,2) col_first
     # forward (z->x pencils) and perm (1,2,0) row_first backward — the same
@@ -289,38 +304,40 @@ def build_pencil_rfft3d(
     n0p, n1pc, n1pr = spec.n0p, spec.n1p_col, spec.n1p_row
     n2h = n2 // 2 + 1
     n2hp = pad_to(n2h, cols)
+    bo = 0 if batch is None else 1  # leading-batch axis offset
+    in_spec = batch_pspec(spec.in_spec, batch)
+    out_spec = batch_pspec(spec.out_spec, batch)
 
     if forward:
 
         def fft_y(v):
-            return ex(_crop_axis(v, 1, n1), (1,), True)   # Y lines
+            return ex(_crop_axis(v, 1 + bo, n1), (1 + bo,), True)  # Y lines
 
         def fft_x(v):
-            return ex(_crop_axis(v, 0, n0), (0,), True)   # t3: X lines
+            return ex(_crop_axis(v, bo, n0), (bo,), True)  # t3: X lines
 
         def local_fn(x):  # real [n0p/rows, n1pc/cols, N2]
             with add_trace("t0_r2c_z"):
-                y = r2c(x, 2)                           # t0: real Z lines
+                y = r2c(x, 2 + bo)                      # t0: real Z lines
             y = exchange_overlapped(
-                y, col_axis, split_axis=2, concat_axis=1, axis_size=cols,
-                algorithm=algorithm, compute=fft_y,
-                overlap_chunks=overlap_chunks,
+                y, col_axis, split_axis=2 + bo, concat_axis=1 + bo,
+                axis_size=cols, algorithm=algorithm, compute=fft_y,
+                overlap_chunks=overlap_chunks, chunk_axis=bo,
                 exchange_name=f"t2a_exchange_{col_axis}",
                 compute_name="t1_fft_y")
             return exchange_overlapped(
-                y, row_axis, split_axis=1, concat_axis=0, axis_size=rows,
-                algorithm=algorithm, compute=fft_x,
-                overlap_chunks=overlap_chunks,
+                y, row_axis, split_axis=1 + bo, concat_axis=bo,
+                axis_size=rows, algorithm=algorithm, compute=fft_x,
+                overlap_chunks=overlap_chunks, chunk_axis=2 + bo,
                 exchange_name=f"t2b_exchange_{row_axis}",
                 compute_name="t3_fft_x")
 
-        in_spec, out_spec = spec.in_spec, spec.out_spec
-        pre = lambda x: _pad_axis(_pad_axis(x, 0, n0p), 1, n1pc)
-        post = lambda y: _crop_axis(_crop_axis(y, 1, n1), 2, n2h)
+        pre = lambda x: _pad_axis(_pad_axis(x, bo, n0p), 1 + bo, n1pc)
+        post = lambda y: _crop_axis(_crop_axis(y, 1 + bo, n1), 2 + bo, n2h)
     else:
 
         def ifft_y(v):
-            return ex(_crop_axis(v, 1, n1), (1,), False)  # inverse Y lines
+            return ex(_crop_axis(v, 1 + bo, n1), (1 + bo,), False)
 
         def crop_h(v):
             # Per-chunk work after the last exchange is the crop only:
@@ -328,31 +345,30 @@ def build_pencil_rfft3d(
             # RET_CHECK (irfft on a sliced, non-dim0-major operand), so
             # the real Z transform runs monolithically after the merge —
             # the same structure as the slab c2r chain.
-            return _crop_axis(v, 2, n2h)
+            return _crop_axis(v, 2 + bo, n2h)
 
         def local_fn(y):  # complex [N0, n1pr/rows, n2hp/cols]
             with add_trace("t3_ifft_x"):
-                x = ex(y, (0,), False)                  # inverse X lines
+                x = ex(y, (bo,), False)                 # inverse X lines
             x = exchange_overlapped(
-                x, row_axis, split_axis=0, concat_axis=1, axis_size=rows,
-                algorithm=algorithm, compute=ifft_y,
-                overlap_chunks=overlap_chunks,
+                x, row_axis, split_axis=bo, concat_axis=1 + bo,
+                axis_size=rows, algorithm=algorithm, compute=ifft_y,
+                overlap_chunks=overlap_chunks, chunk_axis=2 + bo,
                 exchange_name=f"t2b_exchange_{row_axis}",
                 compute_name="t1_ifft_y")
             x = exchange_overlapped(
-                x, col_axis, split_axis=1, concat_axis=2, axis_size=cols,
-                algorithm=algorithm, compute=crop_h,
-                overlap_chunks=overlap_chunks,
+                x, col_axis, split_axis=1 + bo, concat_axis=2 + bo,
+                axis_size=cols, algorithm=algorithm, compute=crop_h,
+                overlap_chunks=overlap_chunks, chunk_axis=bo,
                 exchange_name=f"t2a_exchange_{col_axis}",
                 compute_name="t1_crop")
             with add_trace("t0_c2r_z"):
-                return c2r(x, n2, 2)                    # real Z lines
+                return c2r(x, n2, 2 + bo)               # real Z lines
 
         # Direction-true spec: perm (1,2,0) row_first makes spec.in_spec the
         # complex x-pencils and spec.out_spec the real z-pencils.
-        in_spec, out_spec = spec.in_spec, spec.out_spec
-        pre = lambda y: _pad_axis(_pad_axis(y, 1, n1pr), 2, n2hp)
-        post = lambda x: _crop_axis(_crop_axis(x, 0, n0), 1, n1)
+        pre = lambda y: _pad_axis(_pad_axis(y, 1 + bo, n1pr), 2 + bo, n2hp)
+        post = lambda x: _crop_axis(_crop_axis(x, bo, n0), 1 + bo, n1)
 
     mapped = _shard_map(local_fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec)
     in_sh = NamedSharding(mesh, in_spec)

@@ -57,6 +57,28 @@ from .exchange import (
 _L = "xyz"  # axis index -> stage-name letter (t0_fft_yz taxonomy)
 
 
+def check_batch(batch: int | None) -> int | None:
+    """Validate a chain-builder ``batch`` argument: ``None`` is the
+    unbatched 3D chain (today's HLO exactly); an int >= 1 prepends a
+    leading batch axis of that extent carrying B independent transforms
+    through ONE shared exchange per t2 stage (the batch rides every
+    collective as a bystander dim — B transforms pay one collective
+    latency)."""
+    if batch is None:
+        return None
+    if not isinstance(batch, int) or isinstance(batch, bool) or batch < 1:
+        raise ValueError(f"batch must be an int >= 1 or None, got {batch!r}")
+    return batch
+
+
+def batch_pspec(pspec: P, batch: int | None) -> P:
+    """The 3D chain PartitionSpec with a leading replicated batch entry
+    prepended when the chain is batched; the spec itself (same object)
+    otherwise — shared by every chain builder and the plan layer so
+    batched shardings can never drift between them."""
+    return pspec if batch is None else P(*((None,) + tuple(pspec)))
+
+
 @dataclass(frozen=True)
 class SlabSpec:
     """Static geometry of a slab plan: true and padded extents.
@@ -125,6 +147,7 @@ def build_slab_general(
     donate: bool = False,
     algorithm: str = "alltoall",
     overlap_chunks: int = 1,
+    batch: int | None = None,
 ) -> tuple[Callable, SlabSpec]:
     """Build the jitted end-to-end slab transform for ANY ordered axis pair.
 
@@ -138,9 +161,17 @@ def build_slab_general(
 
     ``overlap_chunks > 1`` pipelines t2 under t3 along the bystander axis
     (:func:`.exchange.exchange_overlapped`); 1 is today's monolithic chain.
+
+    ``batch=B`` prepends a leading batch axis: the input is ``[B, N0, N1,
+    N2]`` carrying B independent transforms, t0/t3 run as batched FFTs,
+    and the t2 global transpose is ONE shared collective per (chunk,
+    exchange) with the batch riding as a bystander dim — B transforms pay
+    one collective latency. ``None`` is the unbatched 3D chain, today's
+    HLO exactly.
     """
     if in_axis == out_axis or not (0 <= in_axis < 3 and 0 <= out_axis < 3):
         raise ValueError(f"need distinct 3D axes, got {in_axis}, {out_axis}")
+    check_batch(batch)
     p = mesh.shape[axis_name]
     spec = SlabSpec(tuple(int(s) for s in shape), p, axis_name,
                     in_axis, out_axis)
@@ -149,6 +180,11 @@ def build_slab_general(
     n_inp, n_outp = spec.in_padded_extent, spec.out_padded_extent
     local_axes = tuple(a for a in range(3) if a != in_axis)
     platform = mesh.devices.flat[0].platform
+    # Leading-batch offset: spatial axis a of the 3D chain is array axis
+    # a + bo. Stage names, SlabSpec, and all geometry stay spatial.
+    bo = 0 if batch is None else 1
+    ax_in, ax_out = in_axis + bo, out_axis + bo
+    chunk_axis = 3 - in_axis - out_axis + bo  # spatial bystander
 
     # Stage spans of the reference taxonomy (fft_mpi_3d_api.cpp:184-201):
     # recorded dispatch-side when the jit first traces, and passed through
@@ -158,27 +194,29 @@ def build_slab_general(
     t3_name = f"t3_fft_{_L[in_axis]}"
 
     def t3_chunk(y):
-        y = _crop_axis(y, in_axis, n_in)                 # drop in-axis padding
-        return ex(y, (in_axis,), forward)                # t3: final lines
+        y = _crop_axis(y, ax_in, n_in)                   # drop in-axis padding
+        return ex(y, (ax_in,), forward)                  # t3: final lines
 
     def local_fn(x):  # in_axis extent n_inp/p per device, others full
         with add_trace(t0_name):
-            y = ex(x, local_axes, forward)               # t0: local planes
+            y = ex(x, tuple(a + bo for a in local_axes), forward)  # t0
         with add_trace("t1_pack"):
             # exchange prep: dense algorithms ceil-pad the split axis
             # (alltoallv ships the true slices; the pad below is then a
             # no-op inside exchange_uneven, which skips it)
             if algorithm != "alltoallv":
-                y = _pad_axis(y, out_axis, n_outp)
+                y = _pad_axis(y, ax_out, n_outp)
         # t2 + t3: monolithic exchange-then-fft at overlap_chunks=1, the
         # chunked pipelined interleave above it.
         return exchange_overlapped(
-            y, axis_name, split_axis=out_axis, concat_axis=in_axis,
+            y, axis_name, split_axis=ax_out, concat_axis=ax_in,
             axis_size=p, algorithm=algorithm, platform=platform,
             compute=t3_chunk, overlap_chunks=overlap_chunks,
+            chunk_axis=chunk_axis,
             exchange_name=t2_name, compute_name=t3_name)
 
-    in_spec, out_spec = spec.in_pspec, spec.out_pspec
+    in_spec = batch_pspec(spec.in_pspec, batch)
+    out_spec = batch_pspec(spec.out_pspec, batch)
     mapped = _shard_map(local_fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec)
 
     in_sh = NamedSharding(mesh, in_spec)
@@ -192,10 +230,10 @@ def build_slab_general(
 
     @functools.partial(jax.jit, **jit_kw)
     def fn(x):
-        x = _pad_axis(x, in_axis, n_inp)
+        x = _pad_axis(x, ax_in, n_inp)
         x = lax.with_sharding_constraint(x, in_sh)
         y = mapped(x)
-        return _crop_axis(y, out_axis, n_out)
+        return _crop_axis(y, ax_out, n_out)
 
     return fn, spec
 
@@ -212,6 +250,7 @@ def build_slab_fft3d(
     in_axis: int | None = None,
     out_axis: int | None = None,
     overlap_chunks: int = 1,
+    batch: int | None = None,
 ) -> tuple[Callable, SlabSpec]:
     """Canonical-orientation wrapper over :func:`build_slab_general`:
     X-slabs -> Y-slabs forward, Y-slabs -> X-slabs backward (the reference
@@ -225,6 +264,7 @@ def build_slab_fft3d(
         out_axis=d_out if out_axis is None else out_axis,
         axis_name=axis_name, executor=executor, forward=forward,
         donate=donate, algorithm=algorithm, overlap_chunks=overlap_chunks,
+        batch=batch,
     )
 
 
@@ -238,6 +278,7 @@ def build_slab_rfft3d(
     donate: bool = False,
     algorithm: str = "alltoall",
     overlap_chunks: int = 1,
+    batch: int | None = None,
 ) -> tuple[Callable, SlabSpec]:
     """Slab-decomposed real-to-complex (forward) / complex-to-real (backward)
     3D transform — the distributed analog of heFFTe's ``fft3d_r2c``
@@ -248,9 +289,12 @@ def build_slab_rfft3d(
     ``heffte_geometry.h:94``) happens before any exchange. Forward maps real
     X-slabs ``[N0, N1, N2]`` to complex Y-slabs ``[N0, N1, N2//2+1]``;
     backward is the exact inverse (output real, numpy 1/N scaling).
+    ``batch=B`` prepends a leading batch axis with one shared exchange per
+    batch, exactly like :func:`build_slab_general`.
     """
     if not isinstance(executor, str):
         raise TypeError("r2c builders take a registered executor name")
+    check_batch(batch)
     p = mesh.shape[axis_name]
     # Direction-true spec (like build_slab_general): forward maps X-slabs to
     # Y-slabs, backward the mirror — so plan-level shardings read straight
@@ -263,55 +307,57 @@ def build_slab_rfft3d(
     r2c, c2r = get_r2c(executor), get_c2r(executor)
     n0, n1, n2 = spec.shape
     n0p, n1p = spec.n0p, spec.n1p
-    in_spec, out_spec = spec.in_pspec, spec.out_pspec
+    bo = 0 if batch is None else 1  # leading-batch axis offset
+    in_spec = batch_pspec(spec.in_pspec, batch)
+    out_spec = batch_pspec(spec.out_pspec, batch)
 
     if forward:
 
         def t3_chunk(y):
-            y = _crop_axis(y, 0, n0)
-            return ex(y, (0,), True)                     # t3: X lines
+            y = _crop_axis(y, bo, n0)
+            return ex(y, (bo,), True)                    # t3: X lines
 
         def local_fn(x):  # real [n0p/p, N1, N2] per device
             with add_trace("t0_r2c_zy"):
-                y = r2c(x, 2)                            # t0a: real Z lines
-                y = ex(y, (1,), True)                    # t0b: Y lines
+                y = r2c(x, 2 + bo)                       # t0a: real Z lines
+                y = ex(y, (1 + bo,), True)               # t0b: Y lines
             with add_trace("t1_pack"):
                 if algorithm != "alltoallv":
-                    y = _pad_axis(y, 1, n1p)
+                    y = _pad_axis(y, 1 + bo, n1p)
             return exchange_overlapped(
-                y, axis_name, split_axis=1, concat_axis=0, axis_size=p,
-                algorithm=algorithm, compute=t3_chunk,
-                overlap_chunks=overlap_chunks,
+                y, axis_name, split_axis=1 + bo, concat_axis=bo,
+                axis_size=p, algorithm=algorithm, compute=t3_chunk,
+                overlap_chunks=overlap_chunks, chunk_axis=2 + bo,
                 exchange_name=f"t2_exchange_{axis_name}",
                 compute_name="t3_fft_x")
 
-        pre = lambda x: _pad_axis(x, 0, n0p)
-        post = lambda y: _crop_axis(y, 1, n1)
+        pre = lambda x: _pad_axis(x, bo, n0p)
+        post = lambda y: _crop_axis(y, 1 + bo, n1)
     else:
 
         def t0_chunk(x):
-            x = _crop_axis(x, 1, n1)
-            return ex(x, (1,), False)                    # inverse Y lines
+            x = _crop_axis(x, 1 + bo, n1)
+            return ex(x, (1 + bo,), False)               # inverse Y lines
 
         def local_fn(y):  # complex [N0, n1p/p, n2h] per device
             with add_trace("t3_ifft_x"):
-                x = ex(y, (0,), False)                   # inverse X lines
+                x = ex(y, (bo,), False)                  # inverse X lines
             with add_trace("t1_pack"):
                 if algorithm != "alltoallv":
-                    x = _pad_axis(x, 0, n0p)
+                    x = _pad_axis(x, bo, n0p)
             # The c2r (real Z lines) transforms the bystander axis, so it
             # runs monolithically after the chunked exchange/ifft-Y merge.
             x = exchange_overlapped(
-                x, axis_name, split_axis=0, concat_axis=1, axis_size=p,
-                algorithm=algorithm, compute=t0_chunk,
-                overlap_chunks=overlap_chunks,
+                x, axis_name, split_axis=bo, concat_axis=1 + bo,
+                axis_size=p, algorithm=algorithm, compute=t0_chunk,
+                overlap_chunks=overlap_chunks, chunk_axis=2 + bo,
                 exchange_name=f"t2_exchange_{axis_name}",
                 compute_name="t0_ifft_y")
             with add_trace("t0_c2r_z"):
-                return c2r(x, n2, 2)                     # real Z lines
+                return c2r(x, n2, 2 + bo)                # real Z lines
 
-        pre = lambda y: _pad_axis(y, 1, n1p)
-        post = lambda x: _crop_axis(x, 0, n0)
+        pre = lambda y: _pad_axis(y, 1 + bo, n1p)
+        post = lambda x: _crop_axis(x, bo, n0)
 
     mapped = _shard_map(local_fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec)
     in_sh = NamedSharding(mesh, in_spec)
@@ -337,6 +383,7 @@ def build_slab_stages(
     forward: bool = True,
     algorithm: str = "alltoall",
     overlap_chunks: int = 1,
+    batch: int | None = None,
 ) -> tuple[list[tuple[str, Callable]], SlabSpec]:
     """The same transform split into separately-jitted t0..t3 stages for the
     per-stage timing breakdown the reference prints on every execute
@@ -344,54 +391,63 @@ def build_slab_stages(
     the ICI cost (SURVEY.md §7 "hard parts"), so benchmarking keeps this
     staged mode alongside the fused one. ``overlap_chunks > 1`` keeps the
     overlapped chains' K-collective transport shape inside the t2 stage
-    (:func:`.exchange.exchange_chunked`).
+    (:func:`.exchange.exchange_chunked`). ``batch=B`` runs the stages over
+    ``[B, ...]`` arrays with one shared exchange per chunk.
     """
+    check_batch(batch)
     p = mesh.shape[axis_name]
     spec = SlabSpec(tuple(int(s) for s in shape), p, axis_name)
     ex = get_executor(executor) if isinstance(executor, str) else executor
     n0, n1, n2 = spec.shape
     n0p, n1p = spec.n0p, spec.n1p
+    bo = 0 if batch is None else 1  # leading-batch axis offset
 
-    x_slab = NamedSharding(mesh, P(axis_name, None, None))
-    y_slab = NamedSharding(mesh, P(None, axis_name, None))
+    xs = batch_pspec(P(axis_name, None, None), batch)
+    ys = batch_pspec(P(None, axis_name, None), batch)
+    x_slab = NamedSharding(mesh, xs)
+    y_slab = NamedSharding(mesh, ys)
 
     def smap(f, ins, outs):
         return _shard_map(f, mesh=mesh, in_specs=(ins,), out_specs=outs)
 
-    xs, ys = P(axis_name, None, None), P(None, axis_name, None)
-
     if forward:
         stages = [
             ("t0_fft_yz", jax.jit(
-                lambda x: _pad_axis(smap(lambda v: ex(v, (1, 2), True), xs, xs)(
-                    _pad_axis(x, 0, n0p)), 1, n1p),
+                lambda x: _pad_axis(smap(
+                    lambda v: ex(v, (1 + bo, 2 + bo), True), xs, xs)(
+                    _pad_axis(x, bo, n0p)), 1 + bo, n1p),
                 in_shardings=x_slab, out_shardings=x_slab)),
             ("t2_all_to_all", jax.jit(
                 smap(lambda v: exchange_chunked(
-                    v, axis_name, split_axis=1, concat_axis=0, axis_size=p,
-                    algorithm=algorithm, overlap_chunks=overlap_chunks),
+                    v, axis_name, split_axis=1 + bo, concat_axis=bo,
+                    axis_size=p, algorithm=algorithm,
+                    overlap_chunks=overlap_chunks, chunk_axis=2 + bo),
                     xs, ys),
                 in_shardings=x_slab, out_shardings=y_slab)),
             ("t3_fft_x", jax.jit(
                 lambda v: _crop_axis(smap(
-                    lambda u: ex(_crop_axis(u, 0, n0), (0,), True), ys, ys)(v), 1, n1),
+                    lambda u: ex(_crop_axis(u, bo, n0), (bo,), True),
+                    ys, ys)(v), 1 + bo, n1),
                 in_shardings=y_slab, out_shardings=y_slab)),
         ]
     else:
         stages = [
             ("t3_ifft_x", jax.jit(
-                lambda v: _pad_axis(smap(lambda u: ex(u, (0,), False), ys, ys)(
-                    _pad_axis(v, 1, n1p)), 0, n0p),
+                lambda v: _pad_axis(smap(
+                    lambda u: ex(u, (bo,), False), ys, ys)(
+                    _pad_axis(v, 1 + bo, n1p)), bo, n0p),
                 in_shardings=y_slab, out_shardings=y_slab)),
             ("t2_all_to_all", jax.jit(
                 smap(lambda v: exchange_chunked(
-                    v, axis_name, split_axis=0, concat_axis=1, axis_size=p,
-                    algorithm=algorithm, overlap_chunks=overlap_chunks),
+                    v, axis_name, split_axis=bo, concat_axis=1 + bo,
+                    axis_size=p, algorithm=algorithm,
+                    overlap_chunks=overlap_chunks, chunk_axis=2 + bo),
                     ys, xs),
                 in_shardings=y_slab, out_shardings=x_slab)),
             ("t0_ifft_yz", jax.jit(
                 lambda v: _crop_axis(smap(
-                    lambda u: ex(_crop_axis(u, 1, n1), (1, 2), False), xs, xs)(v), 0, n0),
+                    lambda u: ex(_crop_axis(u, 1 + bo, n1), (1 + bo, 2 + bo),
+                                 False), xs, xs)(v), bo, n0),
                 in_shardings=x_slab, out_shardings=x_slab)),
         ]
     return trace_stages(stages), spec

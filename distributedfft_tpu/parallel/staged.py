@@ -34,7 +34,7 @@ from ..ops.executors import get_c2r, get_executor, get_r2c
 from ..utils.trace import trace_stages
 from .exchange import exchange_chunked
 from .pencil import PencilSpec
-from .slab import SlabSpec, _crop_axis, _pad_axis
+from .slab import SlabSpec, _crop_axis, _pad_axis, batch_pspec, check_batch
 
 __all__ = [
     "build_pencil_stages",
@@ -49,16 +49,20 @@ def build_single_stages(
     *,
     executor: str | Callable = "xla",
     forward: bool = True,
+    batch: int | None = None,
 ) -> list:
     """Single-device staged pipeline: t0 (YZ planes) and t3 (X lines) as
     separate jits — the per-stage breakdown the reference prints even on
     one rank (``fft_mpi_3d_api.cpp:184-201``; t1/t2 are identically zero
     without a transpose/exchange). With the pallas executor, t0 is the
-    fused 2D plane kernel and t3 the strided axis-0 kernel."""
+    fused 2D plane kernel and t3 the strided axis-0 kernel. ``batch=B``
+    runs the stages over ``[B, ...]`` arrays."""
+    check_batch(batch)
+    bo = 0 if batch is None else 1
     ex = get_executor(executor) if isinstance(executor, str) else executor
     return trace_stages([
-        ("t0_fft_yz", jax.jit(lambda x: ex(x, (1, 2), forward))),
-        ("t3_fft_x", jax.jit(lambda y: ex(y, (0,), forward))),
+        ("t0_fft_yz", jax.jit(lambda x: ex(x, (1 + bo, 2 + bo), forward))),
+        ("t3_fft_x", jax.jit(lambda y: ex(y, (bo,), forward))),
     ])
 
 _AXIS_LETTER = "xyz"
@@ -93,13 +97,15 @@ def build_pencil_stages(
     perm: tuple[int, int, int] | None = None,
     order: str | None = None,
     overlap_chunks: int = 1,
+    batch: int | None = None,
 ) -> tuple[list[tuple[str, Callable]], PencilSpec]:
     """Pencil c2c transform as five timed stages:
     t0 (first fft) | t2a (first exchange) | t1 (mid fft) | t2b (second
     exchange) | t3 (last fft) — the reference's taxonomy with the two
     pencil exchanges split out as t2a/t2b. ``overlap_chunks > 1`` keeps
     the overlapped chains' K-collective shape inside each exchange stage
-    (:func:`.exchange.exchange_chunked`).
+    (:func:`.exchange.exchange_chunked`). ``batch=B`` runs the stages
+    over ``[B, ...]`` arrays with one shared exchange per chunk.
 
     Generic over the stage value: ``executor`` may be a callable taking
     any pytree of same-shape arrays (the dd tier passes a (hi, lo) pair
@@ -109,6 +115,8 @@ def build_pencil_stages(
         perm = (0, 1, 2) if forward else (1, 2, 0)
     if order is None:
         order = "col_first" if forward else "row_first"
+    check_batch(batch)
+    bo = 0 if batch is None else 1  # leading-batch axis offset
     rows, cols = mesh.shape[row_axis], mesh.shape[col_axis]
     spec = PencilSpec(tuple(int(s) for s in shape), rows, cols,
                       row_axis, col_axis, tuple(perm), order)
@@ -128,7 +136,8 @@ def build_pencil_stages(
     op = spec.out_placement
     out_lay = {op[0]: row_axis, op[1]: col_axis}
 
-    sh = lambda lay: NamedSharding(mesh, _pspec(lay))
+    bspec = lambda lay: batch_pspec(_pspec(lay), batch)
+    sh = lambda lay: NamedSharding(mesh, bspec(lay))
     in_sh, mid_sh, out_sh = sh(in_lay), sh(mid_lay), sh(out_lay)
     pads = {a: pad_to(n[a], rows), b: pad_to(n[b], cols)}
     # each exchange's split axis is padded to its part count before it runs
@@ -136,23 +145,24 @@ def build_pencil_stages(
     mid_pad = pad_to(n[seq[1][2]], seq[1][1])
 
     def smap(f, lay_in, lay_out):
-        return _shard_map(f, mesh=mesh, in_specs=(_pspec(lay_in),),
-                          out_specs=_pspec(lay_out))
+        return _shard_map(f, mesh=mesh, in_specs=(bspec(lay_in),),
+                          out_specs=bspec(lay_out))
 
     def t0(x):
-        x = _tpad(_tpad(x, a, pads[a]), b, pads[b])
+        x = _tpad(_tpad(x, a + bo, pads[a]), b + bo, pads[b])
         x = lax.with_sharding_constraint(x, in_sh)
-        y = smap(lambda v: ex(v, (c,), forward), in_lay, in_lay)(x)
-        y = _tpad(y, seq[0][2], pads[seq[0][2]])
+        y = smap(lambda v: ex(v, (c + bo,), forward), in_lay, in_lay)(x)
+        y = _tpad(y, seq[0][2] + bo, pads[seq[0][2]])
         return lax.with_sharding_constraint(y, in_sh)
 
     def t2a(x):
         x = lax.with_sharding_constraint(x, in_sh)
         mesh_ax, parts, split, concat = seq[0]
         y = smap(lambda v: exchange_chunked(
-            v, mesh_ax, split_axis=split, concat_axis=concat,
+            v, mesh_ax, split_axis=split + bo, concat_axis=concat + bo,
             axis_size=parts, algorithm=algorithm,
             overlap_chunks=overlap_chunks,
+            chunk_axis=3 - split - concat + bo,
             exchange_name=f"t2a_exchange_{mesh_ax}"),
                  in_lay, mid_lay)(x)
         return lax.with_sharding_constraint(y, mid_sh)
@@ -161,17 +171,19 @@ def build_pencil_stages(
         x = lax.with_sharding_constraint(x, mid_sh)
         concat0 = seq[0][3]
         y = smap(lambda v: _tpad(
-            ex(_tcrop(v, concat0, n[concat0]), (mid_fft,), forward),
-            seq[1][2], mid_pad), mid_lay, mid_lay)(x)
+            ex(_tcrop(v, concat0 + bo, n[concat0]), (mid_fft + bo,),
+               forward),
+            seq[1][2] + bo, mid_pad), mid_lay, mid_lay)(x)
         return lax.with_sharding_constraint(y, mid_sh)
 
     def t2b(x):
         x = lax.with_sharding_constraint(x, mid_sh)
         mesh_ax, parts, split, concat = seq[1]
         y = smap(lambda v: exchange_chunked(
-            v, mesh_ax, split_axis=split, concat_axis=concat,
+            v, mesh_ax, split_axis=split + bo, concat_axis=concat + bo,
             axis_size=parts, algorithm=algorithm,
             overlap_chunks=overlap_chunks,
+            chunk_axis=3 - split - concat + bo,
             exchange_name=f"t2b_exchange_{mesh_ax}"),
                  mid_lay, out_lay)(x)
         return lax.with_sharding_constraint(y, out_sh)
@@ -179,10 +191,11 @@ def build_pencil_stages(
     def t3(x):
         x = lax.with_sharding_constraint(x, out_sh)
         concat1 = seq[1][3]
-        y = smap(lambda v: ex(_tcrop(v, concat1, n[concat1]),
-                              (last_fft,), forward), out_lay, out_lay)(x)
+        y = smap(lambda v: ex(_tcrop(v, concat1 + bo, n[concat1]),
+                              (last_fft + bo,), forward),
+                 out_lay, out_lay)(x)
         for ax in op:
-            y = _tcrop(y, ax, n[ax])
+            y = _tcrop(y, ax + bo, n[ax])
         return y
 
     L = _AXIS_LETTER
@@ -205,10 +218,13 @@ def build_slab_rfft_stages(
     forward: bool = True,
     algorithm: str = "alltoall",
     overlap_chunks: int = 1,
+    batch: int | None = None,
 ) -> tuple[list[tuple[str, Callable]], SlabSpec]:
     """Slab r2c (forward) / c2r (backward) as three timed stages — the
     per-stage breakdown for every benchmarkable r2c config
     (``fft_mpi_3d_api.cpp:184-201`` prints it for every run)."""
+    check_batch(batch)
+    bo = 0 if batch is None else 1
     p = mesh.shape[axis_name]
     spec = SlabSpec(tuple(int(s) for s in shape), p, axis_name,
                     in_axis=0 if forward else 1, out_axis=1 if forward else 0)
@@ -216,7 +232,8 @@ def build_slab_rfft_stages(
     r2c, c2r = get_r2c(executor), get_c2r(executor)
     n0, n1, n2 = spec.shape
     n0p, n1p = spec.n0p, spec.n1p
-    xs, ys = P(axis_name, None, None), P(None, axis_name, None)
+    xs = batch_pspec(P(axis_name, None, None), batch)
+    ys = batch_pspec(P(None, axis_name, None), batch)
     x_sh, y_sh = NamedSharding(mesh, xs), NamedSharding(mesh, ys)
 
     def smap(f, i, o):
@@ -225,24 +242,26 @@ def build_slab_rfft_stages(
     if forward:
 
         def t0(x):  # real [n0, n1, n2] -> complex [n0p, n1p, n2h]
-            x = lax.with_sharding_constraint(_pad_axis(x, 0, n0p), x_sh)
+            x = lax.with_sharding_constraint(_pad_axis(x, bo, n0p), x_sh)
             y = smap(lambda v: _pad_axis(
-                ex(r2c(v, 2), (1,), True), 1, n1p), xs, xs)(x)
+                ex(r2c(v, 2 + bo), (1 + bo,), True), 1 + bo, n1p),
+                xs, xs)(x)
             return lax.with_sharding_constraint(y, x_sh)
 
         def t2(y):
             y = lax.with_sharding_constraint(y, x_sh)
             z = smap(lambda v: exchange_chunked(
-                v, axis_name, split_axis=1, concat_axis=0, axis_size=p,
-                algorithm=algorithm, overlap_chunks=overlap_chunks),
+                v, axis_name, split_axis=1 + bo, concat_axis=bo,
+                axis_size=p, algorithm=algorithm,
+                overlap_chunks=overlap_chunks, chunk_axis=2 + bo),
                 xs, ys)(y)
             return lax.with_sharding_constraint(z, y_sh)
 
         def t3(z):
             z = lax.with_sharding_constraint(z, y_sh)
-            w = smap(lambda v: ex(_crop_axis(v, 0, n0), (0,), True),
+            w = smap(lambda v: ex(_crop_axis(v, bo, n0), (bo,), True),
                      ys, ys)(z)
-            return _crop_axis(w, 1, n1)
+            return _crop_axis(w, 1 + bo, n1)
 
         stages = [("t0_r2c_zy", jax.jit(t0)),
                   ("t2_exchange", jax.jit(t2)),
@@ -250,24 +269,27 @@ def build_slab_rfft_stages(
     else:
 
         def t3i(z):  # complex [n0, n1, n2h] y-slabs
-            z = lax.with_sharding_constraint(_pad_axis(z, 1, n1p), y_sh)
-            w = smap(lambda v: _pad_axis(ex(v, (0,), False), 0, n0p),
+            z = lax.with_sharding_constraint(
+                _pad_axis(z, 1 + bo, n1p), y_sh)
+            w = smap(lambda v: _pad_axis(ex(v, (bo,), False), bo, n0p),
                      ys, ys)(z)
             return lax.with_sharding_constraint(w, y_sh)
 
         def t2(w):
             w = lax.with_sharding_constraint(w, y_sh)
             u = smap(lambda v: exchange_chunked(
-                v, axis_name, split_axis=0, concat_axis=1, axis_size=p,
-                algorithm=algorithm, overlap_chunks=overlap_chunks),
+                v, axis_name, split_axis=bo, concat_axis=1 + bo,
+                axis_size=p, algorithm=algorithm,
+                overlap_chunks=overlap_chunks, chunk_axis=2 + bo),
                 ys, xs)(w)
             return lax.with_sharding_constraint(u, x_sh)
 
         def t0i(u):
             u = lax.with_sharding_constraint(u, x_sh)
-            w = smap(lambda v: c2r(ex(_crop_axis(v, 1, n1), (1,), False),
-                                   n2, 2), xs, xs)(u)
-            return _crop_axis(w, 0, n0)
+            w = smap(lambda v: c2r(
+                ex(_crop_axis(v, 1 + bo, n1), (1 + bo,), False),
+                n2, 2 + bo), xs, xs)(u)
+            return _crop_axis(w, bo, n0)
 
         stages = [("t3_ifft_x", jax.jit(t3i)),
                   ("t2_exchange", jax.jit(t2)),
@@ -285,10 +307,13 @@ def build_pencil_rfft_stages(
     forward: bool = True,
     algorithm: str = "alltoall",
     overlap_chunks: int = 1,
+    batch: int | None = None,
 ) -> tuple[list[tuple[str, Callable]], PencilSpec]:
     """Pencil r2c/c2r as five timed stages with t2a/t2b exchange lines.
     Canonical chains only (the real axis must be device-local axis 2 on the
     real side), matching :func:`.pencil.build_pencil_rfft3d`."""
+    check_batch(batch)
+    bo = 0 if batch is None else 1
     rows, cols = mesh.shape[row_axis], mesh.shape[col_axis]
     spec = PencilSpec(
         tuple(int(s) for s in shape), rows, cols, row_axis, col_axis,
@@ -301,9 +326,9 @@ def build_pencil_rfft_stages(
     n0p, n1pc, n1pr = spec.n0p, spec.n1p_col, spec.n1p_row
     n2h = n2 // 2 + 1
     n2hp = pad_to(n2h, cols)
-    zs, ysp, xs = (P(row_axis, col_axis, None),
-                   P(row_axis, None, col_axis),
-                   P(None, row_axis, col_axis))
+    zs, ysp, xs = (batch_pspec(P(row_axis, col_axis, None), batch),
+                   batch_pspec(P(row_axis, None, col_axis), batch),
+                   batch_pspec(P(None, row_axis, col_axis), batch))
     z_sh, y_sh, x_sh = (NamedSharding(mesh, s) for s in (zs, ysp, xs))
 
     def smap(f, i, o):
@@ -312,38 +337,42 @@ def build_pencil_rfft_stages(
     if forward:
 
         def t0(x):  # real z-pencils -> half-spectrum, padded for exch
-            x = _pad_axis(_pad_axis(x, 0, n0p), 1, n1pc)
+            x = _pad_axis(_pad_axis(x, bo, n0p), 1 + bo, n1pc)
             x = lax.with_sharding_constraint(x, z_sh)
-            y = smap(lambda v: _pad_axis(r2c(v, 2), 2, n2hp), zs, zs)(x)
+            y = smap(lambda v: _pad_axis(r2c(v, 2 + bo), 2 + bo, n2hp),
+                     zs, zs)(x)
             return lax.with_sharding_constraint(y, z_sh)
 
         def t2a(y):
             y = lax.with_sharding_constraint(y, z_sh)
             z = smap(lambda v: exchange_chunked(
-                v, col_axis, split_axis=2, concat_axis=1, axis_size=cols,
-                algorithm=algorithm, overlap_chunks=overlap_chunks),
+                v, col_axis, split_axis=2 + bo, concat_axis=1 + bo,
+                axis_size=cols, algorithm=algorithm,
+                overlap_chunks=overlap_chunks, chunk_axis=bo),
                 zs, ysp)(y)
             return lax.with_sharding_constraint(z, y_sh)
 
         def t1(z):
             z = lax.with_sharding_constraint(z, y_sh)
             w = smap(lambda v: _pad_axis(
-                ex(_crop_axis(v, 1, n1), (1,), True), 1, n1pr), ysp, ysp)(z)
+                ex(_crop_axis(v, 1 + bo, n1), (1 + bo,), True),
+                1 + bo, n1pr), ysp, ysp)(z)
             return lax.with_sharding_constraint(w, y_sh)
 
         def t2b(w):
             w = lax.with_sharding_constraint(w, y_sh)
             u = smap(lambda v: exchange_chunked(
-                v, row_axis, split_axis=1, concat_axis=0, axis_size=rows,
-                algorithm=algorithm, overlap_chunks=overlap_chunks),
+                v, row_axis, split_axis=1 + bo, concat_axis=bo,
+                axis_size=rows, algorithm=algorithm,
+                overlap_chunks=overlap_chunks, chunk_axis=2 + bo),
                 ysp, xs)(w)
             return lax.with_sharding_constraint(u, x_sh)
 
         def t3(u):
             u = lax.with_sharding_constraint(u, x_sh)
-            w = smap(lambda v: ex(_crop_axis(v, 0, n0), (0,), True),
+            w = smap(lambda v: ex(_crop_axis(v, bo, n0), (bo,), True),
                      xs, xs)(u)
-            return _crop_axis(_crop_axis(w, 1, n1), 2, n2h)
+            return _crop_axis(_crop_axis(w, 1 + bo, n1), 2 + bo, n2h)
 
         stages = [("t0_r2c_z", jax.jit(t0)),
                   ("t2a_exchange_col", jax.jit(t2a)),
@@ -353,38 +382,42 @@ def build_pencil_rfft_stages(
     else:
 
         def t3i(u):  # complex x-pencils [n0, n1, n2h]
-            u = _pad_axis(_pad_axis(u, 1, n1pr), 2, n2hp)
+            u = _pad_axis(_pad_axis(u, 1 + bo, n1pr), 2 + bo, n2hp)
             u = lax.with_sharding_constraint(u, x_sh)
-            w = smap(lambda v: _pad_axis(ex(v, (0,), False), 0, n0p),
+            w = smap(lambda v: _pad_axis(ex(v, (bo,), False), bo, n0p),
                      xs, xs)(u)
             return lax.with_sharding_constraint(w, x_sh)
 
         def t2b(w):
             w = lax.with_sharding_constraint(w, x_sh)
             z = smap(lambda v: exchange_chunked(
-                v, row_axis, split_axis=0, concat_axis=1, axis_size=rows,
-                algorithm=algorithm, overlap_chunks=overlap_chunks),
+                v, row_axis, split_axis=bo, concat_axis=1 + bo,
+                axis_size=rows, algorithm=algorithm,
+                overlap_chunks=overlap_chunks, chunk_axis=2 + bo),
                 xs, ysp)(w)
             return lax.with_sharding_constraint(z, y_sh)
 
         def t1i(z):
             z = lax.with_sharding_constraint(z, y_sh)
             w = smap(lambda v: _pad_axis(
-                ex(_crop_axis(v, 1, n1), (1,), False), 1, n1pc), ysp, ysp)(z)
+                ex(_crop_axis(v, 1 + bo, n1), (1 + bo,), False),
+                1 + bo, n1pc), ysp, ysp)(z)
             return lax.with_sharding_constraint(w, y_sh)
 
         def t2a(w):
             w = lax.with_sharding_constraint(w, y_sh)
             z = smap(lambda v: exchange_chunked(
-                v, col_axis, split_axis=1, concat_axis=2, axis_size=cols,
-                algorithm=algorithm, overlap_chunks=overlap_chunks),
+                v, col_axis, split_axis=1 + bo, concat_axis=2 + bo,
+                axis_size=cols, algorithm=algorithm,
+                overlap_chunks=overlap_chunks, chunk_axis=bo),
                 ysp, zs)(w)
             return lax.with_sharding_constraint(z, z_sh)
 
         def t0i(z):
             z = lax.with_sharding_constraint(z, z_sh)
-            w = smap(lambda v: c2r(_crop_axis(v, 2, n2h), n2, 2), zs, zs)(z)
-            return _crop_axis(_crop_axis(w, 0, n0), 1, n1)
+            w = smap(lambda v: c2r(_crop_axis(v, 2 + bo, n2h), n2, 2 + bo),
+                     zs, zs)(z)
+            return _crop_axis(_crop_axis(w, bo, n0), 1 + bo, n1)
 
         stages = [("t3_ifft_x", jax.jit(t3i)),
                   ("t2b_exchange_row", jax.jit(t2b)),

@@ -47,6 +47,7 @@ from . import geometry as geo
 from . import native
 from .parallel.exchange import ALGORITHMS
 from .parallel.mesh import make_mesh
+from .parallel.slab import check_batch
 
 
 #: Valid ``PlanOptions.tune`` values (None defers to the DFFT_TUNE env var).
@@ -259,6 +260,12 @@ class LogicPlan:
     negotiated: tuple | None = None
     # Stage layouts: list of (fft_axes, boxes) pairs, input side first.
     stages: tuple = ()
+    # Leading batch axis of a coalesced multi-request plan: B independent
+    # transforms ride the chain with ONE shared exchange per stage (the
+    # batch is a bystander dim of every collective). None = unbatched.
+    # Geometry (stages, boxes) stays per-transform; the payload/model
+    # accounting below scales with it.
+    batch: int | None = None
 
     @property
     def num_exchanges(self) -> int:
@@ -429,11 +436,17 @@ def logic_plan3d(
     forward: bool = True,
     in_spec: P | None = None,
     out_spec: P | None = None,
+    batch: int | None = None,
 ) -> LogicPlan:
     """Resolve (shape, mesh-or-device-count, options, layouts) to a concrete
     plan skeleton. The role of ``plan_operations``
     (``heffte_plan_logic.cpp:410-432``): all geometry decisions happen here,
     and the builders in :mod:`.parallel` only execute them.
+
+    ``batch=B`` records a leading batch axis of B coalesced transforms
+    (:class:`LogicPlan.batch`); decomposition/mesh decisions are
+    per-transform, but the overlap-K auto heuristic sees the B-fold
+    per-device block.
 
     ``mesh`` may be ``None`` (single device), an int device count (the mesh
     is built here, shaped by the chosen decomposition — pencil grids come
@@ -450,6 +463,7 @@ def logic_plan3d(
     with an edge reshard.
     """
     shape = tuple(int(s) for s in shape)
+    batch = check_batch(batch)
     decomp = options.decomposition
     negotiated = None
     requested = None  # device count requested as an int (renegotiable)
@@ -559,7 +573,10 @@ def logic_plan3d(
     overlap = 1 if (decomp == "single" or mesh is None) else (
         resolve_overlap_chunks(
             options.overlap_chunks, shape=shape,
-            ndev=math.prod(mesh.devices.shape)))
+            ndev=math.prod(mesh.devices.shape),
+            # A batched chain's per-device block is B-fold, which is what
+            # the "auto" block-bytes crossover must judge.
+            itemsize=8 * (batch or 1)))
     return LogicPlan(
         shape=shape, decomposition=decomp, mesh=mesh,
         options=replace(options, decomposition=decomp,
@@ -567,7 +584,7 @@ def logic_plan3d(
         forward=forward,
         slab_axes=slab_axes, pencil_perm=perm, pencil_order=order,
         in_absorbed=in_absorbed, out_absorbed=out_absorbed,
-        negotiated=negotiated, stages=stages,
+        negotiated=negotiated, stages=stages, batch=batch,
     )
 
 
@@ -652,10 +669,16 @@ def exchange_payloads(lp: LogicPlan, shape, itemsize: int) -> list[dict]:
     concat-axis padding (the SPMD equal-shard layout itself) always
     travels. Entries: {stage, mesh_axis, parts, true_bytes,
     alltoall_bytes, alltoallv_bytes}.
+
+    A batched plan (``lp.batch = B``) ships B transforms' payloads in ONE
+    collective per stage — every byte entry scales by B (and the
+    per-execute wire counters and the tuner's pruning model inherit that
+    scaling from here), while ``parts``/launch counts do not.
     """
     if lp.mesh is None:
         return []
     shape = tuple(int(s) for s in shape)
+    bsz = getattr(lp, "batch", None) or 1
     pad = lambda n, k: k * (-(-n // k))
     out = []
     if lp.decomposition == "slab":
@@ -666,11 +689,11 @@ def exchange_payloads(lp: LogicPlan, shape, itemsize: int) -> list[dict]:
         f = (p - 1) / p
         out.append({
             "stage": "t2", "mesh_axis": lp.mesh.axis_names[0], "parts": p,
-            "true_bytes": int(n_in * n_out * n_oth * f * itemsize),
+            "true_bytes": int(n_in * n_out * n_oth * f * itemsize * bsz),
             "alltoall_bytes": int(pad(n_in, p) * pad(n_out, p) * n_oth * f
-                                  * itemsize),
+                                  * itemsize * bsz),
             "alltoallv_bytes": int(pad(n_in, p) * n_out * n_oth * f
-                                   * itemsize),
+                                   * itemsize * bsz),
         })
         return out
     rows, cols = (lp.mesh.shape[ax] for ax in lp.mesh.axis_names[:2])
@@ -691,11 +714,11 @@ def exchange_payloads(lp: LogicPlan, shape, itemsize: int) -> list[dict]:
         out.append({
             "stage": stage, "mesh_axis": lp.mesh.axis_names[ax_i],
             "parts": parts,
-            "true_bytes": int(true_vol * f * itemsize),
+            "true_bytes": int(true_vol * f * itemsize * bsz),
             "alltoall_bytes": int(bystander_padded * pad(shape[split], parts)
-                                  * f * itemsize),
+                                  * f * itemsize * bsz),
             "alltoallv_bytes": int(bystander_padded * shape[split] * f
-                                   * itemsize),
+                                   * itemsize * bsz),
         })
     return out
 
@@ -726,10 +749,17 @@ def model_stage_seconds(
     exchange by XLA), ``t2`` = every exchange's *exposed* time, ``t3`` =
     the output-side FFT pass. Every entry carries ``seconds`` plus the
     quantities it was derived from (``flops``, ``hbm_bytes``,
-    ``wire_bytes``) so MFU/utilization ratios need no re-derivation."""
+    ``wire_bytes``) so MFU/utilization ratios need no re-derivation.
+
+    A batched plan (``lp.batch = B``) scales every per-stage quantity by
+    B — B-fold FFT flops and HBM stream, B-fold exchange payload through
+    :func:`exchange_payloads` — while collective launch counts stay at
+    the unbatched plan's (the batched win the tuner's pruning and the
+    explain attribution must both price honestly)."""
     shape = tuple(int(s) for s in shape)
     ndev = 1 if lp.mesh is None else math.prod(lp.mesh.devices.shape)
-    n_total = math.prod(shape)
+    bsz = getattr(lp, "batch", None) or 1
+    n_total = math.prod(shape) * bsz
     block_bytes = itemsize * n_total / ndev
     alg = algorithm or lp.options.algorithm
     k = overlap_chunks

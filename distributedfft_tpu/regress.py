@@ -77,6 +77,15 @@ DEFAULT_MIN_SAMPLES = 2   # baseline records required for a verdict
 #: are smaller-is-better; both use the same median+MAD noise model.
 AUX_COST_METRICS = ("peak_hbm_bytes", "compile_seconds")
 
+#: Auxiliary metrics of the record's ``rates`` block (throughput stamps
+#: like the serving tier's ``transforms_per_s``): same noise model,
+#: larger-is-better per :func:`metric_direction`'s ``_per_s`` rule. The
+#: gate fails on a confirmed throughput regression even when the
+#: GFlop/s headline is within noise (per-transform flops shrink when a
+#: batched program degrades to serialized exchanges, but the flagship
+#: headline may not move enough to trip alone).
+AUX_RATE_METRICS = ("transforms_per_s",)
+
 _MAD_SCALE = 1.4826       # MAD -> sigma under a normal noise model
 
 
@@ -130,6 +139,7 @@ def make_run_record(
     roofline: dict | None = None,
     metrics: dict | None = None,
     cost: dict | None = None,
+    rates: dict | None = None,
     explain: dict | None = None,
     source: str = "",
     commit: str | None = None,
@@ -141,10 +151,11 @@ def make_run_record(
     ``backend`` so a CPU row can never enter a TPU baseline. ``cost`` is
     the explain layer's compiled cost/memory block (peak-HBM /
     compile-seconds, baselined by :func:`compare_record` alongside the
-    headline); ``explain`` the full attribution record for ``report
-    explain``. A metrics snapshot's own schema version is lifted to
-    ``metrics_schema`` so registry drift is detectable without parsing
-    the block."""
+    headline); ``rates`` the throughput block (``transforms_per_s`` —
+    larger-is-better, gated the same way); ``explain`` the full
+    attribution record for ``report explain``. A metrics snapshot's own
+    schema version is lifted to ``metrics_schema`` so registry drift is
+    detectable without parsing the block."""
     rec = {
         "schema": SCHEMA,
         "recorded_at": recorded_at or _now_iso(),
@@ -170,6 +181,9 @@ def make_run_record(
             rec["metrics_schema"] = metrics["schema"]
     if cost:
         rec["cost"] = cost
+    if rates:
+        rec["rates"] = {str(k): float(v) for k, v in rates.items()
+                        if isinstance(v, (int, float))}
     if explain:
         rec["explain"] = explain
     if extra:
@@ -204,13 +218,15 @@ def normalize_bench_line(
     except (TypeError, ValueError):
         return None
     config = {}
-    # "overlap" (PlanOptions.overlap_chunks != 1) and "tuned" (the
-    # autotuner's winner tuple) are part of the baseline group: an
-    # overlapped or tuned run must never be judged against a monolithic /
-    # heuristic baseline or vice versa — they compile different programs
-    # (the tuned tuple may even move between re-tunes, which the label
-    # then keys into separate baselines).
-    for k in ("dtype", "devices", "decomposition", "overlap", "tuned"):
+    # "overlap" (PlanOptions.overlap_chunks != 1), "tuned" (the
+    # autotuner's winner tuple), and "batch" (a coalesced multi-request
+    # program) are part of the baseline group: an overlapped, tuned, or
+    # batched run must never be judged against a monolithic /
+    # heuristic / single-transform baseline or vice versa — they compile
+    # different programs (the tuned tuple may even move between
+    # re-tunes, which the label then keys into separate baselines).
+    for k in ("dtype", "devices", "decomposition", "overlap", "tuned",
+              "batch"):
         if obj.get(k) is not None:
             config[k] = obj[k]
     ex: dict = {}
@@ -233,6 +249,8 @@ def normalize_bench_line(
     explain = obj.get("explain")
     if not isinstance(explain, dict):
         explain = None
+    rates = {k: obj[k] for k in AUX_RATE_METRICS
+             if isinstance(obj.get(k), (int, float))}
     return make_run_record(
         metric=obj["metric"],
         value=value,
@@ -246,6 +264,7 @@ def normalize_bench_line(
         roofline=obj.get("roofline"),
         metrics=telemetry.get("metrics"),
         cost=cost,
+        rates=rates or None,
         explain=explain,
         source=source,
         commit=commit,
@@ -409,8 +428,14 @@ def metric_direction(metric: str, unit: str | None = None) -> int:
     """+1 when larger is better (throughput), -1 when smaller is better
     (latency, byte footprints). Stage times and the cost-block metrics
     (``peak_hbm_bytes``, ``compile_seconds``) always compare
-    smaller-is-better."""
+    smaller-is-better. Rates (``*_per_s`` — ``transforms_per_s``, the
+    batched-serving throughput stamp) are larger-is-better and must be
+    classified BEFORE the latency rules: ``transforms_per_s`` also ends
+    with ``_s``, and misreading it would gate throughput improvements
+    as regressions."""
     m, u = metric.lower(), (unit or "").lower()
+    if m.endswith("_per_s") or u.endswith("/s"):
+        return 1
     if "seconds" in m or m.endswith("_s") or u in ("s", "seconds", "ms"):
         return -1
     if m.endswith("_bytes") or u in ("b", "bytes"):
@@ -480,38 +505,44 @@ def compare_record(
         out["localization"] = _localize_stages(
             record, base, mads=mads, min_rel=min_rel,
             min_samples=min_samples)
-    aux = _compare_cost(record, base, mads=mads, min_rel=min_rel,
-                        min_samples=min_samples)
+    aux = _compare_block(record, base, "cost", AUX_COST_METRICS,
+                         mads=mads, min_rel=min_rel,
+                         min_samples=min_samples)
+    aux += _compare_block(record, base, "rates", AUX_RATE_METRICS,
+                          mads=mads, min_rel=min_rel,
+                          min_samples=min_samples)
     if aux:
         out["aux"] = aux
     return out
 
 
-def _compare_cost(
-    record: dict, base: list[dict], *, mads: float, min_rel: float,
-    min_samples: int,
+def _compare_block(
+    record: dict, base: list[dict], block: str, names, *, mads: float,
+    min_rel: float, min_samples: int,
 ) -> list[dict]:
-    """Verdicts of the record's ``cost`` block metrics (peak-HBM,
-    compile seconds) against the baseline records' cost blocks — the
-    explain-layer extension of the gate: a wall-time-neutral change
-    that doubles the HBM footprint or the compile bill must still trip
-    ``compare --gate``. Same noise model; both metrics are
-    smaller-is-better (:func:`metric_direction`)."""
-    cost = record.get("cost")
-    if not isinstance(cost, dict):
+    """Verdicts of one auxiliary record block against the baseline
+    records' same block — the gate extension beyond the headline:
+    ``cost`` (peak-HBM / compile seconds, smaller-is-better) catches a
+    wall-time-neutral footprint regression; ``rates``
+    (``transforms_per_s``, larger-is-better via the ``_per_s`` rule)
+    catches a throughput regression of the batched serving tier. Same
+    median+MAD noise model as the headline; direction per
+    :func:`metric_direction`."""
+    vals = record.get(block)
+    if not isinstance(vals, dict):
         return []
     rows: list[dict] = []
-    for name in AUX_COST_METRICS:
-        val = cost.get(name)
+    for name in names:
+        val = vals.get(name)
         if not isinstance(val, (int, float)):
             continue
         samples = []
         for r in base:
-            c = r.get("cost")
+            c = r.get(block)
             if isinstance(c, dict) and isinstance(c.get(name),
                                                   (int, float)):
                 samples.append(float(c[name]))
-        row = {"metric": name, "value": float(val),
+        row = {"metric": name, "block": block, "value": float(val),
                "baseline": {"n": len(samples)}, "verdict": "no-baseline"}
         if len(samples) >= min_samples:
             med, mad = robust_stats(samples)
@@ -640,15 +671,16 @@ def format_compare(results: list[dict]) -> str:
                 f"{tag})")
         for row in res.get("aux", []):
             b = row.get("baseline", {})
+            label = f"{row.get('block', 'cost')}.{row['metric']}"
             if "median" in b:
                 lines.append(
-                    f"    cost.{row['metric']:<17} "
+                    f"    {label:<22} "
                     f"{row.get('delta_pct', 0.0):+.1f}%  "
                     f"({row['value']:g} vs {b['median']:g}; "
                     f"{row['verdict']})")
             else:
                 lines.append(
-                    f"    cost.{row['metric']:<17} value={row['value']:g} "
+                    f"    {label:<22} value={row['value']:g} "
                     f"(baseline n={b.get('n', 0)} < min samples)")
     return "\n".join(lines)
 

@@ -1,0 +1,370 @@
+"""Batched multi-request serving tier: async submit/await + coalescing.
+
+Everything below the plan layer executes one transform at a time; a
+serving tier for heavy traffic needs many *independent* same-shape FFTs
+coalesced into ONE device program — "Large-Scale Discrete Fourier
+Transform on TPUs" (arXiv 2002.03260) reaches peak TPU utilization with
+batched device programs, and DaggerFFT (arXiv 2601.12209) frames
+scheduling concurrent transforms onto one mesh as the distributed-FFT
+throughput play. This module is that tier, three pieces:
+
+1. :func:`submit` / :class:`Handle` — async execute-and-await. JAX
+   dispatch is already asynchronous, so ``submit(plan, x)`` returns the
+   moment the program is enqueued; ``handle.result()`` blocks. Donated
+   plans (``plan_dft_c2c_3d(..., donate=True)``) consume the submitted
+   buffer, halving the resident HBM per in-flight request.
+2. :class:`CoalescingQueue` — groups pending requests by
+   ``(shape, dtype, direction)`` (exactly the tuple the PR 4 wisdom
+   store keys) and executes each group through ONE batched plan
+   (``plan(batch=B)``): B transforms, one collective latency per t2
+   stage. Plans come from the memoized plan cache, so a steady-state
+   queue replays warm executables and never re-plans.
+3. :func:`warm_pool` — preplans the top-N (shape, dtype, direction[,
+   batch]) tuples recorded in the persistent wisdom store at startup, so
+   the first requests of a fresh process hit warm plans instead of
+   paying a compile (``tune="wisdom"`` replays each stored winner with
+   zero timing executions).
+
+Throughput accounting: every flush observes ``serving_batch_size`` and
+bumps ``serving_transforms`` in the metrics registry; bench.py stamps
+``transforms_per_s`` into its result lines and the regress gate treats
+``*_per_s`` as larger-is-better (docs/OBSERVABILITY.md "Batched serving
+& throughput").
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .local import FORWARD
+from .ops.executors import Scale
+from .utils import metrics as _metrics
+
+__all__ = ["Handle", "submit", "CoalescingQueue", "warm_pool"]
+
+
+class Handle:
+    """Awaitable result of one submitted transform.
+
+    Two lifecycles: a direct :func:`submit` handle is born resolved (the
+    async-dispatched output array is already attached — ``result()``
+    only blocks on the device); a :class:`CoalescingQueue` handle stays
+    pending until its group flushes (``result()`` triggers the flush
+    when the caller outruns the coalescer)."""
+
+    __slots__ = ("_value", "_error", "_event", "_queue")
+
+    def __init__(self, queue: "CoalescingQueue | None" = None):
+        self._value: Any = None
+        self._error: BaseException | None = None
+        self._event = threading.Event()
+        self._queue = queue
+
+    @classmethod
+    def _resolved(cls, value) -> "Handle":
+        h = cls()
+        h._set(value)
+        return h
+
+    def _set(self, value) -> None:
+        self._value = value
+        self._queue = None
+        self._event.set()
+
+    def _fail(self, err: BaseException) -> None:
+        self._error = err
+        self._queue = None
+        self._event.set()
+
+    def done(self) -> bool:
+        """True when the result (or failure) is attached AND device
+        execution has finished — ``result()`` will not block."""
+        if not self._event.is_set():
+            return False
+        if self._error is not None:
+            return True
+        try:
+            return bool(self._value.is_ready())
+        except AttributeError:  # non-jax value (already materialized)
+            return True
+
+    def result(self, timeout: float | None = None):
+        """The transform output, blocking until it exists. A pending
+        queue handle flushes its queue first (the caller demanding a
+        result IS the coalescing deadline)."""
+        if not self._event.is_set() and self._queue is not None:
+            self._queue.flush()
+        if not self._event.wait(timeout):
+            raise TimeoutError("submitted transform still pending")
+        if self._error is not None:
+            raise self._error
+        return jax.block_until_ready(self._value)
+
+
+def submit(plan, x, *, scale: Scale = Scale.NONE) -> Handle:
+    """Asynchronously execute ``plan`` on ``x`` -> :class:`Handle`.
+
+    JAX dispatch is async: this returns as soon as the compiled program
+    is enqueued, with the transfer/compute in flight — the caller
+    overlaps host work (or more submits) with device execution and
+    awaits via ``handle.result()``. With a donated plan the submitted
+    buffer is consumed (the bufferDev ping-pong discipline at the
+    serving tier). ``plan`` is any :class:`..api.Plan3D` — batched plans
+    take the stacked ``[B, ...]`` input."""
+    from .api import execute
+
+    if _metrics._enabled:
+        _metrics.inc("serving_submits", kind="direct")
+    return Handle._resolved(execute(plan, x, scale=scale))
+
+
+class CoalescingQueue:
+    """Request-coalescing front of the serving tier.
+
+    ``submit(x)`` enqueues one transform of ``x``'s shape and returns a
+    :class:`Handle`; pending requests with the same ``(shape, dtype,
+    direction)`` are grouped and executed as ONE batched device program
+    when the group reaches ``max_batch`` (auto-flush), on ``flush()``,
+    or when any handle's ``result()`` is awaited. Batched plans build
+    through the memoized plan cache, so each (tuple, B) pair compiles
+    once and every later flush replays it warm — :func:`warm_pool` (or
+    ``queue.warm(...)``) preplans the hot tuples at startup.
+
+    ``kind``: ``"c2c"`` (default) or ``"r2c"`` (forward real input /
+    backward half-spectrum input, canonical ``r2c_axis=2``). ``donate``
+    donates the queue-owned stacked buffer of batched flushes to the
+    device program (singleton flushes never donate — the caller's array
+    must survive). Thread-safe: submits/flushes serialize on one lock.
+    """
+
+    def __init__(
+        self,
+        mesh=None,
+        *,
+        kind: str = "c2c",
+        max_batch: int = 8,
+        donate: bool = False,
+        **plan_kw,
+    ):
+        if kind not in ("c2c", "r2c"):
+            raise ValueError(f"kind must be c2c|r2c, got {kind!r}")
+        if not isinstance(max_batch, int) or max_batch < 1:
+            raise ValueError(f"max_batch must be an int >= 1, "
+                             f"got {max_batch!r}")
+        for bad in ("batch", "donate", "in_spec", "out_spec"):
+            if bad in plan_kw:
+                raise ValueError(f"{bad!r} is owned by the queue; do not "
+                                 f"pass it in plan_kw")
+        self.mesh = mesh
+        self.kind = kind
+        self.max_batch = max_batch
+        self.donate = bool(donate)
+        self.plan_kw = dict(plan_kw)
+        self._lock = threading.RLock()
+        # (shape, dtype str, direction) -> list of (array, handle)
+        self._pending: dict[tuple, list[tuple]] = {}
+
+    # ------------------------------------------------------------ intake
+
+    def _planner(self):
+        from . import api
+
+        return (api.plan_dft_r2c_3d if self.kind == "r2c"
+                else api.plan_dft_c2c_3d)
+
+    def _plan(self, key: tuple, batch: int | None, donate: bool):
+        shape, dtype, direction = key
+        kw = dict(self.plan_kw, direction=direction, batch=batch,
+                  donate=donate)
+        if dtype is not None:
+            kw["dtype"] = dtype
+        return self._planner()(shape, self.mesh, **kw)
+
+    def submit(self, x, *, direction: int = FORWARD,
+               scale: Scale = Scale.NONE) -> Handle:
+        """Enqueue one transform of ``x`` (the plan's unbatched input
+        shape: the 3D world for c2c / forward r2c, the half-spectrum
+        world for backward r2c). Returns immediately; the group executes
+        at ``max_batch``, on :meth:`flush`, or on ``result()``."""
+        shape, dtype, x = self._coerce(x, direction)
+        key = (shape, dtype, direction)
+        handle = Handle(queue=self)
+        if _metrics._enabled:
+            _metrics.inc("serving_submits", kind=self.kind)
+        with self._lock:
+            group = self._pending.setdefault(key, [])
+            group.append((x, handle, scale))
+            full = len(group) >= self.max_batch
+        if full:
+            self.flush(key)
+        return handle
+
+    def _coerce(self, x, direction: int):
+        """Validate/convert one request array against the plan family's
+        unbatched input contract; returns (world shape, dtype str, x)."""
+        plan0 = self._plan_for_probe(jnp.shape(x), direction)
+        x = jnp.asarray(x, dtype=plan0.in_dtype)
+        if x.shape != plan0.in_shape:
+            raise ValueError(
+                f"queue expects the unbatched plan input shape "
+                f"{plan0.in_shape}, got {x.shape}")
+        return plan0.shape, str(jnp.dtype(plan0.dtype)), x
+
+    def _plan_for_probe(self, in_shape, direction: int):
+        """The unbatched plan for a request of ``in_shape`` — resolves
+        the world shape for r2c backward (half-spectrum input) without
+        duplicating that geometry here. Memoized by the plan cache."""
+        if len(in_shape) != 3:
+            raise ValueError(
+                f"submit takes one unbatched 3D input, got {in_shape}")
+        shape = tuple(int(s) for s in in_shape)
+        if self.kind == "r2c" and direction != FORWARD:
+            # Half-spectrum input [n0, n1, n2h]: the world's true n2 is
+            # ambiguous from n2h alone (n2 = 2*(n2h-1) or 2*n2h-1), so
+            # backward r2c groups must declare it via plan_kw["shape"]—
+            # or simply use submit_plan with an explicit plan.
+            raise ValueError(
+                "backward r2c coalescing needs the real-space world "
+                "shape; use CoalescingQueue(kind='r2c') for forward "
+                "only, or submit(plan, x) with an explicit c2r plan")
+        return self._plan((shape, self.plan_kw.get("dtype"), direction),
+                          None, False)
+
+    # ------------------------------------------------------------- flush
+
+    def pending(self) -> int:
+        """Number of requests waiting to be coalesced."""
+        with self._lock:
+            return sum(len(g) for g in self._pending.values())
+
+    def flush(self, key: tuple | None = None) -> int:
+        """Execute every pending group (or just ``key``'s) as batched
+        programs; returns the number of transforms dispatched. Handles
+        resolve to async in-flight arrays (result() blocks on device)."""
+        done = 0
+        with self._lock:
+            keys = [key] if key is not None else list(self._pending)
+            groups = [(k, self._pending.pop(k)) for k in keys
+                      if self._pending.get(k)]
+            for k, group in groups:
+                done += self._execute_group(k, group)
+        return done
+
+    def _execute_group(self, key: tuple, group: list) -> int:
+        b = len(group)
+        try:
+            if b == 1:
+                x, handle, scale = group[0]
+                from .api import execute
+
+                handle._set(execute(self._plan(key, None, False), x,
+                                    scale=scale))
+            else:
+                plan = self._plan(key, b, self.donate)
+                stacked = jnp.stack([x for x, _, _ in group])
+                from .api import _spec_divides
+
+                if plan.in_sharding is not None and _spec_divides(
+                        plan.in_sharding.mesh, plan.in_sharding.spec,
+                        stacked.shape):
+                    # Pre-place the stack on the plan's input layout;
+                    # uneven worlds let the chain's own pad/crop shard it
+                    # (the alloc_local rule).
+                    stacked = jax.device_put(stacked, plan.in_sharding)
+                y = plan(stacked)
+                for i, (_, handle, scale) in enumerate(group):
+                    out = y[i]
+                    if scale != Scale.NONE:
+                        from .ops.executors import apply_scale
+
+                        out = apply_scale(out, scale, plan.world_size)
+                    handle._set(out)
+        except Exception as e:  # noqa: BLE001 — fail the group's handles
+            for _, handle, _ in group:
+                handle._fail(e)
+            raise
+        if _metrics._enabled:
+            _metrics.inc("serving_flushes", kind=self.kind)
+            _metrics.inc("serving_transforms", float(b), kind=self.kind)
+            _metrics.observe("serving_batch_size", float(b), kind=self.kind)
+        return b
+
+    # -------------------------------------------------------------- warm
+
+    def warm(self, shapes, *, batches=(None,),
+             direction: int = FORWARD) -> int:
+        """Preplan (and thereby plan-cache) the given world shapes at the
+        given batch sizes — the explicit-tuple warm path (the wisdom-
+        driven one is :func:`warm_pool`). Returns plans built."""
+        n = 0
+        for shape in shapes:
+            for b in batches:
+                self._plan((tuple(int(s) for s in shape),
+                            self.plan_kw.get("dtype"), direction), b, False)
+                n += 1
+        return n
+
+
+def warm_pool(mesh=None, top_n: int = 4, *, path: str | None = None,
+              max_batch: int | None = None) -> list:
+    """Preplan the top-N problem tuples of the persistent wisdom store.
+
+    The PR 4 wisdom store keys measured winners by exactly the serving
+    tuple — (kind, shape, dtype, direction[, batch], mesh, hardware) —
+    so the hottest entries ARE the shapes a fresh serving process will
+    see first. This reads the store (``DFFT_WISDOM`` / the compile-cache
+    default), keeps entries matching the current platform/x64/device
+    count (``mesh``: a Mesh, int device count, or None = single device),
+    orders newest-first, and builds each of the top ``top_n`` through
+    ``tune="wisdom"`` — replaying the stored winner with zero timing
+    executions into the memoized plan cache. ``max_batch`` additionally
+    preplans each tuple at that batch size, warming the coalescer's
+    full-group program too. Returns the built plans."""
+    import math
+
+    from . import api, tuner
+
+    entries = tuner._read_wisdom(path if path is not None
+                                 else tuner.default_wisdom_path())
+    if isinstance(mesh, int):
+        ndev = mesh
+    elif mesh is None:
+        ndev = 1
+    else:
+        ndev = int(math.prod(mesh.devices.shape))
+    platform = jax.default_backend()
+    x64 = bool(jax.config.jax_enable_x64)
+
+    def eligible(entry) -> bool:
+        k = entry.get("key", {})
+        return (k.get("kind") in ("c2c", "r2c")
+                and k.get("ndev") == ndev
+                and k.get("platform") == platform
+                and k.get("x64") == x64
+                and k.get("layouts") is None)
+
+    ranked = sorted((e for e in entries.values() if eligible(e)),
+                    key=lambda e: str(e.get("recorded_at", "")),
+                    reverse=True)[:max(0, int(top_n))]
+    plans = []
+    for entry in ranked:
+        k = entry["key"]
+        plan_fn = (api.plan_dft_r2c_3d if k["kind"] == "r2c"
+                   else api.plan_dft_c2c_3d)
+        batches = {k.get("batch")}
+        if max_batch is not None:
+            batches.add(int(max_batch))
+        for b in sorted(batches, key=lambda v: (v is not None, v)):
+            try:
+                plans.append(plan_fn(
+                    tuple(k["shape"]), mesh, direction=k["direction"],
+                    dtype=jnp.dtype(k["dtype"]), tune="wisdom", batch=b))
+            except Exception:  # noqa: BLE001 — a stale tuple never
+                continue       # blocks the rest of the pool
+    if _metrics._enabled:
+        _metrics.set_gauge("serving_warm_pool_plans", float(len(plans)))
+    return plans

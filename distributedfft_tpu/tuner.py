@@ -170,11 +170,14 @@ def enumerate_candidates(
     mesh_dims: tuple[int, ...] | None = None,
     executors: Sequence[str] | None = None,
     itemsize: int = 8,
+    batch: int | None = None,
 ) -> list[Candidate]:
     """Enumerate the joint (decomposition x transport x executor x K)
     space for one plan. ``mesh_dims`` (a caller-fixed Mesh) pins the
     decomposition axis — a 1D mesh can only run slab chains, a 2D mesh
-    only pencil; an int device count leaves both in play."""
+    only pencil; an int device count leaves both in play. ``batch``
+    scales the per-device block the K axis brackets (a batched plan's
+    auto-K crossover moves with the B-fold payload)."""
     shape = tuple(int(s) for s in shape)
     if mesh_dims is not None:
         decomps: tuple[str, ...] = (
@@ -183,7 +186,7 @@ def enumerate_candidates(
         decomps = tuple(d for d in eligible_decompositions(shape, ndev)
                         if d != "single")
     execs = list(executors) if executors is not None else _default_executors()
-    ks = _overlap_values(shape, ndev, itemsize)
+    ks = _overlap_values(shape, ndev, itemsize * (batch or 1))
     out = []
     for d in decomps:
         for alg in WIRE_BYTE_KEYS:
@@ -199,6 +202,7 @@ def model_cost(
     mesh,
     *,
     itemsize: int = 8,
+    batch: int | None = None,
 ) -> float:
     """Analytical seconds estimate of one candidate — the pruning model.
 
@@ -211,16 +215,17 @@ def model_cost(
     ``t2/K + max(0, t2 - t3)(K-1)/K`` and adds K-1 extra launches per
     exchange (the crossover model ``auto_overlap_chunks`` implements).
     Used to *rank* candidates before any compile, never to pick a
-    winner.
+    winner. ``batch=B`` prices the B-fold payload/compute of a batched
+    serving plan (launch counts stay per-exchange — the batched win).
     """
     from .parallel.exchange import exchange_model_seconds
 
     shape = tuple(int(s) for s in shape)
     lp = logic_plan3d(shape, mesh, PlanOptions(
         decomposition=cand.decomposition, algorithm=cand.algorithm,
-        tune="off"))
+        tune="off"), batch=batch)
     ndev = (math.prod(lp.mesh.devices.shape) if lp.mesh is not None else 1)
-    world_bytes = itemsize * math.prod(shape)
+    world_bytes = itemsize * math.prod(shape) * (batch or 1)
     t_fft = 3 * 2 * (world_bytes / ndev) / (MODEL_HBM_GBPS * 1e9)
     payloads = exchange_payloads(lp, shape, itemsize)
     # Downstream FFT time each exchange can hide under: one chain stage.
@@ -244,6 +249,7 @@ def prune_candidates(
     *,
     itemsize: int = 8,
     limit: int | None = None,
+    batch: int | None = None,
 ) -> list[Candidate]:
     """Prune the enumerated space to <= ``limit`` survivors before any
     compile: geometry tuples (decomposition, transport, K) are ranked by
@@ -263,7 +269,8 @@ def prune_candidates(
     def geo_cost(key) -> float:
         d, alg, k = key
         probe = geos[(d, alg, k)][0]
-        return model_cost(probe, shape, mesh, itemsize=itemsize)
+        return model_cost(probe, shape, mesh, itemsize=itemsize,
+                          batch=batch)
 
     ranked = sorted(geos, key=lambda g: (geo_cost(g), g))
 
@@ -464,12 +471,16 @@ def wisdom_key(
     layouts: str | None = None,
     device_kind: str | None = None,
     platform: str | None = None,
+    batch: int | None = None,
 ) -> dict:
     """The identity a wisdom entry is valid for. A measured winner
     transfers only within one (plan family, problem, mesh, hardware,
     code version) tuple — FFTW's wisdom scoping, plus the library
     versions because a new release may change what any candidate
-    compiles to."""
+    compiles to. ``batch`` keys batched serving plans separately: a
+    B-fold exchange payload moves the transport/overlap crossovers, so a
+    winner measured unbatched must never be replayed for a batched
+    program (or vice versa)."""
     import jax
 
     from . import __version__
@@ -487,6 +498,7 @@ def wisdom_key(
         "ndev": int(ndev),
         "mesh": None if mesh_dims is None else [int(d) for d in mesh_dims],
         "layouts": layouts,
+        "batch": None if batch is None else int(batch),
         "device_kind": str(device_kind),
         "platform": platform or jax.default_backend(),
         "x64": bool(jax.config.jax_enable_x64),
@@ -595,6 +607,7 @@ def _log_model_divergence(
     mesh,
     *,
     itemsize: int = 8,
+    batch: int | None = None,
 ) -> None:
     """Audit the pruning model against the tournament it pruned for:
     per candidate, the measured/predicted ratio goes into the
@@ -605,7 +618,8 @@ def _log_model_divergence(
     configuration's candidates. Best-effort: never fatal, never changes
     the winner."""
     try:
-        model = {label: model_cost(c, shape, mesh, itemsize=itemsize)
+        model = {label: model_cost(c, shape, mesh, itemsize=itemsize,
+                                   batch=batch)
                  for label, c in by_label.items()
                  if label in times and math.isfinite(times[label])}
         for label, m in model.items():
@@ -679,12 +693,13 @@ def tuned_plan(kind: str, shape, mesh, options: PlanOptions,
 
     dtype = api._default_cdtype(plan_kw.get("dtype"))
     in_spec, out_spec = plan_kw.get("in_spec"), plan_kw.get("out_spec")
+    batch = plan_kw.get("batch")
     layouts = (f"{in_spec}|{out_spec}"
                if (in_spec is not None or out_spec is not None) else None)
     key = wisdom_key(
         kind=kind, shape=shape, dtype=dtype,
         direction=plan_kw.get("direction", -1),
-        ndev=ndev, mesh_dims=mesh_dims, layouts=layouts)
+        ndev=ndev, mesh_dims=mesh_dims, layouts=layouts, batch=batch)
     path = default_wisdom_path()
 
     entry = lookup_wisdom(key, path) if path is not None else None
@@ -709,8 +724,8 @@ def tuned_plan(kind: str, shape, mesh, options: PlanOptions,
     itemsize = np.dtype(dtype).itemsize
     cands = prune_candidates(
         enumerate_candidates(shape, ndev, mesh_dims=mesh_dims,
-                             itemsize=itemsize),
-        shape, mesh, itemsize=itemsize)
+                             itemsize=itemsize, batch=batch),
+        shape, mesh, itemsize=itemsize, batch=batch)
     _metrics.set_gauge("tune_candidates", len(cands), kind=kind,
                        stage="pruned")
     by_label = {c.label: c for c in cands}
@@ -731,7 +746,7 @@ def tuned_plan(kind: str, shape, mesh, options: PlanOptions,
     winner, built, times = measured_select(
         list(by_label), build, measure, what=f"{kind} tune candidate")
     _log_model_divergence(by_label, times, winner, shape, mesh,
-                          itemsize=itemsize)
+                          itemsize=itemsize, batch=batch)
     record_wisdom(key, by_label[winner], times[winner], path=path,
                   times=times)
     if options.donate:

@@ -323,6 +323,11 @@ def plan_info(plan) -> str:
         lines.append(
             f"overlap: {_oc} chunks (pipelined t2/t3 exchange-compute "
             f"interleave along the bystander axis)")
+    _b = getattr(plan, "batch", None)
+    if _b is not None:
+        lines.append(
+            f"batch: {_b} coalesced transforms (one shared exchange per "
+            f"t2 stage; batch rides the collectives as a bystander dim)")
     if plan.mesh is not None:
         lines.append(
             "mesh: "

@@ -1,0 +1,390 @@
+"""Batched multi-request execution: the leading batch axis + coalescing.
+
+``plan(..., batch=B)`` threads a leading batch axis through the chain
+builders: batched t0/t3 FFT stages, batched pads/crops, and ONE shared
+collective per (chunk, exchange) with the batch riding as a bystander
+dim — B transforms pay one collective latency. These tests pin the
+tentpole's three contracts on the 8-way CPU mesh:
+
+1. **Bit parity** — the batch axis is a pure bystander, so a batch=B
+   execution must equal B sequential executes of the unbatched plan bit
+   for bit, across slab/pencil/staged/dd x every transport x overlap
+   K in {1, 2}.
+2. **batch=1 is free** — ``batch=1`` (and None) compiles byte-identical
+   HLO to an unadorned plan: the serving tier's singleton path costs
+   nothing.
+3. **One shared exchange** — the compiled collective count of a batch=B
+   plan equals the batch=1 count for every transport (dense K, ring
+   K*(P-1), pencil 2K): batching must never serialize into per-element
+   collectives.
+
+Plus the serving tier riding on it: the coalescing queue groups pending
+same-(shape, dtype, direction) requests into one batched execution.
+
+NOTE on the filename: this module must collect BEFORE
+``test_alltoallv.py`` — the environment's XLA:CPU fft-thunk layout bug
+poisons the process's sharded dispatch stream for every later 8-device
+execute once tripped (see ``test_a2a_overlap.py``; the guard in
+``test_explain.py`` pins the ordering). This file avoids the one bad
+chain geometry, so running first is safe for the rest of the suite.
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu.parallel.slab import batch_pspec, check_batch
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the virtual 8-device mesh"
+)
+
+SHAPE = (16, 16, 16)
+UNEVEN = (12, 10, 9)
+CDT = jnp.complex128
+B = 3
+
+ALGS = ("alltoall", "alltoallv", "ppermute")
+
+_COLLECTIVE = re.compile(
+    r"\b(all-to-all|all-gather|all-reduce|collective-permute)(?:-start)?\("
+)
+
+
+def _collectives(plan) -> int:
+    txt = plan.fn.lower(
+        jax.ShapeDtypeStruct(plan.in_shape, plan.in_dtype)
+    ).compile().as_text()
+    return len(_COLLECTIVE.findall(txt))
+
+
+def _world(shape=SHAPE, seed=7, real=False, batch=None):
+    rng = np.random.default_rng(seed)
+    full = shape if batch is None else (batch,) + tuple(shape)
+    r = rng.standard_normal(full)
+    return r if real else r + 1j * rng.standard_normal(full)
+
+
+def _assert_batch_equals_sequential(pb, p1, x):
+    """The acceptance contract: batch=B output bit-identical to B
+    sequential executes of the unbatched plan."""
+    yb = np.asarray(pb(jnp.asarray(x)))
+    ys = np.stack([np.asarray(p1(jnp.asarray(x[i])))
+                   for i in range(x.shape[0])])
+    assert np.array_equal(yb, ys)
+
+
+# ------------------------------------------------------------- bit parity
+
+@pytest.mark.parametrize("alg", ALGS)
+@pytest.mark.parametrize("k", [1, 2])
+def test_slab_batch_parity_bitwise(alg, k):
+    mesh = dfft.make_mesh(8)
+    kw = dict(mesh=mesh, dtype=CDT, algorithm=alg, overlap_chunks=k)
+    pb = dfft.plan_dft_c2c_3d(SHAPE, **kw, batch=B)
+    p1 = dfft.plan_dft_c2c_3d(SHAPE, **kw)
+    _assert_batch_equals_sequential(pb, p1, _world(batch=B))
+
+
+@pytest.mark.parametrize("alg", ALGS)
+@pytest.mark.parametrize("k", [1, 2])
+def test_pencil_batch_parity_bitwise(alg, k):
+    mesh = dfft.make_mesh((2, 4))
+    kw = dict(mesh=mesh, dtype=CDT, algorithm=alg, overlap_chunks=k)
+    pb = dfft.plan_dft_c2c_3d(SHAPE, **kw, batch=B)
+    p1 = dfft.plan_dft_c2c_3d(SHAPE, **kw)
+    _assert_batch_equals_sequential(pb, p1, _world(batch=B))
+
+
+@pytest.mark.parametrize("alg", ALGS)
+def test_uneven_batch_parity_bitwise(alg):
+    """Uneven worlds exercise the batched pad/crop path (pads ride at
+    spatial-axis + 1); K=2 does not divide the 9-extent bystander."""
+    mesh = dfft.make_mesh(8)
+    kw = dict(mesh=mesh, dtype=CDT, algorithm=alg, overlap_chunks=2)
+    pb = dfft.plan_dft_c2c_3d(UNEVEN, **kw, batch=B)
+    p1 = dfft.plan_dft_c2c_3d(UNEVEN, **kw)
+    _assert_batch_equals_sequential(pb, p1, _world(UNEVEN, batch=B))
+
+
+@pytest.mark.parametrize("mesh_shape", [8, (2, 4)])
+def test_r2c_batch_parity_bitwise(mesh_shape):
+    mesh = dfft.make_mesh(mesh_shape)
+    pb = dfft.plan_dft_r2c_3d(SHAPE, mesh, batch=B)
+    p1 = dfft.plan_dft_r2c_3d(SHAPE, mesh)
+    _assert_batch_equals_sequential(pb, p1, _world(real=True, batch=B))
+    assert pb.in_shape == (B,) + SHAPE
+    assert pb.out_shape == (B, 16, 16, 9)
+
+
+def test_c2r_batch_parity_bitwise():
+    mesh = dfft.make_mesh(8)
+    kw = dict(mesh=mesh, direction=dfft.BACKWARD)
+    pb = dfft.plan_dft_r2c_3d(SHAPE, **kw, batch=B)
+    p1 = dfft.plan_dft_r2c_3d(SHAPE, **kw)
+    spec = np.stack([np.fft.rfftn(np.asarray(w))
+                     for w in _world(real=True, batch=B)])
+    _assert_batch_equals_sequential(pb, p1, spec)
+
+
+@pytest.mark.parametrize("alg,k", [("alltoall", 1), ("alltoall", 2),
+                                   ("alltoallv", 2), ("ppermute", 2)])
+def test_staged_slab_batch_parity_bitwise(alg, k):
+    """The staged t0/t2/t3 pipeline at batch=B reproduces the unbatched
+    stages applied per element, stage by stage."""
+    from distributedfft_tpu.parallel.slab import build_slab_stages
+
+    mesh = dfft.make_mesh(8)
+    sb, _ = build_slab_stages(mesh, SHAPE, algorithm=alg,
+                              overlap_chunks=k, batch=B)
+    s1, _ = build_slab_stages(mesh, SHAPE, algorithm=alg, overlap_chunks=k)
+    x = _world(batch=B)
+    b = jnp.asarray(x)
+    seq = [jnp.asarray(x[i]) for i in range(B)]
+    for (_, fb), (_, f1) in zip(sb, s1):
+        b = fb(b)
+        seq = [f1(v) for v in seq]
+        assert np.array_equal(
+            np.asarray(b), np.stack([np.asarray(v) for v in seq]))
+
+
+def test_staged_pencil_batch_parity_bitwise():
+    from distributedfft_tpu.parallel.staged import build_pencil_stages
+
+    mesh = dfft.make_mesh((2, 4))
+    sb, _ = build_pencil_stages(mesh, UNEVEN, overlap_chunks=2, batch=B)
+    s1, _ = build_pencil_stages(mesh, UNEVEN, overlap_chunks=2)
+    x = _world(UNEVEN, batch=B)
+    b = jnp.asarray(x)
+    seq = [jnp.asarray(x[i]) for i in range(B)]
+    for (_, fb), (_, f1) in zip(sb, s1):
+        b, seq = fb(b), [f1(v) for v in seq]
+    assert np.array_equal(
+        np.asarray(b), np.stack([np.asarray(v) for v in seq]))
+
+
+def _dd_pair(seed=3, batch=None):
+    rng = np.random.default_rng(seed)
+    full = SHAPE if batch is None else (batch,) + SHAPE
+    hi = jnp.asarray((rng.standard_normal(full)
+                      + 1j * rng.standard_normal(full)).astype(np.complex64))
+    lo = jnp.asarray((rng.standard_normal(full) * 2.0 ** -25
+                      + 0j).astype(np.complex64))
+    return hi, lo
+
+
+@pytest.mark.parametrize("alg,k", [("alltoall", 1), ("alltoall", 2),
+                                   ("alltoallv", 2), ("ppermute", 2)])
+def test_dd_slab_batch_parity_bitwise(alg, k):
+    """Both dd components carry the batch axis through the shared
+    collectives; the dd matmul engine is line-independent, so batch=B
+    stays bit-identical to sequential executes."""
+    from distributedfft_tpu.parallel.ddslab import build_dd_slab_fft3d
+
+    mesh = dfft.make_mesh(8)
+    fb, _ = build_dd_slab_fft3d(mesh, SHAPE, algorithm=alg,
+                                overlap_chunks=k, batch=B)
+    f1, _ = build_dd_slab_fft3d(mesh, SHAPE, algorithm=alg,
+                                overlap_chunks=k)
+    hi, lo = _dd_pair(batch=B)
+    bh, bl = fb(hi, lo)
+    for i in range(B):
+        sh, sl = f1(hi[i], lo[i])
+        assert np.array_equal(np.asarray(bh[i]), np.asarray(sh))
+        assert np.array_equal(np.asarray(bl[i]), np.asarray(sl))
+
+
+def test_dd_pencil_batch_parity_bitwise():
+    mesh = dfft.make_mesh((2, 4))
+    pb = dfft.plan_dd_dft_c2c_3d(SHAPE, mesh, batch=B, overlap_chunks=2)
+    p1 = dfft.plan_dd_dft_c2c_3d(SHAPE, mesh, overlap_chunks=2)
+    assert pb.batch == B
+    hi, lo = _dd_pair(batch=B)
+    bh, bl = pb(hi, lo)
+    for i in range(B):
+        sh, sl = p1(hi[i], lo[i])
+        assert np.array_equal(np.asarray(bh[i]), np.asarray(sh))
+        assert np.array_equal(np.asarray(bl[i]), np.asarray(sl))
+
+
+# ----------------------------------------------------------- lowering pins
+
+@pytest.mark.parametrize("mesh_shape", [8, (2, 4)])
+def test_batch1_hlo_byte_identical(mesh_shape):
+    """batch=1 (and None) IS the unbatched plan: byte-identical HLO, no
+    [1, ...] program for the serving tier's singleton path."""
+    mesh = dfft.make_mesh(mesh_shape)
+    base = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT)
+    b1 = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT, batch=1)
+    assert b1.batch is None and b1.in_shape == SHAPE
+    t_base = base.fn.lower(
+        jax.ShapeDtypeStruct(base.in_shape, base.in_dtype)).as_text()
+    t_b1 = b1.fn.lower(
+        jax.ShapeDtypeStruct(b1.in_shape, b1.in_dtype)).as_text()
+    assert t_base == t_b1
+
+
+@pytest.mark.parametrize("k", [1, 2])
+@pytest.mark.parametrize("alg,per_exchange", [
+    ("alltoall", 1),
+    ("alltoallv", 1),   # CPU mirrors the ragged op densely: still 1/chunk
+    ("ppermute", 7),    # (P-1)-step ring per chunk
+])
+def test_batch_collective_count_matches_unbatched(alg, k, per_exchange):
+    """One SHARED exchange per (chunk, exchange) regardless of B: the
+    compiled collective count of a batch=B plan equals the batch=1
+    count for every transport — batching must never serialize into
+    per-element collectives (that would forfeit the whole win)."""
+    mesh = dfft.make_mesh(8)
+    kw = dict(dtype=CDT, algorithm=alg, overlap_chunks=k)
+    pb = dfft.plan_dft_c2c_3d(SHAPE, mesh, **kw, batch=4)
+    p1 = dfft.plan_dft_c2c_3d(SHAPE, mesh, **kw)
+    assert _collectives(pb) == _collectives(p1) == k * per_exchange
+
+
+@pytest.mark.parametrize("k", [1, 2])
+def test_pencil_batch_compiles_to_2k_collectives(k):
+    mesh = dfft.make_mesh((2, 4))
+    pb = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT, overlap_chunks=k,
+                              batch=4)
+    assert _collectives(pb) == 2 * k
+
+
+# ------------------------------------------------------------- plan layer
+
+def test_batched_plan_metadata_and_info():
+    mesh = dfft.make_mesh(8)
+    plan = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT, batch=B)
+    assert plan.batch == B
+    assert plan.in_shape == (B,) + SHAPE
+    assert plan.logic.batch == B
+    assert plan.in_sharding.spec == batch_pspec(plan.spec.in_pspec, B)
+    info = dfft.plan_info(plan)
+    assert f"batch: {B} coalesced transforms" in info
+    # Boxes stay per-transform (every batch element shares the geometry).
+    assert plan.in_boxes[0].shape == (2, 16, 16)
+
+
+def test_batched_exchange_bytes_scale_with_b():
+    from distributedfft_tpu.api import _plan_exchange_bytes
+
+    mesh = dfft.make_mesh(8)
+    pb = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT, batch=B)
+    p1 = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT)
+    tb, wb = _plan_exchange_bytes(pb)
+    t1, w1 = _plan_exchange_bytes(p1)
+    assert tb == B * t1 and wb == B * w1
+
+
+def test_batch_validation():
+    mesh = dfft.make_mesh(8)
+    with pytest.raises(ValueError, match="batch"):
+        dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT, batch=0)
+    with pytest.raises(ValueError, match="batch"):
+        dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT, batch=2.5)
+    from jax.sharding import PartitionSpec as P
+
+    with pytest.raises(ValueError, match="in_spec"):
+        dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT, batch=2,
+                             in_spec=P("slab", None, None))
+    with pytest.raises(ValueError, match="r2c_axis"):
+        dfft.plan_dft_r2c_3d(SHAPE, mesh, batch=2, r2c_axis=0)
+    assert check_batch(None) is None and check_batch(4) == 4
+
+
+def test_batched_model_scales_with_b():
+    """exchange_model_seconds / model_stage_seconds price the B-fold
+    payload (tuner pruning and explain attribution stay honest)."""
+    from distributedfft_tpu.parallel.exchange import exchange_model_seconds
+    from distributedfft_tpu.plan_logic import model_stage_seconds
+
+    m1 = exchange_model_seconds(1e6, 8, "alltoall", wire_gbps=45.0,
+                                launch_seconds=1e-4)
+    mb = exchange_model_seconds(1e6, 8, "alltoall", wire_gbps=45.0,
+                                launch_seconds=1e-4, batch=4)
+    wire1 = m1["seconds"] - 1e-4
+    wireb = mb["seconds"] - 1e-4
+    assert abs(wireb - 4 * wire1) < 1e-12  # launches paid once
+
+    mesh = dfft.make_mesh(8)
+    pb = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT, batch=B)
+    p1 = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT)
+    kw = dict(hbm_gbps=819.0, wire_gbps=45.0, launch_seconds=1e-4)
+    s1 = model_stage_seconds(p1.logic, SHAPE, 16, **kw)
+    sb = model_stage_seconds(pb.logic, SHAPE, 16, **kw)
+    for st in ("t0", "t3"):
+        assert abs(sb[st]["hbm_bytes"] - B * s1[st]["hbm_bytes"]) < 1e-9
+        assert abs(sb[st]["flops"] - B * s1[st]["flops"]) < 1e-6
+    assert abs(sb["t2"]["wire_bytes"] - B * s1["t2"]["wire_bytes"]) < 1e-9
+
+
+def test_wisdom_key_separates_batched_plans():
+    from distributedfft_tpu import tuner
+
+    k1 = tuner.wisdom_key(kind="c2c", shape=SHAPE, dtype=CDT,
+                          direction=-1, ndev=8, mesh_dims=(8,))
+    kb = tuner.wisdom_key(kind="c2c", shape=SHAPE, dtype=CDT,
+                          direction=-1, ndev=8, mesh_dims=(8,), batch=8)
+    assert k1["batch"] is None and kb["batch"] == 8
+    assert tuner._key_id(k1) != tuner._key_id(kb)
+
+
+# ------------------------------------------------------------ serving tier
+
+def test_coalescing_queue_one_batched_execute_on_mesh():
+    """Three pending same-tuple requests flush as ONE batched device
+    program (metrics prove a single batch=3 execute), bit-identical to
+    direct unbatched executes."""
+    from distributedfft_tpu.utils import metrics as _m
+
+    mesh = dfft.make_mesh(8)
+    ref = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT)
+    q = dfft.CoalescingQueue(mesh, max_batch=8, dtype=CDT)
+    xs = [_world(seed=s) for s in (1, 2, 3)]
+    dfft.enable_metrics()
+    _m.metrics_reset()
+    handles = [q.submit(jnp.asarray(v)) for v in xs]
+    assert q.pending() == 3
+    assert q.flush() == 3
+    snap = dfft.metrics_snapshot()
+    assert snap["counters"]["serving_flushes"]["kind=c2c"] == 1.0
+    assert snap["counters"]["serving_transforms"]["kind=c2c"] == 3.0
+    # Exactly one (batched) chain execute ran for the whole group.
+    execs = snap["counters"]["executes"]
+    assert sum(execs.values()) == 1.0
+    for v, h in zip(xs, handles):
+        assert np.array_equal(np.asarray(h.result()),
+                              np.asarray(ref(jnp.asarray(v))))
+
+
+def test_queue_auto_flush_and_lazy_result():
+    mesh = dfft.make_mesh(8)
+    ref = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT)
+    q = dfft.CoalescingQueue(mesh, max_batch=2, dtype=CDT)
+    x1, x2 = _world(seed=11), _world(seed=12)
+    h1 = q.submit(jnp.asarray(x1))
+    h2 = q.submit(jnp.asarray(x2))  # reaches max_batch -> auto-flush
+    assert q.pending() == 0
+    assert np.array_equal(np.asarray(h1.result()),
+                          np.asarray(ref(jnp.asarray(x1))))
+    # A singleton group flushes through the UNBATCHED plan on result().
+    h3 = q.submit(jnp.asarray(x1))
+    assert q.pending() == 1
+    assert np.array_equal(np.asarray(h3.result()),
+                          np.asarray(ref(jnp.asarray(x1))))
+    assert q.pending() == 0
+
+
+def test_submit_await_direct():
+    mesh = dfft.make_mesh(8)
+    plan = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT)
+    x = _world(seed=21)
+    h = dfft.submit(plan, jnp.asarray(x))
+    y = h.result()
+    assert h.done()
+    assert np.array_equal(np.asarray(y), np.asarray(plan(jnp.asarray(x))))

@@ -298,3 +298,64 @@ def test_bench_orchestrator_appends_history(tmp_path):
     assert rec["value"] == line["value"]
     assert rec["source"] == "bench.py"
     assert rec["device_kind"] == "cpu"  # a CPU record, never TPU-keyed
+
+
+# --------------------------------------------- throughput (_per_s) rates
+
+def _rate_rec(value, tps, batch=8):
+    return regress.make_run_record(
+        metric="fft3d_c2c_512_forward_gflops", value=value,
+        config={"dtype": "complex64", "devices": 8, "batch": batch},
+        backend="tpu", device_kind="TPU v5 lite",
+        rates={"transforms_per_s": tps}, source="test")
+
+
+def test_per_s_metrics_are_larger_is_better():
+    """The ``_per_s`` carve-out must classify BEFORE the latency rules:
+    ``transforms_per_s`` also ends with ``_s`` and would otherwise gate
+    throughput improvements as regressions."""
+    assert regress.metric_direction("transforms_per_s") == 1
+    assert regress.metric_direction("requests_per_s") == 1
+    assert regress.metric_direction("transforms", "1/s") == 1
+    # ... and the latency/footprint rules still bite after it.
+    assert regress.metric_direction("t2_seconds") == -1
+    assert regress.metric_direction("tune_measure_s") == -1
+    assert regress.metric_direction("peak_hbm_bytes") == -1
+
+
+def test_transforms_per_s_gates_both_directions():
+    """A confirmed throughput drop trips the shared gate rule even when
+    the GFlop/s headline is clean; a throughput gain is called improved
+    and never gates."""
+    hist = [_rate_rec(186.0 + d, 1200.0 + 10 * d) for d in (-1, 0, 1, 2)]
+    res = regress.compare_record(_rate_rec(186.2, 700.0), hist)
+    assert res["verdict"] == "within-noise"
+    by = {a["metric"]: a for a in res["aux"]}
+    assert by["transforms_per_s"]["verdict"] == "regressed"
+    assert ("fft3d_c2c_512_forward_gflops:transforms_per_s"
+            in regress.regressed_metrics(res))
+    res2 = regress.compare_record(_rate_rec(186.2, 2400.0), hist)
+    assert {a["metric"]: a["verdict"] for a in res2["aux"]}[
+        "transforms_per_s"] == "improved"
+    assert regress.regressed_metrics(res2) == []
+    # The human report labels the row by its block, not as a cost metric.
+    assert "rates.transforms_per_s" in regress.format_compare([res])
+
+
+def test_batched_records_never_share_single_transform_baseline():
+    """``batch`` joins overlap/tuned in the baseline config group, and
+    ``transforms_per_s`` is lifted from the bench line into rates."""
+    line = {"metric": "fft3d_c2c_512_forward_gflops", "value": 200.0,
+            "unit": "GFlops/s", "dtype": "complex64", "devices": 8,
+            "decomposition": "slab", "backend": "tpu",
+            "transforms_per_s": 5.0}
+    single = regress.normalize_bench_line(dict(line), source="t")
+    batched = regress.normalize_bench_line(dict(line, batch=8), source="t")
+    assert regress.group_key(single) != regress.group_key(batched)
+    assert "batch=8" in regress.config_signature(batched)
+    assert single["rates"]["transforms_per_s"] == 5.0
+    # A batched history yields no baseline for single-transform runs.
+    hist = [regress.normalize_bench_line(dict(line, batch=8, value=v),
+                                         source="t")
+            for v in (199.0, 200.0, 201.0)]
+    assert regress.compare_record(single, hist)["verdict"] == "no-baseline"

@@ -1,0 +1,210 @@
+"""Serving-tier tests: handles, coalescing rules, warm pool, drivers.
+
+Single-device executions only — this file collects after
+``test_alltoallv.py``'s backend poisoning, so nothing here may run an
+8-device plan (the mesh-execution tier of the serving tests lives in
+``test_a2e_batch.py``, which collects early). Covers: Handle lifecycle,
+the queue's grouping/validation rules, the wisdom-driven warm pool,
+bench.py's transforms_per_s/batch stamps, and the speed3d '+bB' label.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu import tuner
+from distributedfft_tpu.serving import Handle
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SHAPE = (8, 8, 8)
+CDT = jnp.complex128
+
+
+def _world(seed=0, real=False):
+    rng = np.random.default_rng(seed)
+    r = rng.standard_normal(SHAPE)
+    return r if real else r + 1j * rng.standard_normal(SHAPE)
+
+
+# ---------------------------------------------------------------- handles
+
+def test_submit_returns_resolved_async_handle():
+    plan = dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT)
+    x = _world(1)
+    h = dfft.submit(plan, jnp.asarray(x))
+    y = h.result()
+    assert h.done()
+    assert np.array_equal(np.asarray(y), np.asarray(plan(jnp.asarray(x))))
+    # result() is idempotent.
+    assert np.array_equal(np.asarray(h.result()), np.asarray(y))
+
+
+def test_handle_failure_propagates():
+    h = Handle()
+    h._fail(RuntimeError("boom"))
+    assert h.done()
+    with pytest.raises(RuntimeError, match="boom"):
+        h.result()
+
+
+def test_pending_handle_times_out_without_queue():
+    h = Handle()  # never resolved, no queue to flush
+    with pytest.raises(TimeoutError):
+        h.result(timeout=0.01)
+
+
+# ------------------------------------------------------------------ queue
+
+def test_queue_groups_by_shape_dtype_direction():
+    """Different tuples coalesce into different groups; flush drains
+    them all, each through its own plan."""
+    q = dfft.CoalescingQueue(None, dtype=CDT, max_batch=8)
+    a = q.submit(jnp.asarray(_world(2)))
+    bshape = np.asarray(np.random.default_rng(3).standard_normal(
+        (4, 4, 4)) + 0j)
+    b = q.submit(jnp.asarray(bshape).astype(CDT))
+    inv = q.submit(jnp.asarray(_world(4)), direction=dfft.BACKWARD)
+    assert q.pending() == 3
+    assert len(q._pending) == 3  # three distinct groups
+    assert q.flush() == 3
+    fwd = dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT)
+    bwd = dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT,
+                               direction=dfft.BACKWARD)
+    assert np.array_equal(np.asarray(a.result()),
+                          np.asarray(fwd(jnp.asarray(_world(2)))))
+    assert np.array_equal(np.asarray(inv.result()),
+                          np.asarray(bwd(jnp.asarray(_world(4)))))
+    assert b.result().shape == (4, 4, 4)
+
+
+def test_queue_batched_flush_matches_direct_executes():
+    """A >1 group executes through a batch=B plan; results match the
+    unbatched plan bit for bit (single-device tier)."""
+    q = dfft.CoalescingQueue(None, dtype=CDT, max_batch=8)
+    xs = [_world(s) for s in (5, 6, 7)]
+    hs = [q.submit(jnp.asarray(v)) for v in xs]
+    assert q.flush() == 3
+    ref = dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT)
+    for v, h in zip(xs, hs):
+        assert np.array_equal(np.asarray(h.result()),
+                              np.asarray(ref(jnp.asarray(v))))
+
+
+def test_queue_validation():
+    with pytest.raises(ValueError, match="kind"):
+        dfft.CoalescingQueue(None, kind="c2r")
+    with pytest.raises(ValueError, match="max_batch"):
+        dfft.CoalescingQueue(None, max_batch=0)
+    with pytest.raises(ValueError, match="owned by the queue"):
+        dfft.CoalescingQueue(None, batch=4)
+    q = dfft.CoalescingQueue(None, dtype=CDT)
+    with pytest.raises(ValueError, match="3D"):
+        q.submit(jnp.zeros((2,) + SHAPE, CDT))
+    with pytest.raises(ValueError, match="backward r2c"):
+        dfft.CoalescingQueue(None, kind="r2c").submit(
+            jnp.zeros((8, 8, 5)), direction=dfft.BACKWARD)
+
+
+def test_queue_r2c_forward():
+    q = dfft.CoalescingQueue(None, kind="r2c", max_batch=4)
+    xs = [_world(s, real=True) for s in (8, 9)]
+    hs = [q.submit(jnp.asarray(v)) for v in xs]
+    q.flush()
+    ref = dfft.plan_dft_r2c_3d(SHAPE, None)
+    for v, h in zip(xs, hs):
+        assert np.array_equal(np.asarray(h.result()),
+                              np.asarray(ref(jnp.asarray(v))))
+
+
+def test_queue_warm_preplans():
+    q = dfft.CoalescingQueue(None, dtype=CDT, max_batch=4)
+    assert q.warm([SHAPE], batches=(None, 4)) == 2
+    # The warmed batched plan is the one a full group replays.
+    plan = dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT, batch=4)
+    assert plan.batch == 4
+
+
+# -------------------------------------------------------------- warm pool
+
+def _wisdom_entry(recorded_at, shape=SHAPE, batch=None, ndev=1):
+    key = tuner.wisdom_key(kind="c2c", shape=shape, dtype=CDT,
+                           direction=dfft.FORWARD, ndev=ndev,
+                           mesh_dims=None, batch=batch)
+    return {"schema": tuner.WISDOM_SCHEMA, "recorded_at": recorded_at,
+            "key": key,
+            "winner": {"decomposition": "slab", "algorithm": "alltoall",
+                       "executor": "xla", "overlap_chunks": 1},
+            "seconds": 0.001}
+
+
+def test_warm_pool_preplans_top_n_from_wisdom(tmp_path):
+    path = tmp_path / "wisdom.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps(_wisdom_entry("2026-08-01T00:00:00")) + "\n")
+        f.write(json.dumps(_wisdom_entry(
+            "2026-08-02T00:00:00", shape=(4, 4, 4))) + "\n")
+        # A foreign-ndev entry must be filtered out, not built.
+        f.write(json.dumps(_wisdom_entry(
+            "2026-08-03T00:00:00", shape=(6, 6, 6), ndev=64)) + "\n")
+    plans = dfft.warm_pool(None, top_n=2, path=str(path))
+    assert {p.shape for p in plans} == {SHAPE, (4, 4, 4)}
+    # top_n=1 keeps only the newest eligible tuple.
+    plans1 = dfft.warm_pool(None, top_n=1, path=str(path))
+    assert [p.shape for p in plans1] == [(4, 4, 4)]
+    # max_batch additionally warms the coalescer's full-group program.
+    plansb = dfft.warm_pool(None, top_n=1, path=str(path), max_batch=4)
+    assert {p.batch for p in plansb} == {None, 4}
+
+
+def test_warm_pool_empty_store_is_quiet(tmp_path):
+    assert dfft.warm_pool(None, top_n=4,
+                          path=str(tmp_path / "none.jsonl")) == []
+
+
+# ---------------------------------------------------------------- drivers
+
+def test_bench_emit_stamps_transforms_per_s_and_batch(capsys):
+    sys.path.insert(0, REPO)
+    import bench
+
+    bench._emit(16, 1e-4, 1e-7, "xla", 8, "slab", {"xla": 1e-4}, batch=4)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["batch"] == 4
+    assert out["transforms_per_s"] == pytest.approx(40000.0)
+    # GFlops count all four transforms of the batched execution.
+    single = bench._emit(16, 1e-4, 1e-7, "xla", 8, "slab", {"xla": 1e-4})
+    capsys.readouterr()
+    assert "batch" not in single  # default rows keep the old schema
+    assert single["transforms_per_s"] == pytest.approx(10000.0)
+    assert out["value"] == pytest.approx(4 * single["value"], rel=0.05)
+
+
+def test_bench_flagship_metric_name_follows_swept_shape(monkeypatch):
+    sys.path.insert(0, REPO)
+    import bench
+
+    monkeypatch.delenv("DFFT_BENCH_SHAPE", raising=False)
+    assert bench._flagship_n() == 512
+    monkeypatch.setenv("DFFT_BENCH_SHAPE", "256")
+    assert bench._flagship_n() == 256
+    monkeypatch.setenv("DFFT_BENCH_SHAPE", "garbage")
+    assert bench._flagship_n() == 512
+
+
+def test_speed3d_algorithm_label_stamps_batch():
+    sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+    from speed3d import _algorithm_label
+
+    assert _algorithm_label("alltoall", 1) == "alltoall"
+    assert _algorithm_label("alltoall", 1, batch=8) == "alltoall+b8"
+    assert _algorithm_label("alltoall", 4, batch=8) == "alltoall+ov4+b8"
+    assert _algorithm_label("ppermute", None, batch=None) == "ppermute"
+    assert _algorithm_label("alltoall", 2, batch=1) == "alltoall+ov2"
