@@ -330,6 +330,19 @@ def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
         # never share a compare baseline; untuned rows keep the old
         # schema.
         out["tuned"] = tuned
+    try:
+        # Calibrated-hardware-profile stamp: when a measured profile
+        # (report calibrate) drives the model/divergence constants, the
+        # run must form its own baseline group — divergence flags and
+        # model ratios mean something different against measured
+        # constants. Default/table-profile rows keep the old schema so
+        # existing baselines keep accumulating.
+        from distributedfft_tpu.explain import device_profile
+
+        if device_profile().get("source") == "calibrated":
+            out["profile"] = "calibrated"
+    except Exception:  # noqa: BLE001 — telemetry, not contract
+        pass
     if jax.default_backend() == "tpu":
         out.update(_roofline(shape, seconds, n_dev))
     if stages:

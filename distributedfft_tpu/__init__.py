@@ -14,14 +14,17 @@ Quick start::
     y = plan(x)                                    # X-slabs in, Y-slabs out
 """
 
-# The explain submodule is imported eagerly so its one-time package
-# attribute binding happens HERE, before the api import below rebinds
-# ``explain`` to the function — ``dfft.explain(plan)`` stays callable no
-# matter who imports ``distributedfft_tpu.explain`` later (a late
-# submodule import would otherwise clobber the function with the
-# module). Access the module via ``from distributedfft_tpu.explain
-# import ...`` direct-name imports.
-from . import explain as _explain_module  # noqa: F401
+# Package/module name-collision rule: ``dfft.explain`` is the FUNCTION
+# (the api convenience below), ``dfft.explain_mod`` the module. The
+# submodule is imported eagerly so its one-time package attribute
+# binding happens HERE, before the api import below rebinds ``explain``
+# to the function — ``dfft.explain(plan)`` stays callable no matter who
+# imports ``distributedfft_tpu.explain`` later (a late submodule import
+# would otherwise clobber the function with the module). Module
+# contents are reachable two stable ways: ``dfft.explain_mod.<name>``
+# or ``from distributedfft_tpu.explain import <name>`` — never via
+# ``dfft.explain.<name>`` (that's the function).
+from . import explain as explain_mod  # noqa: F401
 
 from .api import (  # noqa: F401
     BACKWARD,

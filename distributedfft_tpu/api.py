@@ -1410,8 +1410,11 @@ _PLAN_ENV_KNOBS = (
     "DFFT_PALLAS_PACK", "DFFT_PALLAS_SPLIT", "DFFT_XLA_REAL",
     "DFFT_FORCE_REAL_LOWERING", "DFFT_OVERLAP",
     # Tuned planning: mode, wisdom store, budget, and survivor cap all
-    # change what a tuned planner call would build/measure.
+    # change what a tuned planner call would build/measure — as do the
+    # calibrated-profile path and its correction opt-out (they move the
+    # pruning model's ranking).
     "DFFT_TUNE", "DFFT_WISDOM", "DFFT_TUNE_ITERS", "DFFT_TUNE_MAX",
+    "DFFT_HW_PROFILE", "DFFT_TUNE_CORRECTION",
 )
 
 
@@ -1573,7 +1576,10 @@ def explain(plan: Plan3D, **kw) -> dict:
     t0..t3 stage, with per-stage MFU, ICI utilization, whole-program
     cost/memory, and divergence flags (:mod:`.explain`). ``iters``
     controls the measured warm passes; ``measure=False`` skips every
-    execution. Render with :func:`.explain.format_explain`, or use the
+    execution; ``device_timing=True`` attributes stages from the
+    ``jax.profiler`` device timeline (host-bracket fallback);
+    ``allgather=True`` merges per-host stage medians (collective).
+    Render with :func:`.explain.format_explain`, or use the
     ``report explain`` subcommand / ``speed3d -explain`` drivers."""
     from .explain import explain as _explain_impl
 

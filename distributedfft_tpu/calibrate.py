@@ -1,0 +1,368 @@
+"""Calibrated hardware profiles — measured per-chip constants.
+
+Every model surface in this repo (the tuner's pruning model, the
+explain layer's divergence gate, the roofline rows) runs on hardware
+constants, and until now those were *datasheet* numbers — the
+``DEVICE_SPECS`` table for known TPU kinds, cross-platform ranking
+magnitudes for everything else (``explain.device_profile()`` reports
+``source: "table"`` or ``"default"``). AccFFT (arXiv 1506.07933) and
+the HPX collectives benchmark (arXiv 2504.03657) both calibrate their
+communication models against measured link bandwidth before attributing
+anything; a divergence flag computed against a datasheet constant says
+as much about the constant as about the code.
+
+This module closes that gap with short microbenchmarks:
+
+- **HBM bandwidth** — a jitted device-to-device copy of a block large
+  enough to stream (read + write per pass), timed amortized.
+- **Matmul peak** — one square matmul sized to saturate the MXU (or the
+  host's GEMM on CPU), ``2 n^3`` flops over the amortized time.
+- **ICI link bandwidth** — a ``ppermute`` ring shift of per-device
+  blocks across the mesh (every device ships its block one hop — the
+  per-link number the exchange model wants), multi-device only.
+- **Launch overhead** — a trivial jitted op round-tripped through
+  :func:`..utils.timing.sync`: the fixed per-collective cost floor.
+
+The resulting profile persists as JSON next to the tuner's wisdom store
+(``<compile cache dir>/hwprofile.json``; ``DFFT_HW_PROFILE`` overrides,
+empty/``0`` disables) — same lifecycle: derived, hardware-keyed, safe
+to delete. ``explain.device_profile()`` consumes a matching profile and
+reports ``source: "calibrated"``; ``tuner.model_cost`` applies the
+profile's per-transport ``model_correction`` factors (the persisted
+``tune_model_measured_ratio`` feedback loop) when ranking candidates.
+
+CLI: ``python -m distributedfft_tpu.report calibrate`` (see
+docs/OBSERVABILITY.md "Calibration").
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+
+__all__ = [
+    "PROFILE_SCHEMA",
+    "default_profile_path",
+    "load_profile",
+    "matching_profile",
+    "write_profile",
+    "update_model_correction",
+    "model_correction",
+    "calibrate",
+    "format_profile",
+]
+
+PROFILE_SCHEMA = 1
+
+#: Per-device block the bandwidth/peak microbenchmarks stream —
+#: large enough to leave caches on any current chip, small enough to
+#: fit the CPU test backend comfortably.
+_HBM_BYTES = 64 * 1024 * 1024
+_MM_N = 1024
+_WIRE_BYTES = 8 * 1024 * 1024
+
+
+def default_profile_path() -> str | None:
+    """The hardware-profile path: ``DFFT_HW_PROFILE`` when set (empty or
+    ``0`` disables the profile entirely -> None), else
+    ``hwprofile.json`` under the persistent compile-cache directory —
+    the same home (and lifecycle) as the tuner's wisdom store."""
+    env = os.environ.get("DFFT_HW_PROFILE")
+    if env is not None:
+        env = env.strip()
+        return None if env in ("", "0") else env
+    from .utils.cache import compile_cache_dir
+
+    return os.path.join(compile_cache_dir(), "hwprofile.json")
+
+
+# Loaded-profile cache keyed (path, mtime) so the per-candidate
+# model_cost calls of a pruning pass do not re-read the file.
+_cache: tuple[str, float, dict | None] | None = None
+
+
+def load_profile(path: str | None = None) -> dict | None:
+    """The stored profile document, or None (disabled store, missing or
+    malformed file — never a raise). Cached by file mtime."""
+    global _cache
+    if path is None:
+        path = default_profile_path()
+    if path is None:
+        return None
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return None
+    if _cache is not None and _cache[0] == path and _cache[1] == mtime:
+        return _cache[2]
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        doc = None
+    if not isinstance(doc, dict):
+        doc = None
+    _cache = (path, mtime, doc)
+    return doc
+
+
+def _current_identity() -> tuple[str, str]:
+    """(device_kind, platform) of the running backend; best-effort."""
+    kind, platform = "unknown", "unknown"
+    try:
+        import jax
+
+        platform = jax.default_backend()
+        kind = jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001 — identity must work backendless
+        pass
+    return kind, platform
+
+
+def matching_profile(path: str | None = None) -> dict | None:
+    """The stored profile, but only when it was calibrated on THIS
+    hardware (device_kind and platform both match) — a v5e profile must
+    never price a v4's exchanges, and a TPU profile never the CPU test
+    backend's."""
+    prof = load_profile(path)
+    if prof is None:
+        return None
+    kind, platform = _current_identity()
+    if prof.get("device_kind") != kind or prof.get("platform") != platform:
+        return None
+    return prof
+
+
+def write_profile(profile: dict, path: str | None = None) -> str | None:
+    """Write (replace) the profile document; returns the path, or None
+    when the store is disabled. Atomic rename so a concurrently reading
+    ``model_cost`` never sees a half-written file."""
+    global _cache
+    if path is None:
+        path = default_profile_path()
+    if path is None:
+        return None
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(profile, f, sort_keys=True, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+    _cache = None
+    return path
+
+
+def update_model_correction(
+    ratios: dict[str, float], path: str | None = None,
+) -> dict | None:
+    """Merge measured/model ratios per transport into the profile's
+    ``model_correction`` block — the persisted
+    ``tune_model_measured_ratio`` feedback the tuner's pruning reads
+    back. A profile that does not exist yet gets a correction-only stub
+    (no bandwidth fields, so ``device_profile()`` keeps reporting its
+    uncalibrated source); an existing calibrated profile keeps every
+    measured field. New ratios are blended 50/50 with stored ones so a
+    single noisy tournament cannot swing the ranking."""
+    ratios = {str(k): float(v) for k, v in ratios.items()
+              if isinstance(v, (int, float)) and math.isfinite(v) and v > 0}
+    if not ratios:
+        return None
+    if path is None:
+        path = default_profile_path()
+    if path is None:
+        return None
+    kind, platform = _current_identity()
+    prof = load_profile(path)
+    if (prof is None or prof.get("device_kind") != kind
+            or prof.get("platform") != platform):
+        prof = {"schema": PROFILE_SCHEMA, "device_kind": kind,
+                "platform": platform}
+    corr = dict(prof.get("model_correction") or {})
+    for alg, r in ratios.items():
+        old = corr.get(alg)
+        corr[alg] = (0.5 * (float(old) + r)
+                     if isinstance(old, (int, float)) and old > 0 else r)
+    prof["model_correction"] = corr
+    prof["correction_updated_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    write_profile(prof, path)
+    return prof
+
+
+def model_correction(algorithm: str, path: str | None = None) -> float:
+    """The pruning model's per-transport correction factor (measured
+    seconds / modeled seconds, persisted by the tuner's divergence
+    audit) for ``algorithm`` on this hardware; 1.0 when no profile, no
+    matching hardware, or no stored ratio. Clamped to [0.1, 10] — a
+    correction beyond one order of magnitude means the profile is
+    garbage, not that the model is."""
+    prof = matching_profile(path)
+    if prof is None:
+        return 1.0
+    corr = prof.get("model_correction")
+    if not isinstance(corr, dict):
+        return 1.0
+    r = corr.get(str(algorithm))
+    if not isinstance(r, (int, float)) or not math.isfinite(r) or r <= 0:
+        return 1.0
+    return min(10.0, max(0.1, float(r)))
+
+
+# -------------------------------------------------------- microbenchmarks
+
+def _measure_hbm_gbps(iters: int) -> float | None:
+    """Streamed device copy: one pass reads and writes the block once,
+    so bytes-per-pass = 2x the block."""
+    import jax
+    import jax.numpy as jnp
+
+    from .utils.timing import time_fn_amortized
+
+    n = _HBM_BYTES // 4
+    x = jnp.zeros((n,), jnp.float32)
+
+    @jax.jit
+    def stream(v):
+        return v + 1.0
+
+    t, _ = time_fn_amortized(stream, x, iters=iters, repeats=2)
+    return (2.0 * _HBM_BYTES / t) / 1e9 if t > 0 else None
+
+
+def _measure_peak_tflops(iters: int) -> float | None:
+    """One square matmul, ``2 n^3`` flops. bf16 inputs on TPU (the MXU's
+    native feed), f32 elsewhere."""
+    import jax
+    import jax.numpy as jnp
+
+    from .utils.timing import time_fn_amortized
+
+    dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    a = jnp.ones((_MM_N, _MM_N), dt)
+
+    @jax.jit
+    def mm(v):
+        return jnp.dot(v, v, precision=jax.lax.Precision.DEFAULT)
+
+    t, _ = time_fn_amortized(mm, a, iters=iters, repeats=2)
+    return (2.0 * _MM_N ** 3 / t) / 1e12 if t > 0 else None
+
+
+def _measure_wire_gbps(iters: int) -> float | None:
+    """Per-link bandwidth: a one-hop ``ppermute`` ring shift — every
+    device ships its whole block to its neighbor, so per-device wire
+    bytes = block bytes and seconds are one link's serialization time.
+    None on a single device (nothing to measure)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from .utils.timing import time_fn_amortized
+
+    devs = jax.devices()
+    ndev = len(devs)
+    if ndev < 2:
+        return None
+    n = _WIRE_BYTES // 4
+    mesh = Mesh(devs, ("d",))
+    x = jax.device_put(jnp.zeros((ndev, n), jnp.float32),
+                       NamedSharding(mesh, P("d", None)))
+
+    @jax.jit
+    def shift(v):
+        def body(blk):
+            perm = [(i, (i + 1) % ndev) for i in range(ndev)]
+            return jax.lax.ppermute(blk, "d", perm)
+
+        return shard_map(body, mesh=mesh, in_specs=P("d", None),
+                         out_specs=P("d", None))(v)
+
+    t, _ = time_fn_amortized(shift, x, iters=iters, repeats=2)
+    return (_WIRE_BYTES / t) / 1e9 if t > 0 else None
+
+
+def _measure_launch_seconds(iters: int) -> float | None:
+    """Fixed per-dispatch cost: a trivial jitted op, synced per call —
+    the launch + host round-trip floor the exchange model charges per
+    collective step."""
+    import jax
+    import jax.numpy as jnp
+
+    from .utils.timing import sync
+
+    @jax.jit
+    def tiny(v):
+        return v + 1.0
+
+    x = jnp.zeros((8,), jnp.float32)
+    sync(tiny(x))  # compile
+    best = math.inf
+    for _ in range(max(1, iters)):
+        t0 = time.perf_counter()
+        sync(tiny(x))
+        best = min(best, time.perf_counter() - t0)
+    return best if math.isfinite(best) else None
+
+
+def calibrate(iters: int = 10, *, wire: bool = True) -> dict:
+    """Run the microbenchmarks and return a profile document (nothing is
+    written — pair with :func:`write_profile`). Fields a benchmark
+    cannot produce (single-device wire, a failed measurement) are None;
+    the consumers fall back per-field. Never raises past a working
+    backend: each microbenchmark failure nulls its field."""
+    import jax
+
+    kind, platform = _current_identity()
+    prof: dict = {
+        "schema": PROFILE_SCHEMA,
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "device_kind": kind,
+        "platform": platform,
+        "ndev": len(jax.devices()),
+        "jax": jax.__version__,
+    }
+    for field, fn in (
+        ("hbm_gbps", lambda: _measure_hbm_gbps(iters)),
+        ("peak_tflops", lambda: _measure_peak_tflops(iters)),
+        ("wire_gbps", (lambda: _measure_wire_gbps(iters)) if wire
+         else (lambda: None)),
+        ("launch_seconds", lambda: _measure_launch_seconds(iters)),
+    ):
+        try:
+            prof[field] = fn()
+        except Exception:  # noqa: BLE001 — one sick benchmark nulls its
+            prof[field] = None  # field, never the whole calibration
+    # Carry forward corrections an earlier tournament already persisted
+    # for this hardware — calibration refreshes constants, it must not
+    # amnesia the feedback loop.
+    prev = matching_profile()
+    if prev is not None and isinstance(prev.get("model_correction"), dict):
+        prof["model_correction"] = prev["model_correction"]
+    return prof
+
+
+def format_profile(prof: dict) -> str:
+    """One-line-per-field human rendering of a profile document."""
+    def num(v, unit):
+        return "-" if v is None else f"{v:.6g} {unit}"
+
+    lines = [
+        f"device: {prof.get('device_kind')} ({prof.get('platform')}, "
+        f"{prof.get('ndev', '?')} device(s))",
+        f"hbm bandwidth:  {num(prof.get('hbm_gbps'), 'GB/s')}",
+        f"wire bandwidth: {num(prof.get('wire_gbps'), 'GB/s')}"
+        + ("" if prof.get("wire_gbps") is not None
+           else "  (single device: not measurable)"),
+        f"matmul peak:    {num(prof.get('peak_tflops'), 'TFlop/s')}",
+        f"launch floor:   {num(prof.get('launch_seconds'), 's')}",
+    ]
+    corr = prof.get("model_correction")
+    if isinstance(corr, dict) and corr:
+        pairs = ", ".join(f"{k}={v:.3g}x" for k, v in sorted(corr.items()))
+        lines.append(f"model correction: {pairs}")
+    if prof.get("recorded_at"):
+        lines.append(f"calibrated at: {prof['recorded_at']}")
+    return "\n".join(lines)

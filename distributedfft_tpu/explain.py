@@ -16,7 +16,14 @@ produces but never correlates:
   seconds (the separately-jitted staged pipelines give this per stage;
   the fused plan gives the whole-program view);
 - **measured** — warm per-stage wall-clock samples (the PR 1 trace-span
-  quantities, captured with the sync bracketing of the timing harness).
+  quantities, captured with the sync bracketing of the timing harness);
+  with ``device_timing=True`` / ``DFFT_DEVICE_TIMING=1`` the samples
+  come from the ``jax.profiler`` DEVICE timeline instead (per-chunk
+  ``t2[k]``/``t3[k]`` rows under overlap-K; clean host-bracket fallback
+  wherever device lanes don't exist — the CPU backend always), and
+  ``allgather=True`` merges every host process's stage medians into
+  min/median/max straggler rows (docs/OBSERVABILITY.md
+  "Flight recorder").
 
 plus per-stage MFU and ICI-utilization ratios, and **divergence flags**
 wherever the model's prediction falls outside the measured samples'
@@ -36,6 +43,7 @@ compile-time, not just wall time. See docs/OBSERVABILITY.md
 from __future__ import annotations
 
 import math
+import os
 import time
 from typing import Any, Sequence
 
@@ -54,6 +62,9 @@ __all__ = [
     "compiled_summary",
     "model_stage_estimates",
     "stage_divergence",
+    "parse_device_trace",
+    "device_stage_samples",
+    "across_hosts_stages",
     "format_explain",
     "explain_from_record",
 ]
@@ -85,12 +96,19 @@ _MB = 1.0 / (1024 * 1024)
 def device_profile() -> dict:
     """The hardware constants the model side of the join runs on.
 
-    Known TPU kinds come from :data:`DEVICE_SPECS` (``source: "table"``);
-    anything else (the CPU test backend included) falls back to the
-    tuner's cross-platform ranking constants (``source: "default"``) —
-    still useful for *ordering* stages, but divergence flags on a
-    default profile say as much about the constants as about the code,
-    and the record carries the source so readers can tell."""
+    A **calibrated** profile measured on this machine (``python -m
+    distributedfft_tpu.report calibrate``; :mod:`.calibrate`) wins when
+    its device_kind/platform match the running backend — divergence
+    flags are then computed against measured, not datasheet, constants
+    and ``source`` reports ``"calibrated"`` (with ``calibrated_at``).
+    Otherwise known TPU kinds come from :data:`DEVICE_SPECS`
+    (``source: "table"``); anything else (the CPU test backend included)
+    falls back to the tuner's cross-platform ranking constants
+    (``source: "default"``) — still useful for *ordering* stages, but
+    divergence flags on a default profile say as much about the
+    constants as about the code, and the record carries the source so
+    readers can tell."""
+    from .calibrate import matching_profile
     from .tuner import (
         MODEL_HBM_GBPS, MODEL_LAUNCH_SECONDS, MODEL_WIRE_GBPS,
     )
@@ -111,15 +129,30 @@ def device_profile() -> dict:
     else:
         peak_tf, hbm, wire = spec
         source = "table"
-    return {
+    launch = MODEL_LAUNCH_SECONDS
+    out = {
         "device_kind": kind,
         "backend": backend,
         "peak_tflops": peak_tf,
         "hbm_gbps": hbm,
         "wire_gbps": wire,
-        "launch_seconds": MODEL_LAUNCH_SECONDS,
+        "launch_seconds": launch,
         "source": source,
     }
+    cal = matching_profile()
+    if cal is not None and isinstance(cal.get("hbm_gbps"), (int, float)):
+        # Per-field override: a single-device calibration cannot measure
+        # wire bandwidth, so the table/default value stands in for the
+        # fields the microbenchmarks could not produce.
+        for field in ("hbm_gbps", "wire_gbps", "peak_tflops",
+                      "launch_seconds"):
+            v = cal.get(field)
+            if isinstance(v, (int, float)) and v > 0:
+                out[field] = float(v)
+        out["source"] = "calibrated"
+        if cal.get("recorded_at"):
+            out["calibrated_at"] = cal["recorded_at"]
+    return out
 
 
 # ---------------------------------------------------------------- model
@@ -140,7 +173,12 @@ def _model_shape_itemsize(plan) -> tuple[tuple[int, int, int], int]:
 def model_stage_estimates(plan, hw: dict | None = None) -> dict:
     """Per-stage analytic predictions of one execution of ``plan``,
     keyed exactly ``t0..t3`` (:func:`..plan_logic.model_stage_seconds`
-    on the plan's own logic skeleton and hardware profile)."""
+    on the plan's own logic skeleton and hardware profile). When a
+    calibrated profile stores a ``model_correction`` ratio for the
+    plan's transport, the exchange prediction is scaled by it — the
+    divergence gate then judges the model *after* its own persisted
+    feedback."""
+    from .calibrate import model_correction
     from .plan_logic import model_stage_seconds
 
     hw = hw or device_profile()
@@ -155,6 +193,7 @@ def model_stage_estimates(plan, hw: dict | None = None) -> dict:
         launch_seconds=hw["launch_seconds"],
         algorithm=plan.options.algorithm,
         overlap_chunks=oc if isinstance(oc, int) else 1,
+        exchange_correction=model_correction(plan.options.algorithm),
     )
 
 
@@ -385,6 +424,190 @@ def _measure_stages(stages, x, iters: int) -> tuple[dict, dict]:
     return per_pass, compiled
 
 
+# -------------------------------------------------------- device timing
+
+def _device_pids(entries: list[dict]) -> set:
+    """pids of device-lane processes in one XLA profiler chrome trace:
+    the ``process_name`` metadata rows whose name carries a
+    ``device:`` tag (``/device:TPU:0``-style). The CPU backend emits
+    only ``/host:CPU`` lanes -> empty set -> the caller falls back."""
+    pids = set()
+    for e in entries:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            nm = str((e.get("args") or {}).get("name", ""))
+            if "device:" in nm.lower():
+                pids.add(e.get("pid"))
+    return pids
+
+
+def parse_device_trace(doc, iters: int = 1) -> dict | None:
+    """Per-stage device-timeline samples out of one XLA profiler trace
+    (the ``*.trace.json.gz`` chrome document ``jax.profiler.trace``
+    writes). Events are kept when they sit on a device-lane process
+    AND their name normalizes to a ``t0..t3`` stage key (the
+    ``TraceAnnotation`` names the chain builders emit, per-chunk
+    ``t2_...[k]`` variants included) — so the returned seconds are what
+    the DEVICE spent inside each stage, not the host's dispatch
+    bracket.
+
+    Returns ``{"samples": {key: [seconds, ...]}, "chunks": {raw_name:
+    {"count", "seconds"}}, "device_pids": [...]}``. When the per-key
+    event count divides ``iters`` (the expected case: each measured
+    pass emits the same spans), consecutive event groups become one
+    sample per pass; otherwise one aggregate sample (total/iters) is
+    returned and the divergence gate's min-sample rule withholds its
+    verdict. None when the trace has no device lanes or no stage events
+    on them — the caller's signal to fall back to host brackets."""
+    raw = doc.get("traceEvents", []) if isinstance(doc, dict) else doc
+    if not isinstance(raw, list):
+        return None
+    entries = [e for e in raw if isinstance(e, dict)]
+    pids = _device_pids(entries)
+    if not pids:
+        return None
+    per_key: dict[str, list[tuple[float, float]]] = {}
+    chunks: dict[str, dict] = {}
+    for e in entries:
+        if e.get("ph") != "X" or e.get("pid") not in pids:
+            continue
+        name = str(e.get("name", ""))
+        key = stage_key(name)
+        if key is None:
+            continue
+        try:
+            ts, dur = float(e["ts"]), float(e["dur"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        per_key.setdefault(key, []).append((ts, dur / 1e6))
+        if "[" in name:
+            c = chunks.setdefault(name, {"count": 0, "seconds": 0.0})
+            c["count"] += 1
+            c["seconds"] += dur / 1e6
+    if not per_key:
+        return None
+    iters = max(1, int(iters))
+    samples: dict[str, list[float]] = {}
+    for key, evs in per_key.items():
+        evs.sort()
+        durs = [d for _, d in evs]
+        if len(durs) >= iters and len(durs) % iters == 0:
+            per = len(durs) // iters
+            samples[key] = [sum(durs[i * per:(i + 1) * per])
+                            for i in range(iters)]
+        else:
+            samples[key] = [sum(durs) / iters]
+    return {"samples": samples, "chunks": chunks,
+            "device_pids": sorted(pids)}
+
+
+def _load_trace_doc(path: str):
+    import gzip
+
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        import json
+
+        return json.load(f)
+
+
+def device_stage_samples(
+    stages, x, iters: int = 3, logdir: str | None = None,
+) -> tuple[dict | None, str | None]:
+    """Run ``iters`` pipeline passes under ``jax.profiler.trace`` and
+    attribute the stage times from the device timeline.
+
+    Returns ``(parsed, None)`` on success (``parsed`` per
+    :func:`parse_device_trace`) or ``(None, reason)`` when the
+    environment cannot produce a device attribution — profiler
+    unavailable, no trace file written, or no device-lane stage events
+    (the CPU backend's case; its "device" time IS the host bracket).
+    The capture directory is temporary unless ``logdir`` keeps it."""
+    import glob as _glob
+    import shutil
+    import tempfile
+
+    import jax
+
+    from .utils.timing import sync
+
+    tmp = None
+    if logdir is None:
+        tmp = tempfile.mkdtemp(prefix="dfft_devtrace_")
+        logdir = tmp
+    try:
+        try:
+            # One unprofiled warm pass: stage compiles must not land in
+            # (and distort) the captured timeline.
+            cur = x
+            for _, fn in stages:
+                cur = fn(cur)
+            sync(cur)
+            with jax.profiler.trace(logdir):
+                for _ in range(max(1, iters)):
+                    cur = x
+                    for _, fn in stages:
+                        cur = fn(cur)
+                    sync(cur)
+        except Exception as e:  # noqa: BLE001 — capture is best-effort
+            return None, f"profiler capture failed: {type(e).__name__}"
+        files = sorted(
+            _glob.glob(os.path.join(logdir, "**", "*.trace.json.gz"),
+                       recursive=True)
+            + _glob.glob(os.path.join(logdir, "**", "*.trace.json"),
+                         recursive=True))
+        if not files:
+            return None, "profiler wrote no trace file"
+        for path in reversed(files):  # newest capture first
+            try:
+                parsed = parse_device_trace(_load_trace_doc(path),
+                                            iters=iters)
+            except Exception:  # noqa: BLE001 — corrupt capture
+                continue
+            if parsed is not None:
+                return parsed, None
+        return None, "no device-lane stage events in trace"
+    finally:
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+# ----------------------------------------------------------- multi-host
+
+def _allgather_rows(vec: np.ndarray) -> np.ndarray:
+    """One float row per process -> (nproc, len(vec)) matrix; the
+    tuner's indirection so tests can simulate multi-host merges."""
+    from .tuner import _allgather_rows as rows
+
+    return rows(vec)
+
+
+def across_hosts_stages(stage_medians: dict) -> dict:
+    """Allgather one process's per-stage measured medians and fold them
+    into min/median/max-across-hosts rows — the straggler view: a
+    healthy job's t2 rows agree within noise; one slow host stretches
+    ``max`` (and ``straggler_ratio``) while the median stays put.
+    Single-process runs degenerate to n=1 rows (same schema)."""
+    vec = np.array(
+        [float(stage_medians.get(k) if stage_medians.get(k) is not None
+               else math.nan) for k in STAGE_KEYS], np.float64)
+    rows = np.asarray(_allgather_rows(vec), np.float64).reshape(-1, len(vec))
+    out: dict[str, Any] = {}
+    for i, key in enumerate(STAGE_KEYS):
+        col = rows[:, i]
+        col = col[np.isfinite(col)]
+        if not len(col):
+            continue
+        med = float(np.median(col))
+        out[key] = {
+            "min": float(col.min()),
+            "median": med,
+            "max": float(col.max()),
+            "n": int(len(col)),
+            "straggler_ratio": (float(col.max() / med) if med else None),
+        }
+    return {"processes": int(rows.shape[0]), "stages": out}
+
+
 # ----------------------------------------------------------- divergence
 
 def stage_divergence(
@@ -435,6 +658,8 @@ def explain(
     *,
     iters: int = 3,
     measure: bool = True,
+    device_timing: bool | None = None,
+    allgather: bool = False,
     mads: float = DEFAULT_MADS,
     min_rel: float = DEFAULT_MIN_REL,
     min_samples: int = DEFAULT_MIN_SAMPLES,
@@ -447,10 +672,26 @@ def explain(
     ``measure=False`` skips every execution (model + compiled views
     only — safe on a backend whose dispatch is known-sick); ``iters``
     warm passes feed the measured samples (>= ``min_samples`` for
-    divergence verdicts). Never raises on analysis gaps: sections the
-    environment cannot produce carry ``available: False`` / None values
-    so the record shape is stable for the report CLI and the run-record
-    store."""
+    divergence verdicts).
+
+    ``device_timing`` (default: env ``DFFT_DEVICE_TIMING``) swaps the
+    host sync-bracket samples for a ``jax.profiler``-backed device
+    timeline attribution (:func:`device_stage_samples`): the measured
+    seconds are then what the device spent inside each stage span,
+    per-chunk ``t2[k]``/``t3[k]`` rows included under overlap-K. The
+    attempt falls back to host brackets — with the reason in
+    ``record["timing"]`` — wherever the environment cannot produce
+    device lanes (the CPU test backend always falls back).
+
+    ``allgather=True`` additionally merges every process's measured
+    stage medians into min/median/max-across-hosts rows
+    (``record["across_hosts"]``; :func:`across_hosts_stages`) so
+    stragglers are visible. Collective: in a multi-process job every
+    process must make the same call.
+
+    Never raises on analysis gaps: sections the environment cannot
+    produce carry ``available: False`` / None values so the record
+    shape is stable for the report CLI and the run-record store."""
     from .api import alloc_local
 
     hw = device_profile()
@@ -494,8 +735,14 @@ def explain(
     record["compiled"] = dict(whole) if whole else None
 
     # Per-stage compiled + measured via the staged pipelines.
+    if device_timing is None:
+        device_timing = os.environ.get(
+            "DFFT_DEVICE_TIMING", "") not in ("", "0")
+    timing: dict[str, Any] = {"source": "host",
+                              "device_requested": bool(device_timing)}
     samples: dict[str, list[float]] = {}
     stage_compiled: dict[str, dict] = {}
+    chunk_rows: dict[str, dict] = {}
     staged_available = False
     if measure and x is not None and not plan.options.donate:
         stages = _staged_for(plan)
@@ -505,7 +752,18 @@ def explain(
                 staged_available = True
             except Exception:  # noqa: BLE001 — sick dispatch, keep going
                 samples, stage_compiled = {}, {}
+            if staged_available and device_timing:
+                dev, reason = device_stage_samples(stages, x, iters)
+                if dev is not None:
+                    samples = {k: v for k, v in dev["samples"].items()
+                               if k in STAGE_KEYS}
+                    chunk_rows = dev["chunks"]
+                    timing["source"] = "device"
+                    timing["device_pids"] = dev["device_pids"]
+                else:
+                    timing["fallback_reason"] = reason
     record["staged_available"] = staged_available
+    record["timing"] = timing
 
     peak_flops = hw["peak_tflops"] * 1e12
     wire_bps = hw["wire_gbps"] * 1e9
@@ -537,6 +795,13 @@ def explain(
             wire = m.get("wire_bytes", 0.0)
             entry["ici_utilization"] = (
                 wire / (med * wire_bps) if med and wire else None)
+        if chunk_rows:
+            # Per-chunk device attribution (overlap-K): the raw
+            # t2_...[k]/t3_...[k] span rows whose key this stage owns.
+            mine = {n: c for n, c in chunk_rows.items()
+                    if stage_key(n) == key}
+            if mine:
+                entry["chunks"] = mine
         stages_out[key] = entry
         if div.get("diverged"):
             diverged.append(key)
@@ -551,6 +816,13 @@ def explain(
                                    if any(meds) else None),
     }
     record["divergence"] = {"any": bool(diverged), "stages": diverged}
+    if allgather:
+        try:
+            record["across_hosts"] = across_hosts_stages(
+                {k: stages_out[k]["measured"]["seconds"]
+                 for k in STAGE_KEYS})
+        except Exception:  # noqa: BLE001 — a single-controller runtime
+            record["across_hosts"] = None  # without allgather support
     return record
 
 
@@ -586,6 +858,13 @@ def format_explain(record: dict) -> str:
         f"ici {hw.get('wire_gbps')} GB/s, peak {hw.get('peak_tflops')} "
         f"TFlop/s; {hw.get('source')} profile)",
     ]
+    timing = record.get("timing") or {}
+    if timing.get("source") == "device":
+        lines.append("timing: device timeline (jax.profiler capture)")
+    elif timing.get("device_requested"):
+        lines.append(
+            f"timing: host sync brackets (device capture fell back: "
+            f"{timing.get('fallback_reason', 'unavailable')})")
     header = (f"{'stage':<6} {'model(s)':>11} {'measured(s)':>12} "
               f"{'flops':>11} {'peakHBM(MB)':>12} {'MFU':>7} "
               f"{'ICI':>7}  divergence")
@@ -627,6 +906,20 @@ def format_explain(record: dict) -> str:
             f" | compile {_fmt(whole.get('compile_seconds'), 's')} s")
     else:
         lines.append("compiled (whole plan): unavailable")
+    ah = record.get("across_hosts")
+    if isinstance(ah, dict) and ah.get("stages"):
+        lines.append(f"across {ah.get('processes')} host process(es) "
+                     f"(measured seconds, min/median/max):")
+        for key in STAGE_KEYS:
+            row = ah["stages"].get(key)
+            if not row:
+                continue
+            strag = row.get("straggler_ratio")
+            lines.append(
+                f"  {key:<4} {_fmt(row['min'], 's')} / "
+                f"{_fmt(row['median'], 's')} / {_fmt(row['max'], 's')}"
+                + (f"  (straggler {strag:.2f}x)"
+                   if strag and strag > 1.2 else ""))
     d = record.get("divergence") or {}
     if d.get("any"):
         lines.append(

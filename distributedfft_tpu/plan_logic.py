@@ -733,9 +733,17 @@ def model_stage_seconds(
     launch_seconds: float,
     algorithm: str | None = None,
     overlap_chunks: int | None = None,
+    exchange_correction: float = 1.0,
 ) -> dict:
     """Per-stage analytical prediction of one execution, keyed exactly
     ``t0..t3`` — the model side of the explain/attribution join.
+
+    ``exchange_correction`` scales every exchange's modeled seconds (not
+    its byte accounting): the persisted per-(device_kind, transport)
+    measured/model ratio of the calibrated hardware profile
+    (:func:`..calibrate.model_correction`), so a transport the ideal
+    wire model consistently underprices on this fabric is predicted —
+    and divergence-gated — at its observed cost.
 
     FFT stages are the HBM-stream roofline (each axis pass reads and
     writes the per-device block once — the 3-pass bound of
@@ -807,10 +815,10 @@ def model_stage_seconds(
             wire, e["parts"], alg, wire_gbps=wire_gbps,
             launch_seconds=launch_seconds, overlap_chunks=k,
             hide_seconds=hide.get(e["stage"], 0.0))
-        t2["seconds"] += m["exposed_seconds"]
+        t2["seconds"] += m["exposed_seconds"] * exchange_correction
         t2["wire_bytes"] += wire
         t2.setdefault("raw_seconds", 0.0)
-        t2["raw_seconds"] += m["seconds"]
+        t2["raw_seconds"] += m["seconds"] * exchange_correction
         t2.setdefault("steps", 0)
         t2["steps"] += m["steps"]
     return out

@@ -225,8 +225,12 @@ def normalize_bench_line(
     # heuristic / single-transform baseline or vice versa — they compile
     # different programs (the tuned tuple may even move between
     # re-tunes, which the label then keys into separate baselines).
+    # "profile" is the hardware-profile source ("calibrated" — stamped
+    # by bench.py only when a calibrated profile was live, so that
+    # calibrated-model runs and default-constant runs never share a
+    # baseline; default rows keep the old schema AND the old groups).
     for k in ("dtype", "devices", "decomposition", "overlap", "tuned",
-              "batch"):
+              "batch", "profile"):
         if obj.get(k) is not None:
             config[k] = obj[k]
     ex: dict = {}

@@ -11,6 +11,8 @@ arguments)::
     python -m distributedfft_tpu.report wisdom --gate
     python -m distributedfft_tpu.report explain [--json]
     python -m distributedfft_tpu.report explain --plan 256,256,256 -n 8
+    python -m distributedfft_tpu.report explain --trend [--config SUBSTR]
+    python -m distributedfft_tpu.report calibrate
 
 **merge** — the trace tool. The reference writes one trace log per MPI
 rank and leaves correlation to the reader (``heffte_trace.h:98-118``);
@@ -40,7 +42,19 @@ model/compiled/measured join with MFU, ICI utilization, and divergence
 flags. Reads the explain block of a history record (newest by default,
 ``--record FILE`` for an artifact, a bare ``--json`` dump of a prior
 explain also parses), or builds and explains a LIVE plan with
-``--plan NX,NY,NZ`` (imports jax; every plan knob has a flag).
+``--plan NX,NY,NZ`` (imports jax; every plan knob has a flag —
+``--device-timing`` attributes stages from the jax.profiler device
+timeline, ``--allgather`` merges per-host stage medians).
+``--trend`` instead tabulates the model-vs-measured trajectory across
+every history record carrying an explain block (``--config SUBSTR``
+narrows to one baseline group) — the calibration-quality view.
+
+**calibrate** — measure this machine's hardware profile (HBM/ICI/matmul
+microbenchmarks; :mod:`.calibrate`) and persist it next to the wisdom
+store so ``dfft.explain`` divergence-gates against measured constants
+(``hw.source == "calibrated"``) and the tuner's pruning model applies
+persisted per-transport corrections (docs/OBSERVABILITY.md
+"Calibration").
 
 **record / history / compare** — the regression-tracking loop over the
 append-only run-record store (``benchmarks/results/history.jsonl``; see
@@ -607,10 +621,93 @@ def _explain_live(args) -> dict | int:
     try:
         plan = plan_fn(shape, ndev if ndev > 1 else None, **kw)
         return dfft.explain(plan, iters=args.iters,
-                            measure=not args.no_measure)
+                            measure=not args.no_measure,
+                            device_timing=args.device_timing or None,
+                            allgather=args.allgather)
     except Exception as e:  # noqa: BLE001 — CLI boundary
         print(f"report explain: {type(e).__name__}: {e}", file=sys.stderr)
         return 2
+
+
+def _explain_trend(args) -> int:
+    """``report explain --trend``: the model-quality trajectory. One row
+    per history record carrying an explain block (oldest first), with
+    per-stage measured seconds and the measured/model ratios — is the
+    model's t2 prediction converging on reality (calibration working)
+    or drifting (stale profile, changed fabric)?"""
+    from .explain import explain_from_record
+
+    keys = ("t0", "t1", "t2", "t3")
+    history = _resolve_history(args)
+    records, dropped = (regress.load_history(history) if history
+                        else ([], 0))
+    if args.record:
+        try:
+            with open(args.record) as f:
+                extra, _ = regress.records_from_artifact(
+                    f.read(), source=args.record)
+        except OSError as e:
+            print(f"report explain: {e}", file=sys.stderr)
+            return 2
+        records = records + extra
+    if dropped:
+        print(f"report explain: skipped {dropped} malformed line(s) in "
+              f"{history}", file=sys.stderr)
+    rows: list[dict] = []
+    for rec in records:
+        blk = explain_from_record(rec)
+        if blk is None:
+            continue
+        cfg = regress.config_signature(rec) if rec is not blk else ""
+        if args.config and args.config not in cfg:
+            continue
+        stages = blk.get("stages") or {}
+        totals = blk.get("totals") or {}
+        row: dict = {
+            "recorded_at": rec.get("recorded_at")
+            or blk.get("generated_at"),
+            "config": cfg,
+            "hw_source": (blk.get("hw") or {}).get("source"),
+            "model_seconds": totals.get("model_seconds"),
+            "measured_seconds": totals.get("measured_stage_seconds"),
+            "diverged": (blk.get("divergence") or {}).get("stages") or [],
+        }
+        for k in keys:
+            st = stages.get(k) or {}
+            row[k] = (st.get("measured") or {}).get("seconds")
+            if k == "t2":
+                m2 = (st.get("model") or {}).get("seconds")
+                row["t2_ratio"] = (row[k] / m2 if row[k] and m2 else None)
+        ms, mod = row["measured_seconds"], row["model_seconds"]
+        row["ratio"] = (ms / mod) if ms and mod else None
+        rows.append(row)
+    if not rows:
+        print(f"report explain: no explain block matches "
+              f"({history or 'store disabled'}"
+              + (f", config~{args.config!r}" if args.config else "") + ")",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(rows, sort_keys=True))
+        return 0
+
+    def s(v):
+        return "-" if v is None else f"{v:.6f}"
+
+    def r(v):
+        return "-" if v is None else f"{v:.2f}x"
+
+    print(f"{'recorded_at':<19}  {'t0(s)':>10} {'t1(s)':>10} "
+          f"{'t2(s)':>10} {'t3(s)':>10}  {'meas/model':>10} "
+          f"{'t2 ratio':>9}  {'hw':<10}  diverged")
+    for row in rows:
+        print(f"{str(row['recorded_at'] or '-'):<19}  "
+              f"{s(row['t0']):>10} {s(row['t1']):>10} {s(row['t2']):>10} "
+              f"{s(row['t3']):>10}  {r(row['ratio']):>10} "
+              f"{r(row['t2_ratio']):>9}  "
+              f"{str(row['hw_source'] or '-'):<10}  "
+              f"{','.join(row['diverged']) or '-'}")
+    return 0
 
 
 def _main_explain(argv: list[str]) -> int:
@@ -649,12 +746,32 @@ def _main_explain(argv: list[str]) -> int:
     p.add_argument("--no-measure", action="store_true",
                    help="model + compiled views only; skip every "
                         "execution (for --plan)")
+    p.add_argument("--device-timing", action="store_true",
+                   help="attribute stage times from the jax.profiler "
+                        "device timeline for --plan (falls back to host "
+                        "brackets where no device lanes exist)")
+    p.add_argument("--allgather", action="store_true",
+                   help="merge per-process stage medians into "
+                        "min/median/max-across-hosts rows for --plan "
+                        "(collective: every process must run it)")
+    p.add_argument("--trend", action="store_true",
+                   help="tabulate model-vs-measured ratio and per-stage "
+                        "times across ALL history records carrying an "
+                        "explain block (newest last) instead of "
+                        "rendering one record; --config filters by the "
+                        "baseline config signature")
+    p.add_argument("--config", default=None, metavar="SUBSTR",
+                   help="with --trend: only records whose config "
+                        "signature contains this substring (e.g. "
+                        "'devices=8' or 'tuned=')")
     p.add_argument("--json", action="store_true",
                    help="machine-readable JSON instead of the table")
     args = p.parse_args(argv)
 
     from .explain import explain_from_record, format_explain
 
+    if args.trend:
+        return _explain_trend(args)
     if args.plan:
         rec = _explain_live(args)
         if isinstance(rec, int):
@@ -693,6 +810,59 @@ def _main_explain(argv: list[str]) -> int:
         print(json.dumps(rec, sort_keys=True))
     else:
         print(format_explain(rec))
+    return 0
+
+
+# ------------------------------------------------------- calibrate CLI
+
+def _main_calibrate(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m distributedfft_tpu.report calibrate",
+        description="Measure this machine's hardware profile (HBM "
+                    "bandwidth, ICI link bandwidth, matmul peak, launch "
+                    "floor) with short microbenchmarks and persist it "
+                    "next to the wisdom store, so dfft.explain computes "
+                    "divergence against measured constants "
+                    "(hw.source == 'calibrated') and the tuner's pruning "
+                    "model reads persisted per-transport corrections. "
+                    "Exit codes: 0 ok, 2 backend/IO error.")
+    p.add_argument("--out", default=None, metavar="PATH",
+                   help="profile file (default: DFFT_HW_PROFILE or "
+                        "<compile cache dir>/hwprofile.json)")
+    p.add_argument("--iters", type=int, default=10,
+                   help="amortized timing iterations per microbenchmark "
+                        "(default 10)")
+    p.add_argument("--no-wire", action="store_true",
+                   help="skip the multi-device ICI/link microbenchmark")
+    p.add_argument("--dry-run", action="store_true",
+                   help="measure and print, write nothing")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable JSON instead of the summary")
+    args = p.parse_args(argv)
+
+    from . import calibrate as _cal
+
+    try:
+        prof = _cal.calibrate(iters=max(1, args.iters),
+                              wire=not args.no_wire)
+    except Exception as e:  # noqa: BLE001 — CLI boundary (sick backend)
+        print(f"report calibrate: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+    path = None
+    if not args.dry_run:
+        path = _cal.write_profile(prof, args.out)
+        if path is None:
+            print("report calibrate: profile store disabled "
+                  "(DFFT_HW_PROFILE is empty); use --out or --dry-run",
+                  file=sys.stderr)
+            return 2
+    if args.json:
+        print(json.dumps({"profile": prof, "path": path}, sort_keys=True))
+    else:
+        print(_cal.format_profile(prof))
+        print(f"profile written to {path}" if path
+              else "(dry run: nothing written)")
     return 0
 
 
@@ -844,6 +1014,7 @@ _SUBCOMMANDS = {
     "compare": _main_compare,
     "wisdom": _main_wisdom,
     "explain": _main_explain,
+    "calibrate": _main_calibrate,
 }
 
 

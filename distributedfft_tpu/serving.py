@@ -30,11 +30,28 @@ bumps ``serving_transforms`` in the metrics registry; bench.py stamps
 ``transforms_per_s`` into its result lines and the regress gate treats
 ``*_per_s`` as larger-is-better (docs/OBSERVABILITY.md "Batched serving
 & throughput").
+
+**Flight recorder** (docs/OBSERVABILITY.md "Flight recorder"): with
+tracing enabled (``DFFT_TRACE=1`` / ``init_tracing``) every request is
+assigned a process-unique id and its full lifecycle lands in the trace
+timeline next to the chain builders' t0..t3 stage spans —
+``serve_submit[<id>]`` (the enqueue), ``serve_wait[<id>]`` (enqueue ->
+flush, recorded retroactively via :func:`..utils.trace.record_span`),
+``serve_flush[<kind>:b<B>:<reason>]`` wrapping each group's
+``serve_plan``/``serve_execute``, and ``serve_result[<id>]`` (the
+caller's await). Metrics grow ``serving_queue_depth`` (gauge),
+``serving_wait_seconds`` (histogram), and ``serving_flush_reasons``
+(counter; reason = ``full`` | ``manual`` | ``result``). With tracing
+AND metrics disabled every hook is a flag check — the queue's
+execution behavior is byte-identical either way.
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
+import time
+from contextlib import nullcontext
 from typing import Any
 
 import jax
@@ -43,8 +60,19 @@ import jax.numpy as jnp
 from .local import FORWARD
 from .ops.executors import Scale
 from .utils import metrics as _metrics
+from .utils.trace import add_trace, record_span, tracing_enabled
 
 __all__ = ["Handle", "submit", "CoalescingQueue", "warm_pool"]
+
+#: Process-global request ids — the correlation key of one request's
+#: submit/wait/result spans across threads (the MPI-tag role).
+_REQ_IDS = itertools.count(1)
+
+
+def _span(name: str, on: bool):
+    """A live trace span when the recorder is on, else a no-op context —
+    the disabled path must not even construct the annotation object."""
+    return add_trace(name) if on else nullcontext()
 
 
 class Handle:
@@ -56,13 +84,20 @@ class Handle:
     pending until its group flushes (``result()`` triggers the flush
     when the caller outruns the coalescer)."""
 
-    __slots__ = ("_value", "_error", "_event", "_queue")
+    __slots__ = ("_value", "_error", "_event", "_queue", "_req_id",
+                 "_enqueued")
 
     def __init__(self, queue: "CoalescingQueue | None" = None):
         self._value: Any = None
         self._error: BaseException | None = None
         self._event = threading.Event()
         self._queue = queue
+        # Flight-recorder fields: the request id of this handle's spans
+        # and its enqueue timestamp (perf_counter) — both None when
+        # tracing/metrics were off at submit, so the disabled path pays
+        # nothing and records nothing.
+        self._req_id: int | None = None
+        self._enqueued: float | None = None
 
     @classmethod
     def _resolved(cls, value) -> "Handle":
@@ -96,13 +131,16 @@ class Handle:
         """The transform output, blocking until it exists. A pending
         queue handle flushes its queue first (the caller demanding a
         result IS the coalescing deadline)."""
-        if not self._event.is_set() and self._queue is not None:
-            self._queue.flush()
-        if not self._event.wait(timeout):
-            raise TimeoutError("submitted transform still pending")
-        if self._error is not None:
-            raise self._error
-        return jax.block_until_ready(self._value)
+        rid = self._req_id
+        with _span(f"serve_result[{rid}]",
+                   rid is not None and tracing_enabled()):
+            if not self._event.is_set() and self._queue is not None:
+                self._queue.flush(reason="result")
+            if not self._event.wait(timeout):
+                raise TimeoutError("submitted transform still pending")
+            if self._error is not None:
+                raise self._error
+            return jax.block_until_ready(self._value)
 
 
 def submit(plan, x, *, scale: Scale = Scale.NONE) -> Handle:
@@ -119,7 +157,12 @@ def submit(plan, x, *, scale: Scale = Scale.NONE) -> Handle:
 
     if _metrics._enabled:
         _metrics.inc("serving_submits", kind="direct")
-    return Handle._resolved(execute(plan, x, scale=scale))
+    tracing = tracing_enabled()
+    rid = next(_REQ_IDS) if tracing else None
+    with _span(f"serve_submit[{rid}]", tracing):
+        h = Handle._resolved(execute(plan, x, scale=scale))
+    h._req_id = rid
+    return h
 
 
 class CoalescingQueue:
@@ -190,17 +233,29 @@ class CoalescingQueue:
         shape: the 3D world for c2c / forward r2c, the half-spectrum
         world for backward r2c). Returns immediately; the group executes
         at ``max_batch``, on :meth:`flush`, or on ``result()``."""
-        shape, dtype, x = self._coerce(x, direction)
-        key = (shape, dtype, direction)
-        handle = Handle(queue=self)
-        if _metrics._enabled:
-            _metrics.inc("serving_submits", kind=self.kind)
-        with self._lock:
-            group = self._pending.setdefault(key, [])
-            group.append((x, handle, scale))
-            full = len(group) >= self.max_batch
+        tracing = tracing_enabled()
+        recording = tracing or _metrics._enabled
+        rid = next(_REQ_IDS) if recording else None
+        with _span(f"serve_submit[{rid}]", tracing):
+            shape, dtype, x = self._coerce(x, direction)
+            key = (shape, dtype, direction)
+            handle = Handle(queue=self)
+            if recording:
+                handle._req_id = rid
+                handle._enqueued = time.perf_counter()
+            if _metrics._enabled:
+                _metrics.inc("serving_submits", kind=self.kind)
+            with self._lock:
+                group = self._pending.setdefault(key, [])
+                group.append((x, handle, scale))
+                full = len(group) >= self.max_batch
+                if _metrics._enabled:
+                    _metrics.set_gauge(
+                        "serving_queue_depth",
+                        float(sum(len(g) for g in self._pending.values())),
+                        kind=self.kind)
         if full:
-            self.flush(key)
+            self.flush(key, reason="full")
         return handle
 
     def _coerce(self, x, direction: int):
@@ -241,54 +296,94 @@ class CoalescingQueue:
         with self._lock:
             return sum(len(g) for g in self._pending.values())
 
-    def flush(self, key: tuple | None = None) -> int:
+    def flush(self, key: tuple | None = None, *,
+              reason: str = "manual") -> int:
         """Execute every pending group (or just ``key``'s) as batched
         programs; returns the number of transforms dispatched. Handles
-        resolve to async in-flight arrays (result() blocks on device)."""
+        resolve to async in-flight arrays (result() blocks on device).
+        ``reason`` tags the flight-recorder spans/metrics with what
+        triggered the flush: ``full`` (a group reached max_batch),
+        ``manual`` (this call), or ``result`` (a caller's await outran
+        the coalescer)."""
         done = 0
+        recording = tracing_enabled() or _metrics._enabled
+        flushed_at = time.perf_counter() if recording else 0.0
         with self._lock:
             keys = [key] if key is not None else list(self._pending)
             groups = [(k, self._pending.pop(k)) for k in keys
                       if self._pending.get(k)]
             for k, group in groups:
-                done += self._execute_group(k, group)
+                done += self._execute_group(k, group, reason=reason,
+                                            flushed_at=flushed_at)
+            if recording and _metrics._enabled and groups:
+                _metrics.set_gauge(
+                    "serving_queue_depth",
+                    float(sum(len(g) for g in self._pending.values())),
+                    kind=self.kind)
         return done
 
-    def _execute_group(self, key: tuple, group: list) -> int:
+    def _execute_group(self, key: tuple, group: list, *,
+                       reason: str = "manual",
+                       flushed_at: float = 0.0) -> int:
         b = len(group)
+        tracing = tracing_enabled()
+        tag = f"{self.kind}:b{b}:{reason}"
+        if tracing or _metrics._enabled:
+            # Close every request's queue-wait interval: enqueue ->
+            # flush. Retroactive (record_span) because only now is the
+            # wait's end — and the batch it coalesced into — known.
+            for _, handle, _ in group:
+                if handle._enqueued is None:
+                    continue
+                if tracing and handle._req_id is not None:
+                    record_span(f"serve_wait[{handle._req_id}]",
+                                handle._enqueued, flushed_at)
+                if _metrics._enabled:
+                    _metrics.observe(
+                        "serving_wait_seconds",
+                        max(0.0, flushed_at - handle._enqueued),
+                        kind=self.kind)
         try:
-            if b == 1:
-                x, handle, scale = group[0]
-                from .api import execute
+            with _span(f"serve_flush[{tag}]", tracing):
+                if b == 1:
+                    x, handle, scale = group[0]
+                    from .api import execute
 
-                handle._set(execute(self._plan(key, None, False), x,
-                                    scale=scale))
-            else:
-                plan = self._plan(key, b, self.donate)
-                stacked = jnp.stack([x for x, _, _ in group])
-                from .api import _spec_divides
+                    with _span(f"serve_plan[{tag}]", tracing):
+                        plan = self._plan(key, None, False)
+                    with _span(f"serve_execute[{tag}]", tracing):
+                        handle._set(execute(plan, x, scale=scale))
+                else:
+                    with _span(f"serve_plan[{tag}]", tracing):
+                        plan = self._plan(key, b, self.donate)
+                    stacked = jnp.stack([x for x, _, _ in group])
+                    from .api import _spec_divides
 
-                if plan.in_sharding is not None and _spec_divides(
-                        plan.in_sharding.mesh, plan.in_sharding.spec,
-                        stacked.shape):
-                    # Pre-place the stack on the plan's input layout;
-                    # uneven worlds let the chain's own pad/crop shard it
-                    # (the alloc_local rule).
-                    stacked = jax.device_put(stacked, plan.in_sharding)
-                y = plan(stacked)
-                for i, (_, handle, scale) in enumerate(group):
-                    out = y[i]
-                    if scale != Scale.NONE:
-                        from .ops.executors import apply_scale
+                    if plan.in_sharding is not None and _spec_divides(
+                            plan.in_sharding.mesh, plan.in_sharding.spec,
+                            stacked.shape):
+                        # Pre-place the stack on the plan's input layout;
+                        # uneven worlds let the chain's own pad/crop
+                        # shard it (the alloc_local rule).
+                        stacked = jax.device_put(stacked, plan.in_sharding)
+                    with _span(f"serve_execute[{tag}]", tracing):
+                        y = plan(stacked)
+                        for i, (_, handle, scale) in enumerate(group):
+                            out = y[i]
+                            if scale != Scale.NONE:
+                                from .ops.executors import apply_scale
 
-                        out = apply_scale(out, scale, plan.world_size)
-                    handle._set(out)
+                                out = apply_scale(out, scale,
+                                                  plan.world_size)
+                            handle._set(out)
         except Exception as e:  # noqa: BLE001 — fail the group's handles
             for _, handle, _ in group:
                 handle._fail(e)
             raise
         if _metrics._enabled:
             _metrics.inc("serving_flushes", kind=self.kind)
+            _metrics.inc("serving_flush_reasons", kind=self.kind,
+                         reason=reason)
             _metrics.inc("serving_transforms", float(b), kind=self.kind)
             _metrics.observe("serving_batch_size", float(b), kind=self.kind)
         return b

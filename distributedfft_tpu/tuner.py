@@ -203,6 +203,7 @@ def model_cost(
     *,
     itemsize: int = 8,
     batch: int | None = None,
+    corrected: bool = True,
 ) -> float:
     """Analytical seconds estimate of one candidate — the pruning model.
 
@@ -217,9 +218,23 @@ def model_cost(
     Used to *rank* candidates before any compile, never to pick a
     winner. ``batch=B`` prices the B-fold payload/compute of a batched
     serving plan (launch counts stay per-exchange — the batched win).
+
+    When a calibrated hardware profile stores a ``model_correction``
+    ratio for the candidate's transport (the persisted
+    ``tune_model_measured_ratio`` feedback of earlier tournaments on
+    this hardware — :mod:`..calibrate`), the exchange term is scaled by
+    it, so a transport the ideal model consistently underprices on this
+    fabric stops crowding better candidates out of the survivor set.
+    ``DFFT_TUNE_CORRECTION=0`` (or ``corrected=False`` — how the
+    divergence audit computes the *raw* ratio it persists, so the
+    feedback never compounds with itself) disables the scaling.
     """
+    from .calibrate import model_correction
     from .parallel.exchange import exchange_model_seconds
 
+    corr = 1.0
+    if corrected and os.environ.get("DFFT_TUNE_CORRECTION", "1") != "0":
+        corr = model_correction(cand.algorithm)
     shape = tuple(int(s) for s in shape)
     lp = logic_plan3d(shape, mesh, PlanOptions(
         decomposition=cand.decomposition, algorithm=cand.algorithm,
@@ -238,7 +253,7 @@ def model_cost(
             wire_gbps=MODEL_WIRE_GBPS,
             launch_seconds=MODEL_LAUNCH_SECONDS,
             overlap_chunks=cand.overlap_chunks,
-            hide_seconds=t_stage)["exposed_seconds"]
+            hide_seconds=t_stage)["exposed_seconds"] * corr
     return total
 
 
@@ -612,11 +627,15 @@ def _log_model_divergence(
     """Audit the pruning model against the tournament it pruned for:
     per candidate, the measured/predicted ratio goes into the
     ``tune_model_measured_ratio`` gauge (fuel for ``dfft.explain`` /
-    prune-quality analysis), and when the model's own favorite is not
-    the measured winner one stderr line names the disagreement — the
-    signal that the ranking constants are mis-ordering THIS
-    configuration's candidates. Best-effort: never fatal, never changes
-    the winner."""
+    prune-quality analysis), the per-transport median of the *raw*
+    (uncorrected) ratios is persisted into the hardware profile's
+    ``model_correction`` block (:func:`..calibrate
+    .update_model_correction`) so the NEXT pruning pass prices each
+    transport at its observed cost on this fabric, and when the model's
+    own favorite is not the measured winner one stderr line names the
+    disagreement — the signal that the ranking constants are
+    mis-ordering THIS configuration's candidates. Best-effort: never
+    fatal, never changes the winner."""
     try:
         model = {label: model_cost(c, shape, mesh, itemsize=itemsize,
                                    batch=batch)
@@ -628,6 +647,26 @@ def _log_model_divergence(
                                    times[label] / m, candidate=label)
         if not model:
             return
+        # Persist the raw measured/model ratio per transport (median
+        # across the transport's candidates): the feedback loop the
+        # calibrated profile carries and model_cost reads back.
+        try:
+            from .calibrate import update_model_correction
+            from .regress import robust_stats
+
+            raw: dict[str, list[float]] = {}
+            for label, c in by_label.items():
+                if label not in times or not math.isfinite(times[label]):
+                    continue
+                m0 = model_cost(c, shape, mesh, itemsize=itemsize,
+                                batch=batch, corrected=False)
+                if m0 > 0:
+                    raw.setdefault(c.algorithm, []).append(
+                        times[label] / m0)
+            update_model_correction(
+                {alg: robust_stats(v)[0] for alg, v in raw.items() if v})
+        except Exception:  # noqa: BLE001 — feedback is best-effort
+            pass
         model_pick = min(model, key=model.__getitem__)
         if model_pick != winner and model_pick in times:
             print(

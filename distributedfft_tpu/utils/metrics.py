@@ -34,6 +34,21 @@ Tuner series (wired in :mod:`..tuner`):
   candidate) — per-candidate plan-build/compile and timing cost, also
   emitted as ``tune_build_*``/``tune_measure_*`` trace spans.
 
+Serving / flight-recorder series (wired in :mod:`..serving`; see
+docs/OBSERVABILITY.md "Flight recorder"):
+
+- ``serving_submits`` / ``serving_flushes`` / ``serving_transforms``
+  (counter; kind) — request intake and group execution.
+- ``serving_flush_reasons`` (counter; kind/reason) — what triggered
+  each flush: ``full`` (a group reached max_batch), ``manual``
+  (an explicit ``flush()``), ``result`` (a caller's await outran the
+  coalescer — the batch-size-vs-latency tell).
+- ``serving_queue_depth`` (gauge; kind) — pending requests after every
+  submit/flush.
+- ``serving_wait_seconds`` (histogram; kind) — per-request
+  enqueue-to-flush latency, the queue-wait of the request spans.
+- ``serving_batch_size`` (histogram; kind) — transforms per flush.
+
 Disabled-path discipline: everything is gated on one module-level flag
 (the ``tracing_enabled()`` pattern of :mod:`.trace`) — with metrics off
 (the default) every hook is a single attribute check and early return,

@@ -194,6 +194,26 @@ def add_trace(name: str):
             ev.append((name, start, time.perf_counter()))
 
 
+def record_span(name: str, start: float, stop: float) -> bool:
+    """Record one ALREADY-COMPLETED event with explicit
+    ``time.perf_counter()`` endpoints — the retroactive counterpart of
+    :func:`add_trace` for spans whose start crossed a function boundary
+    before anyone knew the span would exist (the serving tier's
+    per-request queue-wait: the wait begins at ``submit`` but is only
+    attributable when the flush fires). Returns True when the event was
+    captured.
+
+    Python recorder only: the C recorder's begin/end API cannot take
+    explicit timestamps, and a retroactive span can by definition not
+    annotate the XLA profiler timeline — callers that need the native
+    path wrap live code in :func:`add_trace` instead."""
+    ev = _events
+    if ev is None:
+        return False
+    ev.append((name, float(start), float(stop)))
+    return True
+
+
 @contextmanager
 def timed_span(name: str):
     """:func:`add_trace` plus wall-clock capture: yields a dict whose

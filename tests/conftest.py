@@ -10,6 +10,12 @@ meaningful; the real-TPU benchmark path runs complex64 (TPU has no C128).
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# The calibrated hardware profile is machine-local mutable state (and
+# every measured tournament persists model-correction ratios into it);
+# reading a developer's real profile — or writing into it — would make
+# model-ranking tests nondeterministic across machines. Disabled here;
+# the profile tests point DFFT_HW_PROFILE at their own tmp files.
+os.environ.setdefault("DFFT_HW_PROFILE", "0")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

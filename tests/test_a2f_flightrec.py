@@ -1,0 +1,163 @@
+"""Flight recorder — the execution tier (8-device CPU-mesh runs).
+
+The acceptance path of the end-to-end flight recorder: a coalesced
+serving run with tracing enabled produces request spans
+(submit/wait/flush/execute/result) that round-trip through ``report
+merge`` into ONE Chrome/Perfetto timeline alongside the chain builders'
+t0..t3 stage spans; ``dfft.explain`` falls back cleanly from the
+device-timeline capture on CPU and produces across-hosts rows under
+``allgather=True``. Pure-python flight-recorder tests (trace parser,
+calibration store, trend CLI) live in ``tests/test_explain.py`` and
+``tests/test_serving.py``.
+
+NOTE on the filename: this module must collect BEFORE
+``test_alltoallv.py`` — the environment's pre-existing XLA:CPU
+fft-thunk layout bug poisons the process's sharded dispatch stream for
+every later 8-device test, and the executions here need a clean
+backend. Same ordering rule as ``test_a2a_overlap.py`` /
+``test_a2c_tuner.py`` / ``test_a2d_explain.py`` / ``test_a2e_batch.py``;
+the guard in ``test_explain.py::test_poison_ordering_guard`` asserts
+the names keep sorting this way.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu import report
+from distributedfft_tpu.utils import metrics as _m
+from distributedfft_tpu.utils import trace as tr
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
+
+SHAPE = (8, 8, 8)
+CDT = jnp.complex128
+
+
+def _world(seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal(SHAPE) + 1j * rng.standard_normal(SHAPE)
+
+
+@pytest.fixture
+def recorder(tmp_path):
+    """A chrome trace session + enabled metrics, torn down clean."""
+    tr.init_tracing(str(tmp_path / "frec"), format="chrome")
+    dfft.enable_metrics()
+    _m.metrics_reset()
+    yield tmp_path
+    if tr.tracing_enabled():
+        tr.finalize_tracing()
+    _m.metrics_reset()
+    dfft.enable_metrics(False)
+
+
+def test_request_spans_merge_with_stage_spans(recorder):
+    """THE acceptance criterion: one coalesced queue run -> request
+    spans and t0..t3 stage spans in the same merged Perfetto trace."""
+    mesh = dfft.make_mesh(8)
+    ref = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT)
+    q = dfft.CoalescingQueue(mesh, max_batch=8, dtype=CDT)
+    xs = [_world(s) for s in (1, 2, 3)]
+    hs = [q.submit(jnp.asarray(v)) for v in xs]
+    assert q.flush() == 3
+    for v, h in zip(xs, hs):
+        assert np.array_equal(np.asarray(h.result()),
+                              np.asarray(ref(jnp.asarray(v))))
+    path = tr.finalize_tracing()
+    events = report.load_events(path)
+    names = {e["name"] for e in events}
+    # Request lifecycle spans, with ids and the batch/reason tags.
+    assert any(n.startswith("serve_submit[") for n in names)
+    assert sum(n.startswith("serve_wait[") for n in names) == 3
+    assert "serve_flush[c2c:b3:manual]" in names
+    assert "serve_plan[c2c:b3:manual]" in names
+    assert "serve_execute[c2c:b3:manual]" in names
+    # ... on the same timeline as the chain's stage spans.
+    stage_keys = {tr.stage_key(n) for n in names} - {None}
+    assert {"t0", "t2", "t3"} <= stage_keys
+    # The wait interval closes before its group's flush span ends.
+    flush = next(e for e in events
+                 if e["name"] == "serve_flush[c2c:b3:manual]")
+    for e in events:
+        if e["name"].startswith("serve_wait["):
+            assert e["ts"] + e["dur"] <= flush["ts"] + flush["dur"] + 1e3
+    # Round-trip: the merged chrome artifact re-loads with every span.
+    merged = str(recorder / "merged.json")
+    report.write_chrome(events, merged)
+    again = {e["name"] for e in report.load_events(merged)}
+    assert names == again
+    # Metrics side of the recorder.
+    snap = dfft.metrics_snapshot()
+    assert snap["counters"]["serving_flush_reasons"][
+        "kind=c2c,reason=manual"] == 1.0
+    assert snap["histograms"]["serving_wait_seconds"][
+        "kind=c2c"]["count"] == 3
+    assert snap["gauges"]["serving_queue_depth"]["kind=c2c"] == 0.0
+
+
+def test_auto_flush_reason_and_result_reason(recorder):
+    mesh = dfft.make_mesh(8)
+    q = dfft.CoalescingQueue(mesh, max_batch=2, dtype=CDT)
+    h1 = q.submit(jnp.asarray(_world(11)))
+    q.submit(jnp.asarray(_world(12)))  # hits max_batch -> reason "full"
+    h1.result()
+    h3 = q.submit(jnp.asarray(_world(13)))
+    h3.result()                        # await outruns -> reason "result"
+    reasons = dfft.metrics_snapshot()["counters"]["serving_flush_reasons"]
+    assert reasons["kind=c2c,reason=full"] == 1.0
+    assert reasons["kind=c2c,reason=result"] == 1.0
+    path = tr.finalize_tracing()
+    names = {e["name"] for e in report.load_events(path)}
+    assert "serve_flush[c2c:b2:full]" in names
+    assert "serve_flush[c2c:b1:result]" in names
+    assert any(n.startswith("serve_result[") for n in names)
+
+
+def test_queue_behavior_identical_with_recorder_off():
+    """The disabled path: no tracing, no metrics -> no ids, no
+    timestamps, and the exact same results (mesh tier)."""
+    assert not tr.tracing_enabled() and not _m.metrics_enabled()
+    mesh = dfft.make_mesh(8)
+    ref = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT)
+    q = dfft.CoalescingQueue(mesh, max_batch=8, dtype=CDT)
+    xs = [_world(s) for s in (21, 22, 23)]
+    hs = [q.submit(jnp.asarray(v)) for v in xs]
+    assert all(h._req_id is None and h._enqueued is None for h in hs)
+    assert q.flush() == 3
+    for v, h in zip(xs, hs):
+        assert np.array_equal(np.asarray(h.result()),
+                              np.asarray(ref(jnp.asarray(v))))
+    assert dfft.metrics_snapshot()["counters"] == {}
+
+
+def test_explain_device_timing_falls_back_cleanly_on_cpu():
+    """DFFT_DEVICE_TIMING on the CPU backend: the capture attempt runs,
+    finds no device lanes, and the record says so — host samples and
+    divergence machinery intact (the acceptance fallback path)."""
+    plan = dfft.plan_dft_c2c_3d(SHAPE, dfft.make_mesh(8), dtype=CDT)
+    rec = dfft.explain(plan, iters=2, device_timing=True)
+    assert rec["timing"]["device_requested"] is True
+    assert rec["timing"]["source"] == "host"
+    assert rec["timing"]["fallback_reason"]
+    for key in ("t0", "t2", "t3"):
+        assert rec["stages"][key]["measured"]["available"]
+    # JSON-serializable end to end (run records embed it verbatim).
+    json.dumps(rec)
+
+
+def test_explain_allgather_single_process_rows():
+    plan = dfft.plan_dft_c2c_3d(SHAPE, dfft.make_mesh(8), dtype=CDT)
+    rec = dfft.explain(plan, iters=2, allgather=True)
+    ah = rec["across_hosts"]
+    assert ah["processes"] == 1
+    for key in ("t0", "t2", "t3"):
+        row = ah["stages"][key]
+        assert row["n"] == 1
+        assert row["min"] == row["median"] == row["max"] > 0

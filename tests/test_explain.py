@@ -15,6 +15,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 from distributedfft_tpu import regress
 from distributedfft_tpu.explain import (
     EXPLAIN_SCHEMA,
@@ -248,6 +250,253 @@ def test_report_history_config_filter(tmp_path):
     assert len(json.loads(out2.stdout)) == 2
 
 
+# -------------------------------------------- device-trace attribution
+
+def _device_trace_doc(device=True, passes=2):
+    """A synthetic XLA-profiler chrome document: one host lane, one
+    device lane (optional), with ``passes`` passes of t0/t2 (t2 split
+    into two overlap chunks) on the device lane and host-side noise."""
+    evs = [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "X", "pid": 1, "tid": 1, "name": "t0_fft_yz",
+         "ts": 0.0, "dur": 9999.0},  # host bracket: must be ignored
+    ]
+    if device:
+        evs.append({"ph": "M", "pid": 7, "name": "process_name",
+                    "args": {"name": "/device:TPU:0"}})
+        t = 1000.0
+        for _ in range(passes):
+            evs.append({"ph": "X", "pid": 7, "tid": 0,
+                        "name": "t0_fft_yz", "ts": t, "dur": 100.0})
+            for k in range(2):
+                evs.append({"ph": "X", "pid": 7, "tid": 0,
+                            "name": f"t2_exchange[{k}]",
+                            "ts": t + 200 + 50 * k, "dur": 40.0})
+            evs.append({"ph": "X", "pid": 7, "tid": 0,
+                        "name": "fusion.123", "ts": t + 400,
+                        "dur": 10.0})  # unnamed device op: ignored
+            t += 1000.0
+    return {"traceEvents": evs}
+
+
+def test_parse_device_trace_attributes_from_device_lane():
+    from distributedfft_tpu.explain import parse_device_trace
+
+    parsed = parse_device_trace(_device_trace_doc(), iters=2)
+    assert parsed["device_pids"] == [7]
+    # Two passes -> two per-pass samples; t2 sums its two chunks.
+    assert parsed["samples"]["t0"] == [pytest.approx(100e-6)] * 2
+    assert parsed["samples"]["t2"] == [pytest.approx(80e-6)] * 2
+    # The host lane's 9999us t0 bracket never leaks into the samples.
+    assert all(s < 1e-3 for s in parsed["samples"]["t0"])
+    # Per-chunk rows keep their raw overlap-K names.
+    assert parsed["chunks"]["t2_exchange[0]"]["count"] == 2
+    assert parsed["chunks"]["t2_exchange[1]"]["seconds"] == \
+        pytest.approx(80e-6)
+
+
+def test_parse_device_trace_none_without_device_lanes():
+    """The CPU backend's case: host lanes only -> None -> the explain
+    layer falls back to sync brackets."""
+    from distributedfft_tpu.explain import parse_device_trace
+
+    assert parse_device_trace(_device_trace_doc(device=False)) is None
+    assert parse_device_trace({"traceEvents": "garbage"}) is None
+
+
+def test_parse_device_trace_indivisible_count_aggregates():
+    from distributedfft_tpu.explain import parse_device_trace
+
+    doc = _device_trace_doc(passes=3)
+    parsed = parse_device_trace(doc, iters=2)  # 3 events % 2 != 0
+    assert parsed["samples"]["t0"] == [pytest.approx(150e-6)]
+
+
+# ------------------------------------------------- across-hosts merge
+
+def test_across_hosts_stages_flags_straggler(monkeypatch):
+    import numpy as np
+
+    # NOTE: `from distributedfft_tpu import explain` would resolve the
+    # package attribute — the FUNCTION. The module travels under the
+    # stable `explain_mod` alias (the PR 5 name-collision fix).
+    import distributedfft_tpu as dfft
+
+    expl = dfft.explain_mod
+    assert not callable(expl) or hasattr(expl, "across_hosts_stages")
+
+    def fake_rows(vec):
+        # Three processes; process 2's t2 is 3x the others'.
+        rows = np.tile(vec, (3, 1))
+        rows[2, 2] *= 3.0
+        return rows
+
+    monkeypatch.setattr(expl, "_allgather_rows", fake_rows)
+    out = expl.across_hosts_stages(
+        {"t0": 0.001, "t1": None, "t2": 0.002, "t3": 0.001})
+    assert out["processes"] == 3
+    assert "t1" not in out["stages"]  # NaN column: no row
+    t2 = out["stages"]["t2"]
+    assert t2["n"] == 3 and t2["max"] == pytest.approx(0.006)
+    assert t2["straggler_ratio"] == pytest.approx(3.0)
+    assert out["stages"]["t0"]["straggler_ratio"] == pytest.approx(1.0)
+
+
+# ------------------------------------------------- calibrated profiles
+
+def test_profile_round_trip_and_identity_match(tmp_path, monkeypatch):
+    from distributedfft_tpu import calibrate as cal
+
+    path = str(tmp_path / "hwprofile.json")
+    monkeypatch.setenv("DFFT_HW_PROFILE", path)
+    assert cal.load_profile() is None
+    kind, platform = cal._current_identity()
+    cal.write_profile({"schema": cal.PROFILE_SCHEMA, "device_kind": kind,
+                       "platform": platform, "hbm_gbps": 123.0,
+                       "recorded_at": "2026-08-04T00:00:00"})
+    assert cal.matching_profile()["hbm_gbps"] == 123.0
+    # A foreign chip's profile never matches this machine.
+    cal.write_profile({"schema": cal.PROFILE_SCHEMA,
+                       "device_kind": "TPU v9", "platform": platform,
+                       "hbm_gbps": 999.0})
+    assert cal.load_profile() is not None
+    assert cal.matching_profile() is None
+
+
+def test_device_profile_reports_calibrated_source(tmp_path, monkeypatch):
+    """The acceptance check: a matching profile flips hw.source to
+    'calibrated' with per-field override + fallback."""
+    from distributedfft_tpu import calibrate as cal
+    from distributedfft_tpu.explain import device_profile
+
+    monkeypatch.setenv("DFFT_HW_PROFILE", str(tmp_path / "p.json"))
+    base = device_profile()
+    assert base["source"] in ("default", "table")
+    kind, platform = cal._current_identity()
+    cal.write_profile({"schema": cal.PROFILE_SCHEMA, "device_kind": kind,
+                       "platform": platform, "hbm_gbps": 55.5,
+                       "wire_gbps": None,
+                       "recorded_at": "2026-08-04T00:00:00"})
+    hw = device_profile()
+    assert hw["source"] == "calibrated"
+    assert hw["hbm_gbps"] == 55.5
+    assert hw["calibrated_at"] == "2026-08-04T00:00:00"
+    # Unmeasured wire falls back to the uncalibrated constant.
+    assert hw["wire_gbps"] == base["wire_gbps"]
+    # Disabled store: back to the uncalibrated source.
+    monkeypatch.setenv("DFFT_HW_PROFILE", "0")
+    assert device_profile()["source"] == base["source"]
+
+
+def test_model_correction_blend_and_clamp(tmp_path, monkeypatch):
+    from distributedfft_tpu import calibrate as cal
+
+    monkeypatch.setenv("DFFT_HW_PROFILE", str(tmp_path / "p.json"))
+    assert cal.model_correction("alltoall") == 1.0
+    cal.update_model_correction({"alltoall": 2.0, "ppermute": 1e9,
+                                 "bogus": -1.0})
+    assert cal.model_correction("alltoall") == 2.0
+    assert cal.model_correction("ppermute") == 10.0  # clamped
+    assert cal.model_correction("alltoallv") == 1.0  # unstored
+    # New ratios blend 50/50 with the stored value.
+    cal.update_model_correction({"alltoall": 4.0})
+    assert cal.model_correction("alltoall") == 3.0
+    # A correction-only stub never claims a calibrated source.
+    from distributedfft_tpu.explain import device_profile
+
+    assert device_profile()["source"] != "calibrated"
+
+
+def test_exchange_correction_scales_model_t2_only():
+    from distributedfft_tpu.plan_logic import (
+        PlanOptions, logic_plan3d, model_stage_seconds,
+    )
+
+    lp = logic_plan3d((32, 32, 32), 8, PlanOptions(tune="off"))
+    kw = dict(hbm_gbps=800.0, wire_gbps=45.0, launch_seconds=1e-4)
+    base = model_stage_seconds(lp, (32, 32, 32), 16, **kw)
+    corr = model_stage_seconds(lp, (32, 32, 32), 16,
+                               exchange_correction=2.0, **kw)
+    assert corr["t2"]["seconds"] == pytest.approx(
+        2.0 * base["t2"]["seconds"])
+    assert corr["t2"]["wire_bytes"] == base["t2"]["wire_bytes"]
+    for k in ("t0", "t1", "t3"):
+        assert corr[k]["seconds"] == base[k]["seconds"]
+
+
+def test_tuner_model_cost_reads_persisted_correction(tmp_path,
+                                                     monkeypatch):
+    from distributedfft_tpu import calibrate as cal
+    from distributedfft_tpu.tuner import Candidate, model_cost
+
+    monkeypatch.setenv("DFFT_HW_PROFILE", str(tmp_path / "p.json"))
+    cand = Candidate("slab", "alltoall", "xla", 1)
+    base = model_cost(cand, (32, 32, 32), 8)
+    cal.update_model_correction({"alltoall": 5.0})
+    boosted = model_cost(cand, (32, 32, 32), 8)
+    assert boosted > base
+    # corrected=False (the audit's raw view) and the env opt-out both
+    # ignore the stored factor.
+    assert model_cost(cand, (32, 32, 32), 8,
+                      corrected=False) == pytest.approx(base)
+    monkeypatch.setenv("DFFT_TUNE_CORRECTION", "0")
+    assert model_cost(cand, (32, 32, 32), 8) == pytest.approx(base)
+
+
+def test_report_calibrate_writes_consumable_profile(tmp_path):
+    """The acceptance CLI path: calibrate writes a profile the same
+    machine's device_profile() consumes as 'calibrated'."""
+    path = str(tmp_path / "hwprofile.json")
+    env = {**CPU_ENV, "DFFT_HW_PROFILE": path}
+    out = _report("calibrate", "--iters", "1", "--json", env=env)
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout)
+    assert doc["path"] == path
+    assert doc["profile"]["hbm_gbps"] > 0
+    probe = subprocess.run(
+        [sys.executable, "-c",
+         "from distributedfft_tpu.explain import device_profile; "
+         "print(device_profile()['source'])"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=240)
+    assert probe.stdout.strip() == "calibrated", probe.stderr
+
+
+# ------------------------------------------------------ profile in records
+
+def test_normalize_bench_line_keys_profile_into_config():
+    line = {"metric": "m", "value": 5.0, "backend": "tpu",
+            "profile": "calibrated"}
+    rec = regress.normalize_bench_line(line, source="t")
+    assert rec["config"]["profile"] == "calibrated"
+    plain = regress.normalize_bench_line(
+        {"metric": "m", "value": 5.0, "backend": "tpu"}, source="t")
+    # Calibrated and default-profile runs never share a baseline group;
+    # default rows keep the pre-calibration group key.
+    assert regress.group_key(rec) != regress.group_key(plain)
+    assert "profile" not in plain["config"]
+
+
+# ------------------------------------------------------------ trend CLI
+
+def test_report_explain_trend_tabulates_history():
+    out = _report("explain", "--trend", "--history", FIXTURE, "--json")
+    assert out.returncode == 0, out.stderr
+    rows = json.loads(out.stdout)
+    assert len(rows) >= 1
+    row = rows[-1]
+    assert row["t2"] > 0 and row["t2_ratio"] > 0
+    assert row["ratio"] > 0 and row["hw_source"]
+    # Table mode renders the same rows.
+    tbl = _report("explain", "--trend", "--history", FIXTURE)
+    assert tbl.returncode == 0 and "meas/model" in tbl.stdout
+    # A config filter that matches nothing errors cleanly.
+    miss = _report("explain", "--trend", "--history", FIXTURE,
+                   "--config", "devices=31415")
+    assert miss.returncode == 2
+    assert "no explain block matches" in miss.stderr
+
+
 # ----------------------------------------------- collection-order guard
 
 def test_poison_ordering_guard():
@@ -260,7 +509,8 @@ def test_poison_ordering_guard():
                    if n.startswith("test_") and n.endswith(".py"))
     poison = names.index("test_alltoallv.py")
     for early in ("test_a2a_overlap.py", "test_a2c_tuner.py",
-                  "test_a2d_explain.py", "test_a2e_batch.py"):
+                  "test_a2d_explain.py", "test_a2e_batch.py",
+                  "test_a2f_flightrec.py"):
         assert early in names, early
         assert names.index(early) < poison, (
             f"{early} must collect before test_alltoallv.py")

@@ -132,6 +132,98 @@ def test_queue_warm_preplans():
     assert plan.batch == 4
 
 
+# --------------------------------------------------------- flight recorder
+
+def test_disabled_recorder_is_zero_overhead_and_byte_identical():
+    """Satellite acceptance: with DFFT_TRACE unset and metrics off, the
+    queue stamps no ids/timestamps, records nothing, and produces the
+    exact same results as an instrumented run would."""
+    from distributedfft_tpu.utils import metrics as _m
+    from distributedfft_tpu.utils import trace as tr
+
+    assert not tr.tracing_enabled()
+    _m.enable_metrics(False)
+    _m.metrics_reset()
+    q = dfft.CoalescingQueue(None, dtype=CDT, max_batch=8)
+    xs = [_world(s) for s in (31, 32)]
+    hs = [q.submit(jnp.asarray(v)) for v in xs]
+    for h in hs:
+        assert h._req_id is None and h._enqueued is None
+    assert q.flush(reason="manual") == 2
+    ref = dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT)
+    for v, h in zip(xs, hs):
+        assert np.array_equal(np.asarray(h.result()),
+                              np.asarray(ref(jnp.asarray(v))))
+    snap = dfft.metrics_snapshot()
+    assert snap["counters"] == {} and snap["histograms"] == {}
+    assert snap["gauges"] == {}
+    # Direct submits too.
+    h = dfft.submit(ref, jnp.asarray(xs[0]))
+    assert h._req_id is None
+    assert dfft.metrics_snapshot()["counters"] == {}
+
+
+def test_metrics_only_run_records_depth_wait_and_reason():
+    """Metrics without tracing: the gauge/histogram/reason series fill,
+    no trace session is ever opened."""
+    from distributedfft_tpu.utils import metrics as _m
+    from distributedfft_tpu.utils import trace as tr
+
+    assert not tr.tracing_enabled()
+    dfft.enable_metrics()
+    _m.metrics_reset()
+    try:
+        q = dfft.CoalescingQueue(None, dtype=CDT, max_batch=2)
+        h1 = q.submit(jnp.asarray(_world(41)))
+        snap = dfft.metrics_snapshot()
+        assert snap["gauges"]["serving_queue_depth"]["kind=c2c"] == 1.0
+        q.submit(jnp.asarray(_world(42)))  # auto-flush at max_batch
+        h1.result()
+        h3 = q.submit(jnp.asarray(_world(43)))
+        h3.result()  # lazy flush
+        snap = dfft.metrics_snapshot()
+        reasons = snap["counters"]["serving_flush_reasons"]
+        assert reasons["kind=c2c,reason=full"] == 1.0
+        assert reasons["kind=c2c,reason=result"] == 1.0
+        assert snap["histograms"]["serving_wait_seconds"][
+            "kind=c2c"]["count"] == 3
+        assert snap["gauges"]["serving_queue_depth"]["kind=c2c"] == 0.0
+        # The pre-existing series kept their label shape.
+        assert snap["counters"]["serving_flushes"]["kind=c2c"] == 2.0
+        assert not tr.tracing_enabled()
+    finally:
+        _m.metrics_reset()
+        dfft.enable_metrics(False)
+
+
+def test_request_spans_round_trip_single_device(tmp_path):
+    """Tracing without metrics: submit/wait/flush/execute/result spans
+    land in the chrome log and parse back via the report machinery."""
+    from distributedfft_tpu import report
+    from distributedfft_tpu.utils import trace as tr
+
+    tr.init_tracing(str(tmp_path / "srv"), format="chrome")
+    try:
+        q = dfft.CoalescingQueue(None, dtype=CDT, max_batch=8)
+        hs = [q.submit(jnp.asarray(_world(s))) for s in (51, 52)]
+        q.flush()
+        for h in hs:
+            h.result()
+            assert h._req_id is not None
+    finally:
+        path = tr.finalize_tracing()
+    names = [e["name"] for e in report.load_events(path)]
+    assert sum(n.startswith("serve_submit[") for n in names) == 2
+    assert sum(n.startswith("serve_wait[") for n in names) == 2
+    assert "serve_flush[c2c:b2:manual]" in names
+    assert "serve_plan[c2c:b2:manual]" in names
+    assert "serve_execute[c2c:b2:manual]" in names
+    assert sum(n.startswith("serve_result[") for n in names) == 2
+    # ids are unique per request.
+    waits = {n for n in names if n.startswith("serve_wait[")}
+    assert len(waits) == 2
+
+
 # -------------------------------------------------------------- warm pool
 
 def _wisdom_entry(recorded_at, shape=SHAPE, batch=None, ndev=1):

@@ -96,6 +96,29 @@ def test_trace_format_rejects_unknown():
     assert not tr.tracing_enabled()
 
 
+def test_record_span_explicit_timestamps(tmp_path, monkeypatch):
+    """record_span injects an already-completed interval (the serving
+    tier's retroactive queue-wait spans): captured in a Python-recorder
+    session with the given endpoints, a no-op (False) when tracing is
+    off."""
+    assert not tr.tracing_enabled()
+    assert tr.record_span("too_late", 0.0, 1.0) is False
+    monkeypatch.setenv("DFFT_TRACE_FORMAT", "chrome")
+    root = str(tmp_path / "rs")
+    tr.init_tracing(root)
+    import time
+
+    t1 = time.perf_counter()
+    assert tr.record_span("retro_wait", t1 - 0.25, t1) is True
+    path = tr.finalize_tracing()
+    with open(path) as f:
+        evs = [e for e in json.load(f)["traceEvents"]
+               if e["name"] == "retro_wait"]
+    begin, end = sorted(evs, key=lambda e: e["ph"] != "B")
+    assert (end["ts"] - begin["ts"]) / 1e6 == pytest.approx(0.25,
+                                                            rel=1e-3)
+
+
 def test_csv_recorder(tmp_path):
     path = str(tmp_path / "out" / "bench.csv")
     rec = tr.CsvRecorder(path, ("n", "time", "gflops"))
