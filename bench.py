@@ -277,9 +277,21 @@ def _plan_cost_block(plan) -> dict:
         return null
 
 
+def _plan_wire_kw(plan) -> dict:
+    """The wire/transport stamps of one plan's result line: the resolved
+    ``wire_dtype`` (DFFT_WIRE_DTYPE lands in the plan's options at plan
+    time) and the exchange transport — _emit drops the defaults so
+    exact/alltoall rows keep the old schema."""
+    opts = getattr(plan, "options", None)
+    return {
+        "wire_dtype": getattr(opts, "wire_dtype", None),
+        "transport": getattr(opts, "algorithm", None),
+    }
+
+
 def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
           all_times, donated=False, stages=None, overlap=None, tuned=None,
-          cost=None, batch=None):
+          cost=None, batch=None, wire_dtype=None, transport=None):
     import jax
 
     from distributedfft_tpu.utils.metrics import metrics_snapshot
@@ -330,6 +342,18 @@ def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
         # never share a compare baseline; untuned rows keep the old
         # schema.
         out["tuned"] = tuned
+    if wire_dtype is not None:
+        # On-wire compressed run (DFFT_WIRE_DTYPE resolved at plan time):
+        # part of the baseline group — a bf16-wire run ships half the t2
+        # bytes and must never be judged against exact-wire baselines or
+        # vice versa. Exact rows keep the old schema.
+        out["wire_dtype"] = wire_dtype
+    if transport not in (None, "alltoall"):
+        # Non-default exchange transport (alltoallv/ppermute/
+        # hierarchical): a different collective program — keyed into the
+        # baseline group like wire_dtype. Default alltoall rows keep the
+        # old schema.
+        out["transport"] = transport
     try:
         # Calibrated-hardware-profile stamp: when a measured profile
         # (report calibrate) drives the model/divergence constants, the
@@ -408,7 +432,8 @@ def _worker_tuned(shape_n, shape, mesh, dtype, n_dev, mode: str) -> None:
     _emit(shape_n, seconds, max_err, plan.executor, n_dev,
           plan.decomposition, {label: round(seconds, 6)},
           overlap=getattr(plan.options, "overlap_chunks", None),
-          tuned=label, cost=_plan_cost_block(plan))
+          tuned=label, cost=_plan_cost_block(plan),
+          **_plan_wire_kw(plan))
 
 
 def _worker_batched(shape_n, shape, mesh, dtype, n_dev, b: int) -> None:
@@ -460,7 +485,7 @@ def _worker_batched(shape_n, shape, mesh, dtype, n_dev, b: int) -> None:
     _emit(shape_n, seconds, max_err, executor, n_dev, plan.decomposition,
           {f"{executor}+b{b}": round(seconds, 6)},
           overlap=getattr(plan.options, "overlap_chunks", None),
-          batch=b, cost=_plan_cost_block(plan))
+          batch=b, cost=_plan_cost_block(plan), **_plan_wire_kw(plan))
 
 
 def _worker(shape_n: int) -> None:
@@ -547,7 +572,8 @@ def _worker(shape_n: int) -> None:
                   results[best][2].decomposition,
                   {e: r[0] for e, r in results.items()},
                   overlap=getattr(results[best][2].options,
-                                  "overlap_chunks", None))
+                                  "overlap_chunks", None),
+                  **_plan_wire_kw(results[best][2]))
 
     if not results:
         raise SystemExit("no benchmark executor succeeded")
@@ -561,7 +587,7 @@ def _worker(shape_n: int) -> None:
     # the tournament, so the insurance path never pays the AOT analysis.
     cost = _plan_cost_block(plan)
     _emit(shape_n, seconds, max_err, best, n_dev, plan.decomposition,
-          all_times, overlap=overlap, cost=cost)
+          all_times, overlap=overlap, cost=cost, **_plan_wire_kw(plan))
 
     # Donated execution of the winner — halves HBM traffic headroom and is
     # how the big-grid campaign runs (bufferDev ping-pong discipline).
@@ -572,7 +598,8 @@ def _worker(shape_n: int) -> None:
         if dsec < seconds:
             seconds, donated = dsec, True
         _emit(shape_n, seconds, max_err, best, n_dev, plan.decomposition,
-              all_times, donated=donated, overlap=overlap, cost=cost)
+              all_times, donated=donated, overlap=overlap, cost=cost,
+              **_plan_wire_kw(plan))
     except Exception:  # noqa: BLE001 — donation is a best-effort extra
         traceback.print_exc(limit=3, file=sys.stderr)
 
@@ -611,7 +638,7 @@ def _worker(shape_n: int) -> None:
     if stages:
         _emit(shape_n, seconds, max_err, best, n_dev, plan.decomposition,
               all_times, donated=donated, stages=stages, overlap=overlap,
-              cost=cost)
+              cost=cost, **_plan_wire_kw(plan))
 
 
 # ----------------------------------------------------------- orchestrator

@@ -83,6 +83,15 @@ def parse_args(argv):
                         "and stamped into the CSV row ('+tuned' algorithm "
                         "suffix), so tuned sweeps never mix with untuned "
                         "baselines")
+    p.add_argument("-wire", default=None, choices=("bf16", "none"),
+                   metavar="DTYPE",
+                   help="on-wire exchange compression: 'bf16' casts the "
+                        "t2 payload to (real, imag) bfloat16 pairs around "
+                        "each collective (half the wire bytes for c64), "
+                        "'none' pins the exact wire (overriding "
+                        "DFFT_WIRE_DTYPE). Stamped into the CSV "
+                        "algorithm column '<alg>+wbf16' so compressed "
+                        "sweep rows never mix with exact baselines")
     p.add_argument("-r2c_axis", type=int, default=2, choices=(0, 1, 2),
                    help="halved axis for r2c/c2r (heFFTe r2c_direction)")
     p.add_argument("-ndev", type=int, default=None, help="device count (default: all)")
@@ -201,11 +210,18 @@ def main(argv=None) -> None:
         if args.a2av or args.p2p_pl:
             raise SystemExit("-tune searches the transport axis; do not pin "
                              "one with -a2av/-p2p_pl")
+        if args.wire is not None:
+            raise SystemExit("-tune owns the wire axis (compressed "
+                             "candidates enter only under a plan error "
+                             "budget); do not pin one with -wire")
     if args.explain:
         if args.bricks or args.precision == "dd":
             raise SystemExit("-explain applies to the c2c/r2c chain "
                              "planners; brick and dd plans do not take it")
         args.metrics = True  # the attribution join reads the registry
+    if args.wire is not None and (args.bricks or args.precision == "dd"):
+        raise SystemExit("-wire applies to the c2c/r2c chain planners; "
+                         "brick and dd plans do not take it")
     if args.batch is not None:
         if args.batch < 1:
             raise SystemExit(f"-batch must be >= 1, got {args.batch}")
@@ -292,6 +308,8 @@ def main(argv=None) -> None:
         kw["batch"] = args.batch
     if args.overlap is not None:
         kw["overlap_chunks"] = args.overlap
+    if args.wire is not None:
+        kw["wire_dtype"] = args.wire
     if args.tune is not None:
         kw["tune"] = args.tune
     if args.kind == "r2c" and args.r2c_axis != 2:
@@ -336,6 +354,10 @@ def main(argv=None) -> None:
     # Resolved overlap chunk count (env/"auto" -> int at plan time) — the
     # staged builders and the CSV row must describe the same schedule.
     overlap = getattr(fwd.options, "overlap_chunks", None) or 1
+    # Resolved wire mode likewise (DFFT_WIRE_DTYPE lands in the plan's
+    # options): the staged breakdown must ship the same wire bytes as
+    # the timed plan.
+    wiredt = getattr(fwd.options, "wire_dtype", None)
 
     # On-device deterministic init (the reference inits on device too,
     # fftSpeed3d_c2c.cpp:61-72). Sharding hints need divisible extents;
@@ -430,7 +452,7 @@ def main(argv=None) -> None:
             stages, _ = build_slab_stages(
                 fwd.mesh, shape, axis_name=fwd.mesh.axis_names[0],
                 executor=args.executor, algorithm=algorithm,
-                overlap_chunks=overlap, batch=bsz,
+                overlap_chunks=overlap, batch=bsz, wire_dtype=wiredt,
             )
         elif fwd.decomposition == "slab":
             from distributedfft_tpu.parallel.staged import build_slab_rfft_stages
@@ -438,7 +460,7 @@ def main(argv=None) -> None:
             stages, _ = build_slab_rfft_stages(
                 fwd.mesh, shape, axis_name=fwd.mesh.axis_names[0],
                 executor=args.executor, algorithm=algorithm,
-                overlap_chunks=overlap, batch=bsz,
+                overlap_chunks=overlap, batch=bsz, wire_dtype=wiredt,
             )
         elif args.kind == "c2c":
             from distributedfft_tpu.parallel.staged import build_pencil_stages
@@ -509,7 +531,9 @@ def main(argv=None) -> None:
         # unchanged for default rows).
         kind = (f"r2c_axis{args.r2c_axis}"
                 if args.kind == "r2c" and args.r2c_axis != 2 else args.kind)
-        alg_label = _algorithm_label(algorithm, overlap, batch=bsz)
+        alg_label = _algorithm_label(
+            algorithm, overlap, batch=bsz,
+            wire=getattr(fwd.options, "wire_dtype", None))
         if tuned_lbl is not None:
             # Tuned rows must never be indistinguishable from rows that
             # pinned the same knobs by hand (the tuple can move between
@@ -556,18 +580,22 @@ def _t2_ratio(exp_rec) -> str:
 
 
 def _algorithm_label(algorithm: str, overlap: int | None,
-                     batch: int | None = None) -> str:
+                     batch: int | None = None,
+                     wire: str | None = None) -> str:
     """Algorithm column label with the overlap chunk count
-    (``alltoall+ov4``) and/or coalesced batch size (``alltoall+b8``)
-    appended — overlapped/batched sweep rows must never be
-    indistinguishable from monolithic single-transform baselines (the
-    regress store keys the label into the baseline config group).
-    Default (K=1, unbatched) rows keep the bare name (schema
+    (``alltoall+ov4``), coalesced batch size (``alltoall+b8``), and/or
+    on-wire compression (``alltoall+wbf16``) appended — overlapped /
+    batched / compressed sweep rows must never be indistinguishable
+    from monolithic exact single-transform baselines (the regress store
+    keys the label into the baseline config group). Default (K=1,
+    unbatched, exact-wire) rows keep the bare name (schema
     unchanged)."""
     label = (f"{algorithm}+ov{overlap}"
              if overlap and overlap != 1 else algorithm)
     if batch and batch > 1:
         label += f"+b{batch}"
+    if wire:
+        label += f"+w{wire}"
     return label
 
 

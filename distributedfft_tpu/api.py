@@ -169,11 +169,14 @@ def _resolve_options(
     options: PlanOptions | None,
     overlap_chunks: int | str | None = None,
     tune: str | None = None,
+    wire_dtype: str | None = None,
+    max_roundtrip_err: float | None = None,
 ) -> PlanOptions:
     if options is not None:
         if (decomposition is not None or executor != "xla" or donate
                 or algorithm != "alltoall" or overlap_chunks is not None
-                or tune is not None):
+                or tune is not None or wire_dtype is not None
+                or max_roundtrip_err is not None):
             raise ValueError(
                 "pass either options= or individual plan keywords, not both"
             )
@@ -185,6 +188,8 @@ def _resolve_options(
         donate=donate,
         overlap_chunks=overlap_chunks,
         tune=tune,
+        wire_dtype=wire_dtype,
+        max_roundtrip_err=max_roundtrip_err,
     )
 
 
@@ -214,6 +219,14 @@ def _norm_batch(batch) -> int | None:
     plan instead of a [1, ...] program."""
     batch = check_batch(batch)
     return None if batch == 1 else batch
+
+
+def _slab_axis_name(mesh: Mesh):
+    """The slab chain's mesh-axis spec: the single 1D axis name, or the
+    (dcn, ici) tuple of a hierarchical plan's hybrid mesh (the combined
+    axis in row-major linearization)."""
+    names = mesh.axis_names
+    return names[0] if len(names) == 1 else tuple(names)
 
 
 def _shardings(lp: LogicPlan, spec, batch: int | None = None):
@@ -346,6 +359,8 @@ def plan_dft_c2c_3d(
     algorithm: str = "alltoall",
     overlap_chunks: int | str | None = None,
     tune: str | None = None,
+    wire_dtype: str | None = None,
+    max_roundtrip_err: float | None = None,
     options: PlanOptions | None = None,
     in_spec: P | None = None,
     out_spec: P | None = None,
@@ -395,6 +410,16 @@ def plan_dft_c2c_3d(
     tier (:mod:`.serving`). ``batch=1``/``None`` is the unbatched plan
     (byte-identical HLO). Batched plans are plan-cache- and wisdom-keyed
     by B; ``in_spec``/``out_spec`` layouts take the unbatched path only.
+
+    ``wire_dtype="bf16"`` compresses the t2 exchange payload on the wire
+    (bf16 component pairs cast immediately before each collective and
+    back after — half the wire bytes for c64 at a bounded precision
+    cost; ``None`` defers to ``DFFT_WIRE_DTYPE``, unset = exact wire,
+    byte-identical HLO). ``algorithm="hierarchical"`` runs the two-leg
+    ICI/DCN transport over a hybrid 2D (dcn x ici) mesh
+    (:func:`~.parallel.exchange.hierarchical_all_to_all`).
+    ``max_roundtrip_err`` declares the plan's error budget — the gate
+    under which the tuner may pick (or replay) compressed candidates.
     """
     shape, forward = _check_direction(shape, direction)
     batch = _norm_batch(batch)
@@ -402,7 +427,8 @@ def plan_dft_c2c_3d(
         raise ValueError("batched plans take the canonical chain layouts; "
                          "in_spec/out_spec require batch=None (or 1)")
     opts = _resolve_options(decomposition, executor, donate, algorithm,
-                            options, overlap_chunks, tune)
+                            options, overlap_chunks, tune, wire_dtype,
+                            max_roundtrip_err)
     if resolve_tune_mode(opts.tune) != "off":
         from . import tuner
 
@@ -432,11 +458,12 @@ def plan_dft_c2c_3d(
         spec = None
     elif lp.decomposition == "slab":
         fn, spec = build_slab_fft3d(
-            lp.mesh, shape, axis_name=lp.mesh.axis_names[0],
+            lp.mesh, shape, axis_name=_slab_axis_name(lp.mesh),
             executor=opts.executor, forward=forward, donate=opts.donate,
             algorithm=opts.algorithm,
             in_axis=lp.slab_axes[0], out_axis=lp.slab_axes[1],
             overlap_chunks=lp.options.overlap_chunks, batch=batch,
+            wire_dtype=lp.options.wire_dtype,
         )
     else:
         row, col = lp.mesh.axis_names[:2]
@@ -446,6 +473,7 @@ def plan_dft_c2c_3d(
             algorithm=opts.algorithm,
             perm=lp.pencil_perm, order=lp.pencil_order,
             overlap_chunks=lp.options.overlap_chunks, batch=batch,
+            wire_dtype=lp.options.wire_dtype,
         )
 
     in_sh, out_sh = _shardings(lp, spec, batch)
@@ -829,6 +857,8 @@ def plan_dft_r2c_3d(
     algorithm: str = "alltoall",
     overlap_chunks: int | str | None = None,
     tune: str | None = None,
+    wire_dtype: str | None = None,
+    max_roundtrip_err: float | None = None,
     options: PlanOptions | None = None,
     in_spec: P | None = None,
     out_spec: P | None = None,
@@ -863,15 +893,17 @@ def plan_dft_r2c_3d(
             shape, mesh, r2c_axis, direction=direction,
             decomposition=decomposition, executor=executor, dtype=dtype,
             donate=donate, algorithm=algorithm,
-            overlap_chunks=overlap_chunks, tune=tune, options=options,
-            in_spec=in_spec, out_spec=out_spec,
+            overlap_chunks=overlap_chunks, tune=tune,
+            wire_dtype=wire_dtype, max_roundtrip_err=max_roundtrip_err,
+            options=options, in_spec=in_spec, out_spec=out_spec,
         )
     if batch is not None and (in_spec is not None or out_spec is not None):
         raise ValueError("batched plans take the canonical chain layouts; "
                          "in_spec/out_spec require batch=None (or 1)")
     shape, forward = _check_direction(shape, direction)
     opts = _resolve_options(decomposition, executor, donate, algorithm,
-                            options, overlap_chunks, tune)
+                            options, overlap_chunks, tune, wire_dtype,
+                            max_roundtrip_err)
     if resolve_tune_mode(opts.tune) != "off":
         from . import tuner
 
@@ -894,6 +926,10 @@ def plan_dft_r2c_3d(
             direction=direction, dtype=dtype, in_spec=in_spec,
             out_spec=out_spec, batch=batch,
         )
+    if opts.algorithm == "hierarchical":
+        raise ValueError(
+            "hierarchical transport supports the c2c chains; r2c/c2r "
+            "plans run the flat transports")
     dtype = _default_cdtype(dtype)
     if not jnp.issubdtype(dtype, jnp.complexfloating):
         raise ValueError(
@@ -925,6 +961,7 @@ def plan_dft_r2c_3d(
             executor=opts.executor, forward=forward, donate=opts.donate,
             algorithm=opts.algorithm,
             overlap_chunks=lp.options.overlap_chunks, batch=batch,
+            wire_dtype=lp.options.wire_dtype,
         )
     else:
         row, col = lp.mesh.axis_names[:2]
@@ -933,6 +970,7 @@ def plan_dft_r2c_3d(
             executor=opts.executor, forward=forward, donate=opts.donate,
             algorithm=opts.algorithm,
             overlap_chunks=lp.options.overlap_chunks, batch=batch,
+            wire_dtype=lp.options.wire_dtype,
         )
 
     if (in_spec is not None or out_spec is not None) and lp.mesh is None:
@@ -1001,7 +1039,8 @@ def _chain_convention_note(e: Exception, axis: int) -> ValueError:
 
 def _r2c_axis_wrapped(shape, mesh, axis: int, *, direction, decomposition,
                       executor, dtype, donate, algorithm, options, in_spec,
-                      out_spec, overlap_chunks=None, tune=None) -> Plan3D:
+                      out_spec, overlap_chunks=None, tune=None,
+                      wire_dtype=None, max_roundtrip_err=None) -> Plan3D:
     """r2c/c2r with the halved axis != 2 (heFFTe ``r2c_direction`` 0/1):
     the canonical chain (real axis = 2) runs on a transposed view.
     Caller-facing metadata — shapes, shardings, boxes — is permuted back
@@ -1020,6 +1059,7 @@ def _r2c_axis_wrapped(shape, mesh, axis: int, *, direction, decomposition,
             pshape, mesh, direction=direction, decomposition=decomposition,
             executor=executor, dtype=dtype, donate=donate,
             algorithm=algorithm, overlap_chunks=overlap_chunks, tune=tune,
+            wire_dtype=wire_dtype, max_roundtrip_err=max_roundtrip_err,
             options=options,
             in_spec=_permute_spec3(in_spec, perm),
             out_spec=_permute_spec3(out_spec, perm),
@@ -1407,7 +1447,8 @@ _PLAN_CACHE_MAX = 128  # plans hold compiled executables; bound the HBM/host
 _PLAN_ENV_KNOBS = (
     "DFFT_AUTO_EXECUTORS", "DFFT_MM_PRECISION", "DFFT_MM_COMPLEX",
     "DFFT_MM_SPLIT", "DFFT_MM_DIRECT_MAX", "DFFT_DD_DEPTH",
-    "DFFT_PALLAS_PACK", "DFFT_PALLAS_SPLIT", "DFFT_XLA_REAL",
+    "DFFT_PALLAS_PACK", "DFFT_PALLAS_SPLIT", "DFFT_PALLAS_TILE",
+    "DFFT_PALLAS_TILE2D", "DFFT_PALLAS_TILE_STRIDED", "DFFT_XLA_REAL",
     "DFFT_FORCE_REAL_LOWERING", "DFFT_OVERLAP",
     # Tuned planning: mode, wisdom store, budget, and survivor cap all
     # change what a tuned planner call would build/measure — as do the
@@ -1415,6 +1456,10 @@ _PLAN_ENV_KNOBS = (
     # pruning model's ranking).
     "DFFT_TUNE", "DFFT_WISDOM", "DFFT_TUNE_ITERS", "DFFT_TUNE_MAX",
     "DFFT_HW_PROFILE", "DFFT_TUNE_CORRECTION",
+    # On-wire exchange compression: the default of PlanOptions.wire_dtype
+    # resolves from the env at plan time, so two calls under different
+    # wire modes compile different collective programs.
+    "DFFT_WIRE_DTYPE",
 )
 
 
@@ -1509,7 +1554,9 @@ def _plan_exchange_bytes(plan: Plan3D) -> tuple[int, int]:
         wire_key = WIRE_BYTE_KEYS[plan.options.algorithm]
         for e in exchange_payloads(lp, shape_eff, itemsize):
             true_b += e["true_bytes"]
-            wire_b += e[wire_key]
+            # wire_factor scales for on-wire compression (bf16 pairs
+            # halve c64 wire bytes); 1.0 on the exact wire.
+            wire_b += int(e[wire_key] * e.get("wire_factor", 1.0))
     if plan.brick_edges is not None:
         itemsize = np.dtype(plan.dtype).itemsize
         for bs in plan.brick_edges:

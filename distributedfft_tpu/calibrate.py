@@ -250,38 +250,70 @@ def _measure_peak_tflops(iters: int) -> float | None:
     return (2.0 * _MM_N ** 3 / t) / 1e12 if t > 0 else None
 
 
-def _measure_wire_gbps(iters: int) -> float | None:
-    """Per-link bandwidth: a one-hop ``ppermute`` ring shift — every
-    device ships its whole block to its neighbor, so per-device wire
-    bytes = block bytes and seconds are one link's serialization time.
-    None on a single device (nothing to measure)."""
+def _measure_axis_gbps(iters: int, mesh, axis_name: str) -> float | None:
+    """Per-link bandwidth along ONE mesh axis: a one-hop ``ppermute``
+    ring shift on that axis — every device ships its whole block to its
+    axis-neighbor, so per-device wire bytes = block bytes and seconds
+    are one link's serialization time. None when the axis has a single
+    member (nothing to measure)."""
     import jax
     import jax.numpy as jnp
     from jax.experimental.shard_map import shard_map
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from .utils.timing import time_fn_amortized
 
-    devs = jax.devices()
-    ndev = len(devs)
-    if ndev < 2:
+    parts = int(mesh.shape[axis_name])
+    if parts < 2:
         return None
     n = _WIRE_BYTES // 4
-    mesh = Mesh(devs, ("d",))
-    x = jax.device_put(jnp.zeros((ndev, n), jnp.float32),
-                       NamedSharding(mesh, P("d", None)))
+    spec = P(axis_name, None)
+    x = jax.device_put(jnp.zeros((parts, n), jnp.float32),
+                       NamedSharding(mesh, spec))
 
     @jax.jit
     def shift(v):
         def body(blk):
-            perm = [(i, (i + 1) % ndev) for i in range(ndev)]
-            return jax.lax.ppermute(blk, "d", perm)
+            perm = [(i, (i + 1) % parts) for i in range(parts)]
+            return jax.lax.ppermute(blk, axis_name, perm)
 
-        return shard_map(body, mesh=mesh, in_specs=P("d", None),
-                         out_specs=P("d", None))(v)
+        return shard_map(body, mesh=mesh, in_specs=spec,
+                         out_specs=spec)(v)
 
     t, _ = time_fn_amortized(shift, x, iters=iters, repeats=2)
     return (_WIRE_BYTES / t) / 1e9 if t > 0 else None
+
+
+def _measure_wire_gbps(iters: int) -> float | None:
+    """The flat (whole-mesh) per-link figure: one ring over every
+    device. None on a single device."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        return None
+    return _measure_axis_gbps(iters, Mesh(devs, ("d",)), "d")
+
+
+def _measure_leg_gbps(iters: int) -> tuple[float | None, float | None]:
+    """Per-leg ``(ici_gbps, dcn_gbps)`` for the hierarchical two-leg
+    exchange model. Multi-process (a real DCN boundary exists): each
+    figure is a ring shift along its own axis of the hybrid
+    (dcn x ici) mesh — the intra-slice ICI links and the inter-slice
+    DCN links measured separately. Single-process: every link is ICI,
+    so ``ici_gbps`` is the flat figure and the DCN entry is null (the
+    model then falls back to its DCN ranking constant)."""
+    import jax
+
+    if jax.process_count() < 2:
+        return _measure_wire_gbps(iters), None
+    from .parallel.multihost import make_hybrid_mesh
+
+    mesh = make_hybrid_mesh()
+    ici = _measure_axis_gbps(iters, mesh, mesh.axis_names[1])
+    dcn = _measure_axis_gbps(iters, mesh, mesh.axis_names[0])
+    return ici, dcn
 
 
 def _measure_launch_seconds(iters: int) -> float | None:
@@ -335,6 +367,23 @@ def calibrate(iters: int = 10, *, wire: bool = True) -> dict:
             prof[field] = fn()
         except Exception:  # noqa: BLE001 — one sick benchmark nulls its
             prof[field] = None  # field, never the whole calibration
+    # Per-leg link bandwidths for the hierarchical two-leg exchange
+    # model: multi-process jobs measure the intra-slice ICI axis and the
+    # inter-slice DCN axis separately (each leg priced on its own
+    # fabric); single-process, every link is ICI — the flat figure
+    # stands in and the DCN entry stays null (consumers fall back to
+    # the ranking constant).
+    try:
+        if not wire:
+            ici = dcn = None
+        elif jax.process_count() < 2:
+            ici, dcn = prof.get("wire_gbps"), None
+        else:
+            ici, dcn = _measure_leg_gbps(iters)
+    except Exception:  # noqa: BLE001
+        ici = dcn = None
+    prof["ici_gbps"] = ici
+    prof["dcn_gbps"] = dcn
     # Carry forward corrections an earlier tournament already persisted
     # for this hardware — calibration refreshes constants, it must not
     # amnesia the feedback loop.
@@ -358,6 +407,10 @@ def format_profile(prof: dict) -> str:
            else "  (single device: not measurable)"),
         f"matmul peak:    {num(prof.get('peak_tflops'), 'TFlop/s')}",
         f"launch floor:   {num(prof.get('launch_seconds'), 's')}",
+        f"ici leg:        {num(prof.get('ici_gbps'), 'GB/s')}",
+        f"dcn leg:        {num(prof.get('dcn_gbps'), 'GB/s')}"
+        + ("" if prof.get("dcn_gbps") is not None
+           else "  (single process: no DCN boundary)"),
     ]
     corr = prof.get("model_correction")
     if isinstance(corr, dict) and corr:

@@ -110,7 +110,8 @@ def device_profile() -> dict:
     readers can tell."""
     from .calibrate import matching_profile
     from .tuner import (
-        MODEL_HBM_GBPS, MODEL_LAUNCH_SECONDS, MODEL_WIRE_GBPS,
+        MODEL_DCN_GBPS, MODEL_HBM_GBPS, MODEL_LAUNCH_SECONDS,
+        MODEL_WIRE_GBPS,
     )
 
     kind, backend = "unknown", "unknown"
@@ -136,6 +137,11 @@ def device_profile() -> dict:
         "peak_tflops": peak_tf,
         "hbm_gbps": hbm,
         "wire_gbps": wire,
+        # DCN (inter-slice) leg bandwidth for the hierarchical/hybrid
+        # exchange model; the ranking default until a multi-process
+        # calibration measures the real figure (single-process
+        # calibrations store a null DCN entry).
+        "dcn_gbps": MODEL_DCN_GBPS,
         "launch_seconds": launch,
         "source": source,
     }
@@ -144,11 +150,18 @@ def device_profile() -> dict:
         # Per-field override: a single-device calibration cannot measure
         # wire bandwidth, so the table/default value stands in for the
         # fields the microbenchmarks could not produce.
-        for field in ("hbm_gbps", "wire_gbps", "peak_tflops",
+        for field in ("hbm_gbps", "wire_gbps", "dcn_gbps", "peak_tflops",
                       "launch_seconds"):
             v = cal.get(field)
             if isinstance(v, (int, float)) and v > 0:
                 out[field] = float(v)
+        # Per-leg ICI figure: a hybrid-mesh calibration measures the
+        # intra-slice axis on its own (calibrate._measure_leg_gbps); the
+        # exchange model prices ICI legs with wire_gbps, so the leg
+        # number wins over the flat whole-mesh ring figure.
+        ici = cal.get("ici_gbps")
+        if isinstance(ici, (int, float)) and ici > 0:
+            out["wire_gbps"] = float(ici)
         out["source"] = "calibrated"
         if cal.get("recorded_at"):
             out["calibrated_at"] = cal["recorded_at"]
@@ -191,6 +204,7 @@ def model_stage_estimates(plan, hw: dict | None = None) -> dict:
         lp, shape, itemsize,
         hbm_gbps=hw["hbm_gbps"], wire_gbps=hw["wire_gbps"],
         launch_seconds=hw["launch_seconds"],
+        dcn_gbps=hw.get("dcn_gbps"),
         algorithm=plan.options.algorithm,
         overlap_chunks=oc if isinstance(oc, int) else 1,
         exchange_correction=model_correction(plan.options.algorithm),
@@ -344,7 +358,8 @@ def _staged_for(plan):
             from .parallel.staged import build_single_stages
 
             return build_single_stages(plan.shape, **kw)
-        kw.update(algorithm=plan.options.algorithm, overlap_chunks=overlap)
+        kw.update(algorithm=plan.options.algorithm, overlap_chunks=overlap,
+                  wire_dtype=getattr(plan.options, "wire_dtype", None))
         if lp.decomposition == "slab":
             if plan.real:
                 from .parallel.staged import build_slab_rfft_stages
@@ -354,9 +369,13 @@ def _staged_for(plan):
                     axis_name=plan.mesh.axis_names[0], **kw)[0]
             from .parallel.slab import build_slab_stages
 
+            # Hierarchical slab plans run over the combined (dcn, ici)
+            # axis pair; the staged builder splits their t2 into per-leg
+            # t2a/t2b stages.
+            names = plan.mesh.axis_names
+            axis = names[0] if len(names) == 1 else tuple(names)
             return build_slab_stages(
-                plan.mesh, plan.shape,
-                axis_name=plan.mesh.axis_names[0], **kw)[0]
+                plan.mesh, plan.shape, axis_name=axis, **kw)[0]
         row, col = plan.mesh.axis_names[:2]
         if plan.real:
             from .parallel.staged import build_pencil_rfft_stages
@@ -372,13 +391,17 @@ def _staged_for(plan):
         return None
 
 
-def _measure_stages(stages, x, iters: int) -> tuple[dict, dict]:
+def _measure_stages(stages, x, iters: int) -> tuple[dict, dict, dict]:
     """Warm per-stage wall-clock samples: one compile/warmup pass, then
-    ``iters`` sync-bracketed passes. Returns ``(samples, compiled)``
-    where ``samples`` maps canonical stage key -> [seconds, ...] and
+    ``iters`` sync-bracketed passes. Returns ``(samples, compiled,
+    legs)`` where ``samples`` maps canonical stage key -> [seconds, ...],
     ``compiled`` maps stage key -> per-stage AOT analysis (summed over
-    a key's stages — the pencil chain has two t2 jits)."""
+    a key's stages — the pencil chain has two t2 jits), and ``legs``
+    maps the per-leg exchange sub-keys (``t2a``/``t2b`` — the pencil
+    chain's two exchanges, or the hierarchical transport's ICI/DCN legs)
+    to their own sample lists so the t2 row can attribute each leg."""
     samples: dict[str, list[float]] = {}
+    legs: dict[str, list[float]] = {}
     compiled: dict[str, dict] = {}
     for it in range(iters + 1):
         cur = x
@@ -406,6 +429,8 @@ def _measure_stages(stages, x, iters: int) -> tuple[dict, dict]:
             dt = time.perf_counter() - t0
             if it > 0:
                 samples.setdefault(key, []).append(dt)
+                if name[:3] in ("t2a", "t2b"):
+                    legs.setdefault(name[:3], []).append(dt)
     # A key emitted by two stages (pencil t2a/t2b) must report the SUM
     # of its per-pass stage times, not interleaved per-stage samples.
     per_pass: dict[str, list[float]] = {}
@@ -421,7 +446,7 @@ def _measure_stages(stages, x, iters: int) -> tuple[dict, dict]:
             # Pass j appended this key's n stage times consecutively.
             per_pass[key] = [sum(vals[j * n:(j + 1) * n])
                              for j in range(len(vals) // n)]
-    return per_pass, compiled
+    return per_pass, compiled, legs
 
 
 # -------------------------------------------------------- device timing
@@ -718,11 +743,31 @@ def explain(
                      else list(plan.mesh.devices.shape)),
             "dtype": str(np.dtype(plan.dtype)),
             "donate": bool(plan.options.donate),
+            "wire_dtype": getattr(plan.options, "wire_dtype", None),
         },
         "hw": hw,
         "gate": {"mads": mads, "min_rel": min_rel,
                  "min_samples": min_samples},
     }
+    # On-wire compression view: the measured round-trip error of one
+    # encode/decode cast at this plan's dtype (0.0 on the exact wire) and
+    # the wire-byte scale — the numbers the tuner's error-budget filter
+    # admits against, surfaced next to the divergence flags so a
+    # compressed run's accuracy cost is part of the attribution record.
+    wd = getattr(plan.options, "wire_dtype", None)
+    try:
+        from .parallel.exchange import wire_itemsize, wire_roundtrip_error
+
+        _, itemsize = _model_shape_itemsize(plan)
+        record["wire"] = {
+            "wire_dtype": wd,
+            "compression_err": wire_roundtrip_error(plan.dtype, wd),
+            "wire_factor": (wire_itemsize(itemsize, wd) / itemsize
+                            if wd else 1.0),
+        }
+    except Exception:  # noqa: BLE001 — attribution, not contract
+        record["wire"] = {"wire_dtype": wd, "compression_err": None,
+                          "wire_factor": None}
 
     x = None
     try:
@@ -741,6 +786,7 @@ def explain(
     timing: dict[str, Any] = {"source": "host",
                               "device_requested": bool(device_timing)}
     samples: dict[str, list[float]] = {}
+    leg_samples: dict[str, list[float]] = {}
     stage_compiled: dict[str, dict] = {}
     chunk_rows: dict[str, dict] = {}
     staged_available = False
@@ -748,10 +794,11 @@ def explain(
         stages = _staged_for(plan)
         if stages is not None:
             try:
-                samples, stage_compiled = _measure_stages(stages, x, iters)
+                samples, stage_compiled, leg_samples = _measure_stages(
+                    stages, x, iters)
                 staged_available = True
             except Exception:  # noqa: BLE001 — sick dispatch, keep going
-                samples, stage_compiled = {}, {}
+                samples, stage_compiled, leg_samples = {}, {}, {}
             if staged_available and device_timing:
                 dev, reason = device_stage_samples(stages, x, iters)
                 if dev is not None:
@@ -795,6 +842,20 @@ def explain(
             wire = m.get("wire_bytes", 0.0)
             entry["ici_utilization"] = (
                 wire / (med * wire_bps) if med and wire else None)
+            model_legs = m.get("legs")
+            if model_legs and len(model_legs) > 1:
+                # Per-leg modeled-vs-measured rows: the pencil chain's
+                # two exchanges, or the hierarchical transport's ICI and
+                # DCN legs — each leg's model prediction joined with its
+                # own measured stage samples (t2a/t2b sub-keys).
+                entry["legs"] = []
+                for leg in model_legs:
+                    ls = leg_samples.get(leg.get("stage"), [])
+                    entry["legs"].append({
+                        **leg,
+                        "measured_seconds": _median(ls),
+                        "measured_samples": [round(v, 9) for v in ls],
+                    })
         if chunk_rows:
             # Per-chunk device attribution (overlap-K): the raw
             # t2_...[k]/t3_...[k] span rows whose key this stage owns.
@@ -858,6 +919,14 @@ def format_explain(record: dict) -> str:
         f"ici {hw.get('wire_gbps')} GB/s, peak {hw.get('peak_tflops')} "
         f"TFlop/s; {hw.get('source')} profile)",
     ]
+    wire = record.get("wire") or {}
+    if wire.get("wire_dtype"):
+        err = wire.get("compression_err")
+        wf = wire.get("wire_factor")
+        lines.append(
+            f"wire: {wire['wire_dtype']} compression"
+            + (f" (x{wf:.2f} wire bytes" if wf else " (")
+            + (f", round-trip err {err:.2e})" if err is not None else ")"))
     timing = record.get("timing") or {}
     if timing.get("source") == "device":
         lines.append("timing: device timeline (jax.profiler capture)")
@@ -889,6 +958,16 @@ def format_explain(record: dict) -> str:
             f"{_fmt(comp.get('peak_hbm_bytes'), 'MB'):>12} "
             f"{_fmt(st.get('mfu'), '%'):>7} "
             f"{_fmt(st.get('ici_utilization'), '%'):>7}  {note}")
+        for leg in st.get("legs") or []:
+            # Per-leg exchange rows (pencil t2a/t2b; hierarchical
+            # ICI/DCN): indented under the t2 summary row.
+            lines.append(
+                f"  {leg.get('stage', '?'):<4} "
+                f"{_fmt(leg.get('seconds'), 's'):>11} "
+                f"{_fmt(leg.get('measured_seconds'), 's'):>12} "
+                f"{'':>11} {'':>12} {'':>7} {'':>7}  "
+                f"[{leg.get('link', '?')} axis {leg.get('mesh_axis')}, "
+                f"{leg.get('parts')} parts]")
     tot = record.get("totals") or {}
     lines.append(
         f"totals: model {_fmt(tot.get('model_seconds'), 's')} s | "

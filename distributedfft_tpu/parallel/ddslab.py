@@ -66,6 +66,7 @@ def build_dd_slab_fft3d(
     donate: bool = False,
     overlap_chunks: int = 1,
     batch: int | None = None,
+    wire_dtype: str | None = None,
 ) -> tuple[Callable, SlabSpec]:
     """Jitted distributed dd 3D C2C transform over a 1D mesh.
 
@@ -110,7 +111,7 @@ def build_dd_slab_fft3d(
         # pair of collectives under the previous chunk's t3.
         return exchange_overlapped(
             (hi, lo), axis_name, split_axis=ax_out, concat_axis=ax_in,
-            axis_size=p, algorithm=algorithm, platform=platform,
+            axis_size=p, algorithm=algorithm, wire_dtype=wire_dtype, platform=platform,
             compute=t3_chunk, overlap_chunks=overlap_chunks,
             chunk_axis=3 - in_axis - out_axis + bo,
             exchange_name=f"t2_exchange_{axis_name}",
@@ -145,6 +146,7 @@ def build_dd_slab_rfft3d(
     forward: bool = True,
     algorithm: str = "alltoall",
     overlap_chunks: int = 1,
+    wire_dtype: str | None = None,
 ) -> tuple[Callable, SlabSpec]:
     """Slab-distributed dd r2c (forward) / c2r (backward) — the double
     tier of heFFTe's distributed ``fft3d_r2c``. The real axis (2) is
@@ -182,7 +184,7 @@ def build_dd_slab_rfft3d(
                 chi, clo = ddfft.fft_axis_dd(chi, clo, 1)  # t0b: Y lines
             return exchange_overlapped(
                 (chi, clo), axis_name, split_axis=1, concat_axis=0,
-                axis_size=p, algorithm=algorithm, platform=platform,
+                axis_size=p, algorithm=algorithm, wire_dtype=wire_dtype, platform=platform,
                 compute=t3_chunk, overlap_chunks=overlap_chunks,
                 exchange_name=f"t2_exchange_{axis_name}",
                 compute_name="t3_dd_fft_x")
@@ -204,7 +206,7 @@ def build_dd_slab_rfft3d(
             # bystander (chunk) axis, so they follow the chunked merge.
             hi, lo = exchange_overlapped(
                 (hi, lo), axis_name, split_axis=0, concat_axis=1,
-                axis_size=p, algorithm=algorithm, platform=platform,
+                axis_size=p, algorithm=algorithm, wire_dtype=wire_dtype, platform=platform,
                 compute=t0_chunk, overlap_chunks=overlap_chunks,
                 exchange_name=f"t2_exchange_{axis_name}",
                 compute_name="t0_dd_ifft_y")
@@ -242,6 +244,7 @@ def build_dd_pencil_rfft3d(
     forward: bool = True,
     algorithm: str = "alltoall",
     overlap_chunks: int = 1,
+    wire_dtype: str | None = None,
 ) -> tuple[Callable, PencilSpec]:
     """Pencil-distributed dd r2c (forward) / c2r (backward) — the last
     cell of the dd decomposition matrix (mirrors the c64
@@ -283,13 +286,13 @@ def build_dd_pencil_rfft3d(
             chi, clo = chi[..., :h], clo[..., :h]       # r2c shrink
             pair = exchange_overlapped(
                 (chi, clo), col_axis, split_axis=2, concat_axis=1,
-                axis_size=cols, algorithm=algorithm, platform=platform,
+                axis_size=cols, algorithm=algorithm, wire_dtype=wire_dtype, platform=platform,
                 compute=fft_y, overlap_chunks=overlap_chunks,
                 exchange_name=f"t2a_exchange_{col_axis}",
                 compute_name="t1_dd_fft_y")
             return exchange_overlapped(
                 pair, row_axis, split_axis=1, concat_axis=0,
-                axis_size=rows, algorithm=algorithm, platform=platform,
+                axis_size=rows, algorithm=algorithm, wire_dtype=wire_dtype, platform=platform,
                 compute=fft_x, overlap_chunks=overlap_chunks,
                 exchange_name=f"t2b_exchange_{row_axis}",
                 compute_name="t3_dd_fft_x")
@@ -319,13 +322,13 @@ def build_dd_pencil_rfft3d(
             hi, lo = ddfft.fft_axis_dd(hi, lo, 0, forward=False)
             pair = exchange_overlapped(
                 (hi, lo), row_axis, split_axis=0, concat_axis=1,
-                axis_size=rows, algorithm=algorithm, platform=platform,
+                axis_size=rows, algorithm=algorithm, wire_dtype=wire_dtype, platform=platform,
                 compute=ifft_y, overlap_chunks=overlap_chunks,
                 exchange_name=f"t2b_exchange_{row_axis}",
                 compute_name="t1_dd_ifft_y")
             hi, lo = exchange_overlapped(
                 pair, col_axis, split_axis=1, concat_axis=2,
-                axis_size=cols, algorithm=algorithm, platform=platform,
+                axis_size=cols, algorithm=algorithm, wire_dtype=wire_dtype, platform=platform,
                 compute=c2r_z, overlap_chunks=overlap_chunks,
                 exchange_name=f"t2a_exchange_{col_axis}",
                 compute_name="t0_dd_c2r_z")
@@ -361,6 +364,7 @@ def build_dd_pencil_fft3d(
     donate: bool = False,
     overlap_chunks: int = 1,
     batch: int | None = None,
+    wire_dtype: str | None = None,
 ) -> tuple[Callable, PencilSpec]:
     """Jitted distributed dd 3D C2C transform over a 2D (rows x cols)
     mesh — the canonical pencil chain (z-pencils -> x-pencils forward;
@@ -401,7 +405,7 @@ def build_dd_pencil_fft3d(
 
             pair = exchange_overlapped(
                 pair, mesh_ax, split_axis=split + bo, concat_axis=concat + bo,
-                axis_size=parts, algorithm=algorithm, platform=platform,
+                axis_size=parts, algorithm=algorithm, wire_dtype=wire_dtype, platform=platform,
                 compute=post_fft, overlap_chunks=overlap_chunks,
                 chunk_axis=3 - split - concat + bo,
                 exchange_name=exch_names[i],
@@ -470,6 +474,7 @@ def build_dd_slab_stages(
     axis_name: str = "slab",
     algorithm: str = "alltoall",
     overlap_chunks: int = 1,
+    wire_dtype: str | None = None,
 ) -> tuple[list, SlabSpec]:
     """Forward dd slab transform as separately-jitted t0/t2/t3 stages.
 
@@ -505,7 +510,7 @@ def build_dd_slab_stages(
     def local_exchange(pair):
         return exchange_chunked(
             pair, axis_name, split_axis=1, concat_axis=0, axis_size=p,
-            algorithm=algorithm, overlap_chunks=overlap_chunks,
+            algorithm=algorithm, wire_dtype=wire_dtype, overlap_chunks=overlap_chunks,
             uneven=True, platform=platform,
             exchange_name="t2_all_to_all")
 
@@ -542,6 +547,7 @@ def build_dd_pencil_stages(
     algorithm: str = "alltoall",
     overlap_chunks: int = 1,
     batch: int | None = None,
+    wire_dtype: str | None = None,
 ):
     """Forward dd pencil transform as the five timed t0/t2a/t1/t2b/t3
     stages: the c64 pencil stage pipeline (``staged.build_pencil_stages``
@@ -561,5 +567,5 @@ def build_dd_pencil_stages(
 
     return build_pencil_stages(mesh, shape, row_axis=row_axis,
                                col_axis=col_axis, executor=dd_ex,
-                               algorithm=algorithm,
+                               algorithm=algorithm, wire_dtype=wire_dtype,
                                overlap_chunks=overlap_chunks, batch=batch)

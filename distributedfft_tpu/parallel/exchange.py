@@ -54,25 +54,57 @@ from jax import lax
 from ..geometry import pad_to
 from ..utils.trace import add_trace
 
-ALGORITHMS = ("alltoall", "alltoallv", "ppermute")
+#: Flat transports: the whole mesh axis is one collective's domain.
+FLAT_ALGORITHMS = ("alltoall", "alltoallv", "ppermute")
+#: Full menu, including the two-leg ICI/DCN transport (hybrid meshes
+#: only — see :func:`hierarchical_all_to_all`).
+ALGORITHMS = FLAT_ALGORITHMS + ("hierarchical",)
 
 #: Which :func:`..plan_logic.exchange_payloads` byte entry each transport
 #: actually ships on the wire — shared by the per-execute byte counters
 #: (api) and the tuner's candidate-pruning model, so wire accounting can
-#: never disagree between the two.
+#: never disagree between the two. The hierarchical transport's payload
+#: entries are already per-leg (dense within each leg's axis), so it
+#: reads the dense key of each leg entry.
 WIRE_BYTE_KEYS = {
     "alltoall": "alltoall_bytes",
     "ppermute": "alltoall_bytes",   # the padded ring ships the pads too
     "alltoallv": "alltoallv_bytes",
+    "hierarchical": "alltoall_bytes",
 }
+
+#: Bytes one complex element occupies on the wire under each compression
+#: mode: bf16 ships a (real, imag) bfloat16 pair — 4 bytes regardless of
+#: the payload's complex width (half of c64, quarter of c128).
+WIRE_DTYPES = (None, "bf16")
+_WIRE_PAIR_BYTES = {"bf16": 4}
+
+
+def wire_itemsize(itemsize: int, wire_dtype: str | None) -> int:
+    """Per-element bytes actually on the wire for a payload of
+    ``itemsize``-byte complex elements under ``wire_dtype`` compression
+    (``None`` = the payload travels as-is)."""
+    if wire_dtype is None:
+        return int(itemsize)
+    try:
+        return _WIRE_PAIR_BYTES[wire_dtype]
+    except KeyError:
+        raise ValueError(
+            f"unknown wire_dtype {wire_dtype!r}; use one of {WIRE_DTYPES}"
+        ) from None
 
 
 def transport_steps(algorithm: str, parts: int) -> int:
     """Sequential collective launches one exchange pays on ``parts``
     devices: the fused transports are one launch; the explicit ring is
-    ``parts - 1`` neighbor shifts (each a dependent ppermute). The
-    latency term of the tuner's analytical cost model."""
-    return max(1, parts - 1) if algorithm == "ppermute" else 1
+    ``parts - 1`` neighbor shifts (each a dependent ppermute); the
+    hierarchical transport is two dependent axis-local collectives
+    (the ``parts`` here are one LEG's parts — each leg entry is priced
+    separately, one launch per leg). The latency term of the tuner's
+    analytical cost model."""
+    if algorithm == "ppermute":
+        return max(1, parts - 1)
+    return 1
 
 
 def exchange_model_seconds(
@@ -116,6 +148,69 @@ def exchange_model_seconds(
     return {"seconds": t_ex, "exposed_seconds": exposed, "steps": steps}
 
 
+# ------------------------------------------------------ wire compression
+
+def wire_encode(x: jnp.ndarray, wire_dtype: str) -> jnp.ndarray:
+    """Cast a complex payload to its on-wire representation immediately
+    before the collective: ``"bf16"`` stacks (real, imag) as a trailing
+    bfloat16 pair — half the wire bytes of c64 at ~2^-9 relative
+    rounding per component. The trailing wire dim is a bystander of
+    every transport (split/concat/chunk axes keep their indices)."""
+    if wire_dtype != "bf16":
+        raise ValueError(
+            f"unknown wire_dtype {wire_dtype!r}; use one of {WIRE_DTYPES}")
+    if not jnp.issubdtype(x.dtype, jnp.complexfloating):
+        raise TypeError(
+            f"wire compression applies to complex exchange payloads, "
+            f"got {x.dtype}")
+    return jnp.stack([x.real, x.imag], axis=-1).astype(jnp.bfloat16)
+
+
+def wire_decode(y: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Inverse of :func:`wire_encode`: trailing (real, imag) wire pair
+    back to the complex payload dtype, immediately after the
+    collective."""
+    rdt = jnp.float64 if jnp.dtype(dtype) == jnp.complex128 else jnp.float32
+    r = y[..., 0].astype(rdt)
+    i = y[..., 1].astype(rdt)
+    return lax.complex(r, i).astype(dtype)
+
+
+def wire_roundtrip_error(dtype, wire_dtype: str | None = "bf16",
+                         n: int = 4096) -> float:
+    """Measured relative round-trip error of one wire cast
+    (``max |decode(encode(x)) - x| / max |x|`` over a seeded
+    standard-normal complex block) — the number the tuner's error-budget
+    filter and ``explain``'s ``wire.compression_err`` field report.
+    Deterministic (fixed seed) and cached per (dtype, wire_dtype), so
+    per-candidate pruning never re-measures. 0.0 for the exact wire."""
+    if wire_dtype is None:
+        return 0.0
+    key = (str(np.dtype(dtype)), wire_dtype, int(n))
+    hit = _WIRE_ERR_CACHE.get(key)
+    if hit is not None:
+        return hit
+    rng = np.random.default_rng(0)
+    x = (rng.standard_normal(n) + 1j * rng.standard_normal(n)).astype(
+        np.dtype(dtype))
+    y = np.asarray(wire_decode(wire_encode(jnp.asarray(x), wire_dtype),
+                               dtype))
+    err = float(np.max(np.abs(y - x)) / np.max(np.abs(x)))
+    _WIRE_ERR_CACHE[key] = err
+    return err
+
+
+_WIRE_ERR_CACHE: dict = {}
+
+
+def _axis_label(axis_name) -> str:
+    """Stage-span label of a mesh axis spec: the name itself, or
+    ``a+b`` for a combined (hierarchical) axis tuple."""
+    if isinstance(axis_name, (tuple, list)):
+        return "+".join(str(a) for a in axis_name)
+    return str(axis_name)
+
+
 def _pad_axis(x: jnp.ndarray, axis: int, to: int) -> jnp.ndarray:
     """Zero-pad ``axis`` up to extent ``to`` (no-op when already there).
     Single definition shared by every chain builder and exchange path — the
@@ -135,19 +230,35 @@ def _crop_axis(x: jnp.ndarray, axis: int, to: int) -> jnp.ndarray:
 
 def exchange(
     x: jnp.ndarray,
-    axis_name: str,
+    axis_name,
     *,
     split_axis: int,
     concat_axis: int,
     axis_size: int,
     algorithm: str = "alltoall",
+    axis_sizes: tuple[int, int] | None = None,
+    wire_dtype: str | None = None,
 ) -> jnp.ndarray:
     """Tiled all-to-all on ``axis_name`` inside ``shard_map``.
 
     Splits the local block into ``axis_size`` chunks along ``split_axis`` and
     concatenates the chunks received from every peer along ``concat_axis``
     (the semantics of ``lax.all_to_all(..., tiled=True)``).
+
+    ``axis_name`` is one mesh axis name, or — for the flat transports on a
+    hybrid mesh and for ``"hierarchical"`` — a (dcn, ici) tuple of names
+    whose combined extent is ``axis_size`` (``axis_sizes`` gives the
+    per-axis factors the hierarchical legs need). ``wire_dtype`` casts the
+    payload to its on-wire form immediately before the collective and back
+    after (:func:`wire_encode`); ``None`` ships the payload as-is —
+    byte-identical to the pre-compression HLO.
     """
+    if wire_dtype is not None:
+        w = wire_encode(x, wire_dtype)
+        y = exchange(w, axis_name, split_axis=split_axis,
+                     concat_axis=concat_axis, axis_size=axis_size,
+                     algorithm=algorithm, axis_sizes=axis_sizes)
+        return wire_decode(y, x.dtype)
     if algorithm == "alltoall":
         return lax.all_to_all(
             x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=True
@@ -161,18 +272,25 @@ def exchange(
         return ring_all_to_all(
             x, axis_name, split_axis=split_axis, concat_axis=concat_axis, p=axis_size
         )
+    if algorithm == "hierarchical":
+        return hierarchical_all_to_all(
+            x, axis_name, split_axis=split_axis, concat_axis=concat_axis,
+            axis_sizes=axis_sizes,
+        )
     raise ValueError(f"unknown exchange algorithm {algorithm!r}; use {ALGORITHMS}")
 
 
 def exchange_uneven(
     x: jnp.ndarray,
-    axis_name: str,
+    axis_name,
     *,
     split_axis: int,
     concat_axis: int,
     axis_size: int,
     algorithm: str = "alltoall",
     platform: str | None = None,
+    axis_sizes: tuple[int, int] | None = None,
+    wire_dtype: str | None = None,
 ) -> jnp.ndarray:
     """Exchange whose split-axis extent need not divide ``axis_size``.
 
@@ -183,8 +301,17 @@ def exchange_uneven(
     concat axis holds ``axis_size`` ceil-chunks per sender — callers crop
     the concat axis to its true extent exactly as before. ``platform`` is
     the mesh devices' platform (used by ``alltoallv`` to pick the real
-    ragged collective vs its CPU mirror).
+    ragged collective vs its CPU mirror). ``wire_dtype`` wraps the whole
+    exchange (both hierarchical legs ride one encoded payload) in the
+    on-wire cast pair; ``axis_sizes`` as in :func:`exchange`.
     """
+    if wire_dtype is not None:
+        w = wire_encode(x, wire_dtype)
+        y = exchange_uneven(w, axis_name, split_axis=split_axis,
+                            concat_axis=concat_axis, axis_size=axis_size,
+                            algorithm=algorithm, platform=platform,
+                            axis_sizes=axis_sizes)
+        return wire_decode(y, x.dtype)
     if algorithm == "alltoallv":
         return ragged_all_to_all_exchange(
             x, axis_name, split_axis=split_axis, concat_axis=concat_axis,
@@ -193,7 +320,142 @@ def exchange_uneven(
     x = _pad_axis(x, split_axis, pad_to(x.shape[split_axis], axis_size))
     return exchange(x, axis_name, split_axis=split_axis,
                     concat_axis=concat_axis, axis_size=axis_size,
-                    algorithm=algorithm)
+                    algorithm=algorithm, axis_sizes=axis_sizes)
+
+
+# ----------------------------------------------- hierarchical (ICI/DCN)
+
+def _hier_names_sizes(axis_name, axis_sizes) -> tuple[str, str, int, int]:
+    """Validate and unpack the (dcn, ici) axis pair of a hierarchical
+    exchange."""
+    if not (isinstance(axis_name, (tuple, list)) and len(axis_name) == 2):
+        raise ValueError(
+            "hierarchical exchange needs a (dcn, ici) mesh-axis name "
+            f"pair, got {axis_name!r}")
+    if not (isinstance(axis_sizes, (tuple, list)) and len(axis_sizes) == 2):
+        raise ValueError(
+            "hierarchical exchange needs axis_sizes=(dcn_parts, "
+            f"ici_parts), got {axis_sizes!r}")
+    dcn_name, ici_name = axis_name
+    d, i = int(axis_sizes[0]), int(axis_sizes[1])
+    return dcn_name, ici_name, d, i
+
+
+def _regroup_split(x: jnp.ndarray, split_axis: int, a: int, b: int,
+                   c: int) -> jnp.ndarray:
+    """Local reindex between the two legs: view ``split_axis`` as
+    ``[a, b, c]`` chunk factors and swap the leading two — the
+    destination-index transpose that turns flat chunk order into the
+    order each leg's tiled all-to-all expects."""
+    shp = x.shape
+    pre, post = shp[:split_axis], shp[split_axis + 1:]
+    x = x.reshape(pre + (a, b, c) + post)
+    perm = list(range(x.ndim))
+    i0 = len(pre)
+    perm[i0], perm[i0 + 1] = perm[i0 + 1], perm[i0]
+    return x.transpose(perm).reshape(pre + (a * b * c,) + post)
+
+
+def hierarchical_all_to_all(
+    x: jnp.ndarray,
+    axis_name,
+    *,
+    split_axis: int,
+    concat_axis: int,
+    axis_sizes: tuple[int, int],
+) -> jnp.ndarray:
+    """Two-leg topology-aware all-to-all over a hybrid (dcn x ici) axis
+    pair: an intra-slice tiled all-to-all on the ICI axis, a local
+    reindex, and an inter-slice tiled all-to-all on the DCN axis — each
+    leg riding the link it was built for, instead of one flat collective
+    the compiler routes across both fabrics at once (the 2.5D
+    decomposition of "Collective-Optimized FFTs", arXiv 2306.16589; the
+    reference's analogous split is peer-DMA within a node vs MPI across,
+    ``fft_mpi_3d_api.cpp:627-672``).
+
+    Bit-identical to the flat tiled all-to-all over the combined axis:
+    with device index ``i = d*I + e`` (the row-major linearization of a
+    ``P((dcn, ici))`` sharding), the ICI leg delivers every chunk to its
+    destination's ici coordinate within each slice, the DCN leg to its
+    destination slice, and the final local reindex lays the P sender
+    chunks onto ``concat_axis`` in sender-major order — exactly the
+    ``tiled=True`` contract. Requires ``split_axis`` extent divisible by
+    ``D * I`` (the ceil-pad discipline of :func:`exchange_uneven`).
+
+    The two legs carry ``t2a_exchange_<ici>`` / ``t2b_exchange_<dcn>``
+    trace spans (both normalize to the ``t2`` stage key), so the explain
+    layer attributes each leg separately.
+    """
+    dcn_name, ici_name, d, i = _hier_names_sizes(axis_name, axis_sizes)
+    p = d * i
+    S = x.shape[split_axis]
+    if S % p:
+        raise ValueError(
+            f"split axis extent {S} not divisible by {p} (= {d} dcn x "
+            f"{i} ici); hierarchical exchange takes the ceil-padded axis")
+    c = S // p
+    # Leg A (ICI): destination-e-major chunk order, intra-slice a2a.
+    with add_trace(f"t2a_exchange_{_axis_label(ici_name)}"):
+        v = _regroup_split(x, split_axis, d, i, c)
+        v = lax.all_to_all(v, ici_name, split_axis=split_axis,
+                           concat_axis=split_axis, tiled=True)
+    # Leg B (DCN): destination-d-major order, inter-slice a2a.
+    with add_trace(f"t2b_exchange_{_axis_label(dcn_name)}"):
+        v = _regroup_split(v, split_axis, i, d, c)
+        v = lax.all_to_all(v, dcn_name, split_axis=split_axis,
+                           concat_axis=split_axis, tiled=True)
+    # Final local reindex: the split axis now holds the P sender-major
+    # chunks [(d_src, e_src), c]; lay them onto the concat axis exactly
+    # where the flat tiled all-to-all would.
+    shp = v.shape
+    pre, post = shp[:split_axis], shp[split_axis + 1:]
+    v = v.reshape(pre + (p, c) + post)
+    v = jnp.moveaxis(v, split_axis, concat_axis)
+    shp2 = v.shape
+    out = list(shp2)
+    out[concat_axis:concat_axis + 2] = [shp2[concat_axis]
+                                        * shp2[concat_axis + 1]]
+    return v.reshape(out)
+
+
+def hierarchical_legs(
+    axis_name,
+    *,
+    split_axis: int,
+    concat_axis: int,
+    axis_sizes: tuple[int, int],
+):
+    """The two legs of :func:`hierarchical_all_to_all` as separate
+    callables ``(leg_ici, leg_dcn)`` — the staged-pipeline view, so the
+    per-stage timing harness (and ``dfft.explain``) can bracket each leg
+    as its own ``t2a``/``t2b`` stage. ``leg_dcn`` includes the final
+    sender-major reindex onto ``concat_axis``; composing
+    ``leg_dcn(leg_ici(x))`` is bit-identical to the fused transport."""
+    dcn_name, ici_name, d, i = _hier_names_sizes(axis_name, axis_sizes)
+    p = d * i
+
+    def leg_ici(x):
+        c = x.shape[split_axis] // p
+        v = _regroup_split(x, split_axis, d, i, c)
+        return lax.all_to_all(v, ici_name, split_axis=split_axis,
+                              concat_axis=split_axis, tiled=True)
+
+    def leg_dcn(v):
+        c = v.shape[split_axis] // p
+        v = _regroup_split(v, split_axis, i, d, c)
+        v = lax.all_to_all(v, dcn_name, split_axis=split_axis,
+                           concat_axis=split_axis, tiled=True)
+        shp = v.shape
+        pre, post = shp[:split_axis], shp[split_axis + 1:]
+        v = v.reshape(pre + (p, c) + post)
+        v = jnp.moveaxis(v, split_axis, concat_axis)
+        shp2 = v.shape
+        out = list(shp2)
+        out[concat_axis:concat_axis + 2] = [shp2[concat_axis]
+                                            * shp2[concat_axis + 1]]
+        return v.reshape(out)
+
+    return leg_ici, leg_dcn
 
 
 def ragged_all_to_all_exchange(
@@ -338,7 +600,7 @@ def overlap_chunk_bounds(extent: int, k: int) -> list[tuple[int, int]]:
 
 def exchange_overlapped(
     x,
-    axis_name: str,
+    axis_name,
     *,
     split_axis: int,
     concat_axis: int,
@@ -348,6 +610,8 @@ def exchange_overlapped(
     chunk_axis: int | None = None,
     algorithm: str = "alltoall",
     platform: str | None = None,
+    axis_sizes: tuple[int, int] | None = None,
+    wire_dtype: str | None = None,
     exchange_name: str = "t2_exchange",
     compute_name: str = "t3_fft",
 ):
@@ -382,7 +646,8 @@ def exchange_overlapped(
     if chunk_axis is None:
         chunk_axis = 3 - split_axis - concat_axis
     ex_kw = dict(split_axis=split_axis, concat_axis=concat_axis,
-                 axis_size=axis_size, algorithm=algorithm, platform=platform)
+                 axis_size=axis_size, algorithm=algorithm, platform=platform,
+                 axis_sizes=axis_sizes, wire_dtype=wire_dtype)
     extent = leaves[0].shape[chunk_axis] if leaves else 1
     bounds = overlap_chunk_bounds(extent, overlap_chunks)
     if len(bounds) <= 1:
@@ -416,7 +681,7 @@ def exchange_overlapped(
 
 def exchange_chunked(
     x,
-    axis_name: str,
+    axis_name,
     *,
     split_axis: int,
     concat_axis: int,
@@ -427,6 +692,8 @@ def exchange_chunked(
     exchange_name: str = "t2_exchange",
     uneven: bool = False,
     platform: str | None = None,
+    axis_sizes: tuple[int, int] | None = None,
+    wire_dtype: str | None = None,
 ):
     """The staged-pipeline tier of the overlap mode: K independent
     per-chunk exchanges inside ONE stage jit. Stage boundaries are
@@ -446,7 +713,8 @@ def exchange_chunked(
     extent = leaves[0].shape[chunk_axis] if leaves else 1
     bounds = overlap_chunk_bounds(extent, overlap_chunks)
     kw = dict(split_axis=split_axis, concat_axis=concat_axis,
-              axis_size=axis_size, algorithm=algorithm)
+              axis_size=axis_size, algorithm=algorithm,
+              axis_sizes=axis_sizes, wire_dtype=wire_dtype)
     if uneven:
         one = lambda u: exchange_uneven(u, axis_name, platform=platform,
                                         **kw)

@@ -95,6 +95,16 @@ def make_hybrid_mesh(
     return Mesh(grid, axis_names)
 
 
+def is_hybrid_mesh(mesh) -> bool:
+    """True when ``mesh`` is a 2D hybrid (dcn x ici) mesh — the shape
+    :func:`make_hybrid_mesh` builds and the one the hierarchical two-leg
+    transport (and the tuner's hierarchical candidates) target. The
+    convention: axis 0 is named ``"dcn"`` and spans processes/slices,
+    axis 1 is the ICI-connected intra-slice axis."""
+    return (isinstance(mesh, Mesh) and len(mesh.axis_names) == 2
+            and mesh.axis_names[0] == "dcn")
+
+
 def fft_mesh_for(ndev_total: int | None = None) -> Mesh:
     """The default distributed-FFT mesh for this runtime: hybrid 2D when
     multi-process, flat 1D slab mesh when single-process."""

@@ -141,6 +141,7 @@ def build_pencil_general(
     algorithm: str = "alltoall",
     overlap_chunks: int = 1,
     batch: int | None = None,
+    wire_dtype: str | None = None,
 ) -> tuple[Callable, PencilSpec]:
     """Build the jitted end-to-end pencil transform for ANY input layout
     permutation and exchange order (see :class:`PencilSpec` for the chain
@@ -191,6 +192,7 @@ def build_pencil_general(
             x = exchange_overlapped(
                 x, mesh_ax, split_axis=split + bo, concat_axis=concat + bo,
                 axis_size=parts, algorithm=algorithm, compute=post_fft,
+                wire_dtype=wire_dtype,
                 overlap_chunks=overlap_chunks,
                 chunk_axis=3 - split - concat + bo,
                 exchange_name=exch_names[i],
@@ -245,6 +247,7 @@ def build_pencil_fft3d(
     order: str | None = None,
     overlap_chunks: int = 1,
     batch: int | None = None,
+    wire_dtype: str | None = None,
 ) -> tuple[Callable, PencilSpec]:
     """Canonical-orientation wrapper over :func:`build_pencil_general`:
     forward maps z-pencils (``P(row, col, None)``) to x-pencils
@@ -259,6 +262,7 @@ def build_pencil_fft3d(
         mesh, shape, perm=perm, order=order, row_axis=row_axis,
         col_axis=col_axis, executor=executor, forward=forward, donate=donate,
         algorithm=algorithm, overlap_chunks=overlap_chunks, batch=batch,
+        wire_dtype=wire_dtype,
     )
 
 
@@ -274,6 +278,7 @@ def build_pencil_rfft3d(
     algorithm: str = "alltoall",
     overlap_chunks: int = 1,
     batch: int | None = None,
+    wire_dtype: str | None = None,
 ) -> tuple[Callable, PencilSpec]:
     """Pencil-decomposed r2c (forward) / c2r (backward) 3D transform.
 
@@ -322,12 +327,14 @@ def build_pencil_rfft3d(
             y = exchange_overlapped(
                 y, col_axis, split_axis=2 + bo, concat_axis=1 + bo,
                 axis_size=cols, algorithm=algorithm, compute=fft_y,
+                wire_dtype=wire_dtype,
                 overlap_chunks=overlap_chunks, chunk_axis=bo,
                 exchange_name=f"t2a_exchange_{col_axis}",
                 compute_name="t1_fft_y")
             return exchange_overlapped(
                 y, row_axis, split_axis=1 + bo, concat_axis=bo,
                 axis_size=rows, algorithm=algorithm, compute=fft_x,
+                wire_dtype=wire_dtype,
                 overlap_chunks=overlap_chunks, chunk_axis=2 + bo,
                 exchange_name=f"t2b_exchange_{row_axis}",
                 compute_name="t3_fft_x")
@@ -353,12 +360,14 @@ def build_pencil_rfft3d(
             x = exchange_overlapped(
                 x, row_axis, split_axis=bo, concat_axis=1 + bo,
                 axis_size=rows, algorithm=algorithm, compute=ifft_y,
+                wire_dtype=wire_dtype,
                 overlap_chunks=overlap_chunks, chunk_axis=2 + bo,
                 exchange_name=f"t2b_exchange_{row_axis}",
                 compute_name="t1_ifft_y")
             x = exchange_overlapped(
                 x, col_axis, split_axis=1 + bo, concat_axis=2 + bo,
                 axis_size=cols, algorithm=algorithm, compute=crop_h,
+                wire_dtype=wire_dtype,
                 overlap_chunks=overlap_chunks, chunk_axis=bo,
                 exchange_name=f"t2a_exchange_{col_axis}",
                 compute_name="t1_crop")

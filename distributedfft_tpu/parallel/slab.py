@@ -32,6 +32,7 @@ is a GPU-memory-coalescing concern that XLA's layout assignment subsumes.
 from __future__ import annotations
 
 import functools
+import math
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
@@ -51,10 +52,23 @@ from ..utils.trace import add_trace, trace_stages
 # _pad_axis/_crop_axis live in exchange.py (single definition shared with
 # the ragged path) and are re-exported here for the other chain builders.
 from .exchange import (
-    _crop_axis, _pad_axis, exchange_chunked, exchange_overlapped,
+    _axis_label, _crop_axis, _pad_axis, exchange_chunked,
+    exchange_overlapped, hierarchical_legs, wire_decode, wire_encode,
 )
 
 _L = "xyz"  # axis index -> stage-name letter (t0_fft_yz taxonomy)
+
+
+def _axis_parts(mesh: Mesh, axis_name) -> tuple[int, tuple | None]:
+    """(combined parts, per-axis sizes) of a slab chain's mesh-axis spec:
+    a plain 1D axis name, or the (dcn, ici) tuple of the hierarchical
+    transport's hybrid mesh (row-major linearization = the combined slab
+    axis). ``axis_sizes`` is None for a plain axis — the flat transports
+    take the single named axis exactly as before."""
+    if isinstance(axis_name, (tuple, list)):
+        sizes = tuple(int(mesh.shape[a]) for a in axis_name)
+        return math.prod(sizes), sizes
+    return int(mesh.shape[axis_name]), None
 
 
 def check_batch(batch: int | None) -> int | None:
@@ -141,13 +155,14 @@ def build_slab_general(
     *,
     in_axis: int,
     out_axis: int,
-    axis_name: str = "slab",
+    axis_name: str | tuple = "slab",
     executor: str | Callable = "xla",
     forward: bool = True,
     donate: bool = False,
     algorithm: str = "alltoall",
     overlap_chunks: int = 1,
     batch: int | None = None,
+    wire_dtype: str | None = None,
 ) -> tuple[Callable, SlabSpec]:
     """Build the jitted end-to-end slab transform for ANY ordered axis pair.
 
@@ -172,7 +187,7 @@ def build_slab_general(
     if in_axis == out_axis or not (0 <= in_axis < 3 and 0 <= out_axis < 3):
         raise ValueError(f"need distinct 3D axes, got {in_axis}, {out_axis}")
     check_batch(batch)
-    p = mesh.shape[axis_name]
+    p, axis_sizes = _axis_parts(mesh, axis_name)
     spec = SlabSpec(tuple(int(s) for s in shape), p, axis_name,
                     in_axis, out_axis)
     ex = get_executor(executor) if isinstance(executor, str) else executor
@@ -190,7 +205,7 @@ def build_slab_general(
     # recorded dispatch-side when the jit first traces, and passed through
     # to the device timeline as profiler annotations.
     t0_name = f"t0_fft_{''.join(_L[a] for a in local_axes)}"
-    t2_name = f"t2_exchange_{axis_name}"
+    t2_name = f"t2_exchange_{_axis_label(axis_name)}"
     t3_name = f"t3_fft_{_L[in_axis]}"
 
     def t3_chunk(y):
@@ -211,6 +226,7 @@ def build_slab_general(
         return exchange_overlapped(
             y, axis_name, split_axis=ax_out, concat_axis=ax_in,
             axis_size=p, algorithm=algorithm, platform=platform,
+            axis_sizes=axis_sizes, wire_dtype=wire_dtype,
             compute=t3_chunk, overlap_chunks=overlap_chunks,
             chunk_axis=chunk_axis,
             exchange_name=t2_name, compute_name=t3_name)
@@ -242,7 +258,7 @@ def build_slab_fft3d(
     mesh: Mesh,
     shape: tuple[int, int, int],
     *,
-    axis_name: str = "slab",
+    axis_name: str | tuple = "slab",
     executor: str | Callable = "xla",
     forward: bool = True,
     donate: bool = False,
@@ -251,6 +267,7 @@ def build_slab_fft3d(
     out_axis: int | None = None,
     overlap_chunks: int = 1,
     batch: int | None = None,
+    wire_dtype: str | None = None,
 ) -> tuple[Callable, SlabSpec]:
     """Canonical-orientation wrapper over :func:`build_slab_general`:
     X-slabs -> Y-slabs forward, Y-slabs -> X-slabs backward (the reference
@@ -264,7 +281,7 @@ def build_slab_fft3d(
         out_axis=d_out if out_axis is None else out_axis,
         axis_name=axis_name, executor=executor, forward=forward,
         donate=donate, algorithm=algorithm, overlap_chunks=overlap_chunks,
-        batch=batch,
+        batch=batch, wire_dtype=wire_dtype,
     )
 
 
@@ -279,6 +296,7 @@ def build_slab_rfft3d(
     algorithm: str = "alltoall",
     overlap_chunks: int = 1,
     batch: int | None = None,
+    wire_dtype: str | None = None,
 ) -> tuple[Callable, SlabSpec]:
     """Slab-decomposed real-to-complex (forward) / complex-to-real (backward)
     3D transform — the distributed analog of heFFTe's ``fft3d_r2c``
@@ -327,6 +345,7 @@ def build_slab_rfft3d(
             return exchange_overlapped(
                 y, axis_name, split_axis=1 + bo, concat_axis=bo,
                 axis_size=p, algorithm=algorithm, compute=t3_chunk,
+                wire_dtype=wire_dtype,
                 overlap_chunks=overlap_chunks, chunk_axis=2 + bo,
                 exchange_name=f"t2_exchange_{axis_name}",
                 compute_name="t3_fft_x")
@@ -350,6 +369,7 @@ def build_slab_rfft3d(
             x = exchange_overlapped(
                 x, axis_name, split_axis=bo, concat_axis=1 + bo,
                 axis_size=p, algorithm=algorithm, compute=t0_chunk,
+                wire_dtype=wire_dtype,
                 overlap_chunks=overlap_chunks, chunk_axis=2 + bo,
                 exchange_name=f"t2_exchange_{axis_name}",
                 compute_name="t0_ifft_y")
@@ -378,12 +398,13 @@ def build_slab_stages(
     mesh: Mesh,
     shape: tuple[int, int, int],
     *,
-    axis_name: str = "slab",
+    axis_name: str | tuple = "slab",
     executor: str | Callable = "xla",
     forward: bool = True,
     algorithm: str = "alltoall",
     overlap_chunks: int = 1,
     batch: int | None = None,
+    wire_dtype: str | None = None,
 ) -> tuple[list[tuple[str, Callable]], SlabSpec]:
     """The same transform split into separately-jitted t0..t3 stages for the
     per-stage timing breakdown the reference prints on every execute
@@ -393,9 +414,18 @@ def build_slab_stages(
     overlapped chains' K-collective transport shape inside the t2 stage
     (:func:`.exchange.exchange_chunked`). ``batch=B`` runs the stages over
     ``[B, ...]`` arrays with one shared exchange per chunk.
+
+    ``algorithm="hierarchical"`` (hybrid mesh; ``axis_name`` a (dcn, ici)
+    tuple) splits the t2 stage into its two axis-local legs — separately
+    jitted ``t2a``/``t2b`` stages, so the per-stage harness times each
+    fabric's leg on its own (overlap_chunks > 1 keeps one chunked t2
+    stage: the leg boundary would multiply stage dispatches per chunk).
+    ``wire_dtype`` compresses each exchange stage's wire exactly like the
+    fused chain (the t2 stage boundary still carries the decoded complex
+    array, so stage I/O shapes are unchanged).
     """
     check_batch(batch)
-    p = mesh.shape[axis_name]
+    p, axis_sizes = _axis_parts(mesh, axis_name)
     spec = SlabSpec(tuple(int(s) for s in shape), p, axis_name)
     ex = get_executor(executor) if isinstance(executor, str) else executor
     n0, n1, n2 = spec.shape
@@ -410,6 +440,44 @@ def build_slab_stages(
     def smap(f, ins, outs):
         return _shard_map(f, mesh=mesh, in_specs=(ins,), out_specs=outs)
 
+    def t2_stages(split_axis, concat_axis, ins, outs, in_sh, out_sh):
+        """The t2 tier: one chunked exchange stage, or the hierarchical
+        transport's two per-leg stages (K=1 only — see docstring)."""
+        if algorithm == "hierarchical" and overlap_chunks <= 1:
+            leg_ici, leg_dcn = hierarchical_legs(
+                axis_name, split_axis=split_axis, concat_axis=concat_axis,
+                axis_sizes=axis_sizes)
+            dcn_name, ici_name = axis_name
+
+            def wrap(leg):
+                if wire_dtype is None:
+                    return leg
+                # Per-leg wire casts: bf16 round-trips are idempotent, so
+                # leg-boundary decode/encode is bit-identical to the
+                # fused chain's single cast pair around both legs.
+                return lambda u: wire_decode(
+                    leg(wire_encode(u, wire_dtype)), u.dtype)
+
+            return [
+                (f"t2a_exchange_{_axis_label(ici_name)}", jax.jit(
+                    smap(wrap(leg_ici), ins, ins),
+                    in_shardings=in_sh, out_shardings=in_sh)),
+                (f"t2b_exchange_{_axis_label(dcn_name)}", jax.jit(
+                    smap(wrap(leg_dcn), ins, outs),
+                    in_shardings=in_sh, out_shardings=out_sh)),
+            ]
+        return [
+            ("t2_all_to_all", jax.jit(
+                smap(lambda v: exchange_chunked(
+                    v, axis_name, split_axis=split_axis,
+                    concat_axis=concat_axis, axis_size=p,
+                    algorithm=algorithm, axis_sizes=axis_sizes,
+                    wire_dtype=wire_dtype,
+                    overlap_chunks=overlap_chunks, chunk_axis=2 + bo),
+                    ins, outs),
+                in_shardings=in_sh, out_shardings=out_sh)),
+        ]
+
     if forward:
         stages = [
             ("t0_fft_yz", jax.jit(
@@ -417,13 +485,7 @@ def build_slab_stages(
                     lambda v: ex(v, (1 + bo, 2 + bo), True), xs, xs)(
                     _pad_axis(x, bo, n0p)), 1 + bo, n1p),
                 in_shardings=x_slab, out_shardings=x_slab)),
-            ("t2_all_to_all", jax.jit(
-                smap(lambda v: exchange_chunked(
-                    v, axis_name, split_axis=1 + bo, concat_axis=bo,
-                    axis_size=p, algorithm=algorithm,
-                    overlap_chunks=overlap_chunks, chunk_axis=2 + bo),
-                    xs, ys),
-                in_shardings=x_slab, out_shardings=y_slab)),
+            *t2_stages(1 + bo, bo, xs, ys, x_slab, y_slab),
             ("t3_fft_x", jax.jit(
                 lambda v: _crop_axis(smap(
                     lambda u: ex(_crop_axis(u, bo, n0), (bo,), True),
@@ -437,13 +499,7 @@ def build_slab_stages(
                     lambda u: ex(u, (bo,), False), ys, ys)(
                     _pad_axis(v, 1 + bo, n1p)), bo, n0p),
                 in_shardings=y_slab, out_shardings=y_slab)),
-            ("t2_all_to_all", jax.jit(
-                smap(lambda v: exchange_chunked(
-                    v, axis_name, split_axis=bo, concat_axis=1 + bo,
-                    axis_size=p, algorithm=algorithm,
-                    overlap_chunks=overlap_chunks, chunk_axis=2 + bo),
-                    ys, xs),
-                in_shardings=y_slab, out_shardings=x_slab)),
+            *t2_stages(bo, 1 + bo, ys, xs, y_slab, x_slab),
             ("t0_ifft_yz", jax.jit(
                 lambda v: _crop_axis(smap(
                     lambda u: ex(_crop_axis(u, 1 + bo, n1), (1 + bo, 2 + bo),

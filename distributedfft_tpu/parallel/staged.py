@@ -98,6 +98,7 @@ def build_pencil_stages(
     order: str | None = None,
     overlap_chunks: int = 1,
     batch: int | None = None,
+    wire_dtype: str | None = None,
 ) -> tuple[list[tuple[str, Callable]], PencilSpec]:
     """Pencil c2c transform as five timed stages:
     t0 (first fft) | t2a (first exchange) | t1 (mid fft) | t2b (second
@@ -161,6 +162,7 @@ def build_pencil_stages(
         y = smap(lambda v: exchange_chunked(
             v, mesh_ax, split_axis=split + bo, concat_axis=concat + bo,
             axis_size=parts, algorithm=algorithm,
+            wire_dtype=wire_dtype,
             overlap_chunks=overlap_chunks,
             chunk_axis=3 - split - concat + bo,
             exchange_name=f"t2a_exchange_{mesh_ax}"),
@@ -182,6 +184,7 @@ def build_pencil_stages(
         y = smap(lambda v: exchange_chunked(
             v, mesh_ax, split_axis=split + bo, concat_axis=concat + bo,
             axis_size=parts, algorithm=algorithm,
+            wire_dtype=wire_dtype,
             overlap_chunks=overlap_chunks,
             chunk_axis=3 - split - concat + bo,
             exchange_name=f"t2b_exchange_{mesh_ax}"),
@@ -219,6 +222,7 @@ def build_slab_rfft_stages(
     algorithm: str = "alltoall",
     overlap_chunks: int = 1,
     batch: int | None = None,
+    wire_dtype: str | None = None,
 ) -> tuple[list[tuple[str, Callable]], SlabSpec]:
     """Slab r2c (forward) / c2r (backward) as three timed stages — the
     per-stage breakdown for every benchmarkable r2c config
@@ -253,6 +257,7 @@ def build_slab_rfft_stages(
             z = smap(lambda v: exchange_chunked(
                 v, axis_name, split_axis=1 + bo, concat_axis=bo,
                 axis_size=p, algorithm=algorithm,
+                wire_dtype=wire_dtype,
                 overlap_chunks=overlap_chunks, chunk_axis=2 + bo),
                 xs, ys)(y)
             return lax.with_sharding_constraint(z, y_sh)
@@ -280,6 +285,7 @@ def build_slab_rfft_stages(
             u = smap(lambda v: exchange_chunked(
                 v, axis_name, split_axis=bo, concat_axis=1 + bo,
                 axis_size=p, algorithm=algorithm,
+                wire_dtype=wire_dtype,
                 overlap_chunks=overlap_chunks, chunk_axis=2 + bo),
                 ys, xs)(w)
             return lax.with_sharding_constraint(u, x_sh)
@@ -308,6 +314,7 @@ def build_pencil_rfft_stages(
     algorithm: str = "alltoall",
     overlap_chunks: int = 1,
     batch: int | None = None,
+    wire_dtype: str | None = None,
 ) -> tuple[list[tuple[str, Callable]], PencilSpec]:
     """Pencil r2c/c2r as five timed stages with t2a/t2b exchange lines.
     Canonical chains only (the real axis must be device-local axis 2 on the
@@ -348,6 +355,7 @@ def build_pencil_rfft_stages(
             z = smap(lambda v: exchange_chunked(
                 v, col_axis, split_axis=2 + bo, concat_axis=1 + bo,
                 axis_size=cols, algorithm=algorithm,
+                wire_dtype=wire_dtype,
                 overlap_chunks=overlap_chunks, chunk_axis=bo),
                 zs, ysp)(y)
             return lax.with_sharding_constraint(z, y_sh)
@@ -364,6 +372,7 @@ def build_pencil_rfft_stages(
             u = smap(lambda v: exchange_chunked(
                 v, row_axis, split_axis=1 + bo, concat_axis=bo,
                 axis_size=rows, algorithm=algorithm,
+                wire_dtype=wire_dtype,
                 overlap_chunks=overlap_chunks, chunk_axis=2 + bo),
                 ysp, xs)(w)
             return lax.with_sharding_constraint(u, x_sh)
@@ -393,6 +402,7 @@ def build_pencil_rfft_stages(
             z = smap(lambda v: exchange_chunked(
                 v, row_axis, split_axis=bo, concat_axis=1 + bo,
                 axis_size=rows, algorithm=algorithm,
+                wire_dtype=wire_dtype,
                 overlap_chunks=overlap_chunks, chunk_axis=2 + bo),
                 xs, ysp)(w)
             return lax.with_sharding_constraint(z, y_sh)
@@ -409,6 +419,7 @@ def build_pencil_rfft_stages(
             z = smap(lambda v: exchange_chunked(
                 v, col_axis, split_axis=1 + bo, concat_axis=2 + bo,
                 axis_size=cols, algorithm=algorithm,
+                wire_dtype=wire_dtype,
                 overlap_chunks=overlap_chunks, chunk_axis=bo),
                 ysp, zs)(w)
             return lax.with_sharding_constraint(z, z_sh)

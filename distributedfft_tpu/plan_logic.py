@@ -45,7 +45,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from . import geometry as geo
 from . import native
-from .parallel.exchange import ALGORITHMS
+from .parallel.exchange import ALGORITHMS, WIRE_DTYPES, wire_itemsize
 from .parallel.mesh import make_mesh
 from .parallel.slab import check_batch
 
@@ -90,6 +90,19 @@ class PlanOptions:
     pruned tournament on a miss and records the winner. ``None`` (the
     default) defers to the ``DFFT_TUNE`` env var (unset -> ``"off"``).
     See ``docs/TUNING.md``.
+    ``wire_dtype``: on-wire compression of the t2 exchange payload —
+    ``"bf16"`` casts the complex payload to (real, imag) bfloat16 pairs
+    immediately before each collective and back after, halving t2 wire
+    bytes for c64 at a bounded precision cost
+    (:func:`..parallel.exchange.wire_roundtrip_error`). ``"none"`` pins
+    the exact wire; ``None`` (the default) defers to the
+    ``DFFT_WIRE_DTYPE`` env var at plan time (unset -> exact,
+    byte-identical HLO to an uncompressed plan).
+    ``max_roundtrip_err``: the plan's relative round-trip error budget.
+    The tuner enumerates compressed (``wire_dtype``) candidates only for
+    plans that declare a budget, filters out candidates whose measured
+    wire round-trip error exceeds it, and replays a stored compressed
+    winner only into plans whose budget admits its recorded error.
     """
 
     decomposition: str = "auto"
@@ -99,12 +112,30 @@ class PlanOptions:
     renegotiate: str = "auto"
     overlap_chunks: int | str | None = None
     tune: str | None = None
+    wire_dtype: str | None = None
+    max_roundtrip_err: float | None = None
 
     def __post_init__(self) -> None:
         if self.algorithm not in ALGORITHMS:
             raise ValueError(
                 f"unknown algorithm {self.algorithm!r}; use one of {ALGORITHMS}"
             )
+        wd = self.wire_dtype
+        if isinstance(wd, str):
+            wd = wd.strip().lower()
+            object.__setattr__(self, "wire_dtype", wd or None)
+            wd = self.wire_dtype
+        if wd not in WIRE_DTYPES and wd != "none":
+            raise ValueError(
+                f"wire_dtype must be one of {WIRE_DTYPES} or 'none', "
+                f"got {self.wire_dtype!r}")
+        mre = self.max_roundtrip_err
+        if mre is not None and (
+                not isinstance(mre, (int, float)) or isinstance(mre, bool)
+                or not mre > 0):
+            raise ValueError(
+                f"max_roundtrip_err must be a positive float or None, "
+                f"got {mre!r}")
         if self.decomposition not in ("auto", "single", "slab", "pencil"):
             raise ValueError(f"unknown decomposition {self.decomposition!r}")
         if self.renegotiate not in ("auto", "force", "never"):
@@ -196,6 +227,26 @@ def resolve_overlap_chunks(
     if value < 1:
         raise ValueError(f"overlap_chunks must be >= 1, got {value}")
     return int(value)
+
+
+def resolve_wire_dtype(value: str | None) -> str | None:
+    """Resolve a ``PlanOptions.wire_dtype`` value to a concrete wire
+    mode: ``None`` (exact) or ``"bf16"``.
+
+    ``None`` reads the ``DFFT_WIRE_DTYPE`` env var at plan time (unset
+    -> exact); ``"none"`` pins the exact wire regardless of the env.
+    One resolution point so the planners, the tuner's candidate space,
+    and the benchmark drivers agree on what a given environment ships."""
+    if value is None:
+        value = os.environ.get("DFFT_WIRE_DTYPE", "").strip() or "none"
+    v = value.strip().lower() if isinstance(value, str) else value
+    if v in (None, "", "none", "0"):
+        return None
+    if v in WIRE_DTYPES:
+        return v
+    raise ValueError(
+        f"wire_dtype must be one of {tuple(w for w in WIRE_DTYPES if w)} "
+        f"or 'none', got {value!r} (check DFFT_WIRE_DTYPE)")
 
 
 def resolve_tune_mode(value: str | None) -> str:
@@ -468,6 +519,25 @@ def logic_plan3d(
     negotiated = None
     requested = None  # device count requested as an int (renegotiable)
 
+    hier = options.algorithm == "hierarchical"
+    if hier:
+        # The two-leg ICI/DCN transport runs the slab chain (ONE logical
+        # exchange, decomposed into two axis-local legs) over a hybrid
+        # 2D mesh whose axes are the two fabrics — a pencil chain's
+        # exchanges are each axis-local already, so there is nothing for
+        # the hierarchical transport to split there.
+        if not isinstance(mesh, Mesh) or len(mesh.axis_names) != 2:
+            raise ValueError(
+                "algorithm='hierarchical' requires an explicit 2D hybrid "
+                "(dcn x ici) Mesh (e.g. multihost.make_hybrid_mesh()); "
+                f"got {mesh!r}")
+        if decomp not in ("auto", "slab"):
+            raise ValueError(
+                "hierarchical transport runs the slab chain over the "
+                f"combined hybrid axis; decomposition={decomp!r} is not "
+                "compatible")
+        decomp = "slab"
+
     if isinstance(mesh, int):
         requested = ndev = mesh
         if decomp == "auto":
@@ -489,16 +559,21 @@ def logic_plan3d(
     elif decomp == "auto":
         decomp = "pencil" if len(mesh.axis_names) == 2 else "slab"
 
-    if decomp == "slab" and mesh is not None and len(mesh.axis_names) != 1:
+    if (decomp == "slab" and mesh is not None
+            and len(mesh.axis_names) != 1 and not hier):
         raise ValueError("slab decomposition requires a 1D mesh")
     if decomp == "pencil" and mesh is not None and len(mesh.axis_names) != 2:
         raise ValueError("pencil decomposition requires a 2D mesh")
 
     # ---- axis assignment (reshape minimization) ----
+    # The hierarchical slab chain runs over the COMBINED hybrid axis, so
+    # 2D-mesh layout classification (which would read the mesh as a
+    # pencil grid) does not apply — unabsorbable layouts get the edge
+    # reshard exactly like any other non-chain layout.
     kin = classify_layout(mesh, in_spec) if (
-        mesh is not None and in_spec is not None) else None
+        mesh is not None and in_spec is not None and not hier) else None
     kout = classify_layout(mesh, out_spec) if (
-        mesh is not None and out_spec is not None) else None
+        mesh is not None and out_spec is not None and not hier) else None
     slab_axes = None
     perm = order = None
     in_absorbed = in_spec is None or mesh is None
@@ -577,10 +652,14 @@ def logic_plan3d(
             # A batched chain's per-device block is B-fold, which is what
             # the "auto" block-bytes crossover must judge.
             itemsize=8 * (batch or 1)))
+    # Resolve the wire-compression knob (None -> DFFT_WIRE_DTYPE env) to
+    # a concrete mode; single-device chains have no wire to compress.
+    wire = None if (decomp == "single" or mesh is None) else (
+        resolve_wire_dtype(options.wire_dtype))
     return LogicPlan(
         shape=shape, decomposition=decomp, mesh=mesh,
         options=replace(options, decomposition=decomp,
-                        overlap_chunks=overlap),
+                        overlap_chunks=overlap, wire_dtype=wire),
         forward=forward,
         slab_axes=slab_axes, pencil_perm=perm, pencil_order=order,
         in_absorbed=in_absorbed, out_absorbed=out_absorbed,
@@ -634,7 +713,10 @@ def stage_layouts(
         return (((0, 1, 2), (world,)),)
     if decomposition == "slab":
         in_axis, out_axis = slab_axes if slab_axes is not None else (0, 1)
-        p = mesh.shape[mesh.axis_names[0]]
+        # Product over every mesh axis: a 1D slab mesh has one, the
+        # hierarchical slab chain's hybrid (dcn x ici) mesh has two
+        # (their row-major linearization IS the combined slab axis).
+        p = math.prod(mesh.shape[a] for a in mesh.axis_names)
         local_axes = tuple(a for a in range(3) if a != in_axis)
         return (
             (local_axes, _grid_boxes(world, {in_axis: p})),
@@ -674,21 +756,55 @@ def exchange_payloads(lp: LogicPlan, shape, itemsize: int) -> list[dict]:
     collective per stage — every byte entry scales by B (and the
     per-execute wire counters and the tuner's pruning model inherit that
     scaling from here), while ``parts``/launch counts do not.
+
+    Every entry additionally carries ``link`` ("ici" | "dcn" — which
+    fabric the entry's mesh axis rides, so the model prices each leg
+    with the right bandwidth) and ``wire_factor`` (the on-wire byte
+    scale of the plan's ``wire_dtype`` compression: 1.0 exact, 0.5 for
+    c64 -> bf16 pairs — multiply any byte entry by it for the bytes
+    actually on the wire). A hierarchical slab plan returns TWO entries
+    (``t2a`` on the ICI axis, ``t2b`` on the DCN axis) — per-leg
+    accounting of the one logical exchange.
     """
     if lp.mesh is None:
         return []
     shape = tuple(int(s) for s in shape)
     bsz = getattr(lp, "batch", None) or 1
     pad = lambda n, k: k * (-(-n // k))
+    wf = wire_itemsize(itemsize, lp.options.wire_dtype) / itemsize
+    link = lambda ax: "dcn" if str(ax) == "dcn" else "ici"
     out = []
     if lp.decomposition == "slab":
-        p = lp.mesh.shape[lp.mesh.axis_names[0]]
+        names = lp.mesh.axis_names
+        p = math.prod(lp.mesh.shape[a] for a in names)
         a_in, a_out = lp.slab_axes if lp.slab_axes else (0, 1)
         oth = 3 - a_in - a_out
         n_in, n_out, n_oth = shape[a_in], shape[a_out], shape[oth]
+        if lp.options.algorithm == "hierarchical" and len(names) == 2:
+            # Two axis-local legs of the one logical exchange: each leg
+            # is a dense tiled all-to-all over ITS axis of the padded
+            # block, so each leg ships fraction (parts-1)/parts of the
+            # padded world on its own fabric.
+            dcn_name, ici_name = names
+            padded = pad(n_in, p) * pad(n_out, p) * n_oth
+            truev = n_in * n_out * n_oth
+            for stage, ax_name, parts in (
+                    ("t2a", ici_name, lp.mesh.shape[ici_name]),
+                    ("t2b", dcn_name, lp.mesh.shape[dcn_name])):
+                f = (parts - 1) / parts
+                dense = int(padded * f * itemsize * bsz)
+                out.append({
+                    "stage": stage, "mesh_axis": ax_name, "parts": parts,
+                    "link": link(ax_name), "wire_factor": wf,
+                    "true_bytes": int(truev * f * itemsize * bsz),
+                    "alltoall_bytes": dense,
+                    "alltoallv_bytes": dense,  # each leg is dense
+                })
+            return out
         f = (p - 1) / p
         out.append({
-            "stage": "t2", "mesh_axis": lp.mesh.axis_names[0], "parts": p,
+            "stage": "t2", "mesh_axis": names[0], "parts": p,
+            "link": link(names[0]), "wire_factor": wf,
             "true_bytes": int(n_in * n_out * n_oth * f * itemsize * bsz),
             "alltoall_bytes": int(pad(n_in, p) * pad(n_out, p) * n_oth * f
                                   * itemsize * bsz),
@@ -714,6 +830,7 @@ def exchange_payloads(lp: LogicPlan, shape, itemsize: int) -> list[dict]:
         out.append({
             "stage": stage, "mesh_axis": lp.mesh.axis_names[ax_i],
             "parts": parts,
+            "link": link(lp.mesh.axis_names[ax_i]), "wire_factor": wf,
             "true_bytes": int(true_vol * f * itemsize * bsz),
             "alltoall_bytes": int(bystander_padded * pad(shape[split], parts)
                                   * f * itemsize * bsz),
@@ -734,6 +851,7 @@ def model_stage_seconds(
     algorithm: str | None = None,
     overlap_chunks: int | None = None,
     exchange_correction: float = 1.0,
+    dcn_gbps: float | None = None,
 ) -> dict:
     """Per-stage analytical prediction of one execution, keyed exactly
     ``t0..t3`` — the model side of the explain/attribution join.
@@ -808,11 +926,21 @@ def model_stage_seconds(
     payloads = exchange_payloads(lp, shape, itemsize)
     hide = {"t2": out["t3"]["seconds"], "t2a": out["t1"]["seconds"],
             "t2b": out["t3"]["seconds"]}
+    if lp.decomposition == "slab":
+        # A hierarchical slab plan's two legs both hide under t3 (the
+        # pencil-style t2a/t2b taxonomy without a mid FFT stage).
+        hide["t2a"] = hide["t2b"] = out["t3"]["seconds"]
     t2 = out["t2"]
     for e in payloads:
-        wire = e[WIRE_BYTE_KEYS[alg]] / ndev
+        # Per-leg link bandwidth: the DCN leg of a hierarchical (or
+        # hybrid-mesh pencil) exchange is priced at the calibrated DCN
+        # figure, not the ICI one. wire_factor scales for the plan's
+        # on-wire compression (bf16 pairs halve c64 wire bytes).
+        gbps = (dcn_gbps if e.get("link") == "dcn" and dcn_gbps
+                else wire_gbps)
+        wire = e[WIRE_BYTE_KEYS[alg]] * e.get("wire_factor", 1.0) / ndev
         m = exchange_model_seconds(
-            wire, e["parts"], alg, wire_gbps=wire_gbps,
+            wire, e["parts"], alg, wire_gbps=gbps,
             launch_seconds=launch_seconds, overlap_chunks=k,
             hide_seconds=hide.get(e["stage"], 0.0))
         t2["seconds"] += m["exposed_seconds"] * exchange_correction
@@ -821,6 +949,15 @@ def model_stage_seconds(
         t2["raw_seconds"] += m["seconds"] * exchange_correction
         t2.setdefault("steps", 0)
         t2["steps"] += m["steps"]
+        # Per-leg attribution rows (the t2a/t2b join axis of explain):
+        # one entry per exchange/leg with its own modeled time.
+        t2.setdefault("legs", []).append({
+            "stage": e["stage"], "mesh_axis": str(e["mesh_axis"]),
+            "link": e.get("link", "ici"), "parts": e["parts"],
+            "wire_bytes": wire, "wire_gbps": gbps,
+            "seconds": m["exposed_seconds"] * exchange_correction,
+            "raw_seconds": m["seconds"] * exchange_correction,
+        })
     return out
 
 

@@ -229,8 +229,14 @@ def normalize_bench_line(
     # by bench.py only when a calibrated profile was live, so that
     # calibrated-model runs and default-constant runs never share a
     # baseline; default rows keep the old schema AND the old groups).
+    # "wire_dtype" (on-wire compressed exchange) and "transport" (a
+    # non-default exchange algorithm, hierarchical included) are keyed
+    # for the same reason: a bf16-wire or two-leg run compiles a
+    # different collective program than the exact flat exchange, so
+    # compressed and exact runs never share a baseline; default rows
+    # (exact wire, alltoall) keep the old schema and groups.
     for k in ("dtype", "devices", "decomposition", "overlap", "tuned",
-              "batch", "profile"):
+              "batch", "profile", "wire_dtype", "transport"):
         if obj.get(k) is not None:
             config[k] = obj[k]
     ex: dict = {}

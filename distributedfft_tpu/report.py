@@ -876,13 +876,23 @@ def _kind_matches(a: str, b: str) -> bool:
     return a == b or a in b or b in a
 
 
+def _winner_label(w: dict) -> str:
+    """One wisdom winner dict as the compact label benchmark lines stamp
+    (``decomposition/transport/executor/ovK[+wDTYPE]`` — must agree with
+    ``tuner.Candidate.label``, wire suffix included, or compressed
+    winners silently never match their history rows)."""
+    label = (f"{w.get('decomposition')}/{w.get('algorithm')}"
+             f"/{w.get('executor')}/ov{w.get('overlap_chunks')}")
+    if w.get("wire_dtype"):
+        label += f"+w{w['wire_dtype']}"
+    return label
+
+
 def _wisdom_fresh_seconds(entry: dict, records: list[dict]) -> list[float]:
     """Per-execute seconds of fresh history records matching one wisdom
     entry's winner tuple (the ``tuned=<label>`` baseline group) on the
     same hardware, eligible runs only."""
-    winner = entry.get("winner") or {}
-    label = (f"{winner.get('decomposition')}/{winner.get('algorithm')}"
-             f"/{winner.get('executor')}/ov{winner.get('overlap_chunks')}")
+    label = _winner_label(entry.get("winner") or {})
     kind = str((entry.get("key") or {}).get("device_kind", ""))
     out = []
     for rec in records:
@@ -909,10 +919,7 @@ def _wisdom_summary(entry: dict) -> tuple[str, str]:
     k = (f"{key.get('kind', '?')} {shape} {key.get('dtype', '?')} "
          f"dir{key.get('direction', '?')} {where} "
          f"[{key.get('device_kind', '?')}]")
-    w = entry.get("winner") or {}
-    label = (f"{w.get('decomposition')}/{w.get('algorithm')}"
-             f"/{w.get('executor')}/ov{w.get('overlap_chunks')}")
-    return k, label
+    return k, _winner_label(entry.get("winner") or {})
 
 
 def _main_wisdom(argv: list[str]) -> int:

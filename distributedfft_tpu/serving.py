@@ -446,6 +446,7 @@ def warm_pool(mesh=None, top_n: int = 4, *, path: str | None = None,
                     key=lambda e: str(e.get("recorded_at", "")),
                     reverse=True)[:max(0, int(top_n))]
     plans = []
+    on = tracing_enabled()
     for entry in ranked:
         k = entry["key"]
         plan_fn = (api.plan_dft_r2c_3d if k["kind"] == "r2c"
@@ -454,10 +455,17 @@ def warm_pool(mesh=None, top_n: int = 4, *, path: str | None = None,
         if max_batch is not None:
             batches.add(int(max_batch))
         for b in sorted(batches, key=lambda v: (v is not None, v)):
+            # One flight-recorder span per preplanned build (same naming
+            # scheme as serve_plan), so a pool warm-up is attributable
+            # on the merged timeline next to the serving spans.
+            name = (f"warm_plan[{k['kind']}:"
+                    f"{'x'.join(str(s) for s in k['shape'])}"
+                    + (f":b{b}" if b else "") + "]") if on else ""
             try:
-                plans.append(plan_fn(
-                    tuple(k["shape"]), mesh, direction=k["direction"],
-                    dtype=jnp.dtype(k["dtype"]), tune="wisdom", batch=b))
+                with _span(name, on):
+                    plans.append(plan_fn(
+                        tuple(k["shape"]), mesh, direction=k["direction"],
+                        dtype=jnp.dtype(k["dtype"]), tune="wisdom", batch=b))
             except Exception:  # noqa: BLE001 — a stale tuple never
                 continue       # blocks the rest of the pool
     if _metrics._enabled:

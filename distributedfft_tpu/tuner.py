@@ -101,6 +101,10 @@ DEFAULT_MAX_CANDIDATES = 8
 MODEL_WIRE_GBPS = 45.0
 MODEL_HBM_GBPS = 819.0
 MODEL_LAUNCH_SECONDS = 100e-6
+#: DCN (inter-slice) leg bandwidth of the ranking model — roughly one
+#: host's share of a data-center NIC, an order of magnitude below ICI.
+#: A calibrated profile's measured ``dcn_gbps`` overrides it.
+MODEL_DCN_GBPS = 11.0
 
 #: Executor preference order when the model cannot rank them (it models
 #: geometry only): the menu order of ``api._AUTO_CANDIDATES``.
@@ -110,28 +114,34 @@ _EXECUTOR_RANK = ("xla", "xla_minor", "matmul", "pallas")
 @dataclass(frozen=True)
 class Candidate:
     """One point of the joint search space (the tuple a wisdom entry
-    records and a tuned plan stamps into benchmark result lines)."""
+    records and a tuned plan stamps into benchmark result lines).
+    ``wire_dtype`` is the on-wire compression dimension (None = exact);
+    compressed candidates enter the space only for plans that declare a
+    ``max_roundtrip_err`` budget."""
 
     decomposition: str
     algorithm: str
     executor: str
     overlap_chunks: int
+    wire_dtype: str | None = None
 
     @property
     def label(self) -> str:
-        return (f"{self.decomposition}/{self.algorithm}/{self.executor}"
+        base = (f"{self.decomposition}/{self.algorithm}/{self.executor}"
                 f"/ov{self.overlap_chunks}")
+        return base + (f"+w{self.wire_dtype}" if self.wire_dtype else "")
 
 
 def tuned_label(plan) -> str:
     """The winner tuple of a tuned plan as the compact
-    ``decomposition/transport/executor/ovK`` label benchmark result
-    lines stamp (and the regress store keys baselines by)."""
+    ``decomposition/transport/executor/ovK[+wDTYPE]`` label benchmark
+    result lines stamp (and the regress store keys baselines by)."""
     return Candidate(
         decomposition=plan.decomposition,
         algorithm=plan.options.algorithm,
         executor=plan.executor,
         overlap_chunks=int(plan.options.overlap_chunks or 1),
+        wire_dtype=getattr(plan.options, "wire_dtype", None),
     ).label
 
 
@@ -171,28 +181,48 @@ def enumerate_candidates(
     executors: Sequence[str] | None = None,
     itemsize: int = 8,
     batch: int | None = None,
+    hybrid: bool = False,
+    wire_dtypes: Sequence[str | None] = (None,),
 ) -> list[Candidate]:
-    """Enumerate the joint (decomposition x transport x executor x K)
-    space for one plan. ``mesh_dims`` (a caller-fixed Mesh) pins the
-    decomposition axis — a 1D mesh can only run slab chains, a 2D mesh
-    only pencil; an int device count leaves both in play. ``batch``
+    """Enumerate the joint (decomposition x transport x executor x K x
+    wire) space for one plan. ``mesh_dims`` (a caller-fixed Mesh) pins
+    the decomposition axis — a 1D mesh can only run slab chains, a 2D
+    mesh only pencil; an int device count leaves both in play. ``batch``
     scales the per-device block the K axis brackets (a batched plan's
-    auto-K crossover moves with the B-fold payload)."""
+    auto-K crossover moves with the B-fold payload).
+
+    ``hybrid=True`` (the caller's mesh is a dcn x ici hybrid,
+    :func:`..parallel.multihost.is_hybrid_mesh`) adds the hierarchical
+    two-leg slab transport next to the flat-transport pencil chains.
+    ``wire_dtypes`` is the on-wire compression axis — ``(None,)`` by
+    default; the tuned planner widens it to ``(None, "bf16")`` only for
+    plans that declare a ``max_roundtrip_err`` budget."""
+    from .parallel.exchange import FLAT_ALGORITHMS
+
     shape = tuple(int(s) for s in shape)
-    if mesh_dims is not None:
-        decomps: tuple[str, ...] = (
-            "slab" if len(mesh_dims) == 1 else "pencil",)
+    if hybrid:
+        # Hybrid (dcn x ici) mesh: the pencil chain runs each exchange
+        # on one mesh axis (flat transports), and the slab chain runs
+        # over the combined axis — reachable only through the two-leg
+        # hierarchical transport.
+        pairs = [("pencil", alg) for alg in FLAT_ALGORITHMS]
+        pairs += [("slab", "hierarchical")]
     else:
-        decomps = tuple(d for d in eligible_decompositions(shape, ndev)
-                        if d != "single")
+        if mesh_dims is not None:
+            decomps: tuple[str, ...] = (
+                "slab" if len(mesh_dims) == 1 else "pencil",)
+        else:
+            decomps = tuple(d for d in eligible_decompositions(shape, ndev)
+                            if d != "single")
+        pairs = [(d, alg) for d in decomps for alg in FLAT_ALGORITHMS]
     execs = list(executors) if executors is not None else _default_executors()
     ks = _overlap_values(shape, ndev, itemsize * (batch or 1))
     out = []
-    for d in decomps:
-        for alg in WIRE_BYTE_KEYS:
+    for d, alg in pairs:
+        for wd in wire_dtypes:
             for k in ks:
                 for ex in execs:
-                    out.append(Candidate(d, alg, ex, k))
+                    out.append(Candidate(d, alg, ex, k, wd))
     return out
 
 
@@ -238,7 +268,7 @@ def model_cost(
     shape = tuple(int(s) for s in shape)
     lp = logic_plan3d(shape, mesh, PlanOptions(
         decomposition=cand.decomposition, algorithm=cand.algorithm,
-        tune="off"), batch=batch)
+        wire_dtype=cand.wire_dtype or "none", tune="off"), batch=batch)
     ndev = (math.prod(lp.mesh.devices.shape) if lp.mesh is not None else 1)
     world_bytes = itemsize * math.prod(shape) * (batch or 1)
     t_fft = 3 * 2 * (world_bytes / ndev) / (MODEL_HBM_GBPS * 1e9)
@@ -247,10 +277,16 @@ def model_cost(
     t_stage = t_fft / (len(payloads) + 1)
     total = t_fft
     for e in payloads:
-        wire = e[WIRE_BYTE_KEYS[cand.algorithm]] / ndev
+        # Per-leg pricing: the DCN leg of a hierarchical (or hybrid-mesh
+        # pencil) exchange rides the slow fabric; wire_factor scales for
+        # the candidate's on-wire compression.
+        gbps = (MODEL_DCN_GBPS if e.get("link") == "dcn"
+                else MODEL_WIRE_GBPS)
+        wire = (e[WIRE_BYTE_KEYS[cand.algorithm]]
+                * e.get("wire_factor", 1.0) / ndev)
         total += exchange_model_seconds(
             wire, e["parts"], cand.algorithm,
-            wire_gbps=MODEL_WIRE_GBPS,
+            wire_gbps=gbps,
             launch_seconds=MODEL_LAUNCH_SECONDS,
             overlap_chunks=cand.overlap_chunks,
             hide_seconds=t_stage)["exposed_seconds"] * corr
@@ -265,25 +301,42 @@ def prune_candidates(
     itemsize: int = 8,
     limit: int | None = None,
     batch: int | None = None,
+    max_err: float | None = None,
+    dtype=None,
 ) -> list[Candidate]:
     """Prune the enumerated space to <= ``limit`` survivors before any
-    compile: geometry tuples (decomposition, transport, K) are ranked by
-    :func:`model_cost`, then crossed with the executor axis (which the
-    payload model cannot rank — executors differ in compute kernels, not
-    wire bytes) best-geometry-first, so the survivor set always measures
-    every executor on the model's preferred geometry before spending
-    compiles on runner-up geometries."""
+    compile: geometry tuples (decomposition, transport, K, wire) are
+    ranked by :func:`model_cost`, then crossed with the executor axis
+    (which the payload model cannot rank — executors differ in compute
+    kernels, not wire bytes) best-geometry-first, so the survivor set
+    always measures every executor on the model's preferred geometry
+    before spending compiles on runner-up geometries.
+
+    ``max_err`` is the plan's round-trip error budget: compressed
+    (``wire_dtype``) candidates whose measured wire round-trip error
+    (:func:`..parallel.exchange.wire_roundtrip_error` at ``dtype``)
+    exceeds it are filtered out before any ranking — a candidate the
+    budget can never admit must not crowd the survivor set."""
+    from .parallel.exchange import wire_roundtrip_error
+
+    if max_err is not None:
+        candidates = [
+            c for c in candidates
+            if c.wire_dtype is None
+            or wire_roundtrip_error(dtype if dtype is not None
+                                    else np.complex64,
+                                    c.wire_dtype) <= max_err]
     if limit is None:
         limit = int(os.environ.get("DFFT_TUNE_MAX", DEFAULT_MAX_CANDIDATES))
     limit = max(1, limit)
     geos: dict[tuple, list[Candidate]] = {}
     for c in candidates:
         geos.setdefault(
-            (c.decomposition, c.algorithm, c.overlap_chunks), []).append(c)
+            (c.decomposition, c.algorithm, c.overlap_chunks,
+             c.wire_dtype or ""), []).append(c)
 
     def geo_cost(key) -> float:
-        d, alg, k = key
-        probe = geos[(d, alg, k)][0]
+        probe = geos[key][0]
         return model_cost(probe, shape, mesh, itemsize=itemsize,
                           batch=batch)
 
@@ -487,6 +540,7 @@ def wisdom_key(
     device_kind: str | None = None,
     platform: str | None = None,
     batch: int | None = None,
+    err_budget: float | None = None,
 ) -> dict:
     """The identity a wisdom entry is valid for. A measured winner
     transfers only within one (plan family, problem, mesh, hardware,
@@ -495,7 +549,10 @@ def wisdom_key(
     compiles to. ``batch`` keys batched serving plans separately: a
     B-fold exchange payload moves the transport/overlap crossovers, so a
     winner measured unbatched must never be replayed for a batched
-    program (or vice versa)."""
+    program (or vice versa). ``err_budget`` (the plan's
+    ``max_roundtrip_err``) keys budgeted and exact-only plans apart: the
+    budget changes the admissible candidate space, so a winner measured
+    under one budget must never replay into a plan with another."""
     import jax
 
     from . import __version__
@@ -514,6 +571,7 @@ def wisdom_key(
         "mesh": None if mesh_dims is None else [int(d) for d in mesh_dims],
         "layouts": layouts,
         "batch": None if batch is None else int(batch),
+        "err_budget": None if err_budget is None else float(err_budget),
         "device_kind": str(device_kind),
         "platform": platform or jax.default_backend(),
         "x64": bool(jax.config.jax_enable_x64),
@@ -599,10 +657,18 @@ def record_wisdom(
             "algorithm": winner.algorithm,
             "executor": winner.executor,
             "overlap_chunks": int(winner.overlap_chunks),
+            "wire_dtype": winner.wire_dtype,
         },
         "seconds": float(seconds),
         "budget": [it, rep],
     }
+    if winner.wire_dtype is not None:
+        # The measured wire round-trip error of the compressed winner:
+        # the number the replay-side budget check admits against.
+        from .parallel.exchange import wire_roundtrip_error
+
+        entry["compression_err"] = wire_roundtrip_error(
+            key.get("dtype", "complex64"), winner.wire_dtype)
     if times:
         entry["times"] = {
             nm: (None if not math.isfinite(t) else float(t))
@@ -701,7 +767,9 @@ def _build_candidate(kind: str, shape, mesh, base: PlanOptions, plan_kw: dict,
     opts = replace(
         base, tune="off", decomposition=cand.decomposition,
         algorithm=cand.algorithm, executor=cand.executor,
-        overlap_chunks=int(cand.overlap_chunks), donate=donate)
+        overlap_chunks=int(cand.overlap_chunks), donate=donate,
+        # "none" pins the exact wire (None would re-defer to the env).
+        wire_dtype=cand.wire_dtype or "none")
     plan_fn = api.plan_dft_r2c_3d if kind == "r2c" else api.plan_dft_c2c_3d
     return plan_fn(shape, mesh, options=opts, **plan_kw)
 
@@ -733,22 +801,38 @@ def tuned_plan(kind: str, shape, mesh, options: PlanOptions,
     dtype = api._default_cdtype(plan_kw.get("dtype"))
     in_spec, out_spec = plan_kw.get("in_spec"), plan_kw.get("out_spec")
     batch = plan_kw.get("batch")
+    err_budget = options.max_roundtrip_err
     layouts = (f"{in_spec}|{out_spec}"
                if (in_spec is not None or out_spec is not None) else None)
     key = wisdom_key(
         kind=kind, shape=shape, dtype=dtype,
         direction=plan_kw.get("direction", -1),
-        ndev=ndev, mesh_dims=mesh_dims, layouts=layouts, batch=batch)
+        ndev=ndev, mesh_dims=mesh_dims, layouts=layouts, batch=batch,
+        err_budget=err_budget)
     path = default_wisdom_path()
 
     entry = lookup_wisdom(key, path) if path is not None else None
     if entry is not None:
         _metrics.inc("tune_wisdom_hits", kind=kind)
+        wd = entry["winner"].get("wire_dtype")
+        if wd is not None:
+            # A compressed winner replays only into plans whose error
+            # budget admits its recorded round-trip error; anything else
+            # (no budget, tighter budget, missing error record) rebuilds
+            # the winner tuple on the exact wire.
+            rec_err = entry.get("compression_err")
+            if rec_err is None:
+                from .parallel.exchange import wire_roundtrip_error
+
+                rec_err = wire_roundtrip_error(dtype, wd)
+            if err_budget is None or rec_err > err_budget:
+                wd = None
         cand = Candidate(
             decomposition=str(entry["winner"]["decomposition"]),
             algorithm=str(entry["winner"]["algorithm"]),
             executor=str(entry["winner"]["executor"]),
             overlap_chunks=int(entry["winner"]["overlap_chunks"]),
+            wire_dtype=wd,
         )
         return _build_candidate(kind, shape, mesh, base, plan_kw, cand,
                                 donate=options.donate)
@@ -760,11 +844,22 @@ def tuned_plan(kind: str, shape, mesh, options: PlanOptions,
                    else api.plan_dft_c2c_3d)
         return plan_fn(shape, mesh, options=heuristic, **plan_kw)
 
+    from .parallel.multihost import is_hybrid_mesh
+
     itemsize = np.dtype(dtype).itemsize
+    # On-wire compression enters the search only for plans that declare
+    # an error budget; the hierarchical transport only on hybrid meshes
+    # (and only for the c2c chains — the r2c builders run flat).
+    wire_dtypes: tuple = (None,)
+    if err_budget is not None:
+        wire_dtypes = (None, "bf16")
+    hybrid = kind == "c2c" and is_hybrid_mesh(mesh)
     cands = prune_candidates(
         enumerate_candidates(shape, ndev, mesh_dims=mesh_dims,
-                             itemsize=itemsize, batch=batch),
-        shape, mesh, itemsize=itemsize, batch=batch)
+                             itemsize=itemsize, batch=batch,
+                             hybrid=hybrid, wire_dtypes=wire_dtypes),
+        shape, mesh, itemsize=itemsize, batch=batch,
+        max_err=err_budget, dtype=dtype)
     _metrics.set_gauge("tune_candidates", len(cands), kind=kind,
                        stage="pruned")
     by_label = {c.label: c for c in cands}

@@ -6,6 +6,12 @@
 #
 # The MPI rank count becomes the device-mesh size; on a machine without that
 # many accelerators, add -cpu to provision a virtual CPU mesh.
+#
+# WIRE=bf16 (or none) sweeps the on-wire exchange compression column
+# without editing the invocation: the value is forwarded as -wire, so a
+# campaign runner can do `WIRE=none ./speedTest.sh ...` then
+# `WIRE=bf16 ./speedTest.sh ...` and the CSV algorithm column keys the
+# two rows apart ('alltoall' vs 'alltoall+wbf16').
 set -euo pipefail
 if [ $# -lt 4 ]; then
     echo "usage: $0 <ndev> <NX> <NY> <NZ> [flags...]" >&2
@@ -13,4 +19,4 @@ if [ $# -lt 4 ]; then
 fi
 NDEV=$1; NX=$2; NY=$3; NZ=$4; shift 4
 exec python "$(dirname "$0")/benchmarks/speed3d.py" c2c single \
-    "$NX" "$NY" "$NZ" -ndev "$NDEV" "$@"
+    "$NX" "$NY" "$NZ" -ndev "$NDEV" ${WIRE:+-wire "$WIRE"} "$@"

@@ -1,0 +1,649 @@
+"""Topology-aware t2 exchange (PR 8): hierarchical ICI/DCN two-leg
+transport + on-wire bf16 compression, through the tuner/explain/regress
+loop.
+
+Contracts pinned on the 8-way CPU mesh:
+
+1. **Defaults are free** — ``wire_dtype=None`` (env unset) and the
+   default transport compile byte-identical HLO to an explicitly exact
+   plan; no bf16 collective sneaks into a default program (the batch=1 /
+   overlap-K=1 pin discipline).
+2. **bf16 wire halves t2 bytes** — `WIRE_BYTE_KEYS`-accounted wire
+   bytes are exactly halved for c64 across all three flat transports x
+   slab/pencil x K in {1,2} x batch in {None, B}, the lowered StableHLO
+   carries the bf16 collective, and the measured round-trip error is
+   bounded (<= 1e-2 rel for the c64 smoke shapes).
+3. **Hierarchical = flat, bit for bit** — the two-leg transport on a
+   2x4 (dcn x ici) hybrid mesh reproduces the flat slab exchange exactly
+   (even and uneven extents, c64 and c128, composed with the bf16 wire),
+   and its legs surface as separate ``t2a``/``t2b`` stages/rows in the
+   staged pipeline and ``dfft.explain``.
+4. **Tuner integration** — both dimensions enumerate (hybrid pairing,
+   budget-gated wire axis), prune under the per-leg model, persist to
+   wisdom with the extended key, and compressed winners replay only into
+   plans whose error budget admits their recorded round-trip error.
+
+NOTE on the filename: this module must collect BEFORE
+``test_alltoallv.py`` (alphabetical collection). The environment's
+XLA:CPU has a known fft-thunk layout bug whose INTERNAL error
+permanently poisons the process's sharded dispatch stream; once any
+earlier test trips it, every later 8-device execute fails regardless of
+correctness. The parity assertions here need a clean backend, and this
+file itself triggers no fft-layout fault. The guard in
+``test_explain.py::test_poison_ordering_guard`` pins the name.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu import regress, report, tuner
+from distributedfft_tpu.parallel import multihost
+from distributedfft_tpu.parallel.exchange import (
+    ALGORITHMS,
+    FLAT_ALGORITHMS,
+    WIRE_DTYPES,
+    wire_decode,
+    wire_encode,
+    wire_itemsize,
+    wire_roundtrip_error,
+)
+from distributedfft_tpu.plan_logic import (
+    PlanOptions,
+    exchange_payloads,
+    model_stage_seconds,
+    resolve_wire_dtype,
+)
+
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the virtual 8-device mesh"
+)
+
+SHAPE = (16, 16, 8)
+UNEVEN = (12, 10, 9)
+CDT = jnp.complex64
+ERR_BOUND = 1e-2  # acceptance bound for c64 smoke shapes
+
+
+def _hybrid_mesh():
+    return Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dcn", "ici"))
+
+
+def _world(shape=SHAPE, seed=7):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(shape)
+            + 1j * rng.standard_normal(shape)).astype(np.complex64)
+
+
+def _rel_err(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return float(np.max(np.abs(a - b)) / np.max(np.abs(b)))
+
+
+@pytest.fixture
+def wisdom_path(tmp_path, monkeypatch):
+    monkeypatch.setenv("DFFT_WISDOM", str(tmp_path / "wisdom.jsonl"))
+    monkeypatch.setenv("DFFT_COMPILE_CACHE", str(tmp_path / "xla_cache"))
+    return str(tmp_path / "wisdom.jsonl")
+
+
+# ------------------------------------------------------- wire primitives
+
+def test_wire_itemsize():
+    assert wire_itemsize(8, None) == 8
+    assert wire_itemsize(16, None) == 16
+    assert wire_itemsize(8, "bf16") == 4    # c64 -> bf16 pair: half
+    assert wire_itemsize(16, "bf16") == 4   # c128 -> bf16 pair: quarter
+    with pytest.raises(ValueError, match="wire_dtype"):
+        wire_itemsize(8, "fp8")
+
+
+def test_wire_encode_decode_roundtrip():
+    x = jnp.asarray(_world((4, 5, 3)))
+    w = wire_encode(x, "bf16")
+    assert w.dtype == jnp.bfloat16 and w.shape == x.shape + (2,)
+    y = wire_decode(w, x.dtype)
+    assert y.dtype == x.dtype and y.shape == x.shape
+    assert _rel_err(y, x) <= ERR_BOUND
+    # bf16 round-trips are idempotent: a second cast pair is exact (the
+    # staged per-leg decode/encode boundary relies on this).
+    assert np.array_equal(
+        np.asarray(wire_decode(wire_encode(y, "bf16"), y.dtype)),
+        np.asarray(y))
+    with pytest.raises(TypeError, match="complex"):
+        wire_encode(jnp.zeros((3,), jnp.float32), "bf16")
+    with pytest.raises(ValueError, match="wire_dtype"):
+        wire_encode(x, "int8")
+
+
+def test_wire_roundtrip_error_measured_and_cached():
+    assert wire_roundtrip_error(np.complex64, None) == 0.0
+    e64 = wire_roundtrip_error(np.complex64, "bf16")
+    assert 0.0 < e64 <= ERR_BOUND
+    e128 = wire_roundtrip_error(np.complex128, "bf16")
+    assert 0.0 < e128 <= ERR_BOUND
+    # Deterministic (seeded + cached): the tuner's per-candidate budget
+    # filter must see one number, not a noise source.
+    assert wire_roundtrip_error(np.complex64, "bf16") == e64
+
+
+# -------------------------------------------------- options / env plumbing
+
+def test_plan_options_validate_wire():
+    assert PlanOptions(wire_dtype="bf16").wire_dtype == "bf16"
+    assert PlanOptions(wire_dtype="BF16").wire_dtype == "bf16"
+    assert PlanOptions(wire_dtype=None).wire_dtype is None
+    assert PlanOptions(wire_dtype="none").wire_dtype == "none"
+    with pytest.raises(ValueError, match="wire_dtype"):
+        PlanOptions(wire_dtype="fp8")
+    assert PlanOptions(max_roundtrip_err=1e-2).max_roundtrip_err == 1e-2
+    for bad in (0.0, -1.0, True, "x"):
+        with pytest.raises(ValueError, match="max_roundtrip_err"):
+            PlanOptions(max_roundtrip_err=bad)
+    assert "hierarchical" in ALGORITHMS
+    assert "hierarchical" not in FLAT_ALGORITHMS
+    assert None in WIRE_DTYPES and "bf16" in WIRE_DTYPES
+
+
+def test_resolve_wire_dtype_env(monkeypatch):
+    monkeypatch.delenv("DFFT_WIRE_DTYPE", raising=False)
+    assert resolve_wire_dtype(None) is None
+    monkeypatch.setenv("DFFT_WIRE_DTYPE", "bf16")
+    assert resolve_wire_dtype(None) == "bf16"
+    # "none" pins the exact wire regardless of the env.
+    assert resolve_wire_dtype("none") is None
+    monkeypatch.setenv("DFFT_WIRE_DTYPE", "fp8")
+    with pytest.raises(ValueError, match="DFFT_WIRE_DTYPE"):
+        resolve_wire_dtype(None)
+
+
+# ----------------------------------------------------------- default pin
+
+@needs_mesh
+@pytest.mark.parametrize("mesh_shape", [8, (2, 4)])
+def test_default_hlo_byte_identical(mesh_shape, monkeypatch):
+    """wire_dtype=None (env unset) IS the exact plan: byte-identical
+    lowered HLO, no bf16 collective — the batch=1 / K=1 pin rule."""
+    monkeypatch.delenv("DFFT_WIRE_DTYPE", raising=False)
+    mesh = dfft.make_mesh(mesh_shape)
+    base = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT)
+    pinned = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT,
+                                  wire_dtype="none")
+    assert base.options.wire_dtype is None
+    t_base = base.fn.lower(
+        jax.ShapeDtypeStruct(base.in_shape, base.in_dtype)).as_text()
+    t_pin = pinned.fn.lower(
+        jax.ShapeDtypeStruct(pinned.in_shape, pinned.in_dtype)).as_text()
+    assert t_base == t_pin
+    assert "bf16" not in t_base
+
+
+# --------------------------------------------------- bf16 wire acceptance
+
+@needs_mesh
+@pytest.mark.parametrize("alg", FLAT_ALGORITHMS)
+@pytest.mark.parametrize("mesh_shape", [8, (2, 4)])
+@pytest.mark.parametrize("k", [1, 2])
+@pytest.mark.parametrize("batch", [None, 3])
+def test_bf16_wire_bytes_halved(alg, mesh_shape, k, batch):
+    """The acceptance matrix: c64 wire bytes exactly halved (per the
+    shared WIRE_BYTE_KEYS accounting) on all three flat transports x
+    slab/pencil x K in {1,2} x batch in {None, B}, with the bf16
+    collective visible in the lowered program."""
+    from distributedfft_tpu.api import _plan_exchange_bytes
+
+    mesh = dfft.make_mesh(mesh_shape)
+    kw = dict(dtype=CDT, algorithm=alg, overlap_chunks=k, batch=batch)
+    exact = dfft.plan_dft_c2c_3d(SHAPE, mesh, **kw)
+    comp = dfft.plan_dft_c2c_3d(SHAPE, mesh, wire_dtype="bf16", **kw)
+    t_e, w_e = _plan_exchange_bytes(exact)
+    t_c, w_c = _plan_exchange_bytes(comp)
+    assert t_c == t_e                  # true information is unchanged
+    assert w_c * 2 == w_e              # wire bytes exactly halved
+    txt = comp.fn.lower(
+        jax.ShapeDtypeStruct(comp.in_shape, comp.in_dtype)).as_text()
+    assert "bf16" in txt
+
+
+@needs_mesh
+@pytest.mark.parametrize("alg", FLAT_ALGORITHMS)
+@pytest.mark.parametrize("shape", [SHAPE, UNEVEN])
+def test_bf16_roundtrip_error_bounded(alg, shape):
+    """Compressed forward output vs the exact plan's: bounded by the
+    measured one-cast error (x2 slack for the two exchanges of a pencil
+    chain and accumulation through the FFTs)."""
+    mesh = dfft.make_mesh(8)
+    exact = dfft.plan_dft_c2c_3d(shape, mesh, dtype=CDT, algorithm=alg)
+    comp = dfft.plan_dft_c2c_3d(shape, mesh, dtype=CDT, algorithm=alg,
+                                wire_dtype="bf16")
+    x = jnp.asarray(_world(shape))
+    assert _rel_err(comp(x), exact(x)) <= ERR_BOUND
+
+
+@needs_mesh
+def test_bf16_env_resolves_into_plan(monkeypatch):
+    monkeypatch.setenv("DFFT_WIRE_DTYPE", "bf16")
+    dfft.clear_plan_cache()
+    try:
+        plan = dfft.plan_dft_c2c_3d(SHAPE, dfft.make_mesh(8), dtype=CDT)
+        assert plan.options.wire_dtype == "bf16"
+    finally:
+        dfft.clear_plan_cache()
+
+
+def test_payload_wire_factor_single_device():
+    # Single-device plans have no wire to compress: the option resolves
+    # to None and the payload list stays empty.
+    plan = dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT, wire_dtype="bf16")
+    assert plan.options.wire_dtype is None
+
+
+# ------------------------------------------------- hierarchical transport
+
+def test_hier_validation():
+    with pytest.raises(ValueError, match="hybrid"):
+        dfft.plan_dft_c2c_3d(SHAPE, dfft.make_mesh(8),
+                             algorithm="hierarchical", dtype=CDT)
+    with pytest.raises(ValueError, match="slab"):
+        dfft.plan_dft_c2c_3d(SHAPE, _hybrid_mesh(),
+                             algorithm="hierarchical",
+                             decomposition="pencil", dtype=CDT)
+    with pytest.raises(ValueError, match="c2c"):
+        dfft.plan_dft_r2c_3d(SHAPE, _hybrid_mesh(),
+                             algorithm="hierarchical")
+
+
+@needs_mesh
+@pytest.mark.parametrize("shape", [SHAPE, UNEVEN])
+@pytest.mark.parametrize("cdt", [jnp.complex64, jnp.complex128])
+@pytest.mark.parametrize("direction", [dfft.FORWARD, dfft.BACKWARD])
+def test_hier_parity_bitwise(shape, cdt, direction):
+    """Bit parity with the flat slab exchange over the combined axis,
+    even and uneven extents, both directions, both widths."""
+    hier = dfft.plan_dft_c2c_3d(shape, _hybrid_mesh(), dtype=cdt,
+                                algorithm="hierarchical",
+                                direction=direction)
+    flat = dfft.plan_dft_c2c_3d(shape, dfft.make_mesh(8), dtype=cdt,
+                                decomposition="slab", direction=direction)
+    assert hier.decomposition == "slab"
+    x = jnp.asarray(_world(shape).astype(np.dtype(cdt)))
+    assert np.array_equal(np.asarray(hier(x)), np.asarray(flat(x)))
+
+
+@needs_mesh
+def test_hier_composes_with_wire_and_overlap():
+    """hier+bf16 == flat+bf16 bitwise (the legs are exact reorderings of
+    the encoded payload), and overlap-K keeps parity too."""
+    x = jnp.asarray(_world())
+    hier = dfft.plan_dft_c2c_3d(SHAPE, _hybrid_mesh(), dtype=CDT,
+                                algorithm="hierarchical",
+                                wire_dtype="bf16")
+    flat = dfft.plan_dft_c2c_3d(SHAPE, dfft.make_mesh(8), dtype=CDT,
+                                decomposition="slab", wire_dtype="bf16")
+    assert np.array_equal(np.asarray(hier(x)), np.asarray(flat(x)))
+    hk = dfft.plan_dft_c2c_3d(SHAPE, _hybrid_mesh(), dtype=CDT,
+                              algorithm="hierarchical", overlap_chunks=2)
+    base = dfft.plan_dft_c2c_3d(SHAPE, _hybrid_mesh(), dtype=CDT,
+                                algorithm="hierarchical")
+    assert np.array_equal(np.asarray(hk(x)), np.asarray(base(x)))
+
+
+@needs_mesh
+def test_hier_staged_legs_parity_and_names():
+    """The staged pipeline splits the hierarchical t2 into separately
+    jitted per-leg stages (t2a on the ICI axis, t2b on the DCN axis)
+    whose composition matches the fused plan bitwise."""
+    from distributedfft_tpu.parallel.slab import build_slab_stages
+
+    mesh = _hybrid_mesh()
+    stages, _ = build_slab_stages(mesh, SHAPE,
+                                  axis_name=("dcn", "ici"),
+                                  algorithm="hierarchical")
+    names = [n for n, _ in stages]
+    assert "t2a_exchange_ici" in names
+    assert "t2b_exchange_dcn" in names
+    fused = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT,
+                                 algorithm="hierarchical")
+    x = jnp.asarray(_world())
+    cur = x
+    for _, fn in stages:
+        cur = fn(cur)
+    assert np.array_equal(np.asarray(cur), np.asarray(fused(x)))
+
+
+def test_hier_payload_entries():
+    """Per-leg byte accounting: one entry per leg, tagged with its link
+    and the wire factor of the plan's compression."""
+    mesh = _hybrid_mesh()
+    lp = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT,
+                              algorithm="hierarchical").logic
+    entries = exchange_payloads(lp, SHAPE, 8)
+    assert [e["stage"] for e in entries] == ["t2a", "t2b"]
+    assert [e["link"] for e in entries] == ["ici", "dcn"]
+    assert [e["parts"] for e in entries] == [4, 2]
+    assert all(e["wire_factor"] == 1.0 for e in entries)
+    lpc = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT,
+                               algorithm="hierarchical",
+                               wire_dtype="bf16").logic
+    assert all(e["wire_factor"] == 0.5
+               for e in exchange_payloads(lpc, SHAPE, 8))
+    # Each leg ships fraction (parts-1)/parts of the world on ITS axis.
+    world = int(np.prod(SHAPE)) * 8
+    assert entries[0]["alltoall_bytes"] == world * 3 // 4
+    assert entries[1]["alltoall_bytes"] == world // 2
+
+
+def test_hier_model_prices_dcn_leg():
+    """The per-leg model: the DCN leg is priced at dcn_gbps, the ICI leg
+    at wire_gbps — visible in the t2 legs rows."""
+    lp = dfft.plan_dft_c2c_3d(SHAPE, _hybrid_mesh(), dtype=CDT,
+                              algorithm="hierarchical").logic
+    out = model_stage_seconds(lp, SHAPE, 8, hbm_gbps=819.0,
+                              wire_gbps=45.0, launch_seconds=1e-4,
+                              dcn_gbps=1.0, algorithm="hierarchical")
+    legs = {leg["stage"]: leg for leg in out["t2"]["legs"]}
+    assert legs["t2a"]["link"] == "ici" and legs["t2a"]["wire_gbps"] == 45.0
+    assert legs["t2b"]["link"] == "dcn" and legs["t2b"]["wire_gbps"] == 1.0
+    # Same wire bytes per device on the DCN leg would take ~45x longer at
+    # 1 GB/s; the leg rows carry that asymmetry.
+    assert legs["t2b"]["raw_seconds"] > legs["t2a"]["raw_seconds"]
+    # No dcn figure -> both legs priced at the flat wire number.
+    out2 = model_stage_seconds(lp, SHAPE, 8, hbm_gbps=819.0,
+                               wire_gbps=45.0, launch_seconds=1e-4,
+                               algorithm="hierarchical")
+    legs2 = {leg["stage"]: leg for leg in out2["t2"]["legs"]}
+    assert legs2["t2b"]["wire_gbps"] == 45.0
+
+
+@needs_mesh
+def test_hier_explain_legs_and_wire_block():
+    """Acceptance: the two legs appear as distinct t2a/t2b rows in
+    dfft.explain with per-leg modeled AND measured times, and the wire
+    block surfaces the measured compression error."""
+    plan = dfft.plan_dft_c2c_3d(SHAPE, _hybrid_mesh(), dtype=CDT,
+                                algorithm="hierarchical",
+                                wire_dtype="bf16")
+    rec = dfft.explain(plan, iters=2)
+    legs = {leg["stage"]: leg for leg in rec["stages"]["t2"]["legs"]}
+    assert set(legs) == {"t2a", "t2b"}
+    for leg in legs.values():
+        assert leg["seconds"] > 0            # modeled
+        assert leg["measured_seconds"] > 0   # measured
+    assert legs["t2a"]["link"] == "ici"
+    assert legs["t2b"]["link"] == "dcn"
+    assert rec["plan"]["wire_dtype"] == "bf16"
+    wire = rec["wire"]
+    assert wire["wire_dtype"] == "bf16"
+    assert 0.0 < wire["compression_err"] <= ERR_BOUND
+    assert wire["wire_factor"] == 0.5
+    # The rendered table carries the per-leg rows and the wire line.
+    txt = dfft.explain_mod.format_explain(rec)
+    assert "t2a" in txt and "t2b" in txt and "bf16" in txt
+
+
+def test_is_hybrid_mesh():
+    assert multihost.is_hybrid_mesh(_hybrid_mesh())
+    assert not multihost.is_hybrid_mesh(dfft.make_mesh(8))
+    assert not multihost.is_hybrid_mesh(dfft.make_mesh((2, 4)))
+
+
+# ------------------------------------------------------ tuner integration
+
+def test_enumerate_hybrid_pairs():
+    cands = tuner.enumerate_candidates(SHAPE, 8, hybrid=True,
+                                       executors=("xla",))
+    pairs = {(c.decomposition, c.algorithm) for c in cands}
+    assert ("slab", "hierarchical") in pairs
+    assert all(alg == "hierarchical" for d, alg in pairs if d == "slab")
+    assert {("pencil", a) for a in FLAT_ALGORITHMS} <= pairs
+    # Flat (non-hybrid) spaces never contain the two-leg transport.
+    flat = tuner.enumerate_candidates(SHAPE, 8, executors=("xla",))
+    assert all(c.algorithm != "hierarchical" for c in flat)
+
+
+def test_enumerate_wire_axis_and_labels():
+    cands = tuner.enumerate_candidates(
+        SHAPE, 8, executors=("xla",), wire_dtypes=(None, "bf16"))
+    by_wire = {c.wire_dtype for c in cands}
+    assert by_wire == {None, "bf16"}
+    comp = next(c for c in cands if c.wire_dtype == "bf16")
+    assert comp.label.endswith("+wbf16")
+    # Default axis is exact-only (today's space).
+    assert {c.wire_dtype for c in tuner.enumerate_candidates(
+        SHAPE, 8, executors=("xla",))} == {None}
+
+
+def test_prune_budget_filters_compressed():
+    cands = tuner.enumerate_candidates(
+        SHAPE, 8, executors=("xla",), wire_dtypes=(None, "bf16"))
+    # A budget below the measured cast error: compressed candidates are
+    # inadmissible and must not crowd the survivor set.
+    tight = tuner.prune_candidates(cands, SHAPE, 8, limit=32,
+                                   max_err=1e-9, dtype=np.complex64)
+    assert tight and all(c.wire_dtype is None for c in tight)
+    # A budget above it keeps the wire axis in play.
+    loose = tuner.prune_candidates(cands, SHAPE, 8, limit=32,
+                                   max_err=1e-1, dtype=np.complex64)
+    assert any(c.wire_dtype == "bf16" for c in loose)
+
+
+def test_wisdom_key_err_budget_isolated():
+    base = dict(kind="c2c", shape=SHAPE, dtype=np.complex64,
+                direction=-1, ndev=8, mesh_dims=None,
+                device_kind="cpu", platform="cpu")
+    k0 = tuner.wisdom_key(**base)
+    kb = tuner.wisdom_key(**base, err_budget=1e-2)
+    assert k0["err_budget"] is None and kb["err_budget"] == 1e-2
+    assert tuner._key_id(k0) != tuner._key_id(kb)
+
+
+def test_record_wisdom_stamps_compression_err(wisdom_path):
+    key = tuner.wisdom_key(kind="c2c", shape=SHAPE, dtype=np.complex64,
+                           direction=-1, ndev=8, mesh_dims=None,
+                           device_kind="cpu", platform="cpu",
+                           err_budget=1e-2)
+    cand = tuner.Candidate("slab", "alltoall", "xla", 1, "bf16")
+    entry = tuner.record_wisdom(key, cand, 0.001, path=wisdom_path)
+    assert entry["winner"]["wire_dtype"] == "bf16"
+    assert 0.0 < entry["compression_err"] <= ERR_BOUND
+    # Exact winners carry no error stamp (old schema preserved).
+    exact = tuner.record_wisdom(
+        tuner.wisdom_key(kind="c2c", shape=SHAPE, dtype=np.complex64,
+                         direction=-1, ndev=8, mesh_dims=None,
+                         device_kind="cpu", platform="cpu"),
+        tuner.Candidate("slab", "alltoall", "xla", 1), 0.001,
+        path=wisdom_path)
+    assert "compression_err" not in exact
+    assert exact["winner"]["wire_dtype"] is None
+
+
+def _replay_entry(wisdom_path, err_budget, compression_err):
+    """Hand-write one compressed-winner entry under the key the tuned
+    planner will look up for (SHAPE, c64, forward, ndev=8)."""
+    key = tuner.wisdom_key(kind="c2c", shape=SHAPE, dtype=np.complex64,
+                           direction=dfft.FORWARD, ndev=8,
+                           mesh_dims=None, err_budget=err_budget)
+    entry = {
+        "schema": tuner.WISDOM_SCHEMA,
+        "recorded_at": "2026-08-01T00:00:00", "key": key,
+        "winner": {"decomposition": "slab", "algorithm": "alltoall",
+                   "executor": "xla", "overlap_chunks": 1,
+                   "wire_dtype": "bf16"},
+        "seconds": 0.001, "compression_err": compression_err,
+    }
+    with open(wisdom_path, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+
+
+@needs_mesh
+def test_compressed_winner_replay_admission(wisdom_path):
+    """A stored compressed winner replays only into plans whose error
+    budget admits its recorded round-trip error; a stale entry whose
+    recorded error exceeds the plan's budget rebuilds on the exact
+    wire."""
+    dfft.clear_plan_cache()
+    try:
+        _replay_entry(wisdom_path, err_budget=1e-2, compression_err=3e-3)
+        ok = dfft.plan_dft_c2c_3d(SHAPE, 8, dtype=CDT, tune="wisdom",
+                                  max_roundtrip_err=1e-2)
+        assert ok.options.wire_dtype == "bf16"
+        assert ok.options.algorithm == "alltoall"
+    finally:
+        dfft.clear_plan_cache()
+
+
+@needs_mesh
+def test_compressed_winner_rejected_over_budget(wisdom_path):
+    dfft.clear_plan_cache()
+    try:
+        # Recorded error ABOVE the (identical) budget: the tuple replays
+        # but on the exact wire.
+        _replay_entry(wisdom_path, err_budget=1e-4, compression_err=0.5)
+        plan = dfft.plan_dft_c2c_3d(SHAPE, 8, dtype=CDT, tune="wisdom",
+                                    max_roundtrip_err=1e-4)
+        assert plan.options.wire_dtype is None
+        assert plan.decomposition == "slab"
+    finally:
+        dfft.clear_plan_cache()
+
+
+@needs_mesh
+def test_measure_tournament_hybrid_with_budget(wisdom_path, monkeypatch):
+    """End-to-end: a measured tournament on the hybrid mesh with an
+    error budget enumerates the hierarchical and wire dimensions,
+    records the winner under the extended key, and replays it from
+    wisdom with zero further measurement."""
+    from distributedfft_tpu.utils import metrics as m
+
+    monkeypatch.setenv("DFFT_TUNE_ITERS", "1x1")
+    monkeypatch.setenv("DFFT_TUNE_MAX", "3")
+    dfft.clear_plan_cache()
+    m.metrics_reset()
+    m.enable_metrics()
+    try:
+        mesh = _hybrid_mesh()
+        plan = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT,
+                                    tune="measure",
+                                    max_roundtrip_err=1e-2)
+        assert m.counter_total("tune_tournaments") == 1
+        assert plan.decomposition in ("slab", "pencil")
+        if plan.decomposition == "slab":
+            assert plan.options.algorithm == "hierarchical"
+        entries = tuner._read_wisdom(wisdom_path)
+        assert len(entries) == 1
+        entry = next(iter(entries.values()))
+        assert entry["key"]["err_budget"] == 1e-2
+        assert "wire_dtype" in entry["winner"]
+        # Replay: same key, zero timing executions.
+        m.metrics_reset()
+        dfft.clear_plan_cache()
+        replay = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT,
+                                      tune="wisdom",
+                                      max_roundtrip_err=1e-2)
+        assert m.counter_total("tune_timing_executions") == 0
+        assert m.counter_total("tune_wisdom_hits") == 1
+        assert replay.decomposition == plan.decomposition
+        assert replay.options.algorithm == plan.options.algorithm
+    finally:
+        m.enable_metrics(False)
+        m.metrics_reset()
+        dfft.clear_plan_cache()
+
+
+def test_report_wisdom_gate_extended_keys(tmp_path, wisdom_path, capsys):
+    """`report wisdom --gate` still verdicts on the extended keys: a
+    compressed winner gates against fresh history rows of its own
+    +wbf16 label."""
+    key = tuner.wisdom_key(kind="c2c", shape=SHAPE, dtype=np.complex64,
+                           direction=-1, ndev=8, mesh_dims=None,
+                           device_kind="cpu", platform="cpu",
+                           err_budget=1e-2)
+    cand = tuner.Candidate("slab", "alltoall", "xla", 1, "bf16")
+    assert cand.label == "slab/alltoall/xla/ov1+wbf16"
+    tuner.record_wisdom(key, cand, 0.001, path=wisdom_path)
+    def hist_with(sub, seconds_list):
+        path = tmp_path / sub / "history.jsonl"
+        regress.append_records([
+            regress.make_run_record(
+                metric="fft3d_c2c_16_forward_gflops", value=10.0,
+                seconds=s, config={"tuned": cand.label}, backend="cpu",
+                device_kind="cpu", source="test")
+            for s in seconds_list], str(path))
+        return str(path)
+
+    # Fresh rows at the recorded speed: the compressed label MATCHES
+    # (fresh n=3, not no-baseline) and the gate passes.
+    ok = hist_with("ok", (0.001, 0.00101, 0.00099))
+    assert report.main(["wisdom", "--gate", "--wisdom", wisdom_path,
+                        "--history", ok]) == 0
+    out = capsys.readouterr().out
+    assert "+wbf16" in out and "n=3" in out
+    # Fresh rows 2x slower: stale, the gate fires on the extended key.
+    stale = hist_with("stale", (0.002, 0.0021, 0.002))
+    assert report.main(["wisdom", "--gate", "--wisdom", wisdom_path,
+                        "--history", stale]) == 1
+    assert "regressed" in capsys.readouterr().out
+
+
+# --------------------------------------------------- driver / regress tier
+
+def test_regress_wire_and_transport_key_baseline_group():
+    """Compressed / two-leg runs never share a compare baseline with
+    exact flat-exchange runs; default rows keep the old group."""
+    base = {"metric": "fft3d_c2c_512_forward_gflops", "value": 100.0,
+            "dtype": "complex64", "devices": 8, "decomposition": "slab",
+            "backend": "tpu", "device_kind": "TPU v5 lite"}
+    r0 = regress.normalize_bench_line(dict(base), source="test")
+    rw = regress.normalize_bench_line(dict(base, wire_dtype="bf16"),
+                                      source="test")
+    rt = regress.normalize_bench_line(dict(base, transport="hierarchical"),
+                                      source="test")
+    assert "wire_dtype" not in r0["config"]
+    assert rw["config"]["wire_dtype"] == "bf16"
+    assert rt["config"]["transport"] == "hierarchical"
+    keys = {regress.group_key(r) for r in (r0, rw, rt)}
+    assert len(keys) == 3
+
+
+def test_bench_emit_stamps_wire_and_transport(capsys):
+    import os
+    import sys
+    TESTS = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.dirname(TESTS))
+    import bench
+
+    out = bench._emit(16, 1e-4, 1e-7, "xla", 8, "slab", {"xla": 1e-4},
+                      wire_dtype="bf16", transport="hierarchical")
+    capsys.readouterr()
+    assert out["wire_dtype"] == "bf16"
+    assert out["transport"] == "hierarchical"
+    # Default rows keep the old schema.
+    dflt = bench._emit(16, 1e-4, 1e-7, "xla", 8, "slab", {"xla": 1e-4},
+                       wire_dtype=None, transport="alltoall")
+    capsys.readouterr()
+    assert "wire_dtype" not in dflt and "transport" not in dflt
+
+
+def test_speed3d_wire_label():
+    import os
+    import sys
+    TESTS = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(os.path.dirname(TESTS), "benchmarks"))
+    from speed3d import _algorithm_label
+
+    assert _algorithm_label("alltoall", 1, wire="bf16") == "alltoall+wbf16"
+    assert _algorithm_label("alltoall", 4, batch=8,
+                            wire="bf16") == "alltoall+ov4+b8+wbf16"
+    assert _algorithm_label("alltoall", 1) == "alltoall"
+
+
+def test_tuned_label_carries_wire(wisdom_path):
+    plan = dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT, wire_dtype="bf16")
+    # Single-device plans resolve wire to None: label stays bare.
+    assert tuner.tuned_label(plan) == "single/alltoall/xla/ov1"

@@ -1,0 +1,130 @@
+"""Env-knob lint — every ``DFFT_*`` knob must land documented and keyed.
+
+PRs 4-7 grew knobs piecemeal (tune budget, wisdom path, profile file,
+correction opt-out, device timing, ...) and each one had to be chased
+into the docs env tables and — when it changes what a planner call
+compiles — into ``api._PLAN_ENV_KNOBS`` (the plan-cache key) by hand.
+This pure test (no jax import) closes that loop mechanically:
+
+1. every ``DFFT_*`` name referenced anywhere in the package source must
+   appear in the docs env tables (OBSERVABILITY.md or TUNING.md);
+2. every knob in the curated plan-affecting list below must be in
+   ``api._PLAN_ENV_KNOBS`` (parsed textually from api.py — the tuple is
+   a pure literal, and importing api would drag in jax).
+
+A knob that fails 1 was added without documentation; a knob that fails
+2 can serve a stale memoized plan after the env changes.
+"""
+
+import ast
+import os
+import re
+
+TESTS = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TESTS)
+PKG = os.path.join(REPO, "distributedfft_tpu")
+DOC_FILES = (
+    os.path.join(REPO, "docs", "OBSERVABILITY.md"),
+    os.path.join(REPO, "docs", "TUNING.md"),
+)
+
+#: Knobs whose value changes what a planner call builds/compiles — these
+#: MUST be part of the plan-cache key. Grow this list when adding such a
+#: knob (the docs check below will already have flagged it).
+PLAN_AFFECTING = {
+    "DFFT_AUTO_EXECUTORS", "DFFT_MM_PRECISION", "DFFT_MM_COMPLEX",
+    "DFFT_MM_SPLIT", "DFFT_MM_DIRECT_MAX", "DFFT_DD_DEPTH",
+    "DFFT_PALLAS_PACK", "DFFT_PALLAS_SPLIT", "DFFT_PALLAS_TILE",
+    "DFFT_PALLAS_TILE2D", "DFFT_PALLAS_TILE_STRIDED",
+    "DFFT_XLA_REAL", "DFFT_FORCE_REAL_LOWERING", "DFFT_OVERLAP",
+    "DFFT_TUNE", "DFFT_WISDOM", "DFFT_TUNE_ITERS", "DFFT_TUNE_MAX",
+    "DFFT_HW_PROFILE", "DFFT_TUNE_CORRECTION", "DFFT_WIRE_DTYPE",
+}
+
+_KNOB = re.compile(r"DFFT_[A-Z0-9_]*[A-Z0-9]")
+
+
+def _knobs_in(text: str) -> set[str]:
+    """Full DFFT_* names in ``text``. A match directly followed by an
+    underscore is a prose prefix fragment ("the DFFT_MM_* knobs"), not a
+    knob reference, and is skipped."""
+    out = set()
+    for m in _KNOB.finditer(text):
+        if text[m.end():m.end() + 1] == "_":
+            continue
+        out.add(m.group())
+    return out
+
+
+def _package_knobs() -> set[str]:
+    knobs: set[str] = set()
+    for root, dirs, files in os.walk(PKG):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for name in files:
+            if name.endswith(".py"):
+                with open(os.path.join(root, name)) as f:
+                    knobs |= _knobs_in(f.read())
+    return knobs
+
+
+def _documented_knobs() -> set[str]:
+    knobs: set[str] = set()
+    for path in DOC_FILES:
+        with open(path) as f:
+            knobs |= _knobs_in(f.read())
+    return knobs
+
+
+def _plan_env_knobs_literal() -> set[str]:
+    """``api._PLAN_ENV_KNOBS`` parsed from source (pure — no jax)."""
+    with open(os.path.join(PKG, "api.py")) as f:
+        tree = ast.parse(f.read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "_PLAN_ENV_KNOBS"
+                for t in node.targets):
+            return set(ast.literal_eval(node.value))
+    raise AssertionError("api._PLAN_ENV_KNOBS not found")
+
+
+def test_every_package_knob_is_documented():
+    missing = _package_knobs() - _documented_knobs()
+    assert not missing, (
+        f"DFFT_* knobs referenced by the package but absent from the "
+        f"docs env tables (OBSERVABILITY.md / TUNING.md): "
+        f"{sorted(missing)} — document them where they were added")
+
+
+def test_plan_affecting_knobs_are_plan_cache_keyed():
+    keyed = _plan_env_knobs_literal()
+    missing = PLAN_AFFECTING - keyed
+    assert not missing, (
+        f"plan-affecting knobs missing from api._PLAN_ENV_KNOBS "
+        f"(the plan-cache key): {sorted(missing)} — a cached plan "
+        f"would go stale when one of these changes")
+    # The keyed tuple must itself stay within the referenced/known set:
+    # a key entry for a knob nothing reads is dead weight that silently
+    # fragments the plan cache.
+    unknown = keyed - _package_knobs()
+    assert not unknown, (
+        f"api._PLAN_ENV_KNOBS entries no code references: "
+        f"{sorted(unknown)}")
+
+
+def test_plan_affecting_list_matches_docs_claim():
+    """TUNING.md's env tables claim their knobs are plan-cache-keyed;
+    hold the claim to the tuple (cache-lifecycle knobs that never change
+    what a plan compiles to are the documented exceptions)."""
+    exceptions = {
+        "DFFT_NO_COMPILE_CACHE", "DFFT_COMPILE_CACHE",  # cache lifecycle
+    }
+    with open(DOC_FILES[1]) as f:
+        tuning = _knobs_in(f.read())
+    keyed = _plan_env_knobs_literal()
+    # Driver-tier knobs (bench.py's DFFT_BENCH_* family) are read by the
+    # benchmark orchestrator, never by a planner call.
+    tuning = {k for k in tuning if not k.startswith("DFFT_BENCH")}
+    unkeyed = tuning - keyed - exceptions
+    assert not unkeyed, (
+        f"TUNING.md documents knobs that are neither plan-cache-keyed "
+        f"nor listed cache-lifecycle exceptions: {sorted(unkeyed)}")

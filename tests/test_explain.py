@@ -510,7 +510,7 @@ def test_poison_ordering_guard():
     poison = names.index("test_alltoallv.py")
     for early in ("test_a2a_overlap.py", "test_a2c_tuner.py",
                   "test_a2d_explain.py", "test_a2e_batch.py",
-                  "test_a2f_flightrec.py"):
+                  "test_a2f_flightrec.py", "test_a2g_wire.py"):
         assert early in names, early
         assert names.index(early) < poison, (
             f"{early} must collect before test_alltoallv.py")

@@ -261,6 +261,47 @@ def test_warm_pool_empty_store_is_quiet(tmp_path):
                           path=str(tmp_path / "none.jsonl")) == []
 
 
+def test_warm_pool_emits_spans_and_metrics_zero_timing(tmp_path):
+    """Flight-recorder coverage for preplans (the PR 7 ROADMAP leftover):
+    with tracing + metrics on, every warm-pool build lands a
+    ``warm_plan[kind:shape[:bB]]`` span on the timeline and the metrics
+    registry records the builds — while the wisdom replay stays at ZERO
+    timing executions (a pool warm-up must never run a tournament)."""
+    from distributedfft_tpu import report
+    from distributedfft_tpu.utils import metrics as m
+    from distributedfft_tpu.utils import trace as tr
+
+    path = tmp_path / "wisdom.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps(_wisdom_entry("2026-08-01T00:00:00")) + "\n")
+        f.write(json.dumps(_wisdom_entry(
+            "2026-08-02T00:00:00", shape=(4, 4, 4))) + "\n")
+    dfft.clear_plan_cache()
+    m.metrics_reset()
+    m.enable_metrics()
+    tr.init_tracing(str(tmp_path / "warm"), format="chrome")
+    try:
+        plans = dfft.warm_pool(None, top_n=2, path=str(path),
+                               max_batch=4)
+    finally:
+        log = tr.finalize_tracing()
+        m.enable_metrics(False)
+    assert len(plans) == 4  # 2 tuples x {unbatched, b4}
+    names = [e["name"] for e in report.load_events(log)]
+    warm = [n for n in names if n.startswith("warm_plan[")]
+    assert "warm_plan[c2c:4x4x4]" in warm
+    assert "warm_plan[c2c:4x4x4:b4]" in warm
+    assert len(warm) == 4
+    snap = dfft.metrics_snapshot()
+    assert snap["gauges"]["serving_warm_pool_plans"][""] == 4.0
+    assert m.counter_total("plan_builds") >= 1  # builds were recorded
+    # The zero-timing-execution contract of the wisdom replay path.
+    assert m.counter_total("tune_timing_executions") == 0
+    assert m.counter_total("tune_tournaments") == 0
+    m.metrics_reset()
+    dfft.clear_plan_cache()
+
+
 # ---------------------------------------------------------------- drivers
 
 def test_bench_emit_stamps_transforms_per_s_and_batch(capsys):
