@@ -291,7 +291,8 @@ def _plan_wire_kw(plan) -> dict:
 
 def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
           all_times, donated=False, stages=None, overlap=None, tuned=None,
-          cost=None, batch=None, wire_dtype=None, transport=None):
+          cost=None, batch=None, wire_dtype=None, transport=None,
+          op=None):
     import jax
 
     from distributedfft_tpu.utils.metrics import metrics_snapshot
@@ -300,20 +301,18 @@ def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
     shape = (shape_n,) * 3
     b = batch if batch and batch > 1 else 1
     # One batched execution computes b transforms; GFlops and the
-    # throughput stamp both count all of them.
-    gf = gflops(shape, seconds) * b
+    # throughput stamp both count all of them. A fused spectral-operator
+    # run (op) computes forward + inverse per solve — 2x the transform
+    # flops — and stamps solves/s instead of transforms/s.
+    gf = gflops(shape, seconds) * b * (2 if op else 1)
+    metric = (f"spectral_{op}_{shape_n}_gflops" if op
+              else f"fft3d_c2c_{shape_n}_forward_gflops")
     out = {
-        "metric": f"fft3d_c2c_{shape_n}_forward_gflops",
+        "metric": metric,
         "value": round(gf, 1),
         "unit": "GFlops/s",
         "vs_baseline": round(gf / HEFFTE_BASELINE_GFLOPS, 3),
         "seconds": round(seconds, 6),
-        # Throughput as a first-class metric (transforms per second, not
-        # just GFlop/s): the serving tier's gated number. Unbatched runs
-        # stamp 1/seconds, batched runs B/seconds; the run-record store
-        # lifts it into rates and compare --gate treats *_per_s as
-        # larger-is-better.
-        "transforms_per_s": round(b / seconds, 3),
         "max_roundtrip_err": max_err,
         "dtype": "complex64",
         "backend": jax.default_backend(),
@@ -323,6 +322,22 @@ def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
         "donated": donated,
         "all": {e: round(t, 6) for e, t in all_times.items()},
     }
+    if op:
+        # Fused spectral-operator run (DFFT_BENCH_OP): solves/s is the
+        # workload's unit of throughput (one solve = FFT -> pointwise ->
+        # iFFT). The run-record store lifts *_per_s into rates (gated
+        # larger-is-better) and keys "op" into the baseline config
+        # group, so operator runs never share baselines with bare
+        # transforms. Transform rows keep the old schema exactly.
+        out["op"] = op
+        out["solves_per_s"] = round(b / seconds, 3)
+    else:
+        # Throughput as a first-class metric (transforms per second, not
+        # just GFlop/s): the serving tier's gated number. Unbatched runs
+        # stamp 1/seconds, batched runs B/seconds; the run-record store
+        # lifts it into rates and compare --gate treats *_per_s as
+        # larger-is-better.
+        out["transforms_per_s"] = round(b / seconds, 3)
     if b > 1:
         # Batched multi-request run (DFFT_BENCH_BATCH): part of the
         # baseline group — a B=8 coalesced run must never be judged
@@ -488,6 +503,69 @@ def _worker_batched(shape_n, shape, mesh, dtype, n_dev, b: int) -> None:
           batch=b, cost=_plan_cost_block(plan), **_plan_wire_kw(plan))
 
 
+def _worker_op(shape_n, shape, mesh, dtype, n_dev, opname: str,
+               b: int | None) -> None:
+    """The spectral-operator measurement (``DFFT_BENCH_OP=poisson|grad|
+    gauss``, composable with ``DFFT_BENCH_BATCH=B``): one fused
+    FFT -> pointwise -> iFFT plan per solve. Verified against the
+    unfused composition (forward plan, full-grid multiplier, inverse
+    plan); the result line stamps ``op`` + ``solves_per_s`` so the
+    run-record store gates operator throughput in its own baseline
+    group."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    import distributedfft_tpu as dfft
+    from distributedfft_tpu import operators
+    from distributedfft_tpu.utils.timing import (
+        max_rel_err, sync, time_fn_amortized,
+    )
+
+    op = operators.named_op(opname)
+    executor = os.environ.get("DFFT_BENCH_EXECUTORS", "xla").split(",")[0]
+    with _precision_env(executor.strip()) as base:
+        plan = operators.plan_spectral_op(
+            shape, mesh, op=op, dtype=dtype, executor=base, batch=b)
+
+        mk_kw = {}
+        if plan.in_sharding is not None:
+            mk_kw["out_shardings"] = plan.in_sharding
+
+        @functools.partial(jax.jit, **mk_kw)
+        def make_input():
+            k1, k2 = jax.random.split(jax.random.PRNGKey(4242))
+            re = jax.random.normal(k1, plan.in_shape, jnp.float32)
+            im = jax.random.normal(k2, plan.in_shape, jnp.float32)
+            return (re + 1j * im).astype(dtype)
+
+        x = make_input()
+        sync(x)
+        # Verify fused == unfused composition (the operator-tier analog
+        # of the transform roundtrip gate): forward transform, multiply
+        # by the full-grid multiplier in natural layout, inverse.
+        fwd = dfft.plan_dft_c2c_3d(shape, mesh, direction=dfft.FORWARD,
+                                   dtype=dtype, executor=base)
+        bwd = dfft.plan_dft_c2c_3d(shape, mesh, direction=dfft.BACKWARD,
+                                   dtype=dtype, executor=base)
+        m = operators.multiplier_grid(op, shape, dtype)
+        probe = x if b is None else x[0]
+        max_err = max_rel_err(plan(x) if b is None else plan(x)[0],
+                              bwd(m * fwd(probe)))
+        if not max_err < ERR_GATE:
+            raise AssertionError(
+                f"fused-vs-unfused {opname} error {max_err} exceeds "
+                f"{ERR_GATE}")
+        seconds, _ = time_fn_amortized(lambda: plan(x), iters=10,
+                                       repeats=3)
+    _emit(shape_n, seconds, max_err, executor, n_dev, plan.decomposition,
+          {f"{executor}+op{opname}": round(seconds, 6)},
+          overlap=getattr(plan.options, "overlap_chunks", None),
+          batch=b, op=opname, cost=_plan_cost_block(plan),
+          **_plan_wire_kw(plan))
+
+
 def _worker(shape_n: int) -> None:
     """Measure and print result JSON lines (runs in a subprocess). A line
     is printed after EVERY improvement — the first candidate's number is
@@ -526,9 +604,19 @@ def _worker(shape_n: int) -> None:
     # Batched serving mode: one batch=B plan per execution (throughput
     # measurement; transforms_per_s is the number under test).
     batch_env = os.environ.get("DFFT_BENCH_BATCH", "").strip()
-    if batch_env and batch_env not in ("0", "1"):
+    batch_b = (int(batch_env) if batch_env and batch_env not in ("0", "1")
+               else None)
+
+    # Spectral-operator mode: one fused FFT -> pointwise -> iFFT plan
+    # per solve (solves_per_s is the number under test; composes with
+    # DFFT_BENCH_BATCH for batched operator fusion).
+    op_env = os.environ.get("DFFT_BENCH_OP", "").strip().lower()
+    if op_env:
+        return _worker_op(shape_n, shape, mesh, dtype, n_dev, op_env,
+                          batch_b)
+    if batch_b is not None:
         return _worker_batched(shape_n, shape, mesh, dtype, n_dev,
-                               int(batch_env))
+                               batch_b)
 
     # Upgrade-phase menu: xla first (a line exists after one compile),
     # then the dense HIGH-precision MXU path (kept only if it passes the

@@ -57,6 +57,19 @@ def parse_args(argv):
                         "(MPI_Alltoallv analog; TPU backend only, the CPU "
                         "test backend mirrors the dense path)")
     p.add_argument("-executor", default="xla", help="local FFT backend (xla|matmul|...)")
+    p.add_argument("-op", default=None, choices=("poisson", "grad", "gauss"),
+                   help="run the fused spectral OPERATOR instead of a "
+                        "bare transform: one FFT -> pointwise -> iFFT "
+                        "program whose multiplier applies in the "
+                        "transposed midpoint layout, skipping the "
+                        "cancelling transpose pair (half the all-to-alls "
+                        "of a natural-layout unfused composition). "
+                        "Prints solves/s; CSV algorithm column gains "
+                        "'+op<name>' (mirroring '+ovK'/'+wbf16') so "
+                        "operator sweeps never share a regress baseline "
+                        "with bare transforms. c2c only; verified "
+                        "against the unfused composition unless "
+                        "-no-verify")
     p.add_argument("-batch", type=int, default=None, metavar="B",
                    help="coalesced multi-request batch: one batch=B plan "
                         "computes B independent transforms per execution "
@@ -235,6 +248,17 @@ def main(argv=None) -> None:
     if args.r2c_axis != 2 and (args.kind != "r2c"
                                or args.precision == "dd"):
         raise SystemExit("-r2c_axis applies to the c64/c128 r2c path only")
+    if args.op is not None:
+        if (args.kind != "c2c" or args.precision == "dd" or args.bricks
+                or args.ingrid or args.outgrid):
+            raise SystemExit("-op runs the fused c2c operator chains; "
+                             "r2c, dd, brick, and layout "
+                             "(-ingrid/-outgrid) plans do not take it")
+        if args.tune and args.tune != "off":
+            raise SystemExit("-op with -tune is not wired in this "
+                             "driver; use the planner API "
+                             "(plan_spectral_op(..., tune=...)) for "
+                             "tuned operator plans")
 
     if args.precision == "dd":
         # Emulated-double tier: the CLI meaning of "double precision" on
@@ -314,7 +338,14 @@ def main(argv=None) -> None:
         kw["tune"] = args.tune
     if args.kind == "r2c" and args.r2c_axis != 2:
         kw["r2c_axis"] = args.r2c_axis
-    if args.bricks:
+    op_spec = None
+    if args.op is not None:
+        from distributedfft_tpu import operators
+
+        op_spec = operators.named_op(args.op)
+        fwd = operators.plan_spectral_op(shape, mesh, op=op_spec, **kw)
+        bwd = None  # the operator IS the round trip (one fused program)
+    elif args.bricks:
         if mesh is None:
             raise SystemExit("-bricks needs a multi-device mesh")
         from distributedfft_tpu.geometry import (
@@ -412,7 +443,24 @@ def main(argv=None) -> None:
 
     max_err = float("nan")
     if not args.no_verify:
-        max_err = max_rel_err(bwd(fwd(x)), x)
+        if args.op is not None:
+            # Fused-vs-unfused gate: forward transform, full-grid
+            # multiplier in natural layout, inverse — the reference
+            # composition the fused chain must reproduce.
+            from distributedfft_tpu import operators as _ops
+
+            tf = dfft.plan_dft_c2c_3d(
+                shape, mesh, direction=dfft.FORWARD, dtype=dtype,
+                executor=args.executor, algorithm=algorithm)
+            tb = dfft.plan_dft_c2c_3d(
+                shape, mesh, direction=dfft.BACKWARD, dtype=dtype,
+                executor=args.executor, algorithm=algorithm)
+            m = _ops.multiplier_grid(op_spec, shape, dtype)
+            probe = x if bsz is None else x[0]
+            got = fwd(x) if bsz is None else fwd(x)[0]
+            max_err = max_rel_err(got, tb(m * tf(probe)))
+        else:
+            max_err = max_rel_err(bwd(fwd(x)), x)
 
     stage_times = None
     if args.staged and args.bricks:
@@ -432,6 +480,25 @@ def main(argv=None) -> None:
         # device transpose per edge).
         print("note: -staged is not available with -r2c_axis != 2; "
               "ignoring", file=sys.stderr)
+        args.staged = False
+    if args.staged and args.op is not None:
+        stages = None
+        if (fwd.decomposition == "slab" and fwd.mesh is not None
+                and len(fwd.mesh.axis_names) == 1):
+            from distributedfft_tpu.parallel.staged import (
+                build_slab_op_stages,
+            )
+
+            stages, _ = build_slab_op_stages(
+                fwd.mesh, shape, fwd.multiplier,
+                axis_name=fwd.mesh.axis_names[0], executor=args.executor,
+                algorithm=algorithm, overlap_chunks=overlap, batch=bsz,
+                wire_dtype=wiredt,
+            )
+            stage_times, _ = time_staged(stages, x, iters=args.iters)
+        else:
+            print("note: -staged with -op supports the slab chain only; "
+                  "ignoring", file=sys.stderr)
         args.staged = False
     if args.staged:
         stages = None
@@ -491,11 +558,16 @@ def main(argv=None) -> None:
                                        repeats=2)
     is_real = args.kind == "r2c"
     # One batched execution computes bsz transforms: GFlops and the
-    # throughput line count all of them.
-    gf = gflops(shape, seconds, real=is_real) * (bsz or 1)
+    # throughput line count all of them. A fused operator run pays
+    # forward + inverse per solve (2x the transform flops).
+    gf = (gflops(shape, seconds, real=is_real) * (bsz or 1)
+          * (2 if args.op else 1))
 
     print(result_block(shape, ndev, seconds, max_err, stage_times, real=is_real))
-    if bsz is not None:
+    if args.op is not None:
+        print(f"operator: fused {args.op} -> "
+              f"{(bsz or 1) / seconds:.2f} solves/s")
+    if bsz is not None and args.op is None:
         print(f"batch: {bsz} coalesced transforms -> "
               f"{bsz / seconds:.2f} transforms/s")
 
@@ -533,7 +605,7 @@ def main(argv=None) -> None:
                 if args.kind == "r2c" and args.r2c_axis != 2 else args.kind)
         alg_label = _algorithm_label(
             algorithm, overlap, batch=bsz,
-            wire=getattr(fwd.options, "wire_dtype", None))
+            wire=getattr(fwd.options, "wire_dtype", None), op=args.op)
         if tuned_lbl is not None:
             # Tuned rows must never be indistinguishable from rows that
             # pinned the same knobs by hand (the tuple can move between
@@ -581,21 +653,25 @@ def _t2_ratio(exp_rec) -> str:
 
 def _algorithm_label(algorithm: str, overlap: int | None,
                      batch: int | None = None,
-                     wire: str | None = None) -> str:
+                     wire: str | None = None,
+                     op: str | None = None) -> str:
     """Algorithm column label with the overlap chunk count
-    (``alltoall+ov4``), coalesced batch size (``alltoall+b8``), and/or
-    on-wire compression (``alltoall+wbf16``) appended — overlapped /
-    batched / compressed sweep rows must never be indistinguishable
+    (``alltoall+ov4``), coalesced batch size (``alltoall+b8``), on-wire
+    compression (``alltoall+wbf16``), and/or fused spectral operator
+    (``alltoall+oppoisson``) appended — overlapped / batched /
+    compressed / operator sweep rows must never be indistinguishable
     from monolithic exact single-transform baselines (the regress store
     keys the label into the baseline config group). Default (K=1,
-    unbatched, exact-wire) rows keep the bare name (schema
-    unchanged)."""
+    unbatched, exact-wire, bare-transform) rows keep the bare name
+    (schema unchanged)."""
     label = (f"{algorithm}+ov{overlap}"
              if overlap and overlap != 1 else algorithm)
     if batch and batch > 1:
         label += f"+b{batch}"
     if wire:
         label += f"+w{wire}"
+    if op:
+        label += f"+op{op}"
     return label
 
 

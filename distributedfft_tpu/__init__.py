@@ -12,6 +12,9 @@ Quick start::
     mesh = dfft.make_mesh(8)                       # 1D slab mesh
     plan = dfft.plan_dft_c2c_3d((512, 512, 512), mesh)
     y = plan(x)                                    # X-slabs in, Y-slabs out
+
+    solve = dfft.solve_poisson((512, 512, 512), mesh)
+    u = solve(f)     # fused FFT -> -1/|k|^2 -> iFFT, one program
 """
 
 # Package/module name-collision rule: ``dfft.explain`` is the FUNCTION
@@ -50,6 +53,15 @@ from .api import (  # noqa: F401
     plan_dft_r2c_3d,
 )
 from .ops.ddfft import dd_from_host, dd_to_host  # noqa: F401
+from .operators import (  # noqa: F401
+    SpectralOp,
+    fft_convolve,
+    gaussian_filter,
+    plan_spectral_op,
+    solve_poisson,
+    spectral_gradient,
+)
+from .api import OpPlan3D  # noqa: F401
 from .serving import (  # noqa: F401
     CoalescingQueue,
     Handle,

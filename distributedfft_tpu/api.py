@@ -161,6 +161,23 @@ class Plan3D:
         return geo.fft_flops(self.shape)
 
 
+@dataclass
+class OpPlan3D(Plan3D):
+    """A compiled fused spectral-operator plan (:mod:`.operators`):
+    FFT -> pointwise multiplier -> iFFT as one program, I/O in the
+    chain's canonical input layout on both sides (``in_sharding ==
+    out_sharding``). ``op`` is the operator label ("poisson", ...),
+    ``op_spec`` the symbolic :class:`~.operators.SpectralOp`, and
+    ``multiplier`` the per-shard wavenumber-indexed generator (kept so
+    the explain layer can rebuild the staged ``t_mid`` pipeline).
+    Execution via ``plan(x)`` / :func:`execute` exactly like a
+    transform plan."""
+
+    op: str = ""
+    op_spec: Any = None
+    multiplier: Any = None
+
+
 def _resolve_options(
     decomposition: str | None,
     executor: str,
@@ -1330,6 +1347,7 @@ def plan_dd_dft_r2c_3d(
     r2c_axis: int = 2,
     donate: bool = False,
     overlap_chunks: int | str | None = None,
+    batch: int | None = None,
 ) -> DDPlan3D:
     """Real<->complex 3D plan at the emulated double tier — heFFTe's
     ``fft3d_r2c`` double gate on f32/bf16 hardware. ``shape`` is the
@@ -1342,10 +1360,19 @@ def plan_dd_dft_r2c_3d(
     dd components (the same discipline as :func:`plan_dft_r2c_3d`).
     ``donate`` is accepted for API symmetry but is a no-op here: real
     and half-spectrum buffers differ in dtype and size, so XLA can
-    never alias them."""
+    never alias them. ``batch=B`` coalesces B same-shape transforms
+    into one program with one shared pair of collectives per exchange
+    (the :func:`plan_dd_dft_c2c_3d` convention — both dd components
+    carry the leading batch axis); canonical ``r2c_axis=2`` only."""
     from .ops import ddfft
+    from .parallel.slab import batch_pspec as _bp
 
+    batch = _norm_batch(batch)
     if r2c_axis != 2:
+        if batch is not None:
+            raise ValueError(
+                "batched dd r2c plans run the canonical r2c_axis=2 chain; "
+                "transpose the batch's world instead of passing r2c_axis")
         return _dd_r2c_axis_wrapped(shape, mesh, r2c_axis,
                                     direction=direction,
                                     overlap_chunks=overlap_chunks)
@@ -1355,31 +1382,65 @@ def plan_dd_dft_r2c_3d(
     # donation would only emit unusable-donation warnings per execute:
     # accepted for API symmetry, documented no-op.
     del donate
+    bo = 0 if batch is None else 1
     if mesh is None:
-        if forward:
-            fn = jax.jit(ddfft.rfftn_dd)
+        if batch is None:
+            if forward:
+                fn = jax.jit(ddfft.rfftn_dd)
+            else:
+                fn = jax.jit(functools.partial(ddfft.irfftn_dd,
+                                               n2=shape[2]))
         else:
-            fn = jax.jit(functools.partial(ddfft.irfftn_dd, n2=shape[2]))
+            # Batched single-device tier: rfftn_dd/irfftn_dd transform
+            # every leading axis, so the batched program spells the
+            # spatial axes explicitly (same stage order — batch=1 and an
+            # unadorned plan stay byte-identical via _norm_batch).
+            h = shape[2] // 2 + 1
+
+            def _rfft_b(hi, lo):
+                from jax import lax as _lax
+
+                chi = _lax.complex(hi, jnp.zeros_like(hi))
+                clo = _lax.complex(lo, jnp.zeros_like(lo))
+                chi, clo = ddfft.fft_axis_dd(chi, clo, 2 + bo)
+                chi, clo = chi[..., :h], clo[..., :h]
+                for ax in (bo, 1 + bo):
+                    chi, clo = ddfft.fft_axis_dd(chi, clo, ax)
+                return chi, clo
+
+            def _irfft_b(hi, lo):
+                for ax in (bo, 1 + bo):
+                    hi, lo = ddfft.fft_axis_dd(hi, lo, ax, forward=False)
+                hi, lo = ddfft.fft_axis_dd(
+                    ddfft.mirror_half_spectrum(hi, shape[2], axis=2 + bo),
+                    ddfft.mirror_half_spectrum(lo, shape[2], axis=2 + bo),
+                    2 + bo, forward=False)
+                return jnp.real(hi), jnp.real(lo)
+
+            fn = jax.jit(_rfft_b if forward else _irfft_b)
         return DDPlan3D(shape=shape, direction=direction,
                         decomposition="single", mesh=None, fn=fn,
-                        in_sharding=None, out_sharding=None)
+                        in_sharding=None, out_sharding=None, batch=batch)
     if isinstance(mesh, int):
         from .parallel.mesh import make_mesh
 
         mesh = make_mesh(mesh)
     overlap = resolve_overlap_chunks(
-        overlap_chunks, shape=shape, ndev=math.prod(mesh.devices.shape))
+        overlap_chunks, shape=shape, ndev=math.prod(mesh.devices.shape),
+        itemsize=8 * (batch or 1))
     if len(mesh.axis_names) == 1:
         from .parallel.ddslab import build_dd_slab_rfft3d
 
         fn, spec = build_dd_slab_rfft3d(mesh, shape, forward=forward,
                                         axis_name=mesh.axis_names[0],
-                                        overlap_chunks=overlap)
+                                        overlap_chunks=overlap,
+                                        batch=batch)
         return DDPlan3D(
             shape=shape, direction=direction, decomposition="slab",
             mesh=mesh, fn=fn,
-            in_sharding=NamedSharding(mesh, spec.in_pspec),
-            out_sharding=NamedSharding(mesh, spec.out_pspec),
+            in_sharding=NamedSharding(mesh, _bp(spec.in_pspec, batch)),
+            out_sharding=NamedSharding(mesh, _bp(spec.out_pspec, batch)),
+            batch=batch,
         )
     if len(mesh.axis_names) == 2:
         from .parallel.ddslab import build_dd_pencil_rfft3d
@@ -1387,12 +1448,13 @@ def plan_dd_dft_r2c_3d(
         row, col = mesh.axis_names[:2]
         fn, spec = build_dd_pencil_rfft3d(
             mesh, shape, row_axis=row, col_axis=col, forward=forward,
-            overlap_chunks=overlap)
+            overlap_chunks=overlap, batch=batch)
         return DDPlan3D(
             shape=shape, direction=direction, decomposition="pencil",
             mesh=mesh, fn=fn,
-            in_sharding=NamedSharding(mesh, spec.in_spec),
-            out_sharding=NamedSharding(mesh, spec.out_spec),
+            in_sharding=NamedSharding(mesh, _bp(spec.in_spec, batch)),
+            out_sharding=NamedSharding(mesh, _bp(spec.out_spec, batch)),
+            batch=batch,
         )
     raise ValueError("dd r2c plans support single-device, 1D, or 2D meshes")
 
@@ -1581,7 +1643,10 @@ def execute(plan: Plan3D, x, *, scale: Scale = Scale.NONE):
     x = jnp.asarray(x, dtype=plan.in_dtype)
     if x.shape != plan.in_shape:
         raise ValueError(f"plan input shape is {plan.in_shape}, got {x.shape}")
-    if plan.real:
+    opname = getattr(plan, "op", "")
+    if opname:
+        kind = f"op_{opname}"  # fused spectral-operator execution
+    elif plan.real:
         kind = "r2c" if plan.forward else "c2r"
     else:
         kind = "c2c"

@@ -52,7 +52,7 @@ import numpy as np
 from . import regress
 from .utils import metrics as _metrics
 from .utils.timing import sync
-from .utils.trace import STAGE_KEYS, stage_key
+from .utils.trace import OP_STAGE_KEYS, STAGE_KEYS, stage_key
 
 __all__ = [
     "EXPLAIN_SCHEMA",
@@ -343,7 +343,32 @@ def _canonical_chain(plan) -> bool:
 def _staged_for(plan):
     """The separately-jitted t0..t3 pipeline matching ``plan`` (the
     builders bench.py / speed3d -staged use), or None when no staged
-    equivalent exists for this plan family."""
+    equivalent exists for this plan family. A fused spectral-operator
+    plan measures through its OWN staged chain (t0 | t2 | t_mid | t2 |
+    t3 — slab, flat transports; other op geometries have no staged
+    twin and report model/compiled views only): the transform stage
+    builders describe a different program than the fused solve."""
+    if getattr(plan, "op", None):
+        lp = plan.logic
+        if (lp is None or lp.decomposition != "slab" or plan.mesh is None
+                or len(plan.mesh.axis_names) != 1
+                or plan.options.algorithm == "hierarchical"
+                or getattr(plan, "multiplier", None) is None):
+            return None
+        from .parallel.staged import build_slab_op_stages
+
+        oc = plan.options.overlap_chunks
+        try:
+            return build_slab_op_stages(
+                plan.mesh, plan.shape, plan.multiplier,
+                axis_name=plan.mesh.axis_names[0],
+                executor=plan.executor,
+                algorithm=plan.options.algorithm,
+                overlap_chunks=oc if isinstance(oc, int) else 1,
+                batch=getattr(plan, "batch", None),
+                wire_dtype=getattr(plan.options, "wire_dtype", None))[0]
+        except Exception:  # noqa: BLE001 — no staged view is a soft miss
+            return None
     if not _canonical_chain(plan):
         return None
     lp = plan.logic
@@ -723,9 +748,16 @@ def explain(
     model = model_stage_estimates(plan, hw)
     lp = plan.logic
     ndev = 1 if plan.mesh is None else int(plan.mesh.devices.size)
+    opname = getattr(plan, "op", None) or None
+    # Operator plans carry the t_mid midpoint stage (the fused
+    # FFT -> pointwise -> iFFT chain); transforms keep t0..t3 exactly.
+    keys = OP_STAGE_KEYS if "t_mid" in model else STAGE_KEYS
 
-    kind = ("r2c" if plan.real and plan.forward
-            else "c2r" if plan.real else "c2c")
+    if opname:
+        kind = f"op_{opname}"
+    else:
+        kind = ("r2c" if plan.real and plan.forward
+                else "c2r" if plan.real else "c2c")
     oc = plan.options.overlap_chunks
     record: dict[str, Any] = {
         "schema": EXPLAIN_SCHEMA,
@@ -733,6 +765,7 @@ def explain(
         "plan": {
             "shape": list(plan.shape),
             "kind": kind,
+            "op": opname,
             "forward": plan.forward,
             "decomposition": plan.decomposition,
             "executor": plan.executor,
@@ -803,7 +836,7 @@ def explain(
                 dev, reason = device_stage_samples(stages, x, iters)
                 if dev is not None:
                     samples = {k: v for k, v in dev["samples"].items()
-                               if k in STAGE_KEYS}
+                               if k in keys}
                     chunk_rows = dev["chunks"]
                     timing["source"] = "device"
                     timing["device_pids"] = dev["device_pids"]
@@ -816,7 +849,7 @@ def explain(
     wire_bps = hw["wire_gbps"] * 1e9
     stages_out: dict[str, dict] = {}
     diverged: list[str] = []
-    for key in STAGE_KEYS:
+    for key in keys:
         m = model.get(key) or {}
         s = samples.get(key, [])
         med = _median(s)
@@ -869,8 +902,8 @@ def explain(
     record["stages"] = stages_out
 
     model_total = sum((model.get(k) or {}).get("seconds", 0.0)
-                      for k in STAGE_KEYS)
-    meds = [stages_out[k]["measured"]["seconds"] for k in STAGE_KEYS]
+                      for k in keys)
+    meds = [stages_out[k]["measured"]["seconds"] for k in keys]
     record["totals"] = {
         "model_seconds": model_total,
         "measured_stage_seconds": (sum(v for v in meds if v)
@@ -911,8 +944,9 @@ def format_explain(record: dict) -> str:
     shape = "x".join(str(s) for s in p.get("shape") or [])
     lines = [
         f"plan: {shape} {p.get('kind')} "
-        f"{'forward' if p.get('forward', True) else 'backward'}  "
-        f"{p.get('decomposition')}/{p.get('algorithm')}"
+        + (f"(fused {p['op']} operator)  " if p.get("op")
+           else f"{'forward' if p.get('forward', True) else 'backward'}  ")
+        + f"{p.get('decomposition')}/{p.get('algorithm')}"
         f"/{p.get('executor')}/ov{p.get('overlap_chunks')}  "
         f"{p.get('devices')} device(s)  [{p.get('dtype')}]",
         f"hw: {hw.get('device_kind')} (hbm {hw.get('hbm_gbps')} GB/s, "
@@ -938,8 +972,13 @@ def format_explain(record: dict) -> str:
               f"{'flops':>11} {'peakHBM(MB)':>12} {'MFU':>7} "
               f"{'ICI':>7}  divergence")
     lines.append(header)
-    for key in STAGE_KEYS:
-        st = (record.get("stages") or {}).get(key) or {}
+    rec_stages = record.get("stages") or {}
+    # Operator records carry the t_mid midpoint row between t2 and t3;
+    # transform records render exactly t0..t3 as before.
+    row_keys = ([k for k in OP_STAGE_KEYS if k in rec_stages]
+                or list(STAGE_KEYS))
+    for key in row_keys:
+        st = rec_stages.get(key) or {}
         m = st.get("model") or {}
         comp = st.get("compiled") or {}
         meas = st.get("measured") or {}

@@ -146,6 +146,7 @@ def build_dd_slab_rfft3d(
     forward: bool = True,
     algorithm: str = "alltoall",
     overlap_chunks: int = 1,
+    batch: int | None = None,
     wire_dtype: str | None = None,
 ) -> tuple[Callable, SlabSpec]:
     """Slab-distributed dd r2c (forward) / c2r (backward) — the double
@@ -154,10 +155,15 @@ def build_dd_slab_rfft3d(
     like the c64 pipeline (:func:`..slab.build_slab_rfft3d`); the r2c
     itself is the dd full-transform-and-slice (``ddfft.rfftn_dd``
     rationale). Forward maps real dd X-slab pairs ``[N0, N1, N2]`` to
-    complex dd Y-slab pairs ``[N0, N1, N2//2+1]``; backward inverts."""
+    complex dd Y-slab pairs ``[N0, N1, N2//2+1]``; backward inverts.
+    ``batch=B`` prepends a leading batch axis to BOTH dd components with
+    one shared pair of collectives per (chunk, exchange) — the
+    :func:`build_dd_slab_fft3d` convention at the real tier."""
     shape = tuple(int(s) for s in shape)
     for n in shape:
         _check_dd_extent(n, shape)
+    check_batch(batch)
+    bo = 0 if batch is None else 1  # leading-batch axis offset
     p = mesh.shape[axis_name]
     spec = SlabSpec(shape, p, axis_name,
                     in_axis=0 if forward else 1,
@@ -171,55 +177,58 @@ def build_dd_slab_rfft3d(
 
         def t3_chunk(pair):
             chi, clo = pair
-            chi = _crop_axis(chi, 0, n0)
-            clo = _crop_axis(clo, 0, n0)
-            return ddfft.fft_axis_dd(chi, clo, 0)          # t3: X lines
+            chi = _crop_axis(chi, bo, n0)
+            clo = _crop_axis(clo, bo, n0)
+            return ddfft.fft_axis_dd(chi, clo, bo)         # t3: X lines
 
-        def local_fn(hi, lo):  # real f32 [n0p/p, N1, N2] per device
+        def local_fn(hi, lo):  # real f32 [(B,) n0p/p, N1, N2] per device
             with add_trace("t0_dd_r2c_zy"):
                 chi = lax.complex(hi, jnp.zeros_like(hi))
                 clo = lax.complex(lo, jnp.zeros_like(lo))
-                chi, clo = ddfft.fft_axis_dd(chi, clo, 2)  # t0a: Z lines
+                chi, clo = ddfft.fft_axis_dd(chi, clo, 2 + bo)  # t0a: Z
                 chi, clo = chi[..., :h], clo[..., :h]      # r2c shrink
-                chi, clo = ddfft.fft_axis_dd(chi, clo, 1)  # t0b: Y lines
+                chi, clo = ddfft.fft_axis_dd(chi, clo, 1 + bo)  # t0b: Y
             return exchange_overlapped(
-                (chi, clo), axis_name, split_axis=1, concat_axis=0,
+                (chi, clo), axis_name, split_axis=1 + bo, concat_axis=bo,
                 axis_size=p, algorithm=algorithm, wire_dtype=wire_dtype, platform=platform,
                 compute=t3_chunk, overlap_chunks=overlap_chunks,
+                chunk_axis=2 + bo,
                 exchange_name=f"t2_exchange_{axis_name}",
                 compute_name="t3_dd_fft_x")
 
-        pre = lambda v: _pad_axis(v, 0, n0p)  # noqa: E731
-        post = lambda v: _crop_axis(v, 1, n1)  # noqa: E731
+        pre = lambda v: _pad_axis(v, bo, n0p)  # noqa: E731
+        post = lambda v: _crop_axis(v, 1 + bo, n1)  # noqa: E731
     else:
 
         def t0_chunk(pair):
             hi, lo = pair
-            hi = _crop_axis(hi, 1, n1)
-            lo = _crop_axis(lo, 1, n1)
-            return ddfft.fft_axis_dd(hi, lo, 1, forward=False)
+            hi = _crop_axis(hi, 1 + bo, n1)
+            lo = _crop_axis(lo, 1 + bo, n1)
+            return ddfft.fft_axis_dd(hi, lo, 1 + bo, forward=False)
 
-        def local_fn(hi, lo):  # complex dd [N0, n1p/p, h] per device
+        def local_fn(hi, lo):  # complex dd [(B,) N0, n1p/p, h] per device
             with add_trace("t3_dd_ifft_x"):
-                hi, lo = ddfft.fft_axis_dd(hi, lo, 0, forward=False)
+                hi, lo = ddfft.fft_axis_dd(hi, lo, bo, forward=False)
             # The half-spectrum mirror + inverse Z transform run along the
             # bystander (chunk) axis, so they follow the chunked merge.
             hi, lo = exchange_overlapped(
-                (hi, lo), axis_name, split_axis=0, concat_axis=1,
+                (hi, lo), axis_name, split_axis=bo, concat_axis=1 + bo,
                 axis_size=p, algorithm=algorithm, wire_dtype=wire_dtype, platform=platform,
                 compute=t0_chunk, overlap_chunks=overlap_chunks,
+                chunk_axis=2 + bo,
                 exchange_name=f"t2_exchange_{axis_name}",
                 compute_name="t0_dd_ifft_y")
             hi, lo = ddfft.fft_axis_dd(
-                ddfft.mirror_half_spectrum(hi, n2, axis=2),
-                ddfft.mirror_half_spectrum(lo, n2, axis=2),
-                2, forward=False)
+                ddfft.mirror_half_spectrum(hi, n2, axis=2 + bo),
+                ddfft.mirror_half_spectrum(lo, n2, axis=2 + bo),
+                2 + bo, forward=False)
             return jnp.real(hi), jnp.real(lo)
 
-        pre = lambda v: _pad_axis(v, 1, n1p)  # noqa: E731
-        post = lambda v: _crop_axis(v, 0, n0)  # noqa: E731
+        pre = lambda v: _pad_axis(v, 1 + bo, n1p)  # noqa: E731
+        post = lambda v: _crop_axis(v, bo, n0)  # noqa: E731
 
-    in_spec, out_spec = spec.in_pspec, spec.out_pspec
+    in_spec = batch_pspec(spec.in_pspec, batch)
+    out_spec = batch_pspec(spec.out_pspec, batch)
     mapped = _shard_map(local_fn, mesh=mesh,
                         in_specs=(in_spec, in_spec),
                         out_specs=(out_spec, out_spec))
@@ -244,15 +253,20 @@ def build_dd_pencil_rfft3d(
     forward: bool = True,
     algorithm: str = "alltoall",
     overlap_chunks: int = 1,
+    batch: int | None = None,
     wire_dtype: str | None = None,
 ) -> tuple[Callable, PencilSpec]:
     """Pencil-distributed dd r2c (forward) / c2r (backward) — the last
     cell of the dd decomposition matrix (mirrors the c64
     :func:`..pencil.build_pencil_rfft3d` chain: real Z lines shrink
-    before the first exchange; canonical z->x pencils forward)."""
+    before the first exchange; canonical z->x pencils forward).
+    ``batch=B`` prepends a leading batch axis to BOTH dd components
+    with one shared pair of collectives per (chunk, exchange)."""
     shape = tuple(int(s) for s in shape)
     for n in shape:
         _check_dd_extent(n, shape)
+    check_batch(batch)
+    bo = 0 if batch is None else 1  # leading-batch axis offset
     rows, cols = mesh.shape[row_axis], mesh.shape[col_axis]
     spec = PencilSpec(
         shape, rows, cols, row_axis, col_axis,
@@ -269,75 +283,80 @@ def build_dd_pencil_rfft3d(
 
         def fft_y(pair):
             chi, clo = pair
-            chi = _crop_axis(chi, 1, n1)
-            clo = _crop_axis(clo, 1, n1)
-            return ddfft.fft_axis_dd(chi, clo, 1)       # Y lines
+            chi = _crop_axis(chi, 1 + bo, n1)
+            clo = _crop_axis(clo, 1 + bo, n1)
+            return ddfft.fft_axis_dd(chi, clo, 1 + bo)  # Y lines
 
         def fft_x(pair):
             chi, clo = pair
-            chi = _crop_axis(chi, 0, n0)
-            clo = _crop_axis(clo, 0, n0)
-            return ddfft.fft_axis_dd(chi, clo, 0)       # t3: X lines
+            chi = _crop_axis(chi, bo, n0)
+            clo = _crop_axis(clo, bo, n0)
+            return ddfft.fft_axis_dd(chi, clo, bo)      # t3: X lines
 
-        def local_fn(hi, lo):  # real f32 [n0p/rows, n1pc/cols, N2]
+        def local_fn(hi, lo):  # real f32 [(B,) n0p/rows, n1pc/cols, N2]
             chi = lax.complex(hi, jnp.zeros_like(hi))
             clo = lax.complex(lo, jnp.zeros_like(lo))
-            chi, clo = ddfft.fft_axis_dd(chi, clo, 2)   # t0: real Z lines
+            chi, clo = ddfft.fft_axis_dd(chi, clo, 2 + bo)  # t0: Z lines
             chi, clo = chi[..., :h], clo[..., :h]       # r2c shrink
             pair = exchange_overlapped(
-                (chi, clo), col_axis, split_axis=2, concat_axis=1,
+                (chi, clo), col_axis, split_axis=2 + bo, concat_axis=1 + bo,
                 axis_size=cols, algorithm=algorithm, wire_dtype=wire_dtype, platform=platform,
                 compute=fft_y, overlap_chunks=overlap_chunks,
+                chunk_axis=bo,
                 exchange_name=f"t2a_exchange_{col_axis}",
                 compute_name="t1_dd_fft_y")
             return exchange_overlapped(
-                pair, row_axis, split_axis=1, concat_axis=0,
+                pair, row_axis, split_axis=1 + bo, concat_axis=bo,
                 axis_size=rows, algorithm=algorithm, wire_dtype=wire_dtype, platform=platform,
                 compute=fft_x, overlap_chunks=overlap_chunks,
+                chunk_axis=2 + bo,
                 exchange_name=f"t2b_exchange_{row_axis}",
                 compute_name="t3_dd_fft_x")
 
-        pre = lambda v: _pad_axis(_pad_axis(v, 0, n0p), 1, n1pc)  # noqa: E731
-        post = lambda v: _crop_axis(_crop_axis(v, 1, n1), 2, h)  # noqa: E731
+        pre = lambda v: _pad_axis(_pad_axis(v, bo, n0p), 1 + bo, n1pc)  # noqa: E731
+        post = lambda v: _crop_axis(_crop_axis(v, 1 + bo, n1), 2 + bo, h)  # noqa: E731
     else:
 
         def ifft_y(pair):
             hi, lo = pair
-            hi = _crop_axis(hi, 1, n1)
-            lo = _crop_axis(lo, 1, n1)
-            return ddfft.fft_axis_dd(hi, lo, 1, forward=False)
+            hi = _crop_axis(hi, 1 + bo, n1)
+            lo = _crop_axis(lo, 1 + bo, n1)
+            return ddfft.fft_axis_dd(hi, lo, 1 + bo, forward=False)
 
         def c2r_z(pair):
             # mirror + inverse Z transform axis 2 (fully local after this
             # exchange); the chunk axis is 0, so per-chunk c2r is exact.
             hi, lo = pair
-            hi = _crop_axis(hi, 2, h)
-            lo = _crop_axis(lo, 2, h)
+            hi = _crop_axis(hi, 2 + bo, h)
+            lo = _crop_axis(lo, 2 + bo, h)
             return ddfft.fft_axis_dd(
-                ddfft.mirror_half_spectrum(hi, n2, axis=2),
-                ddfft.mirror_half_spectrum(lo, n2, axis=2),
-                2, forward=False)
+                ddfft.mirror_half_spectrum(hi, n2, axis=2 + bo),
+                ddfft.mirror_half_spectrum(lo, n2, axis=2 + bo),
+                2 + bo, forward=False)
 
-        def local_fn(hi, lo):  # complex dd [N0, n1pr/rows, n2hp/cols]
-            hi, lo = ddfft.fft_axis_dd(hi, lo, 0, forward=False)
+        def local_fn(hi, lo):  # complex dd [(B,) N0, n1pr/rows, n2hp/cols]
+            hi, lo = ddfft.fft_axis_dd(hi, lo, bo, forward=False)
             pair = exchange_overlapped(
-                (hi, lo), row_axis, split_axis=0, concat_axis=1,
+                (hi, lo), row_axis, split_axis=bo, concat_axis=1 + bo,
                 axis_size=rows, algorithm=algorithm, wire_dtype=wire_dtype, platform=platform,
                 compute=ifft_y, overlap_chunks=overlap_chunks,
+                chunk_axis=2 + bo,
                 exchange_name=f"t2b_exchange_{row_axis}",
                 compute_name="t1_dd_ifft_y")
             hi, lo = exchange_overlapped(
-                pair, col_axis, split_axis=1, concat_axis=2,
+                pair, col_axis, split_axis=1 + bo, concat_axis=2 + bo,
                 axis_size=cols, algorithm=algorithm, wire_dtype=wire_dtype, platform=platform,
                 compute=c2r_z, overlap_chunks=overlap_chunks,
+                chunk_axis=bo,
                 exchange_name=f"t2a_exchange_{col_axis}",
                 compute_name="t0_dd_c2r_z")
             return jnp.real(hi), jnp.real(lo)
 
-        pre = lambda v: _pad_axis(_pad_axis(v, 1, n1pr), 2, n2hp)  # noqa: E731
-        post = lambda v: _crop_axis(_crop_axis(v, 0, n0), 1, n1)  # noqa: E731
+        pre = lambda v: _pad_axis(_pad_axis(v, 1 + bo, n1pr), 2 + bo, n2hp)  # noqa: E731
+        post = lambda v: _crop_axis(_crop_axis(v, bo, n0), 1 + bo, n1)  # noqa: E731
 
-    in_spec, out_spec = spec.in_spec, spec.out_spec
+    in_spec = batch_pspec(spec.in_spec, batch)
+    out_spec = batch_pspec(spec.out_spec, batch)
     mapped = _shard_map(local_fn, mesh=mesh,
                         in_specs=(in_spec, in_spec),
                         out_specs=(out_spec, out_spec))

@@ -614,6 +614,7 @@ def exchange_overlapped(
     wire_dtype: str | None = None,
     exchange_name: str = "t2_exchange",
     compute_name: str = "t3_fft",
+    compute_takes_bounds: bool = False,
 ):
     """Pipelined global transpose + downstream compute (t2 ↔ t3 overlap).
 
@@ -640,6 +641,13 @@ def exchange_overlapped(
     monolithic exchange + compute with today's HLO and the original
     un-suffixed trace spans; K > 1 emits ``{exchange_name}[k]`` /
     ``{compute_name}[k]`` spans so the PR 1 timeline shows the interleave.
+
+    ``compute_takes_bounds=True`` calls ``compute(chunk, lo, hi)`` with
+    the chunk's static (start, stop) bounds along ``chunk_axis`` — the
+    midpoint hook of the fused spectral-operator chains, whose
+    wavenumber-indexed pointwise multiplier must be generated for
+    exactly the chunk's global slice (the bystander axis keeps global
+    positions through the exchange, so the bounds ARE the slice).
     """
     tree = jax.tree_util
     leaves = tree.tree_leaves(x)
@@ -655,7 +663,8 @@ def exchange_overlapped(
             y = tree.tree_map(
                 lambda u: exchange_uneven(u, axis_name, **ex_kw), x)
         with add_trace(compute_name):
-            return compute(y)
+            return (compute(y, 0, extent) if compute_takes_bounds
+                    else compute(y))
 
     def take(lo, hi):
         return tree.tree_map(
@@ -666,15 +675,20 @@ def exchange_overlapped(
             return tree.tree_map(
                 lambda u: exchange_uneven(u, axis_name, **ex_kw), chunk)
 
+    def run_chunk(i, chunk):
+        if compute_takes_bounds:
+            return compute(chunk, *bounds[i])
+        return compute(chunk)
+
     parts = []
     inflight = exch(0, take(*bounds[0]))
     for i in range(1, len(bounds)):
         nxt = exch(i, take(*bounds[i]))  # issued before chunk i-1's compute
         with add_trace(f"{compute_name}[{i - 1}]"):
-            parts.append(compute(inflight))
+            parts.append(run_chunk(i - 1, inflight))
         inflight = nxt
     with add_trace(f"{compute_name}[{len(bounds) - 1}]"):
-        parts.append(compute(inflight))
+        parts.append(run_chunk(len(bounds) - 1, inflight))
     return tree.tree_map(
         lambda *ps: jnp.concatenate(ps, axis=chunk_axis), *parts)
 

@@ -28,6 +28,7 @@ from dataclasses import dataclass
 from typing import Callable
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -40,7 +41,9 @@ from ..geometry import pad_to
 from ..ops.executors import get_c2r, get_executor, get_r2c
 from ..utils.trace import add_trace
 from .exchange import exchange_overlapped
-from .slab import _L, _crop_axis, _pad_axis, batch_pspec, check_batch
+from .slab import (
+    _L, _crop_axis, _pad_axis, apply_multiplier, batch_pspec, check_batch,
+)
 
 
 @dataclass(frozen=True)
@@ -142,6 +145,7 @@ def build_pencil_general(
     overlap_chunks: int = 1,
     batch: int | None = None,
     wire_dtype: str | None = None,
+    midpoint: Callable | None = None,
 ) -> tuple[Callable, PencilSpec]:
     """Build the jitted end-to-end pencil transform for ANY input layout
     permutation and exchange order (see :class:`PencilSpec` for the chain
@@ -157,7 +161,25 @@ def build_pencil_general(
     independent transforms): batched FFT stages and ONE shared collective
     per (chunk, exchange) with the batch riding as a bystander dim —
     exactly the :func:`..slab.build_slab_general` convention.
+
+    ``midpoint`` is the spectral-operator fusion hook (the
+    stop-at-transposed / start-from-transposed mode): the chain stops in
+    the transposed x-pencil layout, applies the wavenumber-diagonal
+    multiplier there, and continues with the inverse legs back to the
+    input layout (:func:`build_pencil_spectral_op`; canonical forward
+    orientation only).
     """
+    if midpoint is not None:
+        if (not forward or tuple(perm) != (0, 1, 2)
+                or order != "col_first"):
+            raise ValueError(
+                "the midpoint (spectral-operator) hook runs the canonical "
+                "forward chain: forward=True, perm=(0, 1, 2), col_first")
+        return build_pencil_spectral_op(
+            mesh, shape, midpoint, row_axis=row_axis, col_axis=col_axis,
+            executor=executor, donate=donate, algorithm=algorithm,
+            overlap_chunks=overlap_chunks, batch=batch,
+            wire_dtype=wire_dtype)
     if sorted(perm) != [0, 1, 2]:
         raise ValueError(f"perm must be a permutation of (0, 1, 2), got {perm}")
     if order not in ("col_first", "row_first"):
@@ -229,6 +251,130 @@ def build_pencil_general(
     def fn(x):
         x = lax.with_sharding_constraint(pre(x), in_sh)
         return post(mapped(x))
+
+    return fn, spec
+
+
+def build_pencil_spectral_op(
+    mesh: Mesh,
+    shape: tuple[int, int, int],
+    multiplier: Callable,
+    *,
+    row_axis: str = "row",
+    col_axis: str = "col",
+    executor: str | Callable = "xla",
+    donate: bool = False,
+    algorithm: str = "alltoall",
+    overlap_chunks: int = 1,
+    batch: int | None = None,
+    wire_dtype: str | None = None,
+) -> tuple[Callable, PencilSpec]:
+    """Fused pencil FFT -> pointwise -> iFFT pipeline in ONE jitted
+    program — the 2D-mesh tier of the spectral-operator chain
+    (:func:`..slab.build_slab_spectral_op` documents the multiplier
+    contract and the transposed-layout fusion).
+
+    The forward half runs the canonical z-pencil -> x-pencil chain and
+    STOPS in the transposed x-pencil layout (k0 full local, k1 on rows,
+    k2 on cols); the multiplier is generated per shard (and per overlap
+    chunk) right there, and the inverse half retraces the chain back to
+    z-pencils. Four exchanges total (t2a/t2b out, t2b/t2a back) vs the
+    six a natural-layout unfused forward+multiply+inverse composition
+    pays — and the caller-side layout round trip disappears entirely.
+    I/O is the canonical z-pencil layout on both sides.
+    """
+    check_batch(batch)
+    rows, cols = mesh.shape[row_axis], mesh.shape[col_axis]
+    spec = PencilSpec(tuple(int(s) for s in shape), rows, cols,
+                      row_axis, col_axis, (0, 1, 2), "col_first")
+    ex = get_executor(executor) if isinstance(executor, str) else executor
+    n0, n1, n2 = spec.shape
+    n0p, n1pc, n1pr, n2p = spec.n0p, spec.n1p_col, spec.n1p_row, spec.n2p
+    bo = 0 if batch is None else 1
+    c1 = n1pr // rows  # midpoint local k1 extent (row shard)
+    c2 = n2p // cols   # midpoint local k2 extent (col shard)
+
+    def local_fn(x):  # z-pencil shard [(B,) n0p/rows, n1pc/cols, N2]
+        with add_trace("t0_fft_z"):
+            x = ex(x, (2 + bo,), True)                   # t0: Z lines
+
+        def fft_y(v):
+            v = _crop_axis(v, 1 + bo, n1)
+            return ex(v, (1 + bo,), True)                # t1: Y lines
+
+        x = exchange_overlapped(
+            x, col_axis, split_axis=2 + bo, concat_axis=1 + bo,
+            axis_size=cols, algorithm=algorithm, compute=fft_y,
+            wire_dtype=wire_dtype,
+            overlap_chunks=overlap_chunks, chunk_axis=bo,
+            exchange_name=f"t2a_exchange_{col_axis}",
+            compute_name="t1_fft_y")
+        k1_lo = lax.axis_index(row_axis) * c1
+        k2_lo = lax.axis_index(col_axis) * c2
+
+        def mid_chunk(u, lo, hi):
+            # Transposed x-pencil midpoint: final forward FFT, the
+            # wavenumber-diagonal multiply, first inverse FFT — all
+            # local (bounds are this chunk's slice of the col shard).
+            u = _crop_axis(u, bo, n0)
+            u = ex(u, (bo,), True)                       # t3 of fwd half
+            with add_trace("t_mid_pointwise"):
+                m = multiplier(
+                    jnp.arange(n0, dtype=jnp.int32)[:, None, None],
+                    (k1_lo + jnp.arange(c1, dtype=jnp.int32))[None, :, None],
+                    (k2_lo + jnp.arange(lo, hi,
+                                        dtype=jnp.int32))[None, None, :])
+                u = apply_multiplier(u, m)
+            return ex(u, (bo,), False)                   # inverse X lines
+
+        x = exchange_overlapped(
+            x, row_axis, split_axis=1 + bo, concat_axis=bo,
+            axis_size=rows, algorithm=algorithm,
+            compute=mid_chunk, compute_takes_bounds=True,
+            wire_dtype=wire_dtype,
+            overlap_chunks=overlap_chunks, chunk_axis=2 + bo,
+            exchange_name=f"t2b_exchange_{row_axis}",
+            compute_name="t_mid")
+
+        def inv_y(v):
+            v = _crop_axis(v, 1 + bo, n1)
+            return ex(v, (1 + bo,), False)               # inverse Y lines
+
+        x = exchange_overlapped(
+            x, row_axis, split_axis=bo, concat_axis=1 + bo,
+            axis_size=rows, algorithm=algorithm, compute=inv_y,
+            wire_dtype=wire_dtype,
+            overlap_chunks=overlap_chunks, chunk_axis=2 + bo,
+            exchange_name=f"t2b_exchange_{row_axis}",
+            compute_name="t3_ifft_y")
+
+        def inv_z(v):
+            v = _crop_axis(v, 2 + bo, n2)
+            return ex(v, (2 + bo,), False)               # inverse Z lines
+
+        return exchange_overlapped(
+            x, col_axis, split_axis=1 + bo, concat_axis=2 + bo,
+            axis_size=cols, algorithm=algorithm, compute=inv_z,
+            wire_dtype=wire_dtype,
+            overlap_chunks=overlap_chunks, chunk_axis=bo,
+            exchange_name=f"t2a_exchange_{col_axis}",
+            compute_name="t3_ifft_z")
+
+    io_spec = batch_pspec(spec.in_spec, batch)
+    mapped = _shard_map(local_fn, mesh=mesh, in_specs=(io_spec,),
+                        out_specs=io_spec)
+    io_sh = NamedSharding(mesh, io_spec)
+    even = n0p == n0 and n1pc == n1
+    jit_kw: dict = {"donate_argnums": 0} if donate else {}
+    if even:
+        jit_kw |= {"in_shardings": io_sh, "out_shardings": io_sh}
+
+    @functools.partial(jax.jit, **jit_kw)
+    def fn(x):
+        x = _pad_axis(_pad_axis(x, bo, n0p), 1 + bo, n1pc)
+        x = lax.with_sharding_constraint(x, io_sh)
+        y = mapped(x)
+        return _crop_axis(_crop_axis(y, bo, n0), 1 + bo, n1)
 
     return fn, spec
 

@@ -163,6 +163,7 @@ def build_slab_general(
     overlap_chunks: int = 1,
     batch: int | None = None,
     wire_dtype: str | None = None,
+    midpoint: Callable | None = None,
 ) -> tuple[Callable, SlabSpec]:
     """Build the jitted end-to-end slab transform for ANY ordered axis pair.
 
@@ -183,7 +184,26 @@ def build_slab_general(
     exchange) with the batch riding as a bystander dim — B transforms pay
     one collective latency. ``None`` is the unbatched 3D chain, today's
     HLO exactly.
+
+    ``midpoint`` is the spectral-operator fusion hook (the
+    stop-at-transposed / start-from-transposed mode): a wavenumber-
+    indexed pointwise multiplier generator applied at the chain's
+    transposed full-spectrum midpoint, after which the chain continues
+    with the INVERSE legs back to the input layout — the whole fused
+    FFT -> pointwise -> iFFT round trip as one program
+    (:func:`build_slab_spectral_op`; canonical forward orientation
+    only).
     """
+    if midpoint is not None:
+        if not forward or (in_axis, out_axis) != (0, 1):
+            raise ValueError(
+                "the midpoint (spectral-operator) hook runs the canonical "
+                "forward chain: forward=True, (in_axis, out_axis)=(0, 1)")
+        return build_slab_spectral_op(
+            mesh, shape, midpoint, axis_name=axis_name, executor=executor,
+            donate=donate, algorithm=algorithm,
+            overlap_chunks=overlap_chunks, batch=batch,
+            wire_dtype=wire_dtype)
     if in_axis == out_axis or not (0 <= in_axis < 3 and 0 <= out_axis < 3):
         raise ValueError(f"need distinct 3D axes, got {in_axis}, {out_axis}")
     check_batch(batch)
@@ -250,6 +270,163 @@ def build_slab_general(
         x = lax.with_sharding_constraint(x, in_sh)
         y = mapped(x)
         return _crop_axis(y, ax_out, n_out)
+
+    return fn, spec
+
+
+def combined_axis_index(mesh: Mesh, axis_name):
+    """Device index along a slab chain's mesh-axis spec, inside
+    ``shard_map``: ``lax.axis_index`` of a plain axis, or the row-major
+    linearization of a hierarchical plan's (dcn, ici) tuple — the same
+    device order as ``P((dcn, ici))``'s combined sharding, so per-shard
+    wavenumber offsets agree with what XLA placed on each device."""
+    if isinstance(axis_name, (tuple, list)):
+        idx = lax.axis_index(axis_name[0])
+        for a in axis_name[1:]:
+            idx = idx * mesh.shape[a] + lax.axis_index(a)
+        return idx
+    return lax.axis_index(axis_name)
+
+
+def apply_multiplier(u: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
+    """Pointwise spectral multiply without dtype surprises: a real
+    multiplier casts to the payload's component dtype (f64 constants
+    must not promote a c64 chain to c128), a complex one to the payload
+    dtype. ``m`` is rank-3 (spatial) and broadcasts over any leading
+    batch axis."""
+    if jnp.issubdtype(m.dtype, jnp.complexfloating):
+        return u * m.astype(u.dtype)
+    rdt = jnp.float64 if u.dtype == jnp.dtype(jnp.complex128) else jnp.float32
+    return u * m.astype(rdt)
+
+
+def build_slab_spectral_op(
+    mesh: Mesh,
+    shape: tuple[int, int, int],
+    multiplier: Callable,
+    *,
+    axis_name: str | tuple = "slab",
+    executor: str | Callable = "xla",
+    donate: bool = False,
+    algorithm: str = "alltoall",
+    overlap_chunks: int = 1,
+    batch: int | None = None,
+    wire_dtype: str | None = None,
+) -> tuple[Callable, SlabSpec]:
+    """Fused slab FFT -> pointwise -> iFFT pipeline in ONE jitted program.
+
+    The spectral-operator chain (AccFFT's operator tier, arXiv
+    1506.07933): the forward half runs ``stop_at_transposed`` — t0
+    (local YZ FFTs), t1 pack, t2 exchange, then the final X FFT *in the
+    transposed (Y-slab) layout* — the pointwise multiplier is applied
+    right there (the ``t_mid`` stage), and the inverse half runs
+    ``start_from_transposed``: inverse X FFT, the return exchange, and
+    the inverse YZ FFTs back to the input's X-slab layout. Because the
+    multiplier is diagonal (pointwise) in the transposed layout, the
+    natural-order restore transpose a back-to-back forward+inverse pair
+    would pay on each side of the multiply cancels — the fused chain
+    compiles exactly TWO all-to-alls where the unfused natural-layout
+    pair compiles four (the classic pruned-spectral-solver trick;
+    pinned in ``tests/test_a2h_operators.py``).
+
+    ``multiplier(i0, i1, i2)`` receives broadcastable int32 GLOBAL index
+    grids of the three spatial axes (already offset for this shard and
+    overlap chunk — the transposed midpoint layout) and returns the
+    pointwise factor (real or complex, broadcastable to the grids'
+    shape). Index rows landing in ceil-pad territory are cropped before
+    any inverse transform, so their values only need to be finite.
+
+    Composes with every chain axis: ``overlap_chunks`` pipelines BOTH
+    exchanges (the multiplier is generated per chunk via the midpoint
+    bounds hook), ``batch=B`` rides the collectives as a bystander dim
+    (the multiplier broadcasts over it), ``wire_dtype`` compresses each
+    exchange's wire with the multiplier applying on the DECODED payload,
+    and ``algorithm="hierarchical"`` runs each exchange as the two-leg
+    ICI/DCN transport over a hybrid-mesh ``axis_name`` tuple.
+
+    I/O is the canonical X-slab layout on both sides (in == out
+    sharding); forward transform unnormalized, inverse scaled 1/N —
+    i.e. a unit multiplier is the identity.
+    """
+    check_batch(batch)
+    p, axis_sizes = _axis_parts(mesh, axis_name)
+    spec = SlabSpec(tuple(int(s) for s in shape), p, axis_name, 0, 1)
+    ex = get_executor(executor) if isinstance(executor, str) else executor
+    n0, n1, n2 = spec.shape
+    n0p, n1p = spec.n0p, spec.n1p
+    platform = mesh.devices.flat[0].platform
+    bo = 0 if batch is None else 1
+    c1 = n1p // p  # transposed-midpoint local extent of the k1 axis
+    t2_name = f"t2_exchange_{_axis_label(axis_name)}"
+
+    def local_fn(x):  # X-slab shard [(B,) n0p/p, N1, N2]
+        with add_trace("t0_fft_yz"):
+            y = ex(x, (1 + bo, 2 + bo), True)            # t0: YZ planes
+        with add_trace("t1_pack"):
+            if algorithm != "alltoallv":
+                y = _pad_axis(y, 1 + bo, n1p)
+        k1_lo = combined_axis_index(mesh, axis_name) * c1
+
+        def mid_chunk(u, lo, hi):
+            # The transposed-space midpoint: final forward FFT, the
+            # wavenumber-diagonal multiply, and the first inverse FFT —
+            # all local in the Y-slab layout (k0 full, k1 this shard's
+            # slice, k2 this overlap chunk's slice).
+            u = _crop_axis(u, bo, n0)
+            u = ex(u, (bo,), True)                       # t3 of fwd half
+            with add_trace("t_mid_pointwise"):
+                m = multiplier(
+                    jnp.arange(n0, dtype=jnp.int32)[:, None, None],
+                    (k1_lo + jnp.arange(c1, dtype=jnp.int32))[None, :, None],
+                    jnp.arange(lo, hi, dtype=jnp.int32)[None, None, :])
+                u = apply_multiplier(u, m)
+            return ex(u, (bo,), False)                   # inverse X lines
+
+        y = exchange_overlapped(
+            y, axis_name, split_axis=1 + bo, concat_axis=bo,
+            axis_size=p, algorithm=algorithm, platform=platform,
+            axis_sizes=axis_sizes, wire_dtype=wire_dtype,
+            compute=mid_chunk, compute_takes_bounds=True,
+            overlap_chunks=overlap_chunks, chunk_axis=2 + bo,
+            exchange_name=t2_name, compute_name="t_mid")
+        with add_trace("t1_pack"):
+            if algorithm != "alltoallv":
+                y = _pad_axis(y, bo, n0p)
+
+        def inv_chunk(v):
+            v = _crop_axis(v, 1 + bo, n1)
+            return ex(v, (1 + bo,), False)               # inverse Y lines
+
+        # The inverse Z pass transforms the bystander (chunk) axis, so it
+        # runs monolithically after the chunked exchange/ifft-Y merge —
+        # the same discipline as the c2r chains' final real transform.
+        y = exchange_overlapped(
+            y, axis_name, split_axis=bo, concat_axis=1 + bo,
+            axis_size=p, algorithm=algorithm, platform=platform,
+            axis_sizes=axis_sizes, wire_dtype=wire_dtype,
+            compute=inv_chunk,
+            overlap_chunks=overlap_chunks, chunk_axis=2 + bo,
+            exchange_name=t2_name, compute_name="t3_ifft_y")
+        with add_trace("t3_ifft_z"):
+            return ex(y, (2 + bo,), False)               # inverse Z lines
+
+    io_spec = batch_pspec(spec.in_pspec, batch)
+    mapped = _shard_map(local_fn, mesh=mesh, in_specs=(io_spec,),
+                        out_specs=io_spec)
+    io_sh = NamedSharding(mesh, io_spec)
+    # Only axis 0 is sharded at the jit boundary (in == out layout), so
+    # the sharding pin needs only the in-axis to divide.
+    even = n0p == n0
+    jit_kw: dict = {"donate_argnums": 0} if donate else {}
+    if even:
+        jit_kw |= {"in_shardings": io_sh, "out_shardings": io_sh}
+
+    @functools.partial(jax.jit, **jit_kw)
+    def fn(x):
+        x = _pad_axis(x, bo, n0p)
+        x = lax.with_sharding_constraint(x, io_sh)
+        y = mapped(x)
+        return _crop_axis(y, bo, n0)
 
     return fn, spec
 

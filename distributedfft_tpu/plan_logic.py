@@ -317,10 +317,19 @@ class LogicPlan:
     # Geometry (stages, boxes) stays per-transform; the payload/model
     # accounting below scales with it.
     batch: int | None = None
+    # Fused spectral-operator chain marker (:mod:`.operators`): the op
+    # kind ("poisson", ...) of a FFT -> pointwise -> iFFT plan whose
+    # forward half stops at the transposed midpoint and whose inverse
+    # half retraces the chain. The payload/model accounting below
+    # doubles per-exchange entries (out + back legs) and inserts the
+    # ``t_mid`` stage when this is set. None = a plain transform.
+    op: str | None = None
 
     @property
     def num_exchanges(self) -> int:
-        return {"single": 0, "slab": 1, "pencil": 2}[self.decomposition]
+        n = {"single": 0, "slab": 1, "pencil": 2}[self.decomposition]
+        # An operator chain retraces every exchange on the way back.
+        return 2 * n if self.op else n
 
 
 def spec_entries(mesh: Mesh, spec: P, ndim: int) -> tuple:
@@ -744,6 +753,15 @@ def exchange_payloads(lp: LogicPlan, shape, itemsize: int) -> list[dict]:
     """Per-exchange payload accounting: the TRUE information moved versus
     the bytes each algorithm ships on the wire.
 
+    A fused spectral-operator plan (``lp.op``) retraces every exchange on
+    its inverse half, so its entry list is the forward chain's entries
+    followed by their mirrors in reverse chain order (the return legs) —
+    per-execute wire counters and the pruning model inherit the doubling
+    from here. Mirror byte figures reuse the forward leg's (exact for the
+    dense transports, whose padded volume is split/concat-symmetric; the
+    ragged transport's uneven-world mirror differs only in which axis's
+    ceil padding it strips).
+
     The reference sizes true payloads with exact per-peer count tables
     (``TransInfo``, ``fft_mpi_3d_api.cpp:84-133``; ``dfft_exchange_table``);
     on TPU the dense ``alltoall`` ships both split- and concat-axis ceil
@@ -768,6 +786,13 @@ def exchange_payloads(lp: LogicPlan, shape, itemsize: int) -> list[dict]:
     """
     if lp.mesh is None:
         return []
+
+    def _done(entries: list[dict]) -> list[dict]:
+        # Operator chains pay every exchange twice (out + back).
+        if getattr(lp, "op", None):
+            return entries + [dict(e) for e in reversed(entries)]
+        return entries
+
     shape = tuple(int(s) for s in shape)
     bsz = getattr(lp, "batch", None) or 1
     pad = lambda n, k: k * (-(-n // k))
@@ -800,7 +825,7 @@ def exchange_payloads(lp: LogicPlan, shape, itemsize: int) -> list[dict]:
                     "alltoall_bytes": dense,
                     "alltoallv_bytes": dense,  # each leg is dense
                 })
-            return out
+            return _done(out)
         f = (p - 1) / p
         out.append({
             "stage": "t2", "mesh_axis": names[0], "parts": p,
@@ -811,7 +836,7 @@ def exchange_payloads(lp: LogicPlan, shape, itemsize: int) -> list[dict]:
             "alltoallv_bytes": int(pad(n_in, p) * n_out * n_oth * f
                                    * itemsize * bsz),
         })
-        return out
+        return _done(out)
     rows, cols = (lp.mesh.shape[ax] for ax in lp.mesh.axis_names[:2])
     a, b, c = lp.pencil_perm if lp.pencil_perm else (0, 1, 2)
     order = lp.pencil_order or "col_first"
@@ -837,7 +862,7 @@ def exchange_payloads(lp: LogicPlan, shape, itemsize: int) -> list[dict]:
             "alltoallv_bytes": int(bystander_padded * shape[split] * f
                                    * itemsize * bsz),
         })
-    return out
+    return _done(out)
 
 
 def model_stage_seconds(
@@ -854,7 +879,11 @@ def model_stage_seconds(
     dcn_gbps: float | None = None,
 ) -> dict:
     """Per-stage analytical prediction of one execution, keyed exactly
-    ``t0..t3`` — the model side of the explain/attribution join.
+    ``t0..t3`` — the model side of the explain/attribution join. A fused
+    spectral-operator plan (``lp.op``) additionally carries the
+    ``t_mid`` midpoint stage (final forward FFT + pointwise multiply +
+    first inverse FFT in the transposed layout) and prices BOTH legs of
+    every exchange (``exchange_payloads`` doubles the entries).
 
     ``exchange_correction`` scales every exchange's modeled seconds (not
     its byte accounting): the persisted per-(device_kind, transport)
@@ -902,7 +931,29 @@ def model_stage_seconds(
 
     zero = {"seconds": 0.0, "flops": 0.0, "hbm_bytes": 0.0,
             "wire_bytes": 0.0}
-    if lp.decomposition == "single" or lp.mesh is None:
+    op_chain = bool(getattr(lp, "op", None))
+    if op_chain:
+        # Fused spectral-operator taxonomy (canonical chains only): t0 =
+        # forward input-side pass(es), t1 = the pencil chain's forward
+        # mid FFT, t2 = every exchange's exposed time (out AND back legs
+        # — exchange_payloads doubles the entries), t_mid = the
+        # transposed-midpoint stage (final forward FFT + the pointwise
+        # multiply + first inverse FFT), t3 = the inverse passes back to
+        # the input layout.
+        mid = fft_stage((0, 0))  # forward + inverse pass of the mid axis
+        pw = 2.0 * block_bytes   # pointwise multiply: read + write once
+        mid["hbm_bytes"] += pw
+        mid["seconds"] += pw / (hbm_gbps * 1e9)
+        mid["flops"] += 6.0 * n_total / ndev  # one complex multiply/elem
+        if lp.decomposition == "pencil" and lp.mesh is not None:
+            out = {"t0": fft_stage((2,)), "t1": fft_stage((1,)),
+                   "t2": dict(zero), "t_mid": mid,
+                   "t3": fft_stage((1, 2))}
+        else:  # slab and single-device fused chains share the shape
+            out = {"t0": fft_stage((1, 2)), "t1": dict(zero),
+                   "t2": dict(zero), "t_mid": mid,
+                   "t3": fft_stage((1, 2))}
+    elif lp.decomposition == "single" or lp.mesh is None:
         # The staged single pipeline splits the whole-cube transform into
         # t0 (YZ planes) and t3 (X lines); no pack, no exchange.
         out = {"t0": fft_stage((1, 2)), "t1": dict(zero),
@@ -930,6 +981,13 @@ def model_stage_seconds(
         # A hierarchical slab plan's two legs both hide under t3 (the
         # pencil-style t2a/t2b taxonomy without a mid FFT stage).
         hide["t2a"] = hide["t2b"] = out["t3"]["seconds"]
+    if op_chain:
+        # Operator chains: the outbound exchange hides under t_mid, the
+        # return one under t3 — per-entry attribution collapses to one
+        # figure because mirrored entries share their stage names, so
+        # each exchange hides under half the downstream compute.
+        half = 0.5 * (out["t_mid"]["seconds"] + out["t3"]["seconds"])
+        hide = {"t2": half, "t2a": half, "t2b": half}
     t2 = out["t2"]
     for e in payloads:
         # Per-leg link bandwidth: the DCN leg of a hierarchical (or

@@ -78,13 +78,17 @@ DEFAULT_MIN_SAMPLES = 2   # baseline records required for a verdict
 AUX_COST_METRICS = ("peak_hbm_bytes", "compile_seconds")
 
 #: Auxiliary metrics of the record's ``rates`` block (throughput stamps
-#: like the serving tier's ``transforms_per_s``): same noise model,
+#: like the serving tier's ``transforms_per_s`` and the spectral-
+#: operator tier's ``solves_per_s``): same noise model,
 #: larger-is-better per :func:`metric_direction`'s ``_per_s`` rule. The
 #: gate fails on a confirmed throughput regression even when the
 #: GFlop/s headline is within noise (per-transform flops shrink when a
 #: batched program degrades to serialized exchanges, but the flagship
-#: headline may not move enough to trip alone).
-AUX_RATE_METRICS = ("transforms_per_s",)
+#: headline may not move enough to trip alone). ``solves_per_s`` rows
+#: additionally live in their own baseline group: the operator name is
+#: keyed into the record config (``op``), so operator runs never share
+#: baselines with bare transforms.
+AUX_RATE_METRICS = ("transforms_per_s", "solves_per_s")
 
 _MAD_SCALE = 1.4826       # MAD -> sigma under a normal noise model
 
@@ -235,8 +239,14 @@ def normalize_bench_line(
     # different collective program than the exact flat exchange, so
     # compressed and exact runs never share a baseline; default rows
     # (exact wire, alltoall) keep the old schema and groups.
+    # "op" is the fused spectral-operator name (DFFT_BENCH_OP /
+    # speed3d -op): an operator run executes a different program class
+    # (forward + pointwise + inverse, double the exchanges) than a bare
+    # transform, so operator rows form their own baseline groups and
+    # their solves_per_s rate never compares against transform rows;
+    # transform rows keep the old schema.
     for k in ("dtype", "devices", "decomposition", "overlap", "tuned",
-              "batch", "profile", "wire_dtype", "transport"):
+              "batch", "profile", "wire_dtype", "transport", "op"):
         if obj.get(k) is not None:
             config[k] = obj[k]
     ex: dict = {}

@@ -41,9 +41,12 @@ flush, recorded retroactively via :func:`..utils.trace.record_span`),
 ``serve_plan``/``serve_execute``, and ``serve_result[<id>]`` (the
 caller's await). Metrics grow ``serving_queue_depth`` (gauge),
 ``serving_wait_seconds`` (histogram), and ``serving_flush_reasons``
-(counter; reason = ``full`` | ``manual`` | ``result``). With tracing
-AND metrics disabled every hook is a flag check — the queue's
-execution behavior is byte-identical either way.
+(counter; reason = ``full`` | ``manual`` | ``result`` |
+``deadline`` — the latter from the ``max_wait_s`` coalescing deadline).
+With tracing AND metrics disabled every hook is a flag check — the
+queue's execution behavior is byte-identical either way (the deadline
+timer stamps enqueue times regardless: the deadline is behavior, not
+telemetry).
 """
 
 from __future__ import annotations
@@ -182,6 +185,16 @@ class CoalescingQueue:
     donates the queue-owned stacked buffer of batched flushes to the
     device program (singleton flushes never donate — the caller's array
     must survive). Thread-safe: submits/flushes serialize on one lock.
+
+    ``max_wait_s`` is the coalescing deadline (the first step of the
+    multi-tenant fairness/deadline policy): a pending group whose
+    OLDEST request ages past it is flushed at whatever batch size it
+    reached, so a trickle of traffic never waits unboundedly for a
+    full batch. The flush is driven by a daemon timer armed when a
+    group forms; its reason stamps ``"deadline"`` into the
+    ``serving_flush_reasons`` counter and the ``serve_flush`` span
+    label. ``None`` (the default) keeps today's behavior: groups wait
+    for max_batch, an explicit ``flush()``, or a ``result()``.
     """
 
     def __init__(
@@ -191,6 +204,7 @@ class CoalescingQueue:
         kind: str = "c2c",
         max_batch: int = 8,
         donate: bool = False,
+        max_wait_s: float | None = None,
         **plan_kw,
     ):
         if kind not in ("c2c", "r2c"):
@@ -198,6 +212,12 @@ class CoalescingQueue:
         if not isinstance(max_batch, int) or max_batch < 1:
             raise ValueError(f"max_batch must be an int >= 1, "
                              f"got {max_batch!r}")
+        if max_wait_s is not None and (
+                isinstance(max_wait_s, bool)
+                or not isinstance(max_wait_s, (int, float))
+                or not max_wait_s > 0):
+            raise ValueError(f"max_wait_s must be a positive number or "
+                             f"None, got {max_wait_s!r}")
         for bad in ("batch", "donate", "in_spec", "out_spec"):
             if bad in plan_kw:
                 raise ValueError(f"{bad!r} is owned by the queue; do not "
@@ -206,6 +226,7 @@ class CoalescingQueue:
         self.kind = kind
         self.max_batch = max_batch
         self.donate = bool(donate)
+        self.max_wait_s = None if max_wait_s is None else float(max_wait_s)
         self.plan_kw = dict(plan_kw)
         self._lock = threading.RLock()
         # (shape, dtype str, direction) -> list of (array, handle)
@@ -247,8 +268,20 @@ class CoalescingQueue:
                 _metrics.inc("serving_submits", kind=self.kind)
             with self._lock:
                 group = self._pending.setdefault(key, [])
+                first = not group
                 group.append((x, handle, scale))
                 full = len(group) >= self.max_batch
+                if self.max_wait_s is not None:
+                    # The deadline clock runs even with the recorder
+                    # off: the timer callback judges the group's oldest
+                    # enqueue stamp against max_wait_s.
+                    if handle._enqueued is None:
+                        handle._enqueued = time.perf_counter()
+                    if first and not full:
+                        t = threading.Timer(self.max_wait_s,
+                                            self._deadline_flush, (key,))
+                        t.daemon = True
+                        t.start()
                 if _metrics._enabled:
                     _metrics.set_gauge(
                         "serving_queue_depth",
@@ -257,6 +290,22 @@ class CoalescingQueue:
         if full:
             self.flush(key, reason="full")
         return handle
+
+    def _deadline_flush(self, key: tuple) -> None:
+        """Timer callback of the ``max_wait_s`` deadline: flush ``key``'s
+        group iff its oldest request has aged past the deadline. A group
+        that already flushed (and possibly re-formed with younger
+        requests) is left alone — the newer generation armed its own
+        timer when it formed."""
+        with self._lock:
+            group = self._pending.get(key)
+            if not group:
+                return
+            oldest = group[0][1]._enqueued
+            if oldest is None or (time.perf_counter() - oldest
+                                  < self.max_wait_s * 0.999):
+                return
+        self.flush(key, reason="deadline")
 
     def _coerce(self, x, direction: int):
         """Validate/convert one request array against the plan family's
@@ -303,8 +352,9 @@ class CoalescingQueue:
         resolve to async in-flight arrays (result() blocks on device).
         ``reason`` tags the flight-recorder spans/metrics with what
         triggered the flush: ``full`` (a group reached max_batch),
-        ``manual`` (this call), or ``result`` (a caller's await outran
-        the coalescer)."""
+        ``manual`` (this call), ``result`` (a caller's await outran
+        the coalescer), or ``deadline`` (the oldest request aged past
+        ``max_wait_s``)."""
         done = 0
         recording = tracing_enabled() or _metrics._enabled
         flushed_at = time.perf_counter() if recording else 0.0

@@ -234,15 +234,27 @@ def timed_span(name: str):
 #: (``fft_mpi_3d_api.cpp:184-201``) — the join axis of the explain layer.
 STAGE_KEYS = ("t0", "t1", "t2", "t3")
 
+#: Stage keys of a fused spectral-operator chain (:mod:`...operators`):
+#: the transform taxonomy plus the ``t_mid`` pointwise stage between the
+#: forward and inverse halves (final forward FFT, wavenumber-diagonal
+#: multiply, first inverse FFT — all in the transposed midpoint layout).
+OP_STAGE_KEYS = ("t0", "t1", "t2", "t_mid", "t3")
+
 
 def stage_key(name: str) -> str | None:
-    """Canonical ``t0..t3`` key of a stage/span name, or None.
+    """Canonical ``t0..t3`` / ``t_mid`` key of a stage/span name, or None.
 
     Normalizes every variant the chain builders emit — ``t0_fft_yz``,
     ``t2_all_to_all``, ``t2a_exchange_x``/``t2b_exchange_y`` (both map
-    to ``t2``), per-chunk overlap spans ``t3_fft_x[4]`` — so the
+    to ``t2``), per-chunk overlap spans ``t3_fft_x[4]``, the operator
+    chains' ``t_mid``/``t_mid[k]`` midpoint spans — so the
     explain/attribution layer and the regress localization agree on one
-    stage taxonomy regardless of which builder produced the span."""
+    stage taxonomy regardless of which builder produced the span.
+    ``t_mid_pointwise`` (the multiply sub-span nested inside ``t_mid``)
+    maps to None so device-trace attribution never double-counts it."""
+    if name.startswith("t_mid"):
+        rest = name[5:]
+        return "t_mid" if (not rest or rest[0] == "[") else None
     if len(name) >= 2 and name[0] == "t" and name[1] in "0123":
         key = name[:2]
         rest = name[2:]
@@ -348,6 +360,12 @@ def plan_info(plan) -> str:
         lines.append(
             f"batch: {_b} coalesced transforms (one shared exchange per "
             f"t2 stage; batch rides the collectives as a bystander dim)")
+    _op = getattr(plan, "op", "")
+    if _op:
+        lines.append(
+            f"operator: fused {_op} (FFT -> pointwise -> iFFT in one "
+            f"program; multiplier applied at the transposed t_mid "
+            f"midpoint, skipping the cancelling transpose pair)")
     if plan.mesh is not None:
         lines.append(
             "mesh: "

@@ -72,6 +72,12 @@ def test_stage_key_normalization():
     assert stage_key("t1") == "t1"
     assert stage_key("tune_build_xla") is None
     assert stage_key("execute_c2c_slab") is None
+    # Operator-chain midpoint spans: t_mid (and per-chunk variants) map
+    # to the t_mid key; the nested pointwise sub-span maps to None so
+    # device-trace attribution never double-counts it.
+    assert stage_key("t_mid") == "t_mid"
+    assert stage_key("t_mid[2]") == "t_mid"
+    assert stage_key("t_mid_pointwise") is None
 
 
 # ----------------------------------------------------------- fixture
@@ -510,7 +516,8 @@ def test_poison_ordering_guard():
     poison = names.index("test_alltoallv.py")
     for early in ("test_a2a_overlap.py", "test_a2c_tuner.py",
                   "test_a2d_explain.py", "test_a2e_batch.py",
-                  "test_a2f_flightrec.py", "test_a2g_wire.py"):
+                  "test_a2f_flightrec.py", "test_a2g_wire.py",
+                  "test_a2h_operators.py"):
         assert early in names, early
         assert names.index(early) < poison, (
             f"{early} must collect before test_alltoallv.py")

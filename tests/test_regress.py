@@ -342,6 +342,53 @@ def test_transforms_per_s_gates_both_directions():
     assert "rates.transforms_per_s" in regress.format_compare([res])
 
 
+def test_solves_per_s_gates_as_a_rate_in_its_own_group():
+    """The spectral-operator throughput stamp: ``solves_per_s`` is
+    classified by the ``_per_s`` larger-is-better rule, lifted into the
+    rates block, gated by the shared rule, and the operator name is
+    keyed into the baseline config group so operator runs never share
+    baselines with bare transforms."""
+    assert regress.metric_direction("solves_per_s") == 1
+
+    def op_rec(value, sps):
+        return regress.make_run_record(
+            metric="spectral_poisson_512_gflops", value=value,
+            config={"dtype": "complex64", "devices": 8, "op": "poisson"},
+            backend="tpu", device_kind="TPU v5 lite",
+            rates={"solves_per_s": sps}, source="test")
+
+    hist = [op_rec(370.0 + d, 600.0 + 5 * d) for d in (-1, 0, 1, 2)]
+    res = regress.compare_record(op_rec(370.2, 350.0), hist)
+    assert res["verdict"] == "within-noise"
+    by = {a["metric"]: a for a in res["aux"]}
+    assert by["solves_per_s"]["verdict"] == "regressed"
+    assert ("spectral_poisson_512_gflops:solves_per_s"
+            in regress.regressed_metrics(res))
+    res2 = regress.compare_record(op_rec(370.2, 1200.0), hist)
+    assert {a["metric"]: a["verdict"] for a in res2["aux"]}[
+        "solves_per_s"] == "improved"
+    assert "rates.solves_per_s" in regress.format_compare([res])
+
+
+def test_operator_records_never_share_transform_baseline():
+    """The ``op`` config key: a fused-operator bench line forms its own
+    baseline group; transform rows keep the old schema."""
+    line = {"metric": "spectral_poisson_512_gflops", "value": 370.0,
+            "unit": "GFlops/s", "dtype": "complex64", "devices": 8,
+            "decomposition": "slab", "backend": "tpu",
+            "solves_per_s": 9.0}
+    op = regress.normalize_bench_line(dict(line, op="poisson"),
+                                      source="t")
+    assert op["config"]["op"] == "poisson"
+    assert op["rates"]["solves_per_s"] == 9.0
+    plain = regress.normalize_bench_line(
+        {"metric": "spectral_poisson_512_gflops", "value": 370.0,
+         "dtype": "complex64", "devices": 8, "backend": "tpu"},
+        source="t")
+    assert "op" not in plain["config"]
+    assert regress.group_key(op) != regress.group_key(plain)
+
+
 def test_batched_records_never_share_single_transform_baseline():
     """``batch`` joins overlap/tuned in the baseline config group, and
     ``transforms_per_s`` is lifted from the bench line into rates."""

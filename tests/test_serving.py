@@ -132,6 +132,90 @@ def test_queue_warm_preplans():
     assert plan.batch == 4
 
 
+# ------------------------------------------------------ deadline flush
+
+def _wait_until(cond, timeout=10.0):
+    import time
+
+    end = time.time() + timeout
+    while time.time() < end:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return cond()
+
+
+def _reason_count(reason: str) -> float:
+    rows = dfft.metrics_snapshot()["counters"].get(
+        "serving_flush_reasons", {})
+    return sum(v for lbl, v in rows.items() if f"reason={reason}" in lbl)
+
+
+def test_deadline_flushes_stale_group_with_reason():
+    """``max_wait_s``: a group whose oldest request ages past the
+    deadline flushes at whatever batch it reached, stamping reason
+    "deadline" into serving_flush_reasons — the first step of the
+    multi-tenant fairness/deadline policy."""
+    from distributedfft_tpu.utils import metrics as m
+
+    dfft.enable_metrics()
+    m.metrics_reset()
+    try:
+        q = dfft.CoalescingQueue(None, dtype=CDT, max_batch=8,
+                                 max_wait_s=0.1)
+        h = q.submit(jnp.asarray(_world(11)))
+        assert q.pending() == 1
+        assert _wait_until(lambda: q.pending() == 0), \
+            "deadline flush never fired"
+        ref = dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT)
+        assert np.array_equal(np.asarray(h.result(timeout=10)),
+                              np.asarray(ref(jnp.asarray(_world(11)))))
+        assert _reason_count("deadline") == 1
+    finally:
+        m.metrics_reset()
+
+
+def test_deadline_never_misfires_on_full_flushed_group():
+    """A group that already flushed full is left alone by its timer; a
+    later group gets its own deadline clock."""
+    from distributedfft_tpu.utils import metrics as m
+
+    dfft.enable_metrics()
+    m.metrics_reset()
+    try:
+        q = dfft.CoalescingQueue(None, dtype=CDT, max_batch=2,
+                                 max_wait_s=0.15)
+        h1 = q.submit(jnp.asarray(_world(12)))
+        h2 = q.submit(jnp.asarray(_world(13)))  # full -> immediate flush
+        assert q.pending() == 0
+        h1.result(timeout=10), h2.result(timeout=10)
+        assert _reason_count("full") == 1
+        assert _reason_count("deadline") == 0
+        # A later singleton group still gets its own deadline flush.
+        h3 = q.submit(jnp.asarray(_world(14)))
+        assert _wait_until(lambda: q.pending() == 0)
+        h3.result(timeout=10)
+        assert _reason_count("deadline") == 1
+    finally:
+        m.metrics_reset()
+
+
+def test_deadline_validation_and_default_off():
+    with pytest.raises(ValueError, match="max_wait_s"):
+        dfft.CoalescingQueue(None, max_wait_s=0.0)
+    with pytest.raises(ValueError, match="max_wait_s"):
+        dfft.CoalescingQueue(None, max_wait_s=True)
+    # Default: no deadline — a pending group stays pending.
+    import time
+
+    q = dfft.CoalescingQueue(None, dtype=CDT, max_batch=8)
+    h = q.submit(jnp.asarray(_world(15)))
+    time.sleep(0.25)
+    assert q.pending() == 1
+    q.flush()
+    h.result(timeout=10)
+
+
 # --------------------------------------------------------- flight recorder
 
 def test_disabled_recorder_is_zero_overhead_and_byte_identical():
