@@ -292,7 +292,7 @@ def _plan_wire_kw(plan) -> dict:
 def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
           all_times, donated=False, stages=None, overlap=None, tuned=None,
           cost=None, batch=None, wire_dtype=None, transport=None,
-          op=None):
+          op=None, degraded=False):
     import jax
 
     from distributedfft_tpu.utils.metrics import metrics_snapshot
@@ -363,6 +363,13 @@ def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
         # bytes and must never be judged against exact-wire baselines or
         # vice versa. Exact rows keep the old schema.
         out["wire_dtype"] = wire_dtype
+    if degraded:
+        # Degraded-mode fallback run (docs/ROBUSTNESS.md): the matmul-
+        # DFT executor stood in for a faulted default. The run-record
+        # store keys "degraded" into the baseline group, so a degraded
+        # run can never poison the fast baselines (nor be gated against
+        # them); healthy rows keep the old schema.
+        out["degraded"] = True
     if transport not in (None, "alltoall"):
         # Non-default exchange transport (alltoallv/ppermute/
         # hierarchical): a different collective program — keyed into the
@@ -664,6 +671,29 @@ def _worker(shape_n: int) -> None:
                   **_plan_wire_kw(results[best][2]))
 
     if not results:
+        # Degraded-mode last resort (docs/ROBUSTNESS.md): when every
+        # menu candidate failed, try the matmul-DFT executor — it shares
+        # no code with the XLA fft thunk, so the long-standing fft-thunk
+        # fault class cannot take it down with the rest. A success is
+        # emitted with degraded=true (its own baseline group) and the
+        # extras (donation, stage breakdown) are skipped: this is an
+        # insurance line, not a campaign number.
+        fb = os.environ.get("DFFT_FALLBACK_EXECUTOR", "matmul").strip()
+        if fb and fb not in ("0", "none") and fb not in candidates:
+            try:
+                seconds, max_err, plan = bench_executor(
+                    shape, mesh, dtype, fb)
+            except Exception:  # noqa: BLE001 — the last resort failed too
+                traceback.print_exc(limit=3, file=sys.stderr)
+            else:
+                print(f"degraded: every candidate failed; {fb} fallback "
+                      f"succeeded", file=sys.stderr)
+                _emit(shape_n, seconds, max_err, fb, n_dev,
+                      plan.decomposition, {fb: round(seconds, 6)},
+                      overlap=getattr(plan.options, "overlap_chunks", None),
+                      cost=_plan_cost_block(plan), degraded=True,
+                      **_plan_wire_kw(plan))
+                return
         raise SystemExit("no benchmark executor succeeded")
     seconds, max_err, plan = results[best]
     all_times = {e: r[0] for e, r in results.items()}

@@ -64,10 +64,17 @@ from .operators import (  # noqa: F401
 from .api import OpPlan3D  # noqa: F401
 from .serving import (  # noqa: F401
     CoalescingQueue,
+    DeadlineExceeded,
     Handle,
+    QueueFull,
     submit,
     warm_pool,
 )
+# Deterministic fault injection (docs/ROBUSTNESS.md): the module is the
+# API surface (dfft.faults.inject / .injected / .check / .classify);
+# the fault error type is lifted for except clauses.
+from . import faults  # noqa: F401
+from .faults import InjectedFault  # noqa: F401
 from .geometry import Box3, world_box  # noqa: F401
 from .local import (  # noqa: F401
     LocalPlan,

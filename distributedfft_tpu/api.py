@@ -42,6 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .utils import metrics as _metrics
 from .utils.trace import add_trace
 
+from . import faults as _faults
 from . import geometry as geo
 from .geometry import Box3, world_box
 from .ops.executors import (
@@ -149,8 +150,10 @@ class Plan3D:
         ``self`` for chaining."""
         from .utils.timing import sync
 
+        _faults.check("compile", self.executor)
         t0 = time.perf_counter()
         sync(self.fn(alloc_local(self)))
+        self._warm = True  # the compile fault point fired (or passed)
         if _metrics._enabled:
             _metrics.observe(
                 "compile_seconds", time.perf_counter() - t0,
@@ -1548,6 +1551,10 @@ def _plan_cache_key(kind: str, shape, mesh, kw: dict):
 
 
 def _timed_build(kind: str, build: Callable, shape, mesh, kw: dict):
+    # Fault-injection point "plan": a cache miss is about to construct a
+    # plan (docs/ROBUSTNESS.md; cache hits replay an already-built plan
+    # and are not a build). The label lets match= target one executor.
+    _faults.check("plan", str(kw.get("executor") or ""))
     t0 = time.perf_counter()
     plan = build(shape, mesh, **kw)
     if _metrics._enabled:
@@ -1658,7 +1665,20 @@ def execute(plan: Plan3D, x, *, scale: Scale = Scale.NONE):
             _metrics.inc("exchange_true_bytes", float(true_b))
             _metrics.inc("exchange_wire_bytes", float(wire_b))
     with add_trace(f"execute_{kind}_{plan.decomposition}"):
+        # Fault-injection points (docs/ROBUSTNESS.md): "compile" fires
+        # on a plan's FIRST execution (JAX compiles at first call),
+        # "exchange" emulates a t2-exchange fault host-side for plans
+        # that own one (a fault inside the compiled collective cannot
+        # raise from XLA), "execute" on every dispatch. All three are
+        # env-dict lookups when nothing is armed, and none touch the
+        # traced program — the HLO is byte-identical either way.
+        if not getattr(plan, "_warm", False):
+            _faults.check("compile", plan.executor)
+        if plan.mesh is not None:
+            _faults.check("exchange", plan.options.algorithm)
+        _faults.check("execute", plan.executor)
         y = plan.fn(x)
+        plan._warm = True
         if scale != Scale.NONE:
             y = apply_scale(y, scale, plan.world_size)
     return y

@@ -144,13 +144,10 @@ def write_profile(profile: dict, path: str | None = None) -> str | None:
         path = default_profile_path()
     if path is None:
         return None
-    d = os.path.dirname(os.path.abspath(path))
-    os.makedirs(d, exist_ok=True)
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(profile, f, sort_keys=True, indent=1)
-        f.write("\n")
-    os.replace(tmp, path)
+    from .utils.atomicio import replace_file
+
+    replace_file(path,
+                 json.dumps(profile, sort_keys=True, indent=1) + "\n")
     _cache = None
     return path
 

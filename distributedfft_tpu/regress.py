@@ -245,8 +245,15 @@ def normalize_bench_line(
     # transform, so operator rows form their own baseline groups and
     # their solves_per_s rate never compares against transform rows;
     # transform rows keep the old schema.
+    # "degraded" marks a run produced by the executor-fallback chain
+    # (docs/ROBUSTNESS.md): the matmul-DFT fallback runs a different —
+    # typically slower — program class than the configured executor, so
+    # degraded rows form their own baseline group and can never poison
+    # the fast baselines (nor be judged against them). Non-degraded
+    # rows keep the old schema exactly.
     for k in ("dtype", "devices", "decomposition", "overlap", "tuned",
-              "batch", "profile", "wire_dtype", "transport", "op"):
+              "batch", "profile", "wire_dtype", "transport", "op",
+              "degraded"):
         if obj.get(k) is not None:
             config[k] = obj[k]
     ex: dict = {}
@@ -370,14 +377,24 @@ def records_from_artifact(
 
 def append_records(records: list[dict], path: str) -> None:
     """Append run records to the JSONL history store (created, with
-    parent directory, on first use)."""
+    parent directory, on first use). One ``O_APPEND`` write for the
+    whole batch, so concurrent writers (parallel bench rounds, the
+    record CLI) land line-atomically — no interleaved/torn lines.
+    Inlined rather than imported from ``utils.atomicio`` because this
+    module must stay loadable from its file path alone (bench.py's
+    orchestrator discipline: no package imports)."""
     if not records:
         return
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
-    with open(path, "a") as f:
-        for rec in records:
-            f.write(json.dumps(rec, sort_keys=True) + "\n")
+    payload = "".join(
+        json.dumps(rec, sort_keys=True) + "\n" for rec in records
+    ).encode("utf-8")
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        os.write(fd, payload)
+    finally:
+        os.close(fd)
 
 
 def load_history(path: str) -> tuple[list[dict], int]:

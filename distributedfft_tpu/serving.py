@@ -6,7 +6,7 @@ coalesced into ONE device program — "Large-Scale Discrete Fourier
 Transform on TPUs" (arXiv 2002.03260) reaches peak TPU utilization with
 batched device programs, and DaggerFFT (arXiv 2601.12209) frames
 scheduling concurrent transforms onto one mesh as the distributed-FFT
-throughput play. This module is that tier, three pieces:
+throughput play. This module is that tier, four pieces:
 
 1. :func:`submit` / :class:`Handle` — async execute-and-await. JAX
    dispatch is already asynchronous, so ``submit(plan, x)`` returns the
@@ -24,6 +24,22 @@ throughput play. This module is that tier, three pieces:
    the first requests of a fresh process hit warm plans instead of
    paying a compile (``tune="wisdom"`` replays each stored winner with
    zero timing executions).
+4. **Fault tolerance** (docs/ROBUSTNESS.md): with the retry machinery
+   armed (``retry_max=``/``DFFT_RETRY_MAX``), a failed flush is
+   classified (:func:`..faults.classify`) and recovered instead of
+   failing every co-batched request: transient errors retry with
+   bounded exponential backoff (``DFFT_RETRY_BACKOFF_S``), persistent
+   failures rebuild the group on the degraded matmul-DFT executor
+   (``DFFT_FALLBACK_EXECUTOR`` — :mod:`..ops.dft_matmul` shares no code
+   with the XLA fft thunk), and a batched flush that still fails
+   *bisects*: each request re-runs unbatched (with its own degraded
+   fallback) so one poisoned buffer fails alone while its cohort
+   completes. ``submit(..., deadline_s=T)`` cancels a request that
+   waits past T with :class:`DeadlineExceeded` (queue-wait breakdown
+   attached); ``max_pending``/``admission`` bound the queue depth so
+   overload degrades predictably (:class:`QueueFull`). With none of
+   these knobs set the queue's behavior is byte-identical to the
+   pre-robustness tier — one classification-free try/except per flush.
 
 Throughput accounting: every flush observes ``serving_batch_size`` and
 bumps ``serving_transforms`` in the metrics registry; bench.py stamps
@@ -39,10 +55,15 @@ timeline next to the chain builders' t0..t3 stage spans —
 flush, recorded retroactively via :func:`..utils.trace.record_span`),
 ``serve_flush[<kind>:b<B>:<reason>]`` wrapping each group's
 ``serve_plan``/``serve_execute``, and ``serve_result[<id>]`` (the
-caller's await). Metrics grow ``serving_queue_depth`` (gauge),
-``serving_wait_seconds`` (histogram), and ``serving_flush_reasons``
-(counter; reason = ``full`` | ``manual`` | ``result`` |
-``deadline`` — the latter from the ``max_wait_s`` coalescing deadline).
+caller's await). Recovery paths add ``serve_retry[<tag>:a<N>]`` (the
+Nth backoff retry), ``serve_degraded[<tag>:<executor>]`` (the fallback
+rebuild), and ``serve_expire[<id>]`` (a deadline cancellation,
+retroactive like ``serve_wait``). Metrics grow ``serving_queue_depth``
+(gauge), ``serving_wait_seconds`` (histogram), ``serving_flush_reasons``
+(counter; reason = ``full`` | ``manual`` | ``result`` | ``deadline`` —
+the latter from the ``max_wait_s`` coalescing deadline), and the
+recovery counters ``serving_retries`` / ``serving_isolated_failures`` /
+``serving_degraded`` / ``serving_expired`` / ``serving_rejected``.
 With tracing AND metrics disabled every hook is a flag check — the
 queue's execution behavior is byte-identical either way (the deadline
 timer stamps enqueue times regardless: the deadline is behavior, not
@@ -52,6 +73,8 @@ telemetry).
 from __future__ import annotations
 
 import itertools
+import os
+import sys
 import threading
 import time
 from contextlib import nullcontext
@@ -60,16 +83,46 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from . import faults as _faults
 from .local import FORWARD
 from .ops.executors import Scale
 from .utils import metrics as _metrics
 from .utils.trace import add_trace, record_span, tracing_enabled
 
-__all__ = ["Handle", "submit", "CoalescingQueue", "warm_pool"]
+__all__ = ["Handle", "submit", "CoalescingQueue", "warm_pool",
+           "DeadlineExceeded", "QueueFull"]
 
 #: Process-global request ids — the correlation key of one request's
 #: submit/wait/result spans across threads (the MPI-tag role).
 _REQ_IDS = itertools.count(1)
+
+#: Default backoff base of the transient-retry loop (seconds; doubled
+#: per attempt). ``DFFT_RETRY_BACKOFF_S`` / ``retry_backoff_s`` override.
+DEFAULT_RETRY_BACKOFF_S = 0.05
+
+
+class DeadlineExceeded(TimeoutError):
+    """A request's ``deadline_s`` elapsed before it executed. Carries
+    the queue-wait breakdown: ``waited_s`` (how long the request sat),
+    ``deadline_s`` (its budget), and ``stage`` — ``"queued"`` (expired
+    while coalescing) or ``"admission"`` (never admitted past the
+    bounded queue depth). The request never executed; no partial result
+    exists."""
+
+    def __init__(self, *, waited_s: float, deadline_s: float,
+                 stage: str = "queued"):
+        super().__init__(
+            f"request deadline of {deadline_s:g}s exceeded after "
+            f"{waited_s:.3f}s in the {stage} stage (never executed)")
+        self.waited_s = waited_s
+        self.deadline_s = deadline_s
+        self.stage = stage
+
+
+class QueueFull(RuntimeError):
+    """Admission rejected: the queue is at ``max_pending`` and was
+    constructed with ``admission="raise"`` — the caller sheds the load
+    instead of growing an unbounded backlog."""
 
 
 def _span(name: str, on: bool):
@@ -85,16 +138,22 @@ class Handle:
     async-dispatched output array is already attached — ``result()``
     only blocks on the device); a :class:`CoalescingQueue` handle stays
     pending until its group flushes (``result()`` triggers the flush
-    when the caller outruns the coalescer)."""
+    when the caller outruns the coalescer). ``degraded`` is True when
+    the result was produced by the executor-fallback chain rather than
+    the queue's configured executor (docs/ROBUSTNESS.md)."""
 
     __slots__ = ("_value", "_error", "_event", "_queue", "_req_id",
-                 "_enqueued")
+                 "_enqueued", "_key", "degraded")
 
     def __init__(self, queue: "CoalescingQueue | None" = None):
         self._value: Any = None
         self._error: BaseException | None = None
         self._event = threading.Event()
         self._queue = queue
+        # The handle's own group key, so result() can flush just its
+        # group (None for direct submits — nothing pending to flush).
+        self._key: tuple | None = None
+        self.degraded = False
         # Flight-recorder fields: the request id of this handle's spans
         # and its enqueue timestamp (perf_counter) — both None when
         # tracing/metrics were off at submit, so the disabled path pays
@@ -131,14 +190,27 @@ class Handle:
             return True
 
     def result(self, timeout: float | None = None):
-        """The transform output, blocking until it exists. A pending
-        queue handle flushes its queue first (the caller demanding a
-        result IS the coalescing deadline)."""
+        """The transform output, blocking until it exists.
+
+        Ordering contract: a pending queue handle triggers the lazy
+        flush of its own group BEFORE the ``timeout`` wait begins (the
+        caller demanding a result IS the coalescing deadline), so the
+        timeout bounds only execution/completion wait — a singleton
+        request in a never-filled group can never burn its whole
+        timeout waiting for a flush that only this call would trigger.
+        Raises the request's failure (retry-exhausted error,
+        :class:`DeadlineExceeded`, ...) when the queue failed it."""
         rid = self._req_id
         with _span(f"serve_result[{rid}]",
                    rid is not None and tracing_enabled()):
-            if not self._event.is_set() and self._queue is not None:
-                self._queue.flush(reason="result")
+            q = self._queue
+            if not self._event.is_set() and q is not None:
+                q.flush(self._key, reason="result")
+                if not self._event.is_set() and self._queue is not None:
+                    # Raced a concurrent submit/flush cycle: another
+                    # thread may hold this group popped mid-execution.
+                    # Drain everything as the pre-keyed path did.
+                    q.flush(reason="result")
             if not self._event.wait(timeout):
                 raise TimeoutError("submitted transform still pending")
             if self._error is not None:
@@ -168,6 +240,43 @@ def submit(plan, x, *, scale: Scale = Scale.NONE) -> Handle:
     return h
 
 
+class _Req:
+    """One pending request of a coalescing group: the coerced array, its
+    handle, the scale to apply at resolve, and — deadline requests
+    only — the absolute expiry stamp (perf_counter axis)."""
+
+    __slots__ = ("x", "handle", "scale", "expires", "deadline_s")
+
+    def __init__(self, x, handle: Handle, scale: Scale,
+                 expires: float | None = None,
+                 deadline_s: float | None = None):
+        self.x = x
+        self.handle = handle
+        self.scale = scale
+        self.expires = expires
+        self.deadline_s = deadline_s
+
+
+def _env_int(name: str) -> int | None:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be an integer, got {raw!r}") from None
+
+
+def _env_float(name: str) -> float | None:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be a number, got {raw!r}") from None
+
+
 class CoalescingQueue:
     """Request-coalescing front of the serving tier.
 
@@ -195,6 +304,32 @@ class CoalescingQueue:
     ``serving_flush_reasons`` counter and the ``serve_flush`` span
     label. ``None`` (the default) keeps today's behavior: groups wait
     for max_batch, an explicit ``flush()``, or a ``result()``.
+
+    Robustness knobs (docs/ROBUSTNESS.md; all default-off — the queue
+    is byte-identical to the pre-robustness tier without them):
+
+    - ``retry_max`` (env ``DFFT_RETRY_MAX``) arms the fault-tolerant
+      dispatch: transient flush errors retry up to this many times with
+      exponential backoff from ``retry_backoff_s`` (env
+      ``DFFT_RETRY_BACKOFF_S``, default 0.05 s); persistent failures
+      fall through the degraded-executor rebuild and, for batched
+      groups, per-request bisection — failures then surface ONLY
+      through the failed requests' handles, never by poisoning the
+      whole cohort or raising out of ``flush()``. ``retry_max=0``
+      enables isolation/degradation with zero retries.
+    - ``fallback_executor`` (env ``DFFT_FALLBACK_EXECUTOR``, default
+      ``"matmul"``; ``""``/``"0"``/``"none"`` disables) names the
+      degraded-mode executor — the matmul-DFT engine never touches the
+      XLA fft thunk. Handles resolved through it set
+      ``handle.degraded``.
+    - ``max_pending`` bounds the total queued depth; ``admission``
+      picks the overload policy: ``"block"`` (default) parks ``submit``
+      until a flush frees space (pair it with ``max_wait_s`` or
+      another consumer so the queue drains), ``"raise"`` sheds load
+      with :class:`QueueFull`. Both count ``serving_rejected``.
+    - ``submit(..., deadline_s=T)`` cancels the request with
+      :class:`DeadlineExceeded` if it has not executed within T
+      seconds (admission wait included).
     """
 
     def __init__(
@@ -205,6 +340,11 @@ class CoalescingQueue:
         max_batch: int = 8,
         donate: bool = False,
         max_wait_s: float | None = None,
+        max_pending: int | None = None,
+        admission: str = "block",
+        retry_max: int | None = None,
+        retry_backoff_s: float | None = None,
+        fallback_executor: str | None = None,
         **plan_kw,
     ):
         if kind not in ("c2c", "r2c"):
@@ -218,6 +358,36 @@ class CoalescingQueue:
                 or not max_wait_s > 0):
             raise ValueError(f"max_wait_s must be a positive number or "
                              f"None, got {max_wait_s!r}")
+        if max_pending is not None and (
+                isinstance(max_pending, bool)
+                or not isinstance(max_pending, int) or max_pending < 1):
+            raise ValueError(f"max_pending must be an int >= 1 or None, "
+                             f"got {max_pending!r}")
+        if admission not in ("block", "raise"):
+            raise ValueError(f"admission must be block|raise, "
+                             f"got {admission!r}")
+        if retry_max is None:
+            retry_max = _env_int("DFFT_RETRY_MAX")
+        if retry_max is not None and (
+                isinstance(retry_max, bool)
+                or not isinstance(retry_max, int) or retry_max < 0):
+            raise ValueError(f"retry_max must be an int >= 0 or None, "
+                             f"got {retry_max!r}")
+        if retry_backoff_s is None:
+            retry_backoff_s = _env_float("DFFT_RETRY_BACKOFF_S")
+        if retry_backoff_s is None:
+            retry_backoff_s = DEFAULT_RETRY_BACKOFF_S
+        if (isinstance(retry_backoff_s, bool)
+                or not isinstance(retry_backoff_s, (int, float))
+                or retry_backoff_s < 0):
+            raise ValueError(f"retry_backoff_s must be a number >= 0, "
+                             f"got {retry_backoff_s!r}")
+        if fallback_executor is None:
+            fallback_executor = os.environ.get(
+                "DFFT_FALLBACK_EXECUTOR", "matmul")
+        fallback_executor = fallback_executor.strip()
+        if fallback_executor in ("", "0", "none"):
+            fallback_executor = ""
         for bad in ("batch", "donate", "in_spec", "out_spec"):
             if bad in plan_kw:
                 raise ValueError(f"{bad!r} is owned by the queue; do not "
@@ -227,10 +397,18 @@ class CoalescingQueue:
         self.max_batch = max_batch
         self.donate = bool(donate)
         self.max_wait_s = None if max_wait_s is None else float(max_wait_s)
+        self.max_pending = max_pending
+        self.admission = admission
+        self._retry_max = retry_max          # None = legacy dispatch
+        self._retry_backoff = float(retry_backoff_s)
+        self._fallback_executor = fallback_executor
         self.plan_kw = dict(plan_kw)
         self._lock = threading.RLock()
-        # (shape, dtype str, direction) -> list of (array, handle)
-        self._pending: dict[tuple, list[tuple]] = {}
+        # Admission waiters park here; notified whenever a flush or an
+        # expiry frees queue depth.
+        self._space = threading.Condition(self._lock)
+        # (shape, dtype str, direction) -> list of _Req
+        self._pending: dict[tuple, list[_Req]] = {}
 
     # ------------------------------------------------------------ intake
 
@@ -240,20 +418,62 @@ class CoalescingQueue:
         return (api.plan_dft_r2c_3d if self.kind == "r2c"
                 else api.plan_dft_c2c_3d)
 
-    def _plan(self, key: tuple, batch: int | None, donate: bool):
+    def _plan(self, key: tuple, batch: int | None, donate: bool,
+              executor: str | None = None):
         shape, dtype, direction = key
         kw = dict(self.plan_kw, direction=direction, batch=batch,
                   donate=donate)
+        if executor is not None:
+            kw["executor"] = executor  # the degraded-mode rebuild
         if dtype is not None:
             kw["dtype"] = dtype
         return self._planner()(shape, self.mesh, **kw)
 
+    def _admit(self, deadline_s: float | None) -> None:
+        """Bounded-depth admission gate (caller holds the queue lock;
+        ``Condition.wait`` releases it while parked). ``"raise"`` sheds
+        immediately; ``"block"`` parks until a flush/expiry frees depth,
+        bounded by the request's own ``deadline_s`` when it has one."""
+        if self.max_pending is None:
+            return
+        start = time.perf_counter()
+        while (sum(len(g) for g in self._pending.values())
+               >= self.max_pending):
+            if self.admission == "raise":
+                if _metrics._enabled:
+                    _metrics.inc("serving_rejected", kind=self.kind)
+                raise QueueFull(
+                    f"queue depth is at max_pending={self.max_pending} "
+                    f"(admission='raise'); shed or await pending results")
+            timeout = None
+            if deadline_s is not None:
+                timeout = deadline_s - (time.perf_counter() - start)
+                if timeout <= 0:
+                    if _metrics._enabled:
+                        _metrics.inc("serving_rejected", kind=self.kind)
+                    raise DeadlineExceeded(
+                        waited_s=time.perf_counter() - start,
+                        deadline_s=deadline_s, stage="admission")
+            self._space.wait(timeout)
+
     def submit(self, x, *, direction: int = FORWARD,
-               scale: Scale = Scale.NONE) -> Handle:
+               scale: Scale = Scale.NONE,
+               deadline_s: float | None = None) -> Handle:
         """Enqueue one transform of ``x`` (the plan's unbatched input
         shape: the 3D world for c2c / forward r2c, the half-spectrum
         world for backward r2c). Returns immediately; the group executes
-        at ``max_batch``, on :meth:`flush`, or on ``result()``."""
+        at ``max_batch``, on :meth:`flush`, or on ``result()``.
+
+        ``deadline_s`` bounds this request's total queue time: a
+        request that has not begun executing within it is cancelled —
+        its handle raises :class:`DeadlineExceeded` with the queue-wait
+        breakdown — while its group's survivors stay queued."""
+        if deadline_s is not None and (
+                isinstance(deadline_s, bool)
+                or not isinstance(deadline_s, (int, float))
+                or not deadline_s > 0):
+            raise ValueError(f"deadline_s must be a positive number or "
+                             f"None, got {deadline_s!r}")
         tracing = tracing_enabled()
         recording = tracing or _metrics._enabled
         rid = next(_REQ_IDS) if recording else None
@@ -261,15 +481,29 @@ class CoalescingQueue:
             shape, dtype, x = self._coerce(x, direction)
             key = (shape, dtype, direction)
             handle = Handle(queue=self)
+            handle._key = key
             if recording:
                 handle._req_id = rid
                 handle._enqueued = time.perf_counter()
             if _metrics._enabled:
                 _metrics.inc("serving_submits", kind=self.kind)
             with self._lock:
+                self._admit(deadline_s)
                 group = self._pending.setdefault(key, [])
                 first = not group
-                group.append((x, handle, scale))
+                req = _Req(x, handle, scale)
+                if deadline_s is not None:
+                    # The deadline clock needs the enqueue stamp even
+                    # with the recorder off (behavior, not telemetry).
+                    if handle._enqueued is None:
+                        handle._enqueued = time.perf_counter()
+                    req.deadline_s = float(deadline_s)
+                    req.expires = handle._enqueued + req.deadline_s
+                    t = threading.Timer(req.deadline_s, self._expire,
+                                        (key,))
+                    t.daemon = True
+                    t.start()
+                group.append(req)
                 full = len(group) >= self.max_batch
                 if self.max_wait_s is not None:
                     # The deadline clock runs even with the recorder
@@ -301,11 +535,53 @@ class CoalescingQueue:
             group = self._pending.get(key)
             if not group:
                 return
-            oldest = group[0][1]._enqueued
+            oldest = group[0].handle._enqueued
             if oldest is None or (time.perf_counter() - oldest
                                   < self.max_wait_s * 0.999):
                 return
         self.flush(key, reason="deadline")
+
+    def _fail_expired(self, req: _Req, now: float) -> None:
+        """Cancel one expired request: DeadlineExceeded (with the
+        queue-wait breakdown) onto its handle, a retroactive
+        ``serve_expire`` span, and the ``serving_expired`` counter."""
+        waited = (now - req.handle._enqueued
+                  if req.handle._enqueued is not None else 0.0)
+        if _metrics._enabled:
+            _metrics.inc("serving_expired", kind=self.kind)
+        if (tracing_enabled() and req.handle._req_id is not None
+                and req.handle._enqueued is not None):
+            record_span(f"serve_expire[{req.handle._req_id}]",
+                        req.handle._enqueued, now)
+        req.handle._fail(DeadlineExceeded(
+            waited_s=waited, deadline_s=req.deadline_s or 0.0,
+            stage="queued"))
+
+    def _expire(self, key: tuple) -> None:
+        """Deadline timer callback: cancel every expired request of
+        ``key``'s group; survivors stay queued (their own timers run)."""
+        now = time.perf_counter()
+        with self._lock:
+            group = self._pending.get(key)
+            if not group:
+                return
+            live = [r for r in group
+                    if r.expires is None or r.expires > now]
+            if len(live) == len(group):
+                return
+            expired = [r for r in group if r not in live]
+            if live:
+                self._pending[key] = live
+            else:
+                self._pending.pop(key, None)
+            for r in expired:
+                self._fail_expired(r, now)
+            if _metrics._enabled:
+                _metrics.set_gauge(
+                    "serving_queue_depth",
+                    float(sum(len(g) for g in self._pending.values())),
+                    kind=self.kind)
+            self._space.notify_all()
 
     def _coerce(self, x, direction: int):
         """Validate/convert one request array against the plan family's
@@ -354,7 +630,11 @@ class CoalescingQueue:
         triggered the flush: ``full`` (a group reached max_batch),
         ``manual`` (this call), ``result`` (a caller's await outran
         the coalescer), or ``deadline`` (the oldest request aged past
-        ``max_wait_s``)."""
+        ``max_wait_s``). With the retry machinery armed
+        (``retry_max=``/``DFFT_RETRY_MAX``), flush errors are recovered
+        per docs/ROBUSTNESS.md and surface only through the failed
+        requests' handles; without it a failed group fails every handle
+        and re-raises (the legacy contract)."""
         done = 0
         recording = tracing_enabled() or _metrics._enabled
         flushed_at = time.perf_counter() if recording else 0.0
@@ -362,6 +642,8 @@ class CoalescingQueue:
             keys = [key] if key is not None else list(self._pending)
             groups = [(k, self._pending.pop(k)) for k in keys
                       if self._pending.get(k)]
+            if groups:
+                self._space.notify_all()  # admission waiters: depth fell
             for k, group in groups:
                 done += self._execute_group(k, group, reason=reason,
                                             flushed_at=flushed_at)
@@ -375,6 +657,16 @@ class CoalescingQueue:
     def _execute_group(self, key: tuple, group: list, *,
                        reason: str = "manual",
                        flushed_at: float = 0.0) -> int:
+        now = time.perf_counter()
+        live = []
+        for r in group:
+            if r.expires is not None and r.expires <= now:
+                self._fail_expired(r, now)
+            else:
+                live.append(r)
+        group = live
+        if not group:
+            return 0
         b = len(group)
         tracing = tracing_enabled()
         tag = f"{self.kind}:b{b}:{reason}"
@@ -382,54 +674,31 @@ class CoalescingQueue:
             # Close every request's queue-wait interval: enqueue ->
             # flush. Retroactive (record_span) because only now is the
             # wait's end — and the batch it coalesced into — known.
-            for _, handle, _ in group:
-                if handle._enqueued is None:
+            for r in group:
+                if r.handle._enqueued is None:
                     continue
-                if tracing and handle._req_id is not None:
-                    record_span(f"serve_wait[{handle._req_id}]",
-                                handle._enqueued, flushed_at)
+                if tracing and r.handle._req_id is not None:
+                    record_span(f"serve_wait[{r.handle._req_id}]",
+                                r.handle._enqueued, flushed_at)
                 if _metrics._enabled:
                     _metrics.observe(
                         "serving_wait_seconds",
-                        max(0.0, flushed_at - handle._enqueued),
+                        max(0.0, flushed_at - r.handle._enqueued),
                         kind=self.kind)
-        try:
+        if self._retry_max is None:
+            # Legacy dispatch: one try, a failure fails every co-batched
+            # handle and re-raises (byte-identical to the pre-robustness
+            # tier — no classification, no recovery).
+            try:
+                with _span(f"serve_flush[{tag}]", tracing):
+                    self._run_group(key, group, tag, tracing)
+            except Exception as e:  # noqa: BLE001 — fail the handles
+                for r in group:
+                    r.handle._fail(e)
+                raise
+        else:
             with _span(f"serve_flush[{tag}]", tracing):
-                if b == 1:
-                    x, handle, scale = group[0]
-                    from .api import execute
-
-                    with _span(f"serve_plan[{tag}]", tracing):
-                        plan = self._plan(key, None, False)
-                    with _span(f"serve_execute[{tag}]", tracing):
-                        handle._set(execute(plan, x, scale=scale))
-                else:
-                    with _span(f"serve_plan[{tag}]", tracing):
-                        plan = self._plan(key, b, self.donate)
-                    stacked = jnp.stack([x for x, _, _ in group])
-                    from .api import _spec_divides
-
-                    if plan.in_sharding is not None and _spec_divides(
-                            plan.in_sharding.mesh, plan.in_sharding.spec,
-                            stacked.shape):
-                        # Pre-place the stack on the plan's input layout;
-                        # uneven worlds let the chain's own pad/crop
-                        # shard it (the alloc_local rule).
-                        stacked = jax.device_put(stacked, plan.in_sharding)
-                    with _span(f"serve_execute[{tag}]", tracing):
-                        y = plan(stacked)
-                        for i, (_, handle, scale) in enumerate(group):
-                            out = y[i]
-                            if scale != Scale.NONE:
-                                from .ops.executors import apply_scale
-
-                                out = apply_scale(out, scale,
-                                                  plan.world_size)
-                            handle._set(out)
-        except Exception as e:  # noqa: BLE001 — fail the group's handles
-            for _, handle, _ in group:
-                handle._fail(e)
-            raise
+                self._dispatch_ft(key, group, tag, tracing)
         if _metrics._enabled:
             _metrics.inc("serving_flushes", kind=self.kind)
             _metrics.inc("serving_flush_reasons", kind=self.kind,
@@ -437,6 +706,180 @@ class CoalescingQueue:
             _metrics.inc("serving_transforms", float(b), kind=self.kind)
             _metrics.observe("serving_batch_size", float(b), kind=self.kind)
         return b
+
+    def _run_group(self, key: tuple, group: list, tag: str, tracing: bool,
+                   *, executor: str | None = None):
+        """One execution attempt of ``group`` (singleton direct, >1
+        batched through a ``batch=B`` plan). Resolves every handle on
+        success and returns the plan used; on failure raises with NO
+        handle touched — the dispatcher owns the failure policy.
+        ``executor`` overrides the queue's executor (the degraded-mode
+        rebuild)."""
+        from .api import execute
+
+        if len(group) == 1:
+            r = group[0]
+            with _span(f"serve_plan[{tag}]", tracing):
+                plan = self._plan(key, None, False, executor=executor)
+            with _span(f"serve_execute[{tag}]", tracing):
+                out = execute(plan, r.x, scale=r.scale)
+                if executor is not None:
+                    r.handle.degraded = True
+                r.handle._set(out)
+            return plan
+        with _span(f"serve_plan[{tag}]", tracing):
+            plan = self._plan(key, len(group), self.donate,
+                              executor=executor)
+        stacked = jnp.stack([r.x for r in group])
+        from .api import _spec_divides
+
+        if plan.in_sharding is not None and _spec_divides(
+                plan.in_sharding.mesh, plan.in_sharding.spec,
+                stacked.shape):
+            # Pre-place the stack on the plan's input layout; uneven
+            # worlds let the chain's own pad/crop shard it (the
+            # alloc_local rule).
+            stacked = jax.device_put(stacked, plan.in_sharding)
+        with _span(f"serve_execute[{tag}]", tracing):
+            y = plan(stacked)
+            for i, r in enumerate(group):
+                out = y[i]
+                if r.scale != Scale.NONE:
+                    from .ops.executors import apply_scale
+
+                    out = apply_scale(out, r.scale, plan.world_size)
+                if executor is not None:
+                    r.handle.degraded = True
+                r.handle._set(out)
+        return plan
+
+    # ------------------------------------------------- fault tolerance
+
+    def _dispatch_ft(self, key: tuple, group: list, tag: str,
+                     tracing: bool) -> None:
+        """The fault-tolerant dispatch chain (docs/ROBUSTNESS.md):
+
+        1. the group, with transient retries (:meth:`_attempt`);
+        2. the whole group rebuilt on the degraded executor;
+        3. batched groups only: per-request bisection — each request
+           re-runs unbatched (retries + its own degraded fallback), so
+           one poisoned request fails alone while its cohort completes.
+
+        Failures surface ONLY through the failed requests' handles;
+        this method never raises — a caller awaiting an unrelated
+        handle must not catch another tenant's error."""
+        try:
+            self._attempt(key, group, tag, tracing)
+            return
+        except Exception as err:  # noqa: BLE001 — classified upstream
+            last = err
+        if self._try_degraded(key, group, tag, tracing):
+            return
+        if len(group) > 1:
+            for i, r in enumerate(group):
+                sub = [r]
+                subtag = f"{tag}:iso{i}"
+                try:
+                    self._attempt(key, sub, subtag, tracing)
+                    continue
+                except Exception as e:  # noqa: BLE001
+                    iso_err = e
+                if self._try_degraded(key, sub, subtag, tracing):
+                    continue
+                if _metrics._enabled:
+                    _metrics.inc("serving_isolated_failures",
+                                 kind=self.kind)
+                r.handle._fail(iso_err)
+            return
+        group[0].handle._fail(last)
+
+    def _attempt(self, key: tuple, group: list, tag: str, tracing: bool,
+                 *, executor: str | None = None):
+        """One logical execution with the bounded transient-retry loop:
+        a failure classified transient (:func:`..faults.classify`)
+        retries up to ``retry_max`` times under exponential backoff
+        (``serve_retry[<tag>:a<N>]`` spans, ``serving_retries``
+        counter); deterministic failures raise immediately."""
+        delay = self._retry_backoff
+        attempt = 0
+        while True:
+            try:
+                if attempt == 0:
+                    return self._run_group(key, group, tag, tracing,
+                                           executor=executor)
+                with _span(f"serve_retry[{tag}:a{attempt}]", tracing):
+                    return self._run_group(key, group, tag, tracing,
+                                           executor=executor)
+            except Exception as e:  # noqa: BLE001 — classified below
+                if (attempt >= self._retry_max
+                        or _faults.classify(e) != "transient"):
+                    raise
+            attempt += 1
+            if _metrics._enabled:
+                _metrics.inc("serving_retries", kind=self.kind)
+            if delay > 0:
+                time.sleep(delay)
+            delay *= 2
+
+    def _try_degraded(self, key: tuple, group: list, tag: str,
+                      tracing: bool) -> bool:
+        """Degraded-mode executor fallback: rebuild the group's plan on
+        ``fallback_executor`` (matmul-DFT by default — it never touches
+        the XLA fft thunk) and execute. Resolved handles are stamped
+        ``degraded``; the fallback is recorded under its own wisdom
+        annotation so replay is intentional, never sticky. Returns True
+        on success; False (never raises) when disabled, pointless (the
+        queue already runs the fallback executor), or itself failing."""
+        fb = self._fallback_executor
+        if not fb or self.plan_kw.get("executor") == fb:
+            return False
+        try:
+            with _span(f"serve_degraded[{tag}:{fb}]", tracing):
+                plan = self._run_group(key, group, tag, tracing,
+                                       executor=fb)
+        except Exception:  # noqa: BLE001 — the chain's last resort failed
+            return False
+        if _metrics._enabled:
+            _metrics.inc("serving_degraded", float(len(group)),
+                         kind=self.kind, executor=fb)
+        self._annotate_degraded(key, plan, len(group))
+        return True
+
+    def _annotate_degraded(self, key: tuple, plan, b: int) -> None:
+        """Append the executor fallback to the wisdom store under a
+        ``{"annotation": "degraded"}``-marked key: the event is durable
+        and inspectable (``report wisdom``), but a normal wisdom lookup
+        or :func:`warm_pool` never matches the annotated key — replay
+        of the degraded winner stays intentional, not sticky.
+        Best-effort telemetry, never fatal."""
+        try:
+            import math
+
+            from . import tuner
+
+            shape, dtype, direction = key
+            if isinstance(self.mesh, int):
+                ndev = self.mesh
+            elif self.mesh is None:
+                ndev = 1
+            else:
+                ndev = int(math.prod(self.mesh.devices.shape))
+            wkey = tuner.wisdom_key(
+                kind=self.kind, shape=shape,
+                dtype=dtype if dtype is not None else plan.dtype,
+                direction=direction, ndev=ndev,
+                batch=None if b == 1 else b)
+            wkey["annotation"] = "degraded"
+            tuner.record_wisdom(
+                wkey,
+                tuner.Candidate(
+                    decomposition=plan.decomposition,
+                    algorithm=plan.options.algorithm,
+                    executor=plan.executor,
+                    overlap_chunks=int(plan.options.overlap_chunks or 1)),
+                0.0)
+        except Exception:  # noqa: BLE001 — annotation is telemetry
+            pass
 
     # -------------------------------------------------------------- warm
 
@@ -463,12 +906,20 @@ def warm_pool(mesh=None, top_n: int = 4, *, path: str | None = None,
     so the hottest entries ARE the shapes a fresh serving process will
     see first. This reads the store (``DFFT_WISDOM`` / the compile-cache
     default), keeps entries matching the current platform/x64/device
-    count (``mesh``: a Mesh, int device count, or None = single device),
-    orders newest-first, and builds each of the top ``top_n`` through
-    ``tune="wisdom"`` — replaying the stored winner with zero timing
-    executions into the memoized plan cache. ``max_batch`` additionally
-    preplans each tuple at that batch size, warming the coalescer's
-    full-group program too. Returns the built plans."""
+    count (``mesh``: a Mesh, int device count, or None = single device;
+    annotated entries — the degraded-fallback records — are never
+    replayed), orders newest-first, and builds each of the top ``top_n``
+    through ``tune="wisdom"`` — replaying the stored winner with zero
+    timing executions into the memoized plan cache. ``max_batch``
+    additionally preplans each tuple at that batch size, warming the
+    coalescer's full-group program too. Returns the built plans.
+
+    Stale tuples (a stored winner the current build can no longer plan)
+    are skipped, never fatal — but no longer silently: skips are
+    counted into the ``serving_warm_pool_skipped`` metric and one
+    stderr summary line; ``KeyboardInterrupt``/``SystemExit`` always
+    propagate (a Ctrl-C during warm-up must stop the process, not the
+    pool loop)."""
     import math
 
     from . import api, tuner
@@ -490,12 +941,15 @@ def warm_pool(mesh=None, top_n: int = 4, *, path: str | None = None,
                 and k.get("ndev") == ndev
                 and k.get("platform") == platform
                 and k.get("x64") == x64
-                and k.get("layouts") is None)
+                and k.get("layouts") is None
+                and not k.get("annotation"))  # degraded records: never
+        #                                       preplanned (not sticky)
 
     ranked = sorted((e for e in entries.values() if eligible(e)),
                     key=lambda e: str(e.get("recorded_at", "")),
                     reverse=True)[:max(0, int(top_n))]
     plans = []
+    skipped = 0
     on = tracing_enabled()
     for entry in ranked:
         k = entry["key"]
@@ -516,8 +970,16 @@ def warm_pool(mesh=None, top_n: int = 4, *, path: str | None = None,
                     plans.append(plan_fn(
                         tuple(k["shape"]), mesh, direction=k["direction"],
                         dtype=jnp.dtype(k["dtype"]), tune="wisdom", batch=b))
+            except (KeyboardInterrupt, SystemExit):
+                raise  # never eaten: interrupts must stop the process
             except Exception:  # noqa: BLE001 — a stale tuple never
-                continue       # blocks the rest of the pool
+                skipped += 1   # blocks the rest of the pool
+                continue
+    if skipped:
+        print(f"serving: warm_pool skipped {skipped} stale wisdom "
+              f"tuple(s) of {len(ranked)} eligible", file=sys.stderr)
+        if _metrics._enabled:
+            _metrics.inc("serving_warm_pool_skipped", float(skipped))
     if _metrics._enabled:
         _metrics.set_gauge("serving_warm_pool_plans", float(len(plans)))
     return plans

@@ -673,10 +673,12 @@ def record_wisdom(
         entry["times"] = {
             nm: (None if not math.isfinite(t) else float(t))
             for nm, t in times.items()}
-    d = os.path.dirname(os.path.abspath(path))
-    os.makedirs(d, exist_ok=True)
-    with open(path, "a") as f:
-        f.write(json.dumps(entry, sort_keys=True) + "\n")
+    # One O_APPEND write per entry: concurrent tournaments (multi-host
+    # jobs, parallel benchmark workers) append line-atomically — no
+    # torn/interleaved lines for load_wisdom's lenient parser to drop.
+    from .utils.atomicio import append_line
+
+    append_line(path, json.dumps(entry, sort_keys=True))
     return entry
 
 

@@ -1,0 +1,74 @@
+"""Concurrent-writer-safe file primitives for the append-only stores.
+
+Three stores accumulate machine-local state across processes: the
+tuner's wisdom JSONL, the regress history JSONL (both append-only), and
+the calibrated hardware profile JSON (whole-document replace). Multiple
+benchmark workers, serving processes, and tuning tournaments write them
+concurrently, and the old ``open(path, "a"); f.write(...)`` pattern
+gives no interleaving guarantee: Python's buffered layer may split one
+logical line into several OS ``write()`` calls, and two processes'
+fragments can interleave into a torn line that the lenient loaders then
+silently drop.
+
+This module is the one shared discipline:
+
+- :func:`append_line` / :func:`append_lines` — ``O_APPEND`` +
+  exactly ONE ``os.write`` per call. POSIX guarantees the file offset
+  update and the write are atomic with ``O_APPEND``, so concurrent
+  appenders' payloads land whole, in some order, never interleaved
+  (line-atomic). Windows ``O_APPEND`` emulation gives the same
+  practical guarantee for the file sizes at play.
+- :func:`replace_file` — write-to-temp + ``os.replace``, so a
+  concurrent reader sees either the old or the new document, never a
+  half-written one (the hwprofile discipline, factored here).
+
+Stdlib-only (no jax): ``regress.py`` loads from its file path directly
+and must stay importable with a sick TPU transport.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["append_line", "append_lines", "replace_file"]
+
+
+def _ensure_parent(path: str) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+
+
+def append_lines(path: str, lines: list[str]) -> None:
+    """Append ``lines`` (newlines added where missing) to ``path`` as one
+    ``O_APPEND`` ``os.write`` — concurrent appenders from other
+    processes can never tear or interleave within the payload. Creates
+    the file (and parent directory) on first use."""
+    if not lines:
+        return
+    _ensure_parent(path)
+    payload = "".join(
+        ln if ln.endswith("\n") else ln + "\n" for ln in lines
+    ).encode("utf-8")
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        # One write() call: with O_APPEND the offset update + write are
+        # atomic on POSIX, so the whole payload lands contiguously.
+        os.write(fd, payload)
+    finally:
+        os.close(fd)
+
+
+def append_line(path: str, line: str) -> None:
+    """Append one line to ``path`` atomically (see :func:`append_lines`)."""
+    append_lines(path, [line])
+
+
+def replace_file(path: str, text: str) -> None:
+    """Replace ``path``'s contents atomically: write a same-directory
+    temp file, then ``os.replace`` — a concurrent reader sees the old or
+    the new document, never a torn one."""
+    _ensure_parent(path)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)

@@ -49,6 +49,19 @@ docs/OBSERVABILITY.md "Flight recorder"):
   enqueue-to-flush latency, the queue-wait of the request spans.
 - ``serving_batch_size`` (histogram; kind) — transforms per flush.
 
+Fault-tolerance series (wired in :mod:`..serving` / :mod:`..faults`;
+see docs/ROBUSTNESS.md):
+
+- ``fault_injected`` (counter; point/kind) — injected faults fired.
+- ``serving_retries`` / ``serving_isolated_failures`` /
+  ``serving_degraded`` / ``serving_expired`` / ``serving_rejected``
+  (counter; kind, +executor on degraded) — the recovery chain's
+  accounting: transient retries, bisection-isolated failures,
+  fallback-executor resolutions, deadline cancellations, admission
+  rejections.
+- ``serving_warm_pool_skipped`` (counter) — stale wisdom tuples
+  skipped during pool warm-up.
+
 Disabled-path discipline: everything is gated on one module-level flag
 (the ``tracing_enabled()`` pattern of :mod:`.trace`) — with metrics off
 (the default) every hook is a single attribute check and early return,

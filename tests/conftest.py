@@ -54,6 +54,32 @@ def pytest_addoption(parser):
              "deep parameterizations)")
 
 
+@pytest.fixture
+def chaos():
+    """Deterministic fault injection scoped to one test: yields ``arm``,
+    a callable that sets ``DFFT_FAULT_INJECT`` to a spec (see
+    docs/ROBUSTNESS.md for the grammar) with fresh counters/seeds.
+    Teardown restores the prior env value and resets every armed fault —
+    even when the test fails — so chaos can never leak into the next
+    test (the tier-1 suite depends on the default path staying clean)."""
+    from distributedfft_tpu import faults
+
+    old = os.environ.get("DFFT_FAULT_INJECT")
+
+    def arm(spec: str) -> None:
+        os.environ["DFFT_FAULT_INJECT"] = spec
+        faults.reset()  # fresh counters: each arm starts sequence #1
+
+    try:
+        yield arm
+    finally:
+        if old is None:
+            os.environ.pop("DFFT_FAULT_INJECT", None)
+        else:
+            os.environ["DFFT_FAULT_INJECT"] = old
+        faults.reset()
+
+
 def pytest_collection_modifyitems(config, items):
     if config.getoption("--runslow"):
         return

@@ -1,0 +1,388 @@
+"""Fault-tolerance tests: injection matrix, retry, isolation, degraded.
+
+Named ``test_a2i_*`` to collect BEFORE ``test_alltoallv.py`` (the
+XLA:CPU fft-thunk poisoning rule of PRs 3-5 — the collection-order
+guard in ``test_explain.py`` pins the name): the exchange-point tests
+below run 8-device plans and need a clean backend.
+
+The acceptance matrix (ISSUE 11): an injected fault at each injection
+point (plan, compile, execute, exchange) x {transient, deterministic}
+is respectively retried-to-success or degraded onto the matmul-DFT
+fallback, with zero wrong numerical results ever returned to a Handle —
+and a batched flush with exactly one poisoned request fails only that
+request's handle while its cohort completes bit-correct.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu import faults
+from distributedfft_tpu.utils import metrics as m
+
+SHAPE = (8, 8, 8)
+CDT = jnp.complex128
+
+
+def _world(seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal(SHAPE) + 1j * rng.standard_normal(SHAPE)
+
+
+def _counter(snap, name: str, **labels) -> float:
+    rows = snap["counters"].get(name, {})
+    want = [f"{k}={v}" for k, v in labels.items()]
+    return sum(v for lbl, v in rows.items()
+               if all(w in lbl for w in want))
+
+
+@pytest.fixture
+def metrics_on():
+    m.enable_metrics()
+    m.metrics_reset()
+    try:
+        yield
+    finally:
+        m.metrics_reset()
+        m.enable_metrics(False)
+
+
+# ------------------------------------------------------------- spec grammar
+
+def test_fault_spec_grammar_parses_every_directive():
+    pts = faults.parse_spec(
+        "execute:every=3; plan:once; exchange:seed=7,p=0.25;"
+        "compile:at=1+3,kind=deterministic,times=2,match=xla")
+    assert [p.point for p in pts] == ["execute", "plan", "exchange",
+                                      "compile"]
+    assert pts[0].mode == "every" and pts[0].n == 3
+    assert pts[1].mode == "once" and pts[1].times == 1
+    assert pts[2].mode == "p" and pts[2].p == 0.25
+    assert pts[3].at == frozenset({1, 3})
+    assert pts[3].kind == "deterministic"
+    assert pts[3].match == "xla"
+
+
+def test_fault_spec_grammar_rejects_garbage():
+    with pytest.raises(ValueError, match="unknown fault point"):
+        faults.parse_spec("warp:once")
+    with pytest.raises(ValueError, match="lacks a ':'"):
+        faults.parse_spec("execute")
+    with pytest.raises(ValueError, match="exactly one of"):
+        faults.parse_spec("execute:once,every=2")
+    with pytest.raises(ValueError, match="exactly one of"):
+        faults.parse_spec("execute:kind=transient")  # no firing mode
+    with pytest.raises(ValueError, match="unknown directive"):
+        faults.parse_spec("execute:frobnicate=1")
+    with pytest.raises(ValueError, match="transient|deterministic"):
+        faults.parse_spec("execute:once,kind=sometimes")
+
+
+def test_fault_seeded_probability_is_reproducible():
+    a = faults.parse_spec("execute:seed=7,p=0.5")[0]
+    b = faults.parse_spec("execute:seed=7,p=0.5")[0]
+    fires_a = [a.should_fire("") for _ in range(64)]
+    fires_b = [b.should_fire("") for _ in range(64)]
+    assert fires_a == fires_b       # seeded: identical sequences
+    assert any(fires_a) and not all(fires_a)
+
+
+def test_programmatic_injected_scopes_and_clears():
+    with faults.injected("execute", every=1, kind="deterministic"):
+        with pytest.raises(dfft.InjectedFault) as ei:
+            faults.check("execute")
+        assert not ei.value.transient and ei.value.point == "execute"
+    faults.check("execute")  # disarmed on exit — no raise
+    faults.inject("plan", once=True)
+    faults.clear()
+    faults.check("plan")     # clear() disarmed it
+
+
+def test_classify_taxonomy():
+    assert faults.classify(dfft.InjectedFault("execute", "transient", 1)) \
+        == "transient"
+    assert faults.classify(
+        dfft.InjectedFault("plan", "deterministic", 1)) == "deterministic"
+    assert faults.classify(TimeoutError()) == "transient"
+    assert faults.classify(RuntimeError("RESOURCE_EXHAUSTED: oom")) \
+        == "transient"
+    assert faults.classify(ValueError("bad shape")) == "deterministic"
+
+
+# ------------------------------------------------- the fault-sweep matrix
+
+#: Single-device injection points; "exchange" (needs a mesh plan) is
+#: exercised by the mesh tests below.
+POINTS = ("plan", "compile", "execute")
+
+
+@pytest.mark.parametrize("point", POINTS)
+def test_transient_fault_is_retried_to_success(chaos, metrics_on, point):
+    """Matrix row {point} x transient: one bounded-backoff retry
+    recovers the flush; every handle resolves bit-correct against the
+    reference plan, nothing degrades."""
+    dfft.clear_plan_cache()
+    q = dfft.CoalescingQueue(None, dtype=CDT, max_batch=4, retry_max=2,
+                             retry_backoff_s=0.001)
+    xs = [_world(1), _world(2)]
+    hs = [q.submit(jnp.asarray(v)) for v in xs]  # probe plan: pre-chaos
+    chaos(f"{point}:once")
+    assert q.flush() == 2
+    ref = dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT)
+    for v, h in zip(xs, hs):
+        assert np.array_equal(np.asarray(h.result(timeout=60)),
+                              np.asarray(ref(jnp.asarray(v))))
+        assert not h.degraded
+    snap = dfft.metrics_snapshot()
+    assert _counter(snap, "fault_injected", point=point,
+                    kind="transient") == 1
+    assert _counter(snap, "serving_retries") == 1
+    assert _counter(snap, "serving_isolated_failures") == 0
+
+
+@pytest.mark.parametrize("point", POINTS)
+def test_deterministic_fault_degrades_to_matmul(chaos, metrics_on,
+                                                point, tmp_path,
+                                                monkeypatch):
+    """Matrix row {point} x deterministic: no retry (it would reproduce
+    the fault) — the whole group rebuilds on the matmul-DFT fallback,
+    bit-identical to a directly-built matmul plan, and the fallback is
+    recorded under a degraded-annotated wisdom key."""
+    monkeypatch.setenv("DFFT_WISDOM", str(tmp_path / "wisdom.jsonl"))
+    dfft.clear_plan_cache()
+    q = dfft.CoalescingQueue(None, dtype=CDT, max_batch=4, retry_max=1,
+                             retry_backoff_s=0.001)
+    xs = [_world(3), _world(4)]
+    hs = [q.submit(jnp.asarray(v)) for v in xs]
+    chaos(f"{point}:once,kind=deterministic")
+    assert q.flush() == 2
+    mm = dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT, executor="matmul",
+                              batch=2)
+    want = mm(jnp.stack([jnp.asarray(v, CDT) for v in xs]))
+    for i, h in enumerate(hs):
+        assert np.array_equal(np.asarray(h.result(timeout=60)),
+                              np.asarray(want[i]))
+        assert h.degraded
+    snap = dfft.metrics_snapshot()
+    assert _counter(snap, "fault_injected", point=point,
+                    kind="deterministic") == 1
+    assert _counter(snap, "serving_retries") == 0  # deterministic: none
+    assert _counter(snap, "serving_degraded", executor="matmul") == 2
+    # The wisdom annotation: durable, inspectable, and never replayed.
+    entries = [json.loads(ln)
+               for ln in open(tmp_path / "wisdom.jsonl")]
+    assert entries and all(
+        e["key"]["annotation"] == "degraded"
+        and e["winner"]["executor"] == "matmul" for e in entries)
+    assert dfft.warm_pool(None, top_n=8,
+                          path=str(tmp_path / "wisdom.jsonl")) == []
+
+
+@pytest.mark.parametrize("kind", ["transient", "deterministic"])
+def test_exchange_fault_matrix_on_mesh(chaos, metrics_on, kind,
+                                       tmp_path, monkeypatch):
+    """Matrix rows exchange x {transient, deterministic} on a real
+    8-device mesh plan: transient retries to success on the same chain;
+    deterministic degrades onto the distributed matmul chain. Either
+    way the handle's numbers are bit-correct for the chain that
+    produced them."""
+    # The degraded branch annotates the wisdom store: point it at a tmp
+    # file so tests never write the machine-global store.
+    monkeypatch.setenv("DFFT_WISDOM", str(tmp_path / "w.jsonl"))
+    mesh = dfft.make_mesh(8)
+    dfft.clear_plan_cache()
+    q = dfft.CoalescingQueue(mesh, dtype=CDT, max_batch=4, retry_max=2,
+                             retry_backoff_s=0.001)
+    x = _world(5)
+    h = q.submit(jnp.asarray(x))
+    chaos(f"exchange:once,kind={kind}")
+    assert q.flush() == 1
+    ex = "matmul" if kind == "deterministic" else "xla"
+    ref = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT, executor=ex)
+    assert np.array_equal(np.asarray(h.result(timeout=120)),
+                          np.asarray(ref(jnp.asarray(x, CDT))))
+    assert h.degraded == (kind == "deterministic")
+    snap = dfft.metrics_snapshot()
+    assert _counter(snap, "fault_injected", point="exchange",
+                    kind=kind) == 1
+
+
+def test_every_n_fault_fires_on_schedule(chaos):
+    """``every=3`` fires on checks 3, 6, ... — the count-based
+    reproducibility contract of the spec grammar."""
+    chaos("execute:every=3,kind=deterministic")
+    fired = []
+    for i in range(1, 7):
+        try:
+            faults.check("execute")
+            fired.append(False)
+        except dfft.InjectedFault:
+            fired.append(True)
+    assert fired == [False, False, True, False, False, True]
+
+
+# --------------------------------------------------- batch isolation
+
+def test_batched_flush_isolates_single_poisoned_request(chaos,
+                                                        metrics_on):
+    """THE isolation acceptance: a batched flush with exactly one
+    poisoned request fails only that request's handle; both co-batched
+    handles complete with bit-correct output. Fault schedule: execute
+    check #1 is the batched attempt, #2..#4 the bisected singletons —
+    ``at=1+3`` poisons the batch and the middle request only.
+    Fallback disabled so the bisection path itself is under test."""
+    dfft.clear_plan_cache()
+    q = dfft.CoalescingQueue(None, dtype=CDT, max_batch=3, retry_max=0,
+                             fallback_executor="0")
+    xs = [_world(s) for s in (6, 7, 8)]
+    hs = []
+    for i, v in enumerate(xs):
+        if i == len(xs) - 1:
+            chaos("execute:at=1+3,kind=deterministic")
+        hs.append(q.submit(jnp.asarray(v)))  # 3rd submit auto-flushes
+    assert q.pending() == 0
+    ref = dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT)
+    with pytest.raises(dfft.InjectedFault):
+        hs[1].result(timeout=60)
+    for i in (0, 2):
+        assert np.array_equal(np.asarray(hs[i].result(timeout=60)),
+                              np.asarray(ref(jnp.asarray(xs[i]))))
+        assert not hs[i].degraded
+    snap = dfft.metrics_snapshot()
+    assert _counter(snap, "serving_isolated_failures") == 1
+    # The flush itself never raised: the cohort's verdicts are all that
+    # escaped (delivered per-handle).
+
+
+def test_bisected_request_recovers_via_degraded_fallback(chaos,
+                                                         metrics_on,
+                                                         tmp_path,
+                                                         monkeypatch):
+    """The full recovery chain in one flush: batched attempt fails,
+    the whole-group degraded rebuild fails too, bisection finds one
+    healthy request (resolved on the configured executor) and one
+    poisoned request whose own degraded fallback finally lands it —
+    degraded — instead of failing. Fault schedule over execute checks:
+    #1 batched xla, #2 batched matmul rebuild, #3 iso0 xla (passes),
+    #4 iso1 xla; iso1's matmul rebuild (#5) passes."""
+    monkeypatch.setenv("DFFT_WISDOM", str(tmp_path / "w.jsonl"))
+    dfft.clear_plan_cache()
+    q = dfft.CoalescingQueue(None, dtype=CDT, max_batch=2, retry_max=0)
+    xs = [_world(9), _world(10)]
+    hs = [q.submit(jnp.asarray(xs[0]))]
+    chaos("execute:at=1+2+4,kind=deterministic")
+    hs.append(q.submit(jnp.asarray(xs[1])))  # auto-flush at max_batch
+    ref = dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT)
+    mm = dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT, executor="matmul")
+    got0 = np.asarray(hs[0].result(timeout=60))
+    got1 = np.asarray(hs[1].result(timeout=60))
+    assert not hs[0].degraded and hs[1].degraded
+    assert np.array_equal(got0, np.asarray(ref(jnp.asarray(xs[0], CDT))))
+    assert np.array_equal(got1, np.asarray(mm(jnp.asarray(xs[1], CDT))))
+    snap = dfft.metrics_snapshot()
+    assert _counter(snap, "serving_degraded", executor="matmul") == 1
+    assert _counter(snap, "serving_isolated_failures") == 0
+
+
+# ------------------------------------------------- degraded-mode parity
+
+def test_degraded_parity_bit_identical_to_direct_matmul(chaos,
+                                                        tmp_path,
+                                                        monkeypatch):
+    """Degraded-mode parity (satellite): a request forced onto the
+    matmul fallback produces output BIT-IDENTICAL to a directly-built
+    matmul plan — the fallback plumbing adds no numerical difference —
+    and agrees with the healthy reference executor to roundtrip
+    tolerance."""
+    monkeypatch.setenv("DFFT_WISDOM", str(tmp_path / "w.jsonl"))
+    dfft.clear_plan_cache()
+    q = dfft.CoalescingQueue(None, dtype=CDT, max_batch=8, retry_max=0)
+    x = _world(11)
+    h = q.submit(jnp.asarray(x))
+    chaos("execute:every=1,kind=deterministic,match=xla")
+    q.flush()
+    got = np.asarray(h.result(timeout=60))
+    assert h.degraded
+    mm = dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT, executor="matmul")
+    assert np.array_equal(got, np.asarray(mm(jnp.asarray(x, CDT))))
+    ref = np.fft.fftn(x)
+    assert np.allclose(got, ref, rtol=1e-9, atol=1e-9)
+
+
+def test_regress_never_groups_degraded_with_healthy_records():
+    """Degraded run records form their own baseline group (satellite):
+    the compare engine must never judge a matmul-fallback run against
+    the fast baselines, or vice versa."""
+    from distributedfft_tpu import regress
+
+    line = {"metric": "fft3d_c2c_256_forward_gflops", "value": 100.0,
+            "dtype": "complex64", "devices": 8, "backend": "cpu"}
+    healthy = regress.normalize_bench_line(dict(line), source="t")
+    degraded = regress.normalize_bench_line(dict(line, degraded=True),
+                                            source="t")
+    assert degraded["config"]["degraded"] is True
+    assert "degraded" not in healthy["config"]  # old schema preserved
+    assert regress.group_key(healthy) != regress.group_key(degraded)
+    # A degraded record compared against a healthy-only history:
+    # no-baseline, never a verdict against the fast group.
+    hist = [dict(healthy, value=v) for v in (100.0, 101.0, 99.0)]
+    res = regress.compare_record(degraded, hist)
+    assert res["verdict"] == "no-baseline"
+
+
+def test_bench_emit_stamps_degraded_into_result_lines(capsys):
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    out = bench._emit(16, 1e-4, 1e-7, "matmul", 1, "single",
+                      {"matmul": 1e-4}, degraded=True)
+    capsys.readouterr()
+    assert out["degraded"] is True
+    healthy = bench._emit(16, 1e-4, 1e-7, "xla", 1, "single",
+                          {"xla": 1e-4})
+    capsys.readouterr()
+    assert "degraded" not in healthy  # default rows keep the old schema
+
+
+# ------------------------------------------------------ default purity
+
+def test_no_knobs_means_no_fault_tolerance_state(monkeypatch):
+    """Defaults-unchanged acceptance: without DFFT_FAULT_*/DFFT_RETRY_*
+    and no deadline_s, the queue runs the legacy dispatch (retry
+    machinery off) and a flush failure fails every co-batched handle
+    AND re-raises — byte-identical to the pre-robustness tier."""
+    for var in ("DFFT_FAULT_INJECT", "DFFT_RETRY_MAX",
+                "DFFT_RETRY_BACKOFF_S", "DFFT_FALLBACK_EXECUTOR"):
+        monkeypatch.delenv(var, raising=False)
+    q = dfft.CoalescingQueue(None, dtype=CDT, max_batch=8)
+    assert q._retry_max is None
+    hs = [q.submit(jnp.asarray(_world(s))) for s in (12, 13)]
+    with faults.injected("execute", every=1, kind="transient"):
+        with pytest.raises(dfft.InjectedFault):
+            q.flush()  # legacy contract: the flush itself raises
+    for h in hs:
+        with pytest.raises(dfft.InjectedFault):
+            h.result(timeout=10)
+
+
+def test_retry_knobs_resolve_from_env(monkeypatch):
+    monkeypatch.setenv("DFFT_RETRY_MAX", "3")
+    monkeypatch.setenv("DFFT_RETRY_BACKOFF_S", "0.25")
+    monkeypatch.setenv("DFFT_FALLBACK_EXECUTOR", "none")
+    q = dfft.CoalescingQueue(None, dtype=CDT)
+    assert q._retry_max == 3
+    assert q._retry_backoff == 0.25
+    assert q._fallback_executor == ""
+    monkeypatch.setenv("DFFT_RETRY_MAX", "nope")
+    with pytest.raises(ValueError, match="DFFT_RETRY_MAX"):
+        dfft.CoalescingQueue(None, dtype=CDT)
+    with pytest.raises(ValueError, match="retry_max"):
+        dfft.CoalescingQueue(None, dtype=CDT, retry_max=-1)

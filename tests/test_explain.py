@@ -517,7 +517,7 @@ def test_poison_ordering_guard():
     for early in ("test_a2a_overlap.py", "test_a2c_tuner.py",
                   "test_a2d_explain.py", "test_a2e_batch.py",
                   "test_a2f_flightrec.py", "test_a2g_wire.py",
-                  "test_a2h_operators.py"):
+                  "test_a2h_operators.py", "test_a2i_faults.py"):
         assert early in names, early
         assert names.index(early) < poison, (
             f"{early} must collect before test_alltoallv.py")

@@ -216,6 +216,137 @@ def test_deadline_validation_and_default_off():
     h.result(timeout=10)
 
 
+# ---------------------------------------------- deadlines & backpressure
+
+def test_request_deadline_cancels_with_wait_breakdown():
+    """submit(deadline_s=): a request that never executes within its
+    budget fails with DeadlineExceeded carrying the queue-wait
+    breakdown; its group's survivors stay queued and executable."""
+    from distributedfft_tpu.utils import metrics as m
+
+    dfft.enable_metrics()
+    m.metrics_reset()
+    try:
+        q = dfft.CoalescingQueue(None, dtype=CDT, max_batch=8)
+        doomed = q.submit(jnp.asarray(_world(61)), deadline_s=0.05)
+        safe = q.submit(jnp.asarray(_world(62)))
+        assert _wait_until(lambda: doomed.done())
+        with pytest.raises(dfft.DeadlineExceeded) as ei:
+            doomed.result(timeout=10)
+        assert ei.value.stage == "queued"
+        assert ei.value.deadline_s == pytest.approx(0.05)
+        assert ei.value.waited_s >= 0.05
+        assert q.pending() == 1  # the survivor is still queued
+        q.flush()
+        ref = dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT)
+        assert np.array_equal(np.asarray(safe.result(timeout=10)),
+                              np.asarray(ref(jnp.asarray(_world(62)))))
+        rows = dfft.metrics_snapshot()["counters"].get(
+            "serving_expired", {})
+        assert sum(rows.values()) == 1
+    finally:
+        m.metrics_reset()
+        dfft.enable_metrics(False)
+
+
+def test_deadline_met_in_time_resolves_normally():
+    q = dfft.CoalescingQueue(None, dtype=CDT, max_batch=8)
+    h = q.submit(jnp.asarray(_world(63)), deadline_s=30.0)
+    q.flush()
+    ref = dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT)
+    assert np.array_equal(np.asarray(h.result(timeout=10)),
+                          np.asarray(ref(jnp.asarray(_world(63)))))
+
+
+def test_deadline_validation():
+    q = dfft.CoalescingQueue(None, dtype=CDT)
+    with pytest.raises(ValueError, match="deadline_s"):
+        q.submit(jnp.asarray(_world(64)), deadline_s=0.0)
+    with pytest.raises(ValueError, match="deadline_s"):
+        q.submit(jnp.asarray(_world(64)), deadline_s=True)
+
+
+def test_backpressure_raise_policy_sheds_load():
+    from distributedfft_tpu.utils import metrics as m
+
+    dfft.enable_metrics()
+    m.metrics_reset()
+    try:
+        q = dfft.CoalescingQueue(None, dtype=CDT, max_batch=8,
+                                 max_pending=1, admission="raise")
+        h = q.submit(jnp.asarray(_world(65)))
+        with pytest.raises(dfft.QueueFull):
+            q.submit(jnp.asarray(_world(66)))
+        rows = dfft.metrics_snapshot()["counters"].get(
+            "serving_rejected", {})
+        assert sum(rows.values()) == 1
+        q.flush()
+        h.result(timeout=10)
+        # Depth fell: admission is open again.
+        h2 = q.submit(jnp.asarray(_world(66)))
+        q.flush()
+        h2.result(timeout=10)
+    finally:
+        m.metrics_reset()
+        dfft.enable_metrics(False)
+
+
+def test_backpressure_block_policy_waits_for_space():
+    import threading
+
+    q = dfft.CoalescingQueue(None, dtype=CDT, max_batch=8, max_pending=1)
+    h1 = q.submit(jnp.asarray(_world(67)))
+    out = {}
+
+    def second_submit():
+        out["handle"] = q.submit(jnp.asarray(_world(68)))
+
+    t = threading.Thread(target=second_submit, daemon=True)
+    t.start()
+    t.join(0.2)
+    assert t.is_alive()  # parked: the queue is at max_pending
+    q.flush()            # frees depth -> admission wakes
+    t.join(10)
+    assert not t.is_alive()
+    h1.result(timeout=10)
+    q.flush()
+    out["handle"].result(timeout=10)
+
+
+def test_backpressure_block_honors_request_deadline():
+    q = dfft.CoalescingQueue(None, dtype=CDT, max_batch=8, max_pending=1)
+    q.submit(jnp.asarray(_world(69)))
+    with pytest.raises(dfft.DeadlineExceeded) as ei:
+        q.submit(jnp.asarray(_world(70)), deadline_s=0.05)
+    assert ei.value.stage == "admission"
+    q.flush()
+
+
+def test_queue_robustness_validation():
+    with pytest.raises(ValueError, match="max_pending"):
+        dfft.CoalescingQueue(None, max_pending=0)
+    with pytest.raises(ValueError, match="admission"):
+        dfft.CoalescingQueue(None, admission="dropnewest")
+    with pytest.raises(ValueError, match="retry_backoff_s"):
+        dfft.CoalescingQueue(None, retry_backoff_s=-1.0)
+
+
+def test_result_timeout_bounds_wait_not_flush():
+    """Satellite: the lazy flush runs BEFORE the timeout wait — a
+    singleton request in a never-filled group resolves within a tiny
+    timeout instead of burning it waiting for a flush nobody else
+    would trigger."""
+    q = dfft.CoalescingQueue(None, dtype=CDT, max_batch=8)
+    # Warm the plans so the in-timeout work is execution only.
+    q.warm([SHAPE])
+    h = q.submit(jnp.asarray(_world(71)))
+    assert q.pending() == 1  # never auto-flushed
+    ref = dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT)
+    assert np.array_equal(np.asarray(h.result(timeout=30)),
+                          np.asarray(ref(jnp.asarray(_world(71)))))
+    assert q.pending() == 0
+
+
 # --------------------------------------------------------- flight recorder
 
 def test_disabled_recorder_is_zero_overhead_and_byte_identical():
@@ -343,6 +474,34 @@ def test_warm_pool_preplans_top_n_from_wisdom(tmp_path):
 def test_warm_pool_empty_store_is_quiet(tmp_path):
     assert dfft.warm_pool(None, top_n=4,
                           path=str(tmp_path / "none.jsonl")) == []
+
+
+def test_warm_pool_counts_stale_skips(tmp_path, capsys):
+    """Satellite: a stale wisdom tuple is skipped with a count — the
+    serving_warm_pool_skipped metric plus one stderr summary line —
+    never silently eaten."""
+    from distributedfft_tpu.utils import metrics as m
+
+    path = tmp_path / "wisdom.jsonl"
+    stale = _wisdom_entry("2026-08-03T00:00:00")
+    # Poison the tuple so the replay build raises: a 2D "shape" fails
+    # the planner's 3D contract.
+    stale["key"]["shape"] = [8, 8]
+    with open(path, "w") as f:
+        f.write(json.dumps(_wisdom_entry("2026-08-01T00:00:00")) + "\n")
+        f.write(json.dumps(stale) + "\n")
+    m.enable_metrics()
+    m.metrics_reset()
+    try:
+        plans = dfft.warm_pool(None, top_n=4, path=str(path))
+        assert [p.shape for p in plans] == [SHAPE]  # the good one built
+        snap = dfft.metrics_snapshot()
+        assert snap["counters"]["serving_warm_pool_skipped"][""] == 1.0
+        assert snap["gauges"]["serving_warm_pool_plans"][""] == 1.0
+    finally:
+        m.metrics_reset()
+        dfft.enable_metrics(False)
+    assert "skipped 1 stale wisdom tuple" in capsys.readouterr().err
 
 
 def test_warm_pool_emits_spans_and_metrics_zero_timing(tmp_path):
