@@ -63,48 +63,34 @@ def _flagship_n() -> int:
 # --------------------------------------------------------------- worker
 
 class _precision_env:
-    """Candidate names may carry trace-time knob suffixes — ``pallas:high``
-    plans the pallas executor with DFFT_MM_PRECISION=high, and
-    ``matmul:high:gauss`` additionally sets DFFT_MM_COMPLEX=gauss (the
-    3-real-matmul dense complex product) — for the span of its
-    planning/tracing (the measurable accuracy/speed knobs of
+    """Candidate names may carry precision-tier suffixes — ``matmul:high``
+    (== ``matmul:f32``) plans the matmul executor at the 3-pass tier,
+    ``matmul:high:gauss`` additionally in the 3-real-matmul dense complex
+    product (the measurable accuracy/speed knobs of
     ``ops/dft_matmul.py::mm_precision``/``complex_mode``; the reference
     likewise records faster-but-less-accurate backend rows side by side,
-    ``csv/batch_rocResult1D.csv``). The roundtrip gate still applies, so a
-    tier that breaks the c64 accuracy bar is dropped, never reported."""
-
-    _VARS = {"default": "DFFT_MM_PRECISION", "high": "DFFT_MM_PRECISION",
-             "highest": "DFFT_MM_PRECISION",
-             "native": "DFFT_MM_COMPLEX", "gauss": "DFFT_MM_COMPLEX"}
+    ``csv/batch_rocResult1D.csv``). These used to be applied by mutating
+    ``DFFT_MM_*`` around planning — a process-global trace-time race with
+    any concurrent planning (warm pools, tournaments). The tiers are now
+    PLAN-SCOPED: the label goes straight into the planner / stage
+    builders, which bake the tier into that plan's own trace
+    (``ops/executors.py`` tier grammar), so this shim only validates the
+    menu label (keeping the old ValueError contract for bad suffixes) and
+    yields it through unchanged — no env mutation. The roundtrip gate
+    still applies, so a tier that breaks the c64 accuracy bar is dropped,
+    never reported."""
 
     def __init__(self, executor: str):
-        self.base, *suffixes = executor.split(":")
-        try:
-            self.env = {self._VARS[s]: s for s in suffixes}
-        except KeyError as e:
-            raise ValueError(
-                f"unknown executor suffix {e.args[0]!r} in {executor!r}; "
-                f"valid: {sorted(self._VARS)}") from None
-        if len(self.env) != len(suffixes):
-            # e.g. 'matmul:high:default' — the dict keeps only one value
-            # per knob, so the row label would lie about what ran.
-            raise ValueError(
-                f"conflicting suffixes in {executor!r}: at most one "
-                f"precision tier and one complex-product mode")
-        self._saved = {}
+        if ":" in executor:
+            from distributedfft_tpu.ops.executors import split_executor
+
+            split_executor(executor)  # raises on unknown/conflicting
+        self.label = executor        # suffixes (message names 'suffix')
 
     def __enter__(self):
-        for var, val in self.env.items():
-            self._saved[var] = os.environ.get(var)
-            os.environ[var] = val
-        return self.base
+        return self.label
 
     def __exit__(self, *exc):
-        for var, old in self._saved.items():
-            if old is None:
-                os.environ.pop(var, None)
-            else:
-                os.environ[var] = old
         return False
 
 
@@ -278,21 +264,24 @@ def _plan_cost_block(plan) -> dict:
 
 
 def _plan_wire_kw(plan) -> dict:
-    """The wire/transport stamps of one plan's result line: the resolved
-    ``wire_dtype`` (DFFT_WIRE_DTYPE lands in the plan's options at plan
-    time) and the exchange transport — _emit drops the defaults so
-    exact/alltoall rows keep the old schema."""
+    """The wire/transport/precision stamps of one plan's result line:
+    the resolved ``wire_dtype`` (DFFT_WIRE_DTYPE lands in the plan's
+    options at plan time), the exchange transport, and the plan-scoped
+    matmul precision tier (``PlanOptions.mm_precision`` — the executor
+    label's ``:bf16``/``:f32`` suffix) — _emit drops the defaults so
+    exact/alltoall/full-precision rows keep the old schema."""
     opts = getattr(plan, "options", None)
     return {
         "wire_dtype": getattr(opts, "wire_dtype", None),
         "transport": getattr(opts, "algorithm", None),
+        "precision": getattr(opts, "mm_precision", None),
     }
 
 
 def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
           all_times, donated=False, stages=None, overlap=None, tuned=None,
           cost=None, batch=None, wire_dtype=None, transport=None,
-          op=None, degraded=False):
+          precision=None, op=None, degraded=False):
     import jax
 
     from distributedfft_tpu.utils.metrics import metrics_snapshot
@@ -363,6 +352,13 @@ def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
         # bytes and must never be judged against exact-wire baselines or
         # vice versa. Exact rows keep the old schema.
         out["wire_dtype"] = wire_dtype
+    if precision is not None:
+        # Reduced/explicit matmul precision tier (PlanOptions.mm_
+        # precision — a plan-scoped MXU accuracy choice, the executor
+        # label's :bf16/:f32 suffix): part of the baseline group — a
+        # one-pass bf16 run must never be judged against f32-exact
+        # baselines or vice versa. Untier'd rows keep the old schema.
+        out["precision"] = precision
     if degraded:
         # Degraded-mode fallback run (docs/ROBUSTNESS.md): the matmul-
         # DFT executor stood in for a faulted default. The run-record

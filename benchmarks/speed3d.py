@@ -57,6 +57,18 @@ def parse_args(argv):
                         "(MPI_Alltoallv analog; TPU backend only, the CPU "
                         "test backend mirrors the dense path)")
     p.add_argument("-executor", default="xla", help="local FFT backend (xla|matmul|...)")
+    p.add_argument("-mm", default=None, choices=("bf16", "f32", "highest"),
+                   metavar="TIER",
+                   help="plan-scoped matmul precision tier: composes "
+                        "onto -executor as a tiered label "
+                        "('matmul:bf16' — one bf16 MXU pass; 'f32' = "
+                        "3-pass; 'highest' = f32-exact, the bare "
+                        "default), baked into this plan's own trace "
+                        "instead of the process-global DFFT_MM_PRECISION "
+                        "env. Stamped into the CSV algorithm column "
+                        "'<alg>+mmbf16' (mirroring '+wbf16') so "
+                        "reduced-precision sweep rows never mix with "
+                        "exact baselines. Matmul-family executors only")
     p.add_argument("-op", default=None, choices=("poisson", "grad", "gauss"),
                    help="run the fused spectral OPERATOR instead of a "
                         "bare transform: one FFT -> pointwise -> iFFT "
@@ -322,6 +334,15 @@ def main(argv=None) -> None:
         mesh = ndev  # auto decomposition via plan logic
         decomposition = None
 
+    if args.mm is not None:
+        # Compose the tier onto the executor label: every downstream
+        # consumer (planners, staged builders, brick/op paths) resolves
+        # tiered labels through ops.executors.get_executor, so one
+        # composition point covers them all. Raises for non-matmul
+        # executors (the tier is meaningless there).
+        from distributedfft_tpu.ops.executors import tiered_name
+
+        args.executor = tiered_name(args.executor, args.mm)
     plan_fn = dfft.plan_dft_r2c_3d if args.kind == "r2c" else dfft.plan_dft_c2c_3d
     kw = dict(decomposition=decomposition, executor=args.executor,
               dtype=dtype, algorithm=algorithm)
@@ -605,7 +626,8 @@ def main(argv=None) -> None:
                 if args.kind == "r2c" and args.r2c_axis != 2 else args.kind)
         alg_label = _algorithm_label(
             algorithm, overlap, batch=bsz,
-            wire=getattr(fwd.options, "wire_dtype", None), op=args.op)
+            wire=getattr(fwd.options, "wire_dtype", None), op=args.op,
+            mm=getattr(fwd.options, "mm_precision", None))
         if tuned_lbl is not None:
             # Tuned rows must never be indistinguishable from rows that
             # pinned the same knobs by hand (the tuple can move between
@@ -654,16 +676,18 @@ def _t2_ratio(exp_rec) -> str:
 def _algorithm_label(algorithm: str, overlap: int | None,
                      batch: int | None = None,
                      wire: str | None = None,
-                     op: str | None = None) -> str:
+                     op: str | None = None,
+                     mm: str | None = None) -> str:
     """Algorithm column label with the overlap chunk count
     (``alltoall+ov4``), coalesced batch size (``alltoall+b8``), on-wire
-    compression (``alltoall+wbf16``), and/or fused spectral operator
-    (``alltoall+oppoisson``) appended — overlapped / batched /
-    compressed / operator sweep rows must never be indistinguishable
-    from monolithic exact single-transform baselines (the regress store
-    keys the label into the baseline config group). Default (K=1,
-    unbatched, exact-wire, bare-transform) rows keep the bare name
-    (schema unchanged)."""
+    compression (``alltoall+wbf16``), fused spectral operator
+    (``alltoall+oppoisson``), and/or plan-scoped matmul precision tier
+    (``alltoall+mmbf16``) appended — overlapped / batched / compressed /
+    operator / reduced-precision sweep rows must never be
+    indistinguishable from monolithic exact single-transform baselines
+    (the regress store keys the label into the baseline config group).
+    Default (K=1, unbatched, exact-wire, bare-transform, env-default
+    precision) rows keep the bare name (schema unchanged)."""
     label = (f"{algorithm}+ov{overlap}"
              if overlap and overlap != 1 else algorithm)
     if batch and batch > 1:
@@ -672,6 +696,8 @@ def _algorithm_label(algorithm: str, overlap: int | None,
         label += f"+w{wire}"
     if op:
         label += f"+op{op}"
+    if mm:
+        label += f"+mm{mm}"
     return label
 
 
@@ -698,10 +724,22 @@ def _executor_label(executor: str) -> str:
     base = executor.split(":", 1)[0]
     knobs = []
     if base.startswith(_MM_EXECUTORS):
+        # A tiered label ('matmul:bf16') pins its own precision/complex
+        # mode at trace time — the env knobs are defaults only there, so
+        # appending them would mislabel what actually ran.
+        try:
+            from distributedfft_tpu.ops.executors import split_executor
+
+            _, own_tier, own_cmode = (split_executor(executor)
+                                      if ":" in executor
+                                      else (base, None, None))
+        except ValueError:
+            own_tier = own_cmode = None
         prec = os.environ.get("DFFT_MM_PRECISION", "").strip().lower()
-        if prec and prec != "highest":
+        if prec and prec != "highest" and own_tier is None:
             knobs.append(prec)
-        if os.environ.get("DFFT_MM_COMPLEX", "").strip().lower() == "gauss":
+        if (os.environ.get("DFFT_MM_COMPLEX", "").strip().lower() == "gauss"
+                and own_cmode is None):
             knobs.append("gauss")
         split = os.environ.get("DFFT_MM_SPLIT", "").strip()
         if split:  # multi-entry values are comma-separated (512=4x128,...)

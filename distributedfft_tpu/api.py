@@ -181,6 +181,20 @@ class OpPlan3D(Plan3D):
     multiplier: Any = None
 
 
+def _default_executor(executor: str) -> str:
+    """Resolve the planner's executor default: ``DFFT_EXECUTOR`` (when
+    set) replaces the built-in ``"xla"`` default — the documented escape
+    hatch for environments whose XLA FFT lowering is broken (the
+    XLA:CPU fft-thunk fault: ``DFFT_EXECUTOR=matmul`` routes every
+    default-executor plan through the thunk-free MXU matmul engine). An
+    explicitly non-default ``executor=`` argument always wins; the knob
+    is part of the plan-cache key."""
+    if executor != "xla":
+        return executor
+    env = os.environ.get("DFFT_EXECUTOR", "").strip()
+    return env if env and env not in ("0", "none") else executor
+
+
 def _resolve_options(
     decomposition: str | None,
     executor: str,
@@ -191,26 +205,122 @@ def _resolve_options(
     tune: str | None = None,
     wire_dtype: str | None = None,
     max_roundtrip_err: float | None = None,
+    mm_precision: str | None = None,
+    mm_complex: str | None = None,
 ) -> PlanOptions:
     if options is not None:
         if (decomposition is not None or executor != "xla" or donate
                 or algorithm != "alltoall" or overlap_chunks is not None
                 or tune is not None or wire_dtype is not None
-                or max_roundtrip_err is not None):
+                or max_roundtrip_err is not None
+                or mm_precision is not None or mm_complex is not None):
             raise ValueError(
                 "pass either options= or individual plan keywords, not both"
             )
-        return options
-    return PlanOptions(
+        return _apply_mm_tiers(options)
+    return _apply_mm_tiers(PlanOptions(
         decomposition=decomposition or "auto",
         algorithm=algorithm,
-        executor=executor,
+        executor=_default_executor(executor),
         donate=donate,
         overlap_chunks=overlap_chunks,
         tune=tune,
         wire_dtype=wire_dtype,
         max_roundtrip_err=max_roundtrip_err,
+        mm_precision=mm_precision,
+        mm_complex=mm_complex,
+    ))
+
+
+def _apply_mm_tiers(opts: PlanOptions) -> PlanOptions:
+    """Normalize a plan's accuracy tier into its canonical executor
+    label: ``mm_precision``/``mm_complex`` compose into the executor
+    name (``matmul`` + ``bf16`` -> ``matmul:bf16``), and a label that
+    already carries suffixes back-fills the option fields — after this,
+    ``opts.executor`` and ``opts.mm_*`` are two views of one choice (the
+    label is what the plan cache, wisdom store, and benchmark stamps
+    key; the fields are what drivers read). ``mm_precision=None`` with a
+    bare executor is returned unchanged — byte-identical planning."""
+    import dataclasses
+
+    from .ops.executors import (
+        MM_EXECUTOR_BASES, split_executor, tiered_name,
     )
+
+    ex = opts.executor
+    if opts.mm_precision is None and opts.mm_complex is None:
+        if ":" not in ex:
+            return opts
+        base, tier, cmode = split_executor(ex)  # validates the label
+        return dataclasses.replace(
+            opts, mm_precision=tier, mm_complex=cmode,
+            # Canonical spelling ("matmul:high" -> "matmul:f32"): one
+            # label per tier across cache keys, wisdom, and stamps.
+            executor=tiered_name(base, tier, cmode))
+    if not ex.split(":", 1)[0].startswith(MM_EXECUTOR_BASES):
+        if resolve_tune_mode(opts.tune) != "off":
+            # Tuned planning: the tier choice pins the TUNER's precision
+            # axis (every matmul-family candidate carries it) — the base
+            # executor here is just the search's starting point, not
+            # what runs.
+            return opts
+        raise ValueError(
+            f"mm_precision/mm_complex scope the matmul-family executors "
+            f"{MM_EXECUTOR_BASES}; executor={ex!r} never consults them "
+            f"(use tune='measure'/'wisdom' to search the tiered "
+            f"candidate axis instead)")
+    name = tiered_name(ex, opts.mm_precision, opts.mm_complex)
+    base, tier, cmode = (split_executor(name) if ":" in name
+                         else (name, None, None))
+    return dataclasses.replace(opts, executor=name, mm_precision=tier,
+                               mm_complex=cmode)
+
+
+def _thunk_guard_executor(opts: PlanOptions, lp: LogicPlan,
+                          forward: bool) -> str:
+    """The XLA:CPU fft-thunk retirement path at the planner level
+    (:func:`..ops.executors.thunk_guard_substitute` is the shared
+    predicate — the staged pipeline builders apply the same rule): with
+    ``DFFT_THUNK_GUARD`` armed, the known-poisoned class (inverse pencil
+    chains with uneven ceil-padded shards on the CPU backend) routes
+    through the substitute executor; everything else (and every plan
+    when the knob is unset — the default) keeps its executor untouched,
+    HLO-identical. Part of the plan-cache key."""
+    from .ops.executors import thunk_guard_substitute
+
+    if lp.mesh is None:
+        return opts.executor
+    # Uneven = some chain stage ceil-pads (shards of unequal shape); the
+    # even pencil chains run the thunk cleanly. The slab class is the
+    # MINOR-AXIS starved chain only: input slabs on axis 2 with
+    # zero-extent shards (extent < parts) — merely-starved chains on the
+    # major axes run the thunk fine, and substituting there would break
+    # the executor-sensitive bitwise-parity contracts for no protection.
+    uneven = any(len({b.shape for b in boxes}) > 1
+                 for _axes, boxes in lp.stages)
+    starved = bool(
+        lp.decomposition == "slab" and lp.slab_axes
+        and lp.slab_axes[0] == 2
+        and any(0 in b.shape for b in lp.stages[0][1]))
+    return thunk_guard_substitute(
+        opts.executor, decomposition=lp.decomposition, forward=forward,
+        uneven=uneven, starved=starved)
+
+
+def _guarded(opts: PlanOptions, lp: LogicPlan, forward: bool):
+    """Apply :func:`_thunk_guard_executor`; on a substitution, rewrite
+    both option views (the planner's and the logic skeleton's) so every
+    consumer — builders, metrics labels, bench stamps — describes the
+    executor that actually runs."""
+    import dataclasses
+
+    gex = _thunk_guard_executor(opts, lp, forward)
+    if gex == opts.executor:
+        return opts, lp
+    opts = dataclasses.replace(opts, executor=gex)
+    lp = dataclasses.replace(
+        lp, options=dataclasses.replace(lp.options, executor=gex))
+    return opts, lp
 
 
 def _check_direction(shape, direction) -> tuple[tuple[int, int, int], bool]:
@@ -381,6 +491,8 @@ def plan_dft_c2c_3d(
     tune: str | None = None,
     wire_dtype: str | None = None,
     max_roundtrip_err: float | None = None,
+    mm_precision: str | None = None,
+    mm_complex: str | None = None,
     options: PlanOptions | None = None,
     in_spec: P | None = None,
     out_spec: P | None = None,
@@ -439,7 +551,17 @@ def plan_dft_c2c_3d(
     ICI/DCN transport over a hybrid 2D (dcn x ici) mesh
     (:func:`~.parallel.exchange.hierarchical_all_to_all`).
     ``max_roundtrip_err`` declares the plan's error budget — the gate
-    under which the tuner may pick (or replay) compressed candidates.
+    under which the tuner may pick (or replay) compressed and
+    reduced-precision candidates (the errors compose; one budget
+    governs the sum).
+
+    ``mm_precision="bf16"|"f32"|"highest"`` scopes the matmul-family
+    executors' MXU contraction tier to THIS plan (the executor label
+    becomes ``matmul:bf16`` etc. — a distinct, plan-cache-keyed
+    executor; two tiers coexist in one process). ``None`` defers to the
+    ``DFFT_MM_PRECISION`` env default at trace time, byte-identical to
+    today. ``mm_complex="gauss"`` likewise scopes the 3-real-matmul
+    complex product (env default ``DFFT_MM_COMPLEX``).
     """
     shape, forward = _check_direction(shape, direction)
     batch = _norm_batch(batch)
@@ -448,7 +570,7 @@ def plan_dft_c2c_3d(
                          "in_spec/out_spec require batch=None (or 1)")
     opts = _resolve_options(decomposition, executor, donate, algorithm,
                             options, overlap_chunks, tune, wire_dtype,
-                            max_roundtrip_err)
+                            max_roundtrip_err, mm_precision, mm_complex)
     if resolve_tune_mode(opts.tune) != "off":
         from . import tuner
 
@@ -467,6 +589,7 @@ def plan_dft_c2c_3d(
         shape, mesh, opts, forward=forward, in_spec=in_spec,
         out_spec=out_spec, batch=batch,
     )
+    opts, lp = _guarded(opts, lp, forward)
     world = world_box(shape)
     if (in_spec is not None or out_spec is not None) and lp.mesh is None:
         raise ValueError("in_spec/out_spec require a mesh")
@@ -879,6 +1002,8 @@ def plan_dft_r2c_3d(
     tune: str | None = None,
     wire_dtype: str | None = None,
     max_roundtrip_err: float | None = None,
+    mm_precision: str | None = None,
+    mm_complex: str | None = None,
     options: PlanOptions | None = None,
     in_spec: P | None = None,
     out_spec: P | None = None,
@@ -902,6 +1027,9 @@ def plan_dft_r2c_3d(
     ``batch=B`` coalesces B same-shape transforms into one device program
     with one shared exchange per batch (the :func:`plan_dft_c2c_3d`
     convention); canonical ``r2c_axis=2`` chains only.
+
+    ``mm_precision`` / ``mm_complex`` scope the matmul-family executor's
+    accuracy tier to this plan (the :func:`plan_dft_c2c_3d` convention).
     """
     batch = _norm_batch(batch)
     if r2c_axis != 2:
@@ -915,6 +1043,7 @@ def plan_dft_r2c_3d(
             donate=donate, algorithm=algorithm,
             overlap_chunks=overlap_chunks, tune=tune,
             wire_dtype=wire_dtype, max_roundtrip_err=max_roundtrip_err,
+            mm_precision=mm_precision, mm_complex=mm_complex,
             options=options, in_spec=in_spec, out_spec=out_spec,
         )
     if batch is not None and (in_spec is not None or out_spec is not None):
@@ -923,7 +1052,7 @@ def plan_dft_r2c_3d(
     shape, forward = _check_direction(shape, direction)
     opts = _resolve_options(decomposition, executor, donate, algorithm,
                             options, overlap_chunks, tune, wire_dtype,
-                            max_roundtrip_err)
+                            max_roundtrip_err, mm_precision, mm_complex)
     if resolve_tune_mode(opts.tune) != "off":
         from . import tuner
 
@@ -963,6 +1092,7 @@ def plan_dft_r2c_3d(
     # axis 2, device-local on the real side); user layouts go through edge
     # reshards below rather than chain re-axing.
     lp = logic_plan3d(shape, mesh, opts, forward=forward, batch=batch)
+    opts, lp = _guarded(opts, lp, forward)
     world, cworld = world_box(shape), world_box(cshape)
     bo = 0 if batch is None else 1
 
@@ -1060,7 +1190,8 @@ def _chain_convention_note(e: Exception, axis: int) -> ValueError:
 def _r2c_axis_wrapped(shape, mesh, axis: int, *, direction, decomposition,
                       executor, dtype, donate, algorithm, options, in_spec,
                       out_spec, overlap_chunks=None, tune=None,
-                      wire_dtype=None, max_roundtrip_err=None) -> Plan3D:
+                      wire_dtype=None, max_roundtrip_err=None,
+                      mm_precision=None, mm_complex=None) -> Plan3D:
     """r2c/c2r with the halved axis != 2 (heFFTe ``r2c_direction`` 0/1):
     the canonical chain (real axis = 2) runs on a transposed view.
     Caller-facing metadata — shapes, shardings, boxes — is permuted back
@@ -1080,6 +1211,7 @@ def _r2c_axis_wrapped(shape, mesh, axis: int, *, direction, decomposition,
             executor=executor, dtype=dtype, donate=donate,
             algorithm=algorithm, overlap_chunks=overlap_chunks, tune=tune,
             wire_dtype=wire_dtype, max_roundtrip_err=max_roundtrip_err,
+            mm_precision=mm_precision, mm_complex=mm_complex,
             options=options,
             in_spec=_permute_spec3(in_spec, perm),
             out_spec=_permute_spec3(out_spec, perm),
@@ -1515,6 +1647,10 @@ _PLAN_ENV_KNOBS = (
     "DFFT_PALLAS_PACK", "DFFT_PALLAS_SPLIT", "DFFT_PALLAS_TILE",
     "DFFT_PALLAS_TILE2D", "DFFT_PALLAS_TILE_STRIDED", "DFFT_XLA_REAL",
     "DFFT_FORCE_REAL_LOWERING", "DFFT_OVERLAP",
+    # Executor routing: the default-executor escape hatch and the
+    # XLA:CPU fft-thunk guard both change which executor a default
+    # planner call builds with.
+    "DFFT_EXECUTOR", "DFFT_THUNK_GUARD",
     # Tuned planning: mode, wisdom store, budget, and survivor cap all
     # change what a tuned planner call would build/measure — as do the
     # calibrated-profile path and its correction opt-out (they move the

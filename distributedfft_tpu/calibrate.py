@@ -234,17 +234,43 @@ def _measure_peak_tflops(iters: int) -> float | None:
     import jax
     import jax.numpy as jnp
 
+    dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    return _mm_tflops(iters, dt, jax.lax.Precision.DEFAULT)
+
+
+def _mm_tflops(iters: int, dtype, precision) -> float | None:
+    """Matmul TFlop/s at one (input dtype, lax precision) point — the
+    shared microbenchmark behind the per-tier fields."""
+    import jax
+    import jax.numpy as jnp
+
     from .utils.timing import time_fn_amortized
 
-    dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
-    a = jnp.ones((_MM_N, _MM_N), dt)
+    a = jnp.ones((_MM_N, _MM_N), dtype)
 
     @jax.jit
     def mm(v):
-        return jnp.dot(v, v, precision=jax.lax.Precision.DEFAULT)
+        return jnp.dot(v, v, precision=precision)
 
     t, _ = time_fn_amortized(mm, a, iters=iters, repeats=2)
     return (2.0 * _MM_N ** 3 / t) / 1e12 if t > 0 else None
+
+
+def _measure_mm_tier_tflops(iters: int) -> tuple[float | None, float | None]:
+    """Per-precision-tier matmul rates ``(mm_bf16_tflops,
+    mm_f32_tflops)`` — the two measured points the tuner's
+    precision-tier cost model prices candidates with
+    (:func:`..tuner.mm_tier_tflops`; the exact tier derives as half the
+    f32 rate — 6 passes vs 3). bf16 inputs at DEFAULT precision = the
+    one-pass MXU feed of the ``matmul:bf16`` executor tier; f32 inputs
+    at HIGHEST = the multi-pass f32-exact contraction of the bare
+    executor's contractions."""
+    import jax
+    import jax.numpy as jnp
+
+    bf16 = _mm_tflops(iters, jnp.bfloat16, jax.lax.Precision.DEFAULT)
+    f32 = _mm_tflops(iters, jnp.float32, jax.lax.Precision.HIGHEST)
+    return bf16, f32
 
 
 def _measure_axis_gbps(iters: int, mesh, axis_name: str) -> float | None:
@@ -364,6 +390,15 @@ def calibrate(iters: int = 10, *, wire: bool = True) -> dict:
             prof[field] = fn()
         except Exception:  # noqa: BLE001 — one sick benchmark nulls its
             prof[field] = None  # field, never the whole calibration
+    # Per-precision-tier matmul rates: the measured bf16 vs f32(-exact)
+    # MXU throughput the precision-tier cost model prices the
+    # matmul:bf16 / matmul:f32 / bare executor candidates with.
+    try:
+        bf16, f32 = _measure_mm_tier_tflops(iters)
+    except Exception:  # noqa: BLE001
+        bf16 = f32 = None
+    prof["mm_bf16_tflops"] = bf16
+    prof["mm_f32_tflops"] = f32
     # Per-leg link bandwidths for the hierarchical two-leg exchange
     # model: multi-process jobs measure the intra-slice ICI axis and the
     # inter-slice DCN axis separately (each leg priced on its own
@@ -403,6 +438,8 @@ def format_profile(prof: dict) -> str:
         + ("" if prof.get("wire_gbps") is not None
            else "  (single device: not measurable)"),
         f"matmul peak:    {num(prof.get('peak_tflops'), 'TFlop/s')}",
+        f"matmul bf16:    {num(prof.get('mm_bf16_tflops'), 'TFlop/s')}",
+        f"matmul f32:     {num(prof.get('mm_f32_tflops'), 'TFlop/s')}",
         f"launch floor:   {num(prof.get('launch_seconds'), 's')}",
         f"ici leg:        {num(prof.get('ici_gbps'), 'GB/s')}",
         f"dcn leg:        {num(prof.get('dcn_gbps'), 'GB/s')}"

@@ -151,7 +151,8 @@ def device_profile() -> dict:
         # wire bandwidth, so the table/default value stands in for the
         # fields the microbenchmarks could not produce.
         for field in ("hbm_gbps", "wire_gbps", "dcn_gbps", "peak_tflops",
-                      "launch_seconds"):
+                      "launch_seconds", "mm_bf16_tflops",
+                      "mm_f32_tflops"):
             v = cal.get(field)
             if isinstance(v, (int, float)) and v > 0:
                 out[field] = float(v)
@@ -193,6 +194,7 @@ def model_stage_estimates(plan, hw: dict | None = None) -> dict:
     feedback."""
     from .calibrate import model_correction
     from .plan_logic import model_stage_seconds
+    from .tuner import mm_tier_tflops
 
     hw = hw or device_profile()
     lp = plan.logic
@@ -208,6 +210,11 @@ def model_stage_estimates(plan, hw: dict | None = None) -> dict:
         algorithm=plan.options.algorithm,
         overlap_chunks=oc if isinstance(oc, int) else 1,
         exchange_correction=model_correction(plan.options.algorithm),
+        # Matmul-family plans price their FFT stages at the executor
+        # tier's MXU rate (calibrated mm_*_tflops fields win inside
+        # mm_tier_tflops); None for every other executor keeps the pure
+        # HBM roofline byte-identical.
+        mm_tflops=mm_tier_tflops(plan.executor),
     )
 
 
@@ -777,6 +784,12 @@ def explain(
             "dtype": str(np.dtype(plan.dtype)),
             "donate": bool(plan.options.donate),
             "wire_dtype": getattr(plan.options, "wire_dtype", None),
+            # Plan-scoped matmul accuracy tier (PlanOptions.mm_precision
+            # / the executor label's suffix): the per-stage MFU below is
+            # computed against THIS tier's matmul rate, so a bf16-tier
+            # run's utilization is judged on the bf16 peak.
+            "mm_precision": getattr(plan.options, "mm_precision", None),
+            "mm_complex": getattr(plan.options, "mm_complex", None),
         },
         "hw": hw,
         "gate": {"mads": mads, "min_rel": min_rel,
@@ -846,6 +859,19 @@ def explain(
     record["timing"] = timing
 
     peak_flops = hw["peak_tflops"] * 1e12
+    try:
+        # Matmul-family plans: MFU against the executor TIER's matmul
+        # rate (calibrated mm_*_tflops fields win), so predicted-vs-
+        # measured utilization is the tier's own — a bf16-tier stage at
+        # 30% of the bf16 peak must not read as 90% of the exact peak.
+        from .tuner import mm_tier_tflops
+
+        tier_tf = mm_tier_tflops(plan.executor)
+        if tier_tf:
+            peak_flops = tier_tf * 1e12
+            record["plan"]["mm_tflops"] = tier_tf
+    except Exception:  # noqa: BLE001 — attribution, not contract
+        pass
     wire_bps = hw["wire_gbps"] * 1e9
     stages_out: dict[str, dict] = {}
     diverged: list[str] = []

@@ -31,6 +31,8 @@ forward direction and scales by 1/n on the inverse (numpy convention).
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import functools
 import math
 import os
@@ -150,6 +152,44 @@ def _best_split(n: int) -> tuple[int, int] | None:
     return native.balanced_split(n, n)
 
 
+# Plan-scoped precision/complex-mode overrides. The env knobs below are
+# read at TRACE time, which made them process-global state: a warm_pool
+# preplan and a concurrent tune="measure" tournament in one process would
+# share whatever DFFT_MM_PRECISION happened to say when each plan first
+# traced. A tiered executor label ("matmul:bf16" — see
+# :func:`..executors.get_executor`) instead enters this scope around the
+# base executor call, so the setting is baked into that plan's jaxpr at
+# its own trace time and two tiers coexist in one process. ContextVars:
+# concurrent serving/tuner threads each see only their own scope.
+_PRECISION_OVERRIDE: contextvars.ContextVar[str | None] = (
+    contextvars.ContextVar("dfft_mm_precision_override", default=None))
+_COMPLEX_OVERRIDE: contextvars.ContextVar[str | None] = (
+    contextvars.ContextVar("dfft_mm_complex_override", default=None))
+
+
+@contextlib.contextmanager
+def mm_scope(precision: str | None = None, complex_mode: str | None = None):
+    """Scope a plan-level precision/complex-mode override over the DFT
+    contractions traced inside it. ``precision`` is a lax tier name
+    (``"default"|"high"|"highest"``), ``complex_mode``
+    ``"native"|"gauss"``; ``None`` leaves that knob on its env default.
+    Entered by the tiered-executor wrappers at trace time — the single
+    mechanism that makes the ``DFFT_MM_*`` env knobs defaults instead of
+    process-global state."""
+    tokens = []
+    if precision is not None:
+        tokens.append((_PRECISION_OVERRIDE,
+                       _PRECISION_OVERRIDE.set(precision)))
+    if complex_mode is not None:
+        tokens.append((_COMPLEX_OVERRIDE,
+                       _COMPLEX_OVERRIDE.set(complex_mode)))
+    try:
+        yield
+    finally:
+        for var, tok in reversed(tokens):
+            var.reset(tok)
+
+
 def mm_precision() -> "lax.Precision":
     """MXU precision for every DFT contraction (matmul + Pallas engines).
 
@@ -159,10 +199,14 @@ def mm_precision() -> "lax.Precision":
     accuracy) — a measurable knob for the hardware tuning sweeps, in the
     spirit of the reference's per-backend accuracy/speed trade
     (``csv/batch_rocResult1D.csv`` records rocFFT's faster-but-inaccurate
-    rows side by side). Read at trace time: set it before planning."""
+    rows side by side). Read at trace time: set it before planning — or
+    plan-scoped via :func:`mm_scope` (a ``PlanOptions.mm_precision`` /
+    tiered executor label overrides the env for its own plan only)."""
     import os
 
-    s = os.environ.get("DFFT_MM_PRECISION", "highest").strip().lower()
+    s = _PRECISION_OVERRIDE.get()
+    if s is None:
+        s = os.environ.get("DFFT_MM_PRECISION", "highest").strip().lower()
     table = {
         "default": lax.Precision.DEFAULT,
         "high": lax.Precision.HIGH,
@@ -213,8 +257,12 @@ def complex_mode() -> str:
     elementwise passes, and pins the bf16 pass count to exactly
     3 x mm_precision() passes instead of XLA's decomposition choice.
     A hardware-sweep knob (campaign-swept at 512^3), like
-    DFFT_MM_PRECISION. Read at trace time."""
-    m = os.environ.get("DFFT_MM_COMPLEX", "native").strip().lower()
+    DFFT_MM_PRECISION. Read at trace time; a :func:`mm_scope` override
+    (the ``:gauss`` executor suffix / ``PlanOptions.mm_complex``) wins
+    over the env for its own plan."""
+    m = _COMPLEX_OVERRIDE.get()
+    if m is None:
+        m = os.environ.get("DFFT_MM_COMPLEX", "native").strip().lower()
     if m not in ("native", "gauss"):
         raise ValueError(
             f"DFFT_MM_COMPLEX={m!r} is not a complex-product mode; "

@@ -24,6 +24,7 @@ any of them can be dropped into the distributed pipeline under ``jit`` /
 from __future__ import annotations
 
 import enum
+import functools
 import math
 from typing import Callable, Sequence
 
@@ -33,6 +34,221 @@ Array = jnp.ndarray
 ExecutorFn = Callable[..., Array]  # (x, axes, forward=True) -> y
 
 _REGISTRY: dict[str, ExecutorFn] = {}
+
+# --- precision-tiered executor labels -----------------------------------
+#
+# "matmul:bf16" / "matmul:f32" / "matmul:highest" (and the ":gauss"
+# complex-product mode) are DISTINCT executor names: the suffix scopes the
+# MXU contraction precision over the base executor's trace
+# (:func:`.dft_matmul.mm_scope`), so the accuracy tier is per-plan state
+# — plan-cache keyed, wisdom-recorded, two tiers coexisting in one
+# process — instead of the process-global trace-time DFFT_MM_PRECISION /
+# DFFT_MM_COMPLEX env read (which stays as the *default* for bare names).
+
+#: Accuracy tiers of the matmul-family executors, in descending-error
+#: order: ``bf16`` = one bf16 MXU pass (lax DEFAULT), ``f32`` = the
+#: 3-pass bf16 refinement (HIGH), ``highest`` = f32-exact multi-pass
+#: (HIGHEST — the bare executor's default tier).
+MM_TIERS = ("bf16", "f32", "highest")
+
+#: Tier label -> lax precision name (the :func:`.dft_matmul.mm_precision`
+#: table key the scope pins).
+TIER_PRECISION = {"bf16": "default", "f32": "high", "highest": "highest"}
+
+#: Accepted lax-name spellings of the tiers (the grammar bench.py's
+#: executor menus used before the tiers were plan-scoped:
+#: ``matmul:high`` == ``matmul:f32``). Normalized to the canonical MXU
+#: names by :func:`split_executor`.
+TIER_ALIASES = {"default": "bf16", "high": "f32"}
+
+#: Base executors whose contractions consult the DFFT_MM_* knobs — the
+#: only bases a tier suffix is meaningful for (speed3d's
+#: ``_executor_label`` gates on the same family).
+MM_EXECUTOR_BASES = ("matmul", "pallas")
+
+#: Complex-product modes accepted as a suffix (``native`` is the bare
+#: default; only ``gauss`` changes the trace).
+MM_COMPLEX_MODES = ("native", "gauss")
+
+
+def split_executor(name: str) -> tuple[str, str | None, str | None]:
+    """Parse a (possibly tiered) executor label into
+    ``(base, precision_tier, complex_mode)`` — e.g. ``"matmul:bf16:gauss"
+    -> ("matmul", "bf16", "gauss")``; bare names return ``(name, None,
+    None)``. Lax-name tier spellings normalize to the canonical MXU
+    names (``matmul:high -> ("matmul", "f32", None)`` — the bench menu
+    grammar). Validates suffix vocabulary and that the base consults the
+    precision knobs at all; does NOT require the base to be registered
+    (pure label algebra, shared with the tuner's candidate space)."""
+    if ":" not in name:
+        return name, None, None
+    base, *mods = name.split(":")
+    tier: str | None = None
+    cmode: str | None = None
+    for m in mods:
+        if m in MM_TIERS or m in TIER_ALIASES:
+            if tier is not None:
+                raise ValueError(
+                    f"executor {name!r} names two precision tiers")
+            tier = TIER_ALIASES.get(m, m)
+        elif m in MM_COMPLEX_MODES:
+            if cmode is not None:
+                raise ValueError(
+                    f"executor {name!r} repeats the complex mode")
+            cmode = m
+        else:
+            raise ValueError(
+                f"unknown executor suffix {m!r} in {name!r}; tiers: "
+                f"{MM_TIERS} (or lax spellings {sorted(TIER_ALIASES)}), "
+                f"complex modes: {MM_COMPLEX_MODES}")
+    if not base.startswith(MM_EXECUTOR_BASES):
+        raise ValueError(
+            f"executor {base!r} does not consult the matmul precision "
+            f"knobs; tier suffixes apply to {MM_EXECUTOR_BASES}")
+    return base, tier, cmode
+
+
+def tiered_name(base: str, precision: str | None = None,
+                complex_mode: str | None = None) -> str:
+    """Compose the canonical tiered executor label from a base name and
+    plan-level tier choices (``PlanOptions.mm_precision`` /
+    ``mm_complex``). Idempotent: a base that already carries a suffix
+    merges with the requested one — and conflicts raise (a plan asking
+    for ``executor="matmul:bf16", mm_precision="highest"`` is a bug, not
+    a precedence question). ``None`` tiers leave the bare name (the env
+    defaults keep governing that plan's trace)."""
+    b, have_tier, have_cmode = (split_executor(base) if ":" in base
+                                else (base, None, None))
+    if precision is not None:
+        precision = TIER_ALIASES.get(precision, precision)
+    for what, have, want in (("precision tier", have_tier, precision),
+                             ("complex mode", have_cmode, complex_mode)):
+        if have is not None and want is not None and have != want:
+            raise ValueError(
+                f"executor {base!r} already pins {what} {have!r}; "
+                f"conflicting request {want!r}")
+    tier = precision if precision is not None else have_tier
+    cmode = complex_mode if complex_mode is not None else have_cmode
+    if tier is not None and tier not in MM_TIERS:
+        raise ValueError(
+            f"mm_precision must be one of {MM_TIERS} or None, got {tier!r}")
+    if cmode is not None and cmode not in MM_COMPLEX_MODES:
+        raise ValueError(
+            f"mm_complex must be one of {MM_COMPLEX_MODES} or None, "
+            f"got {cmode!r}")
+    if cmode == "native":
+        cmode = None  # the bare default — not a distinct label
+    if tier is None and cmode is None:
+        return b
+    name = b + (f":{tier}" if tier else "") + (f":{cmode}" if cmode else "")
+    split_executor(name)  # one validation path for every composed label
+    return name
+
+
+#: Executor bases that lower through XLA's FFT ops — the family the
+#: fft-thunk guard below may substitute away from.
+THUNK_BASES = ("xla", "xla_minor")
+
+
+def thunk_guard_substitute(executor, *, decomposition: str, forward: bool,
+                           uneven: bool, starved: bool = False):
+    """The XLA:CPU fft-thunk retirement predicate, shared by the planners
+    (``api._thunk_guard_executor``) and the staged pipeline builders:
+    with ``DFFT_THUNK_GUARD`` armed (an executor name, normally
+    ``matmul``), an XLA-family executor on the CPU backend building one
+    of the known-poisoned chain classes —
+
+    - an *inverse pencil chain with uneven (ceil-padded) shards*, whose
+      irfft/ifft feeds the fft thunk a non-major layout, or
+    - a *starved minor-axis slab chain* (input slabs on the minor axis
+      with its extent smaller than the part count — zero-extent shards;
+      the caller passes this condition as ``starved``), whose t0 FFT
+      over the non-minor axes gets the same non-major layout
+
+    — both tripping the ``fft_thunk.cc:69`` RET_CHECK, an INTERNAL error
+    that permanently poisons the process's sharded dispatch stream — is
+    replaced by the substitute, which never touches the FFT thunk (every
+    matmul stage is a dot_general). Anything outside those classes, any
+    non-string executor (the dd tier's callables), and every call with
+    the knob unset (the default) returns ``executor`` untouched."""
+    import os
+
+    guard = os.environ.get("DFFT_THUNK_GUARD", "").strip()
+    if not guard or guard in ("0", "none"):
+        return executor
+    if not isinstance(executor, str):
+        return executor
+    if executor.split(":", 1)[0] not in THUNK_BASES:
+        return executor
+    poisoned = ((decomposition == "pencil" and not forward and uneven)
+                or (decomposition == "slab" and starved))
+    if not poisoned:
+        return executor
+    import jax
+
+    if jax.default_backend() != "cpu":
+        return executor
+    return guard
+
+
+#: Tiers below the exact default — the ones that cost accuracy and must
+#: be admitted against a plan's ``max_roundtrip_err`` budget
+#: (``highest`` IS the bare default's tier: exact by the suite's
+#: convention, like the exact wire).
+REDUCED_TIERS = ("bf16", "f32")
+
+_EXEC_ERR_CACHE: dict = {}
+
+
+def executor_roundtrip_error(name: str, dtype, n: int = 256) -> float:
+    """Measured relative round-trip error of one forward+inverse DFT
+    pass of a *reduced-precision* tiered executor at ``dtype`` (``max
+    |ifft(fft(x)) - x| / max |x|`` over a seeded standard-normal block)
+    — the precision analog of
+    :func:`..parallel.exchange.wire_roundtrip_error`, and the number the
+    tuner's error-budget filter admits a ``matmul:bf16`` candidate
+    against. Deterministic (fixed seed) and cached per (label, dtype,
+    n), so per-candidate pruning never re-measures. 0.0 for bare labels
+    and exact tiers (``highest``/``gauss``) — the accuracy baseline the
+    budget is declared relative to. Measured on the RUNNING backend: on
+    CPU every lax precision collapses to the native f64/f32 kernels (the
+    tiers genuinely cost nothing there); on TPU the bf16 tier's MXU
+    pass shows its real ~1e-2/1e-3 cost."""
+    if ":" not in name:
+        return 0.0
+    _, tier, _ = split_executor(name)
+    if tier not in REDUCED_TIERS:
+        return 0.0
+    import numpy as _np
+
+    key = (name, str(_np.dtype(dtype)), int(n))
+    hit = _EXEC_ERR_CACHE.get(key)
+    if hit is not None:
+        return hit
+    rng = _np.random.default_rng(0)
+    x = (rng.standard_normal((8, n))
+         + 1j * rng.standard_normal((8, n))).astype(_np.dtype(dtype))
+    fn = get_executor(name)
+    y = _np.asarray(fn(fn(jnp.asarray(x), (1,), True), (1,), False))
+    err = float(_np.max(_np.abs(y - x)) / _np.max(_np.abs(x)))
+    _EXEC_ERR_CACHE[key] = err
+    return err
+
+
+def _scoped(fn: Callable, tier: str | None, cmode: str | None) -> Callable:
+    """Wrap an executor-family callable so its trace runs under the
+    tier's :func:`.dft_matmul.mm_scope` — the point where a tiered label
+    becomes baked-in jaxpr precision instead of an env read."""
+    from . import dft_matmul
+
+    prec = TIER_PRECISION[tier] if tier is not None else None
+
+    @functools.wraps(fn)
+    def scoped(*args, **kw):
+        with dft_matmul.mm_scope(precision=prec, complex_mode=cmode):
+            return fn(*args, **kw)
+
+    return scoped
 
 
 class Scale(enum.Enum):
@@ -64,6 +280,9 @@ def register_executor(name: str, fn: ExecutorFn) -> None:
 
 
 def get_executor(name: str) -> ExecutorFn:
+    if ":" in name:
+        base, tier, cmode = split_executor(name)
+        return _scoped(get_executor(base), tier, cmode)
     try:
         return _REGISTRY[name]
     except KeyError:
@@ -302,8 +521,14 @@ register_real_executor("pallas", _pallas_r2c, _pallas_c2r)
 
 
 def get_r2c(name: str) -> Callable:
+    if ":" in name:
+        base, tier, cmode = split_executor(name)
+        return _scoped(get_r2c(base), tier, cmode)
     return _R2C_REGISTRY.get(name, _xla_r2c)
 
 
 def get_c2r(name: str) -> Callable:
+    if ":" in name:
+        base, tier, cmode = split_executor(name)
+        return _scoped(get_c2r(base), tier, cmode)
     return _C2R_REGISTRY.get(name, _xla_c2r)

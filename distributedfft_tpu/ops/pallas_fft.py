@@ -42,7 +42,9 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..utils.compat import pvary
+from ..utils.compat import (
+    pvary, shape_dtype_struct, tpu_compiler_params, typeof_vma,
+)
 from .dft_matmul import _dft_matrix_np
 
 # Largest per-stage DFT factor the kernel accepts; 256 keeps every LUT and
@@ -179,10 +181,7 @@ def _interpret_mode() -> bool:
 def _vma(x) -> frozenset:
     """Varying-across-mesh-axes set of a traced value (empty outside
     shard_map); pallas_call outputs must declare the same set."""
-    try:
-        return frozenset(jax.typeof(x).vma)
-    except (AttributeError, TypeError):
-        return frozenset()
+    return typeof_vma(x)
 
 
 def _mm(a, b):
@@ -272,7 +271,7 @@ def _pack_probe_ok(n1: int, n2: int, g1: int, g2: int) -> bool:
                 jax.ShapeDtypeStruct((bt, n2, n1), jnp.float32),
                 jax.ShapeDtypeStruct((bt, n2, n1), jnp.float32),
             ),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=tpu_compiler_params(
                 dimension_semantics=("parallel",),
                 vmem_limit_bytes=_VMEM_LIMIT,
             ),
@@ -412,15 +411,17 @@ def _fft_tiles(xr, xi, *, n: int, forward: bool, interpret: bool):
         # Under shard_map the operands carry a varying-across-mesh-axes set;
         # the outputs vary the same way (per-device batches are independent).
         out_shape=(
-            jax.ShapeDtypeStruct((batch, n2, n1), jnp.float32, vma=_vma(xr)),
-            jax.ShapeDtypeStruct((batch, n2, n1), jnp.float32, vma=_vma(xr)),
+            shape_dtype_struct((batch, n2, n1), jnp.float32,
+                               vma=_vma(xr)),
+            shape_dtype_struct((batch, n2, n1), jnp.float32,
+                               vma=_vma(xr)),
         ),
         cost_estimate=pl.CostEstimate(
             flops=8 * batch * n * (g1 * n1 + g2 * n2),
             bytes_accessed=4 * batch * n * 4,
             transcendentals=0,
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel",),
             vmem_limit_bytes=_VMEM_LIMIT,
         ),
@@ -490,10 +491,10 @@ def _fft2_tiles(xr, xi, *, ny: int, nz: int, forward: bool, interpret: bool):
         in_specs=lut_specs + [x_spec, x_spec],
         out_specs=(y_spec, y_spec),
         out_shape=(
-            jax.ShapeDtypeStruct((batch, y2, y1, z2, z1), jnp.float32,
-                                 vma=vma),
-            jax.ShapeDtypeStruct((batch, y2, y1, z2, z1), jnp.float32,
-                                 vma=vma),
+            shape_dtype_struct((batch, y2, y1, z2, z1), jnp.float32,
+                               vma=vma),
+            shape_dtype_struct((batch, y2, y1, z2, z1), jnp.float32,
+                               vma=vma),
         ),
         cost_estimate=pl.CostEstimate(
             flops=8 * batch * ny * nz * (gy[0] * y1 + gy[1] * y2
@@ -501,7 +502,7 @@ def _fft2_tiles(xr, xi, *, ny: int, nz: int, forward: bool, interpret: bool):
             bytes_accessed=4 * batch * ny * nz * 4,
             transcendentals=0,
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel",),
             vmem_limit_bytes=_VMEM_LIMIT,
         ),
@@ -582,15 +583,15 @@ def _fft_strided_tiles(xr, xi, *, n: int, forward: bool, interpret: bool):
         in_specs=lut_specs + [x_spec, x_spec],
         out_specs=(y_spec, y_spec),
         out_shape=(
-            jax.ShapeDtypeStruct((n2, n1, cols), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((n2, n1, cols), jnp.float32, vma=vma),
+            shape_dtype_struct((n2, n1, cols), jnp.float32, vma=vma),
+            shape_dtype_struct((n2, n1, cols), jnp.float32, vma=vma),
         ),
         cost_estimate=pl.CostEstimate(
             flops=8 * cols * n * (g1 * n1 + g2 * n2),
             bytes_accessed=4 * cols * n * 4,
             transcendentals=0,
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel",),
             vmem_limit_bytes=_VMEM_LIMIT,
         ),

@@ -55,7 +55,7 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from ..ops.executors import get_executor
-from ..utils.compat import pvary
+from ..utils.compat import pvary, typeof_vma
 from .exchange import exchange
 
 
@@ -180,7 +180,7 @@ def build_dist_fft1d(
         ang = (sign * np.pi / n) * rows.astype(rdt)
         rot = lax.complex(jnp.cos(ang), jnp.sin(ang))
         w = jnp.asarray(w_local_np, dtype=g.dtype)
-        vma = getattr(jax.typeof(g), "vma", None)
+        vma = typeof_vma(g)
         if vma:
             w = pvary(w, tuple(vma))
         return g * rot[:, None] * w

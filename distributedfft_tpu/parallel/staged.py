@@ -31,7 +31,9 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from ..geometry import pad_to
-from ..ops.executors import get_c2r, get_executor, get_r2c
+from ..ops.executors import (
+    get_c2r, get_executor, get_r2c, thunk_guard_substitute,
+)
 from ..utils.trace import trace_stages
 from .exchange import exchange_chunked
 from .pencil import PencilSpec
@@ -123,7 +125,6 @@ def build_pencil_stages(
     rows, cols = mesh.shape[row_axis], mesh.shape[col_axis]
     spec = PencilSpec(tuple(int(s) for s in shape), rows, cols,
                       row_axis, col_axis, tuple(perm), order)
-    ex = get_executor(executor) if isinstance(executor, str) else executor
     n = spec.shape
     a, b, c = perm
     if order == "col_first":
@@ -132,6 +133,16 @@ def build_pencil_stages(
     else:
         seq = [(row_axis, rows, c, a), (col_axis, cols, a, b)]
         mid_fft, last_fft = a, b
+    # fft-thunk guard (DFFT_THUNK_GUARD): the staged view of an uneven
+    # inverse pencil chain is in the known XLA:CPU poisoned class exactly
+    # like the fused chain — substitute before any stage traces (the
+    # planner applies the same shared predicate).
+    executor = thunk_guard_substitute(
+        executor, decomposition="pencil", forward=forward,
+        uneven=bool(n[a] % rows or n[b] % cols
+                    or n[seq[0][2]] % seq[0][1]
+                    or n[seq[1][2]] % seq[1][1]))
+    ex = get_executor(executor) if isinstance(executor, str) else executor
 
     in_lay = {a: row_axis, b: col_axis}
     mid_lay = ({a: row_axis, c: col_axis} if order == "col_first"
@@ -432,6 +443,13 @@ def build_pencil_rfft_stages(
         perm=(0, 1, 2) if forward else (1, 2, 0),
         order="col_first" if forward else "row_first",
     )
+    # fft-thunk guard: the staged uneven c2r pencil pipeline is in the
+    # known XLA:CPU poisoned class (see build_pencil_stages).
+    executor = thunk_guard_substitute(
+        executor, decomposition="pencil", forward=forward,
+        uneven=bool(spec.shape[0] % rows or spec.shape[1] % cols
+                    or spec.shape[1] % rows
+                    or (spec.shape[2] // 2 + 1) % cols))
     ex = get_executor(executor)
     r2c, c2r = get_r2c(executor), get_c2r(executor)
     n0, n1, n2 = spec.shape

@@ -99,10 +99,24 @@ class PlanOptions:
     ``DFFT_WIRE_DTYPE`` env var at plan time (unset -> exact,
     byte-identical HLO to an uncompressed plan).
     ``max_roundtrip_err``: the plan's relative round-trip error budget.
-    The tuner enumerates compressed (``wire_dtype``) candidates only for
-    plans that declare a budget, filters out candidates whose measured
-    wire round-trip error exceeds it, and replays a stored compressed
-    winner only into plans whose budget admits its recorded error.
+    The tuner enumerates reduced-accuracy candidates — compressed wire
+    (``wire_dtype``) and reduced matmul precision (``mm_precision``)
+    tiers — only for plans that declare a budget, filters out candidates
+    whose measured round-trip error (wire + precision errors compose;
+    one budget governs the sum) exceeds it, and replays a stored
+    reduced-accuracy winner only into plans whose budget admits its
+    recorded error.
+    ``mm_precision``: plan-scoped MXU contraction tier of the
+    matmul-family executors — ``"bf16"`` (one bf16 pass), ``"f32"``
+    (3-pass refinement), ``"highest"`` (f32-exact, the bare default).
+    ``None`` (the default) leaves the trace on the ``DFFT_MM_PRECISION``
+    env default — byte-identical HLO to today's plans. A non-None tier
+    composes into the executor label (``matmul:bf16`` — a DISTINCT
+    executor: plan-cache keyed, wisdom-recorded, two tiers coexisting in
+    one process; :func:`..ops.executors.tiered_name`).
+    ``mm_complex``: plan-scoped complex-product mode of the same family
+    (``"gauss"`` = the 3-real-matmul split; ``None``/``"native"`` defers
+    to ``DFFT_MM_COMPLEX``).
     """
 
     decomposition: str = "auto"
@@ -114,6 +128,8 @@ class PlanOptions:
     tune: str | None = None
     wire_dtype: str | None = None
     max_roundtrip_err: float | None = None
+    mm_precision: str | None = None
+    mm_complex: str | None = None
 
     def __post_init__(self) -> None:
         if self.algorithm not in ALGORITHMS:
@@ -161,6 +177,30 @@ class PlanOptions:
             raise ValueError(
                 f"tune must be one of {tuple(m for m in TUNE_MODES if m)} "
                 f"or None, got {self.tune!r}")
+        # Normalize + validate the plan-scoped matmul tiers (the executor
+        # label is composed at plan time by api._apply_mm_tiers; this
+        # keeps an invalid tier from surviving into the plan cache key).
+        from .ops.executors import (
+            MM_COMPLEX_MODES, MM_TIERS, TIER_ALIASES,
+        )
+
+        mp = self.mm_precision
+        if isinstance(mp, str):
+            mp = mp.strip().lower() or None
+            mp = TIER_ALIASES.get(mp, mp)  # lax-name spellings normalize
+            object.__setattr__(self, "mm_precision", mp)
+        if mp is not None and mp not in MM_TIERS:
+            raise ValueError(
+                f"mm_precision must be one of {MM_TIERS} or None, "
+                f"got {self.mm_precision!r}")
+        mc = self.mm_complex
+        if isinstance(mc, str):
+            mc = mc.strip().lower() or None
+            object.__setattr__(self, "mm_complex", mc)
+        if mc is not None and mc not in MM_COMPLEX_MODES:
+            raise ValueError(
+                f"mm_complex must be one of {MM_COMPLEX_MODES} or None, "
+                f"got {self.mm_complex!r}")
 
 
 DEFAULT_OPTIONS = PlanOptions()
@@ -865,6 +905,21 @@ def exchange_payloads(lp: LogicPlan, shape, itemsize: int) -> list[dict]:
     return _done(out)
 
 
+def mm_dft_flops(shape: Sequence[int], axes: Sequence[int] | None = None,
+                 ) -> float:
+    """Real flops of one dense-tier matmul-DFT transform over ``axes``
+    (default: all three): each transformed axis is one complex
+    contraction of the whole block against an n x n DFT matrix — N*n
+    complex MACs = ``8*N*n`` real flops per axis. The four-step split
+    spends fewer flops above the dense bound, so this is the
+    conservative (dense) figure — a RANKING quantity for the
+    precision-tier cost model (:func:`..tuner.mm_tier_tflops`), not a
+    prediction."""
+    shape = tuple(int(s) for s in shape)
+    n_total = math.prod(shape)
+    return sum(8.0 * n_total * shape[a] for a in (axes or range(3)))
+
+
 def model_stage_seconds(
     lp: LogicPlan,
     shape: Sequence[int],
@@ -877,6 +932,7 @@ def model_stage_seconds(
     overlap_chunks: int | None = None,
     exchange_correction: float = 1.0,
     dcn_gbps: float | None = None,
+    mm_tflops: float | None = None,
 ) -> dict:
     """Per-stage analytical prediction of one execution, keyed exactly
     ``t0..t3`` — the model side of the explain/attribution join. A fused
@@ -910,7 +966,16 @@ def model_stage_seconds(
     B — B-fold FFT flops and HBM stream, B-fold exchange payload through
     :func:`exchange_payloads` — while collective launch counts stay at
     the unbatched plan's (the batched win the tuner's pruning and the
-    explain attribution must both price honestly)."""
+    explain attribution must both price honestly).
+
+    ``mm_tflops`` prices the plan's FFT stages as matmul-DFT
+    contractions at that MXU rate (the executor's precision tier —
+    :func:`..tuner.mm_tier_tflops`): each stage's seconds become
+    ``max(HBM stream, mm_flops / rate)`` and the entry carries
+    ``mm_flops``, so the explain join and the pruning model both rank
+    bf16 vs f32 vs exact tiers before any compile. ``None`` (the
+    default, and every non-matmul executor) keeps the pure HBM
+    roofline — byte-identical model output."""
     shape = tuple(int(s) for s in shape)
     ndev = 1 if lp.mesh is None else math.prod(lp.mesh.devices.shape)
     bsz = getattr(lp, "batch", None) or 1
@@ -926,8 +991,16 @@ def model_stage_seconds(
         hbm = 2.0 * block_bytes * len(axes)  # read + write per axis pass
         flops = sum(5.0 * n_total * math.log2(max(2, shape[a]))
                     for a in axes) / ndev
-        return {"seconds": hbm / (hbm_gbps * 1e9), "flops": flops,
-                "hbm_bytes": hbm, "wire_bytes": 0.0}
+        out = {"seconds": hbm / (hbm_gbps * 1e9), "flops": flops,
+               "hbm_bytes": hbm, "wire_bytes": 0.0}
+        if mm_tflops:
+            # Matmul-DFT pricing at the tier's rate; the HBM stream
+            # stays the floor (a memory-bound stage cannot be bought
+            # faster by a cheaper tier).
+            mm = mm_dft_flops(shape, axes) * bsz / ndev
+            out["mm_flops"] = mm
+            out["seconds"] = max(out["seconds"], mm / (mm_tflops * 1e12))
+        return out
 
     zero = {"seconds": 0.0, "flops": 0.0, "hbm_bytes": 0.0,
             "wire_bytes": 0.0}

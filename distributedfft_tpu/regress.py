@@ -251,9 +251,14 @@ def normalize_bench_line(
     # degraded rows form their own baseline group and can never poison
     # the fast baselines (nor be judged against them). Non-degraded
     # rows keep the old schema exactly.
+    # "precision" is the plan-scoped matmul accuracy tier
+    # (PlanOptions.mm_precision, the executor label's :bf16/:f32
+    # suffix): a reduced-precision run trades accuracy for MXU rate and
+    # must never share a baseline with exact runs (nor its faster
+    # numbers poison them); full-precision rows keep the old schema.
     for k in ("dtype", "devices", "decomposition", "overlap", "tuned",
               "batch", "profile", "wire_dtype", "transport", "op",
-              "degraded"):
+              "degraded", "precision"):
         if obj.get(k) is not None:
             config[k] = obj[k]
     ex: dict = {}

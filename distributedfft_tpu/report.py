@@ -880,9 +880,22 @@ def _winner_label(w: dict) -> str:
     """One wisdom winner dict as the compact label benchmark lines stamp
     (``decomposition/transport/executor/ovK[+wDTYPE]`` — must agree with
     ``tuner.Candidate.label``, wire suffix included, or compressed
-    winners silently never match their history rows)."""
+    winners silently never match their history rows). Precision-extended
+    winners need no extra join term: the tier rides INSIDE the executor
+    string itself (``matmul:bf16`` — Candidate.executor and
+    ``plan.executor`` carry the same canonical tiered label), so the
+    label agrees by construction; a stray ``mm_precision`` field in the
+    winner dict must still match the executor suffix (older/foreign
+    entries), or the label would lie about what won."""
+    ex = str(w.get("executor"))
+    mm = w.get("mm_precision")
+    if mm and f":{mm}" not in ex:
+        # Defensive join for entries that recorded the tier out-of-band:
+        # fold it into the executor term so the label matches what a
+        # tiered plan stamps.
+        ex = f"{ex}:{mm}"
     label = (f"{w.get('decomposition')}/{w.get('algorithm')}"
-             f"/{w.get('executor')}/ov{w.get('overlap_chunks')}")
+             f"/{ex}/ov{w.get('overlap_chunks')}")
     if w.get("wire_dtype"):
         label += f"+w{w['wire_dtype']}"
     return label
@@ -919,6 +932,10 @@ def _wisdom_summary(entry: dict) -> tuple[str, str]:
     k = (f"{key.get('kind', '?')} {shape} {key.get('dtype', '?')} "
          f"dir{key.get('direction', '?')} {where} "
          f"[{key.get('device_kind', '?')}]")
+    if key.get("mm_precision"):
+        # Tier-pinned tournaments (PlanOptions.mm_precision) are their
+        # own wisdom identity; surface the pin next to the key.
+        k += f" mm={key['mm_precision']}"
     return k, _winner_label(entry.get("winner") or {})
 
 

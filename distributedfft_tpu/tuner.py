@@ -105,6 +105,12 @@ MODEL_LAUNCH_SECONDS = 100e-6
 #: host's share of a data-center NIC, an order of magnitude below ICI.
 #: A calibrated profile's measured ``dcn_gbps`` overrides it.
 MODEL_DCN_GBPS = 11.0
+#: Matmul throughput per precision tier (TFlop/s) — ranking constants in
+#: the v5e ballpark: the bf16 tier is one MXU pass, the f32 tier the
+#: 3-pass refinement (~1/3 rate), the exact default tier ~6 passes.
+#: A calibrated profile's measured ``mm_bf16_tflops``/``mm_f32_tflops``
+#: fields override (:func:`mm_tier_tflops`).
+MODEL_MM_TFLOPS = {"bf16": 197.0, "f32": 66.0, "highest": 33.0}
 
 #: Executor preference order when the model cannot rank them (it models
 #: geometry only): the menu order of ``api._AUTO_CANDIDATES``.
@@ -147,6 +153,78 @@ def tuned_label(plan) -> str:
 
 # ------------------------------------------------------------ candidates
 
+def mm_tier_tflops(executor: str) -> float | None:
+    """The matmul throughput (TFlop/s) the ranking model prices a
+    matmul-family executor's contractions at: the label's precision tier
+    resolved against the calibrated profile's measured
+    ``mm_bf16_tflops``/``mm_f32_tflops`` fields when present
+    (:mod:`..calibrate`), else the :data:`MODEL_MM_TFLOPS` ranking
+    constants. Bare labels price at the exact (``highest``) tier — the
+    env default's pass count. None for executors whose compute is not a
+    matmul (the HBM roofline alone prices those)."""
+    from .calibrate import matching_profile
+    from .ops.executors import MM_EXECUTOR_BASES, split_executor
+
+    base = executor.split(":", 1)[0]
+    if not base.startswith(MM_EXECUTOR_BASES):
+        return None
+    tier = (split_executor(executor)[1] or "highest") if ":" in executor \
+        else "highest"
+    prof = matching_profile()
+    if prof is not None:
+        bf16 = prof.get("mm_bf16_tflops")
+        f32 = prof.get("mm_f32_tflops")
+        if tier == "bf16" and isinstance(bf16, (int, float)) and bf16 > 0:
+            return float(bf16)
+        if isinstance(f32, (int, float)) and f32 > 0:
+            # The exact tier is ~2x the f32 tier's pass count (6-pass vs
+            # 3-pass bf16 refinement) — derived, not separately measured.
+            return float(f32) if tier == "f32" else float(f32) / 2.0
+    return MODEL_MM_TFLOPS[tier]
+
+
+def candidate_roundtrip_error(cand: Candidate, dtype) -> float:
+    """The measured round-trip error one candidate's reduced-accuracy
+    axes cost TOGETHER: the wire cast's error
+    (:func:`..parallel.exchange.wire_roundtrip_error`) plus the executor
+    tier's (:func:`..ops.executors.executor_roundtrip_error`) — the sum
+    the plan's single ``max_roundtrip_err`` budget governs (compressed
+    wire and reduced precision compose; admitting each against the full
+    budget separately would let the pair overshoot it). 0.0 for an
+    exact-wire, exact-tier candidate. Both terms are seeded and cached —
+    per-candidate pruning never re-measures."""
+    from .ops.executors import executor_roundtrip_error
+    from .parallel.exchange import wire_roundtrip_error
+
+    err = 0.0
+    if cand.wire_dtype is not None:
+        err += wire_roundtrip_error(dtype, cand.wire_dtype)
+    err += executor_roundtrip_error(cand.executor, dtype)
+    return err
+
+
+def _cross_tiers(execs: Sequence[str],
+                 mm_tiers: Sequence[str | None]) -> list[str]:
+    """Cross the executor axis with the precision-tier axis: each
+    matmul-family base gains one tiered label per non-None tier
+    (``matmul`` x ``bf16`` -> ``matmul:bf16``); non-matmul executors and
+    the ``None`` tier keep the bare name. Order-preserving, deduped."""
+    from .ops.executors import MM_EXECUTOR_BASES, tiered_name
+
+    out: list[str] = []
+    for ex in execs:
+        for tier in mm_tiers:
+            if (tier is not None
+                    and ex.split(":", 1)[0].startswith(MM_EXECUTOR_BASES)
+                    and ":" not in ex):
+                name = tiered_name(ex, tier)
+            else:
+                name = ex  # tier axis is meaningless for this base
+            if name not in out:
+                out.append(name)
+    return out
+
+
 def _default_executors() -> list[str]:
     """Executor search axis: ``DFFT_AUTO_EXECUTORS`` (the same knob the
     ``executor="auto"`` tournament honors) or the built-in menu, minus
@@ -183,20 +261,26 @@ def enumerate_candidates(
     batch: int | None = None,
     hybrid: bool = False,
     wire_dtypes: Sequence[str | None] = (None,),
+    mm_tiers: Sequence[str | None] = (None,),
 ) -> list[Candidate]:
     """Enumerate the joint (decomposition x transport x executor x K x
-    wire) space for one plan. ``mesh_dims`` (a caller-fixed Mesh) pins
-    the decomposition axis — a 1D mesh can only run slab chains, a 2D
-    mesh only pencil; an int device count leaves both in play. ``batch``
-    scales the per-device block the K axis brackets (a batched plan's
-    auto-K crossover moves with the B-fold payload).
+    wire x precision) space for one plan. ``mesh_dims`` (a caller-fixed
+    Mesh) pins the decomposition axis — a 1D mesh can only run slab
+    chains, a 2D mesh only pencil; an int device count leaves both in
+    play. ``batch`` scales the per-device block the K axis brackets (a
+    batched plan's auto-K crossover moves with the B-fold payload).
 
     ``hybrid=True`` (the caller's mesh is a dcn x ici hybrid,
     :func:`..parallel.multihost.is_hybrid_mesh`) adds the hierarchical
     two-leg slab transport next to the flat-transport pencil chains.
     ``wire_dtypes`` is the on-wire compression axis — ``(None,)`` by
     default; the tuned planner widens it to ``(None, "bf16")`` only for
-    plans that declare a ``max_roundtrip_err`` budget."""
+    plans that declare a ``max_roundtrip_err`` budget. ``mm_tiers`` is
+    the matmul precision axis, crossed with the matmul-family executors
+    only (``None`` = the bare label; ``"bf16"`` -> ``matmul:bf16``, a
+    distinct executor whose accuracy the same budget admits — the
+    tuned planner widens it to ``(None, "bf16", "f32")`` under a budget,
+    or pins it to an explicit ``PlanOptions.mm_precision``)."""
     from .parallel.exchange import FLAT_ALGORITHMS
 
     shape = tuple(int(s) for s in shape)
@@ -215,7 +299,9 @@ def enumerate_candidates(
             decomps = tuple(d for d in eligible_decompositions(shape, ndev)
                             if d != "single")
         pairs = [(d, alg) for d in decomps for alg in FLAT_ALGORITHMS]
-    execs = list(executors) if executors is not None else _default_executors()
+    execs = _cross_tiers(
+        list(executors) if executors is not None else _default_executors(),
+        mm_tiers)
     ks = _overlap_values(shape, ndev, itemsize * (batch or 1))
     out = []
     for d, alg in pairs:
@@ -272,6 +358,18 @@ def model_cost(
     ndev = (math.prod(lp.mesh.devices.shape) if lp.mesh is not None else 1)
     world_bytes = itemsize * math.prod(shape) * (batch or 1)
     t_fft = 3 * 2 * (world_bytes / ndev) / (MODEL_HBM_GBPS * 1e9)
+    mm_rate = mm_tier_tflops(cand.executor)
+    if mm_rate is not None:
+        # Matmul-family executors: the dense-tier contraction flops
+        # priced at the tier's measured/ranking MXU rate — the term that
+        # lets pruning rank bf16 vs f32 vs exact BEFORE any compile
+        # (8*N*n real flops per transformed axis; the HBM stream stays
+        # the floor, so a memory-bound shape doesn't pretend a tier win).
+        from .plan_logic import mm_dft_flops
+
+        t_mm = (mm_dft_flops(shape) * (batch or 1) / ndev) / (
+            mm_rate * 1e12)
+        t_fft = max(t_fft, t_mm)
     payloads = exchange_payloads(lp, shape, itemsize)
     # Downstream FFT time each exchange can hide under: one chain stage.
     t_stage = t_fft / (len(payloads) + 1)
@@ -312,20 +410,18 @@ def prune_candidates(
     always measures every executor on the model's preferred geometry
     before spending compiles on runner-up geometries.
 
-    ``max_err`` is the plan's round-trip error budget: compressed
-    (``wire_dtype``) candidates whose measured wire round-trip error
-    (:func:`..parallel.exchange.wire_roundtrip_error` at ``dtype``)
+    ``max_err`` is the plan's round-trip error budget: reduced-accuracy
+    candidates — compressed wire, reduced precision tier, or both —
+    whose COMBINED measured round-trip error
+    (:func:`candidate_roundtrip_error` at ``dtype``: the wire cast's
+    error plus the executor tier's, one budget governing the sum)
     exceeds it are filtered out before any ranking — a candidate the
     budget can never admit must not crowd the survivor set."""
-    from .parallel.exchange import wire_roundtrip_error
-
     if max_err is not None:
+        dt = dtype if dtype is not None else np.complex64
         candidates = [
             c for c in candidates
-            if c.wire_dtype is None
-            or wire_roundtrip_error(dtype if dtype is not None
-                                    else np.complex64,
-                                    c.wire_dtype) <= max_err]
+            if candidate_roundtrip_error(c, dt) <= max_err]
     if limit is None:
         limit = int(os.environ.get("DFFT_TUNE_MAX", DEFAULT_MAX_CANDIDATES))
     limit = max(1, limit)
@@ -351,7 +447,18 @@ def prune_candidates(
 
     out: list[Candidate] = []
     for g in ranked:
-        for c in sorted(geos[g], key=exec_rank):
+        # Within a geometry, the model CAN rank the matmul family's
+        # precision tiers (each tier's contraction flops price at its
+        # own MXU rate — mm_tier_tflops); executors it cannot tell apart
+        # fall back to the menu order. Ranking precision before any
+        # compile is what lets a tight survivor cap still measure the
+        # promising tier.
+        def tier_cost(c: Candidate) -> float:
+            return model_cost(c, shape, mesh, itemsize=itemsize,
+                              batch=batch)
+
+        for c in sorted(geos[g], key=lambda c: (tier_cost(c),
+                                                exec_rank(c))):
             out.append(c)
             if len(out) >= limit:
                 return out
@@ -541,6 +648,7 @@ def wisdom_key(
     platform: str | None = None,
     batch: int | None = None,
     err_budget: float | None = None,
+    mm_precision: str | None = None,
 ) -> dict:
     """The identity a wisdom entry is valid for. A measured winner
     transfers only within one (plan family, problem, mesh, hardware,
@@ -552,7 +660,11 @@ def wisdom_key(
     program (or vice versa). ``err_budget`` (the plan's
     ``max_roundtrip_err``) keys budgeted and exact-only plans apart: the
     budget changes the admissible candidate space, so a winner measured
-    under one budget must never replay into a plan with another."""
+    under one budget must never replay into a plan with another.
+    ``mm_precision`` (an explicit ``PlanOptions.mm_precision`` pin) keys
+    tier-pinned tournaments apart from open-tier ones for the same
+    reason — a pinned search never saw the bare-label candidates, and an
+    open search's winner must not override a caller's pinned tier."""
     import jax
 
     from . import __version__
@@ -572,6 +684,7 @@ def wisdom_key(
         "layouts": layouts,
         "batch": None if batch is None else int(batch),
         "err_budget": None if err_budget is None else float(err_budget),
+        "mm_precision": mm_precision,
         "device_kind": str(device_kind),
         "platform": platform or jax.default_backend(),
         "x64": bool(jax.config.jax_enable_x64),
@@ -669,6 +782,15 @@ def record_wisdom(
 
         entry["compression_err"] = wire_roundtrip_error(
             key.get("dtype", "complex64"), winner.wire_dtype)
+    from .ops.executors import executor_roundtrip_error
+
+    prec_err = executor_roundtrip_error(
+        winner.executor, key.get("dtype", "complex64"))
+    if prec_err:
+        # The measured round-trip error of the reduced-precision tier:
+        # replay admission sums it with the wire error against the
+        # plan's single budget (the two reduced-accuracy axes compose).
+        entry["precision_err"] = prec_err
     if times:
         entry["times"] = {
             nm: (None if not math.isfinite(t) else float(t))
@@ -789,7 +911,13 @@ def tuned_plan(kind: str, shape, mesh, options: PlanOptions,
 
     mode = resolve_tune_mode(options.tune)
     shape = tuple(int(s) for s in shape)
-    base = replace(options, tune="off", donate=False)
+    # Candidate executors carry their own (possibly tiered) labels; the
+    # caller's mm tier choice re-enters below as the pinned tier axis,
+    # not as option fields (a field pin would conflict with every
+    # non-matmul candidate's label).
+    base = replace(options, tune="off", donate=False,
+                   executor=options.executor.split(":", 1)[0],
+                   mm_precision=None, mm_complex=None)
     ndev, mesh_dims = _mesh_context(mesh)
     heuristic = replace(options, tune="off")
     if ndev <= 1:
@@ -810,29 +938,48 @@ def tuned_plan(kind: str, shape, mesh, options: PlanOptions,
         kind=kind, shape=shape, dtype=dtype,
         direction=plan_kw.get("direction", -1),
         ndev=ndev, mesh_dims=mesh_dims, layouts=layouts, batch=batch,
-        err_budget=err_budget)
+        err_budget=err_budget, mm_precision=options.mm_precision)
     path = default_wisdom_path()
 
     entry = lookup_wisdom(key, path) if path is not None else None
     if entry is not None:
+        from .ops.executors import (
+            REDUCED_TIERS, executor_roundtrip_error, split_executor,
+        )
+
         _metrics.inc("tune_wisdom_hits", kind=kind)
         wd = entry["winner"].get("wire_dtype")
-        if wd is not None:
-            # A compressed winner replays only into plans whose error
-            # budget admits its recorded round-trip error; anything else
-            # (no budget, tighter budget, missing error record) rebuilds
-            # the winner tuple on the exact wire.
-            rec_err = entry.get("compression_err")
-            if rec_err is None:
-                from .parallel.exchange import wire_roundtrip_error
+        ex = str(entry["winner"]["executor"])
+        tier = split_executor(ex)[1] if ":" in ex else None
+        reduced_tier = tier in REDUCED_TIERS
+        if wd is not None or reduced_tier:
+            # A reduced-accuracy winner — compressed wire, reduced
+            # precision tier, or both — replays only into plans whose
+            # error budget admits the SUM of its recorded errors (one
+            # budget governs both axes); anything else (no budget,
+            # tighter budget, missing error records) rebuilds the winner
+            # tuple exact: exact wire AND the bare executor label.
+            total = 0.0
+            if wd is not None:
+                rec_err = entry.get("compression_err")
+                if rec_err is None:
+                    from .parallel.exchange import wire_roundtrip_error
 
-                rec_err = wire_roundtrip_error(dtype, wd)
-            if err_budget is None or rec_err > err_budget:
+                    rec_err = wire_roundtrip_error(dtype, wd)
+                total += float(rec_err)
+            if reduced_tier:
+                rec_prec = entry.get("precision_err")
+                if rec_prec is None:
+                    rec_prec = executor_roundtrip_error(ex, dtype)
+                total += float(rec_prec)
+            if err_budget is None or total > err_budget:
                 wd = None
+                if reduced_tier:
+                    ex = split_executor(ex)[0]  # the exact bare label
         cand = Candidate(
             decomposition=str(entry["winner"]["decomposition"]),
             algorithm=str(entry["winner"]["algorithm"]),
-            executor=str(entry["winner"]["executor"]),
+            executor=ex,
             overlap_chunks=int(entry["winner"]["overlap_chunks"]),
             wire_dtype=wd,
         )
@@ -849,17 +996,28 @@ def tuned_plan(kind: str, shape, mesh, options: PlanOptions,
     from .parallel.multihost import is_hybrid_mesh
 
     itemsize = np.dtype(dtype).itemsize
-    # On-wire compression enters the search only for plans that declare
-    # an error budget; the hierarchical transport only on hybrid meshes
-    # (and only for the c2c chains — the r2c builders run flat).
+    # Reduced-accuracy axes enter the search only for plans that declare
+    # an error budget — on-wire compression AND the matmul precision
+    # tiers (one budget governs the sum; prune_candidates filters the
+    # combinations it can never admit). An explicit PlanOptions.mm_
+    # precision instead PINS the tier axis: every matmul-family
+    # candidate carries that tier, budget or not (the caller chose the
+    # accuracy; the tournament chooses everything else). The
+    # hierarchical transport enters only on hybrid meshes (and only for
+    # the c2c chains — the r2c builders run flat).
     wire_dtypes: tuple = (None,)
+    mm_tiers: tuple = (None,)
     if err_budget is not None:
         wire_dtypes = (None, "bf16")
+        mm_tiers = (None, "bf16", "f32")
+    if options.mm_precision is not None:
+        mm_tiers = (options.mm_precision,)
     hybrid = kind == "c2c" and is_hybrid_mesh(mesh)
     cands = prune_candidates(
         enumerate_candidates(shape, ndev, mesh_dims=mesh_dims,
                              itemsize=itemsize, batch=batch,
-                             hybrid=hybrid, wire_dtypes=wire_dtypes),
+                             hybrid=hybrid, wire_dtypes=wire_dtypes,
+                             mm_tiers=mm_tiers),
         shape, mesh, itemsize=itemsize, batch=batch,
         max_err=err_budget, dtype=dtype)
     _metrics.set_gauge("tune_candidates", len(cands), kind=kind,

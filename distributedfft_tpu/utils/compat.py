@@ -3,19 +3,74 @@
 The shard_map varying-axes discipline (every operand of a collective or a
 pallas_call must carry the right varying-across-mesh-axes set) is spelled
 ``lax.pcast(..., to="varying")`` from JAX 0.9; older releases spell it
-``lax.pvary``. One shim here so call sites stay warning-free on both.
+``lax.pvary``, and releases before the vma discipline existed (<= 0.4.x)
+spell it not at all — there ``jax.typeof`` is missing too, every
+varying-set reads as empty, and the marking is a no-op. Same story for
+``jax.ShapeDtypeStruct(..., vma=)`` and the Pallas TPU compiler-params
+rename (``TPUCompilerParams`` -> ``CompilerParams``). One shim each here
+so call sites stay warning-free — and importable — on every supported
+release.
 """
 
 from __future__ import annotations
 
+import jax
 from jax import lax
 
 
 def pvary(x, axes: tuple):
-    """Mark replicated ``x`` as varying over mesh ``axes``."""
+    """Mark replicated ``x`` as varying over mesh ``axes`` (identity on
+    releases without the vma discipline — nothing to mark there)."""
     if hasattr(lax, "pcast"):
         return lax.pcast(x, tuple(axes), to="varying")
-    return lax.pvary(x, tuple(axes))  # pragma: no cover — jax < 0.9
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, tuple(axes))
+    return x  # pre-vma jax: varying sets do not exist
+
+
+def typeof_vma(x) -> frozenset:
+    """Varying-across-mesh-axes set of a traced value — empty outside
+    shard_map. On releases without ``jax.typeof``/the vma discipline the
+    tracing axis environment stands in: every mesh axis in scope (the
+    consumers use the set to gate interpret-mode mirrors and to mark
+    operands varying — :func:`pvary` is the identity there, and
+    :func:`shape_dtype_struct` drops the declaration, so the coarser set
+    is safe)."""
+    try:
+        return frozenset(jax.typeof(x).vma)
+    except (AttributeError, TypeError):
+        pass
+    try:  # pre-vma jax: the axis env knows whether shard_map is tracing
+        from jax._src.core import unsafe_get_axis_names
+
+        return frozenset(unsafe_get_axis_names())
+    except Exception:  # noqa: BLE001 — chipless/newer internals moved on
+        return frozenset()
+
+
+def shape_dtype_struct(shape, dtype, vma: frozenset = frozenset()):
+    """``jax.ShapeDtypeStruct`` with the varying set where the release
+    supports declaring one (pallas_call out_shape under shard_map);
+    silently without it elsewhere — matching :func:`typeof_vma`, which
+    reads every set as empty there."""
+    if vma:
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        except TypeError:  # pre-vma jax
+            pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def tpu_compiler_params(**kw):
+    """The Pallas TPU compiler-params object under its current name
+    (``pltpu.CompilerParams``; ``TPUCompilerParams`` before the
+    rename)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kw)
 
 
 def force_real_lowering() -> bool:

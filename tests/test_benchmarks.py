@@ -146,20 +146,23 @@ def test_bench_executor_menu(tmp_path):
     assert secs > 0 and err < 1e-3 and plan.decomposition == "slab"
     with pytest.raises(ValueError):
         bench.bench_executor((16, 16, 16), mesh, jnp.complex64, "nope")
-    # Precision-suffixed candidates plan the base executor under that
-    # DFFT_MM_PRECISION tier and restore the env afterwards.
+    # Precision-suffixed candidates now plan the TIERED executor label
+    # (plan-scoped precision — ops/executors.py tier grammar; the lax
+    # spelling canonicalizes) and never touch the env knobs.
     before = os.environ.get("DFFT_MM_PRECISION")
     secs, err, plan = bench.bench_executor((16, 16, 16), mesh,
                                            jnp.complex64, "matmul:high")
-    assert secs > 0 and err < 1e-3 and plan.executor == "matmul"
+    assert secs > 0 and err < 1e-3 and plan.executor == "matmul:f32"
+    assert plan.options.mm_precision == "f32"
     assert os.environ.get("DFFT_MM_PRECISION") == before
     # Multi-suffix candidates (tier + complex-product mode) compose;
-    # both env knobs are restored afterwards.
+    # the env knobs stay untouched (no mutation to restore).
     before_cm = os.environ.get("DFFT_MM_COMPLEX")
     secs, err, plan = bench.bench_executor((16, 16, 16), mesh,
                                            jnp.complex64,
                                            "matmul:high:gauss")
-    assert secs > 0 and err < 1e-3 and plan.executor == "matmul"
+    assert secs > 0 and err < 1e-3 and plan.executor == "matmul:f32:gauss"
+    assert plan.options.mm_complex == "gauss"
     assert os.environ.get("DFFT_MM_PRECISION") == before
     assert os.environ.get("DFFT_MM_COMPLEX") == before_cm
     with pytest.raises(ValueError, match="suffix"):

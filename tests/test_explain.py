@@ -506,18 +506,23 @@ def test_report_explain_trend_tabulates_history():
 # ----------------------------------------------- collection-order guard
 
 def test_poison_ordering_guard():
-    """The XLA:CPU fft-thunk poisoning rule from PRs 3-5: the files
-    that execute 8-device plans with a clean-backend requirement must
-    collect BEFORE ``test_alltoallv.py`` (alphabetical collection). A
+    """The XLA:CPU fft-thunk poisoning rule from PRs 3-5, derived from
+    the filename convention instead of a hand-extended list: every
+    clean-backend-tier file (``test_a2*.py`` — ``conftest.
+    clean_backend_files``) must collect BEFORE ``test_alltoallv.py``
+    under alphabetical collection, and the tier must be non-empty. A
     rename that silently broke this would resurface as hundreds of
-    mysterious tier-1 failures, so the names themselves are pinned."""
+    mysterious tier-1 failures; conftest additionally enforces the same
+    rule on the live collection order of every run
+    (``_check_poison_collection_order``)."""
+    import conftest
+
     names = sorted(n for n in os.listdir(TESTS)
                    if n.startswith("test_") and n.endswith(".py"))
-    poison = names.index("test_alltoallv.py")
-    for early in ("test_a2a_overlap.py", "test_a2c_tuner.py",
-                  "test_a2d_explain.py", "test_a2e_batch.py",
-                  "test_a2f_flightrec.py", "test_a2g_wire.py",
-                  "test_a2h_operators.py", "test_a2i_faults.py"):
+    poison = names.index(conftest.POISON_FILE)
+    tier = conftest.clean_backend_files()
+    assert len(tier) >= 8, tier  # the PR 3-11 clean-backend files
+    for early in tier:
         assert early in names, early
         assert names.index(early) < poison, (
-            f"{early} must collect before test_alltoallv.py")
+            f"{early} must collect before {conftest.POISON_FILE}")
