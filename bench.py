@@ -348,9 +348,10 @@ def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
         out["tuned"] = tuned
     if wire_dtype is not None:
         # On-wire compressed run (DFFT_WIRE_DTYPE resolved at plan time):
-        # part of the baseline group — a bf16-wire run ships half the t2
-        # bytes and must never be judged against exact-wire baselines or
-        # vice versa. Exact rows keep the old schema.
+        # part of the baseline group — a compressed run ships a fraction
+        # of the t2 bytes (bf16 half, int8 ~quarter) and must never be
+        # judged against exact-wire baselines, or codecs against each
+        # other. Exact rows keep the old schema.
         out["wire_dtype"] = wire_dtype
     if precision is not None:
         # Reduced/explicit matmul precision tier (PlanOptions.mm_

@@ -100,16 +100,19 @@ done
 step dd_512 1200 python benchmarks/speed3d.py c2c dd 512 512 512 -iters 3 \
     -csv benchmarks/csv/dd_tier_tpu.csv
 
-# -- 5b2. wire-compression sweep: exact vs bf16 t2 wire on the flagship
-#         shape, -staged so per-stage t2 rows land for both wire modes
-#         (CSV algorithm column 'alltoall' vs 'alltoall+wbf16' — the
-#         regress store never mixes their baselines). On a single-chip
-#         slice there is no t2 to compress; the rows still record so the
-#         sweep is a no-op there, not a failure.
+# -- 5b2. wire-codec sweep: exact vs bf16 vs block-scaled int8 t2 wire
+#         on the flagship shape, -staged so per-stage t2 rows land for
+#         every wire mode (CSV algorithm column 'alltoall' vs
+#         'alltoall+wbf16' vs 'alltoall+wint8' — the regress store never
+#         mixes their baselines). On a single-chip slice there is no t2
+#         to compress; the rows still record so the sweep is a no-op
+#         there, not a failure.
 step wire_exact 900 python benchmarks/speed3d.py c2c single 512 512 512 \
     -wire none -staged -iters 3 -csv benchmarks/csv/wire_sweep_tpu.csv
 step wire_bf16 900 python benchmarks/speed3d.py c2c single 512 512 512 \
     -wire bf16 -staged -iters 3 -csv benchmarks/csv/wire_sweep_tpu.csv
+step wire_int8 900 python benchmarks/speed3d.py c2c single 512 512 512 \
+    -wire int8 -staged -iters 3 -csv benchmarks/csv/wire_sweep_tpu.csv
 
 # -- 5b. big-grid single-chip rows: 768^3 c64 (3.6 GB in+out — the largest
 #        cubic c64 grid one 16 GB chip holds; 1024^3 needs r2c or a donated

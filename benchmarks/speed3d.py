@@ -108,15 +108,20 @@ def parse_args(argv):
                         "and stamped into the CSV row ('+tuned' algorithm "
                         "suffix), so tuned sweeps never mix with untuned "
                         "baselines")
-    p.add_argument("-wire", default=None, choices=("bf16", "none"),
+    p.add_argument("-wire", default=None,
+                   choices=("bf16", "int8", "none"),
                    metavar="DTYPE",
-                   help="on-wire exchange compression: 'bf16' casts the "
-                        "t2 payload to (real, imag) bfloat16 pairs around "
-                        "each collective (half the wire bytes for c64), "
-                        "'none' pins the exact wire (overriding "
+                   help="on-wire exchange compression codec: 'bf16' "
+                        "casts the t2 payload to (real, imag) bfloat16 "
+                        "pairs around each collective (half the wire "
+                        "bytes for c64), 'int8' block-scales the "
+                        "component planes to int8 with an f32 scale "
+                        "sidecar (~quarter the c64 wire bytes), 'none' "
+                        "pins the exact wire (overriding "
                         "DFFT_WIRE_DTYPE). Stamped into the CSV "
-                        "algorithm column '<alg>+wbf16' so compressed "
-                        "sweep rows never mix with exact baselines")
+                        "algorithm column '<alg>+wbf16'/'+wint8' so "
+                        "compressed sweep rows never mix with exact "
+                        "baselines")
     p.add_argument("-r2c_axis", type=int, default=2, choices=(0, 1, 2),
                    help="halved axis for r2c/c2r (heFFTe r2c_direction)")
     p.add_argument("-ndev", type=int, default=None, help="device count (default: all)")

@@ -543,11 +543,13 @@ def plan_dft_c2c_3d(
     (byte-identical HLO). Batched plans are plan-cache- and wisdom-keyed
     by B; ``in_spec``/``out_spec`` layouts take the unbatched path only.
 
-    ``wire_dtype="bf16"`` compresses the t2 exchange payload on the wire
-    (bf16 component pairs cast immediately before each collective and
-    back after — half the wire bytes for c64 at a bounded precision
-    cost; ``None`` defers to ``DFFT_WIRE_DTYPE``, unset = exact wire,
-    byte-identical HLO). ``algorithm="hierarchical"`` runs the two-leg
+    ``wire_dtype`` compresses the t2 exchange payload on the wire with
+    a registered codec (``"bf16"``: component pairs, half the c64 wire
+    bytes; ``"int8"``: block-scaled component planes with a tiny f32
+    scale sidecar, ~quarter the c64 wire bytes — each at a bounded,
+    measured precision cost; ``None`` defers to ``DFFT_WIRE_DTYPE``,
+    unset = exact wire, byte-identical HLO). ``algorithm="hierarchical"``
+    runs the two-leg
     ICI/DCN transport over a hybrid 2D (dcn x ici) mesh
     (:func:`~.parallel.exchange.hierarchical_all_to_all`).
     ``max_roundtrip_err`` declares the plan's error budget — the gate
@@ -1760,7 +1762,8 @@ def _plan_exchange_bytes(plan: Plan3D) -> tuple[int, int]:
         for e in exchange_payloads(lp, shape_eff, itemsize):
             true_b += e["true_bytes"]
             # wire_factor scales for on-wire compression (bf16 pairs
-            # halve c64 wire bytes); 1.0 on the exact wire.
+            # halve c64 wire bytes, int8 block-scaled pairs quarter
+            # them, sidecar included); 1.0 on the exact wire.
             wire_b += int(e[wire_key] * e.get("wire_factor", 1.0))
     if plan.brick_edges is not None:
         itemsize = np.dtype(plan.dtype).itemsize

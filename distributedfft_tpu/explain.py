@@ -1025,14 +1025,18 @@ def format_explain(record: dict) -> str:
             f"{_fmt(st.get('ici_utilization'), '%'):>7}  {note}")
         for leg in st.get("legs") or []:
             # Per-leg exchange rows (pencil t2a/t2b; hierarchical
-            # ICI/DCN): indented under the t2 summary row.
+            # ICI/DCN): indented under the t2 summary row. A
+            # leg-pipelined row is one the K-chunk schedule hides under
+            # the other leg's transfer (hierarchical K > 1).
             lines.append(
                 f"  {leg.get('stage', '?'):<4} "
                 f"{_fmt(leg.get('seconds'), 's'):>11} "
                 f"{_fmt(leg.get('measured_seconds'), 's'):>12} "
                 f"{'':>11} {'':>12} {'':>7} {'':>7}  "
                 f"[{leg.get('link', '?')} axis {leg.get('mesh_axis')}, "
-                f"{leg.get('parts')} parts]")
+                f"{leg.get('parts')} parts"
+                + (", pipelined" if leg.get("leg_pipelined") else "")
+                + "]")
     tot = record.get("totals") or {}
     lines.append(
         f"totals: model {_fmt(tot.get('model_seconds'), 's')} s | "

@@ -40,10 +40,27 @@ issued before chunk ``i-1``'s downstream FFT — the TPU-native analog of
 the reference's ``MPI_Waitany``-ordered overlap loop
 (``3dmpifft_opt/include/fft_mpi_3d_api.cpp:610-699``, heFFTe's pipelined
 p2p ``src/heffte_reshape3d.cpp:497-625``), with XLA's async collectives
-(start/done pairs) playing the Isend/Irecv role.
+(start/done pairs) playing the Isend/Irecv role. K-chunked hierarchical
+exchanges go one level deeper (:func:`_hierarchical_pipelined`): chunk
+``i``'s intra-slice ICI leg is issued while chunk ``i-1``'s inter-slice
+DCN leg and downstream FFT run — a two-deep pipeline, bit-identical to
+the monolithic two-leg exchange.
+
+Orthogonal to both, the **wire-codec registry** (:data:`WIRE_CODECS`)
+compresses any transport's payload on the wire: each codec declares its
+per-complex-element ``pair_bytes``, its encode/decode callables
+(multi-part wire forms — payload plus a per-tile scale sidecar — ride
+the same collective stage), and is measured by
+:func:`wire_roundtrip_error` the same seeded/cached way. Registered:
+``bf16`` (component pairs, half the c64 wire bytes) and ``int8``
+(per-tile block-scaled planes + f32 power-of-two-step sidecar, ~quarter
+the c64 wire bytes).
 """
 
 from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
 
 import numpy as np
 
@@ -73,17 +90,34 @@ WIRE_BYTE_KEYS = {
     "hierarchical": "alltoall_bytes",
 }
 
-#: Bytes one complex element occupies on the wire under each compression
-#: mode: bf16 ships a (real, imag) bfloat16 pair — 4 bytes regardless of
-#: the payload's complex width (half of c64, quarter of c128).
-WIRE_DTYPES = (None, "bf16")
-_WIRE_PAIR_BYTES = {"bf16": 4}
+#: Registered on-wire codec names, ``None`` (exact) first — the public
+#: wire-mode menu every validation error prints. Rebuilt by
+#: :func:`register_wire_codec`; ``_WIRE_PAIR_BYTES`` mirrors each
+#: codec's per-complex-element wire bytes for the byte accounting.
+WIRE_DTYPES = (None,)
+_WIRE_PAIR_BYTES: dict = {}
+
+
+def wire_codec(name: str) -> "WireCodec":
+    """The registered :class:`WireCodec` for ``name``; raises with the
+    full codec menu for anything unregistered (the plan-time failure
+    mode of an unknown ``wire_dtype`` string)."""
+    try:
+        return WIRE_CODECS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown wire_dtype {name!r}; use one of {WIRE_DTYPES}"
+        ) from None
 
 
 def wire_itemsize(itemsize: int, wire_dtype: str | None) -> int:
     """Per-element bytes actually on the wire for a payload of
     ``itemsize``-byte complex elements under ``wire_dtype`` compression
-    (``None`` = the payload travels as-is)."""
+    (``None`` = the payload travels as-is). Codecs shipping a per-tile
+    scale sidecar (``int8``) declare their ``pair_bytes`` with the
+    sidecar included — the sidecar is O(tiles) f32 values against an
+    O(volume) payload, so the declared figure is the accounting truth
+    the model, the counters, and the docs table all share."""
     if wire_dtype is None:
         return int(itemsize)
     try:
@@ -148,44 +182,189 @@ def exchange_model_seconds(
     return {"seconds": t_ex, "exposed_seconds": exposed, "steps": steps}
 
 
-# ------------------------------------------------------ wire compression
+# ----------------------------------------------- wire codecs (registry)
 
-def wire_encode(x: jnp.ndarray, wire_dtype: str) -> jnp.ndarray:
-    """Cast a complex payload to its on-wire representation immediately
-    before the collective: ``"bf16"`` stacks (real, imag) as a trailing
-    bfloat16 pair — half the wire bytes of c64 at ~2^-9 relative
-    rounding per component. The trailing wire dim is a bystander of
-    every transport (split/concat/chunk axes keep their indices)."""
-    if wire_dtype != "bf16":
-        raise ValueError(
-            f"unknown wire_dtype {wire_dtype!r}; use one of {WIRE_DTYPES}")
+def _check_complex(x) -> None:
     if not jnp.issubdtype(x.dtype, jnp.complexfloating):
         raise TypeError(
             f"wire compression applies to complex exchange payloads, "
             f"got {x.dtype}")
-    return jnp.stack([x.real, x.imag], axis=-1).astype(jnp.bfloat16)
 
 
-def wire_decode(y: jnp.ndarray, dtype) -> jnp.ndarray:
-    """Inverse of :func:`wire_encode`: trailing (real, imag) wire pair
-    back to the complex payload dtype, immediately after the
-    collective."""
-    rdt = jnp.float64 if jnp.dtype(dtype) == jnp.complex128 else jnp.float32
+def _component_dtype(dtype):
+    return (jnp.float64 if jnp.dtype(dtype) == jnp.complex128
+            else jnp.float32)
+
+
+def _bf16_encode(x: jnp.ndarray, *, tile_axis: int = 0,
+                 tiles: int = 1) -> tuple:
+    """bf16 wire form: (real, imag) stacked as a trailing bfloat16 pair
+    — half the wire bytes of c64 at ~2^-9 relative rounding per
+    component. Elementwise (``tile_axis``/``tiles`` unused): the
+    trailing wire dim is a bystander of every transport."""
+    _check_complex(x)
+    return (jnp.stack([x.real, x.imag], axis=-1).astype(jnp.bfloat16),)
+
+
+def _bf16_decode(parts, dtype, *, tile_axis: int = 0,
+                 tiles: int = 1) -> jnp.ndarray:
+    (y,) = parts
+    rdt = _component_dtype(dtype)
     r = y[..., 0].astype(rdt)
     i = y[..., 1].astype(rdt)
     return lax.complex(r, i).astype(dtype)
+
+
+def _pow2_step(amax: jnp.ndarray) -> jnp.ndarray:
+    """Power-of-two quantization step covering ``amax`` in 127 signed
+    levels. Power-of-two steps make every decode product ``q * step``
+    exact in float32 and the encode/decode pair exactly idempotent —
+    the property the staged per-leg wire boundaries (decode at one
+    stage's exit, re-encode at the next stage's entry) rely on for
+    bit-parity with the fused single-cast chain."""
+    return jnp.where(
+        amax > 0.0, jnp.exp2(jnp.ceil(jnp.log2(amax / 127.0))),
+        jnp.float32(1.0)).astype(jnp.float32)
+
+
+def _int8_encode(x: jnp.ndarray, *, tile_axis: int = 0,
+                 tiles: int = 1) -> tuple:
+    """int8 wire form: per-block symmetric quantization of the (real,
+    imag) planes along the exchange tile axis — one power-of-two step
+    per (peer tile, component plane), the steps riding as a tiny f32
+    sidecar part through the same collective stage. ~quarter the c64
+    wire bytes (the sidecar is O(tiles) values against an O(volume)
+    payload).
+
+    Returns ``(q, scales)``: ``q`` int8 of shape ``x.shape + (2,)``
+    (trailing component-plane axis, a transport bystander) and
+    ``scales`` f32 with extent ``tiles`` on ``tile_axis``, 1 on every
+    other payload axis, and the trailing plane pair — exactly the shape
+    that makes the sidecar route through any tiled transport with the
+    same (split, concat) semantics as the payload, one scale slot per
+    peer tile."""
+    _check_complex(x)
+    planes = jnp.stack([x.real, x.imag], axis=-1).astype(jnp.float32)
+    t = tile_axis
+    p = max(1, int(tiles))
+    S = planes.shape[t]
+    c = -(-S // p)
+    padded = _pad_axis(planes, t, p * c)
+    shp = padded.shape
+    view = padded.reshape(shp[:t] + (p, c) + shp[t + 1:])
+    red = tuple(a for a in range(view.ndim)
+                if a != t and a != view.ndim - 1)
+    amax = jnp.max(jnp.abs(view), axis=red, keepdims=True)
+    bshape = [1] * planes.ndim
+    bshape[t] = p
+    bshape[-1] = 2
+    scales = _pow2_step(amax).reshape(bshape)
+    per_row = lax.slice_in_dim(jnp.repeat(scales, c, axis=t), 0, S, axis=t)
+    q = jnp.clip(jnp.round(planes / per_row), -127.0, 127.0).astype(
+        jnp.int8)
+    return (q, scales)
+
+
+def _int8_decode(parts, dtype, *, tile_axis: int = 0,
+                 tiles: int = 1) -> jnp.ndarray:
+    """Inverse of :func:`_int8_encode`, with ``tile_axis`` naming the
+    axis the peer tiles sit on NOW — the split axis before an exchange,
+    the concat axis after (the collective moves tile blocks and sidecar
+    slots identically, so alignment is positional)."""
+    q, scales = parts
+    t = tile_axis
+    p = max(1, int(tiles))
+    S = q.shape[t]
+    c = -(-S // p)
+    per_row = lax.slice_in_dim(jnp.repeat(scales, c, axis=t), 0, S, axis=t)
+    vals = q.astype(jnp.float32) * per_row  # exact: |q| <= 127, pow2 step
+    rdt = _component_dtype(dtype)
+    r = vals[..., 0].astype(rdt)
+    i = vals[..., 1].astype(rdt)
+    return lax.complex(r, i).astype(dtype)
+
+
+@dataclass(frozen=True)
+class WireCodec:
+    """One pluggable on-wire compression codec of the t2 exchange.
+
+    ``pair_bytes`` is the wire bytes per complex element (sidecar
+    included for codecs that ship one — see :func:`wire_itemsize`);
+    ``encode(x, tile_axis=, tiles=)`` returns the tuple of wire parts
+    that ride the collective (payload first, any sidecar after), every
+    part shaped so the SAME (split, concat, axis_size) tiled-transport
+    semantics route it; ``decode(parts, dtype, tile_axis=, tiles=)``
+    restores the complex payload, with ``tile_axis`` naming where the
+    peer tiles sit at decode time. ``sidecar`` flags a multi-part wire
+    (the legacy single-array :func:`wire_encode` API rejects those)."""
+
+    name: str
+    pair_bytes: int
+    encode: Any
+    decode: Any
+    sidecar: bool = False
+
+
+#: The codec registry — one entry per ``wire_dtype`` string. Extend via
+#: :func:`register_wire_codec`; every registered codec must carry a
+#: ``pair_bytes`` figure, a measured-error path (it gets one for free
+#: through :func:`wire_roundtrip_error`), and a docs/TUNING.md table row
+#: (the registry-completeness test holds all three).
+WIRE_CODECS: dict[str, WireCodec] = {}
+
+
+def register_wire_codec(codec: WireCodec) -> WireCodec:
+    """Register a codec and rebuild the public menu/byte tables."""
+    global WIRE_DTYPES
+    WIRE_CODECS[codec.name] = codec
+    _WIRE_PAIR_BYTES[codec.name] = int(codec.pair_bytes)
+    WIRE_DTYPES = (None,) + tuple(WIRE_CODECS)
+    return codec
+
+
+register_wire_codec(WireCodec(
+    name="bf16", pair_bytes=4, encode=_bf16_encode, decode=_bf16_decode))
+register_wire_codec(WireCodec(
+    name="int8", pair_bytes=2, encode=_int8_encode, decode=_int8_decode,
+    sidecar=True))
+
+
+def wire_encode(x: jnp.ndarray, wire_dtype: str) -> jnp.ndarray:
+    """Legacy single-array encode of a sidecar-free codec (``bf16``):
+    the codec's one wire part. Codecs shipping a sidecar (``int8``)
+    need the tile geometry and the multi-part form — use
+    ``wire_codec(name).encode`` directly."""
+    codec = wire_codec(wire_dtype)
+    if codec.sidecar:
+        raise ValueError(
+            f"wire codec {wire_dtype!r} ships a multi-part payload "
+            f"(scale sidecar); use wire_codec({wire_dtype!r}).encode")
+    return codec.encode(x)[0]
+
+
+def wire_decode(y: jnp.ndarray, dtype,
+                wire_dtype: str = "bf16") -> jnp.ndarray:
+    """Inverse of :func:`wire_encode` (single-part codecs only)."""
+    codec = wire_codec(wire_dtype)
+    if codec.sidecar:
+        raise ValueError(
+            f"wire codec {wire_dtype!r} ships a multi-part payload "
+            f"(scale sidecar); use wire_codec({wire_dtype!r}).decode")
+    return codec.decode((y,), dtype)
 
 
 def wire_roundtrip_error(dtype, wire_dtype: str | None = "bf16",
                          n: int = 4096) -> float:
     """Measured relative round-trip error of one wire cast
     (``max |decode(encode(x)) - x| / max |x|`` over a seeded
-    standard-normal complex block) — the number the tuner's error-budget
-    filter and ``explain``'s ``wire.compression_err`` field report.
-    Deterministic (fixed seed) and cached per (dtype, wire_dtype), so
+    standard-normal complex block, tiled the way an 8-way exchange
+    would tile it) — the number the tuner's error-budget filter and
+    ``explain``'s ``wire.compression_err`` field report. Every
+    registered codec is measured the same seeded/cached way, so
     per-candidate pruning never re-measures. 0.0 for the exact wire."""
     if wire_dtype is None:
         return 0.0
+    codec = wire_codec(wire_dtype)
     key = (str(np.dtype(dtype)), wire_dtype, int(n))
     hit = _WIRE_ERR_CACHE.get(key)
     if hit is not None:
@@ -193,8 +372,9 @@ def wire_roundtrip_error(dtype, wire_dtype: str | None = "bf16",
     rng = np.random.default_rng(0)
     x = (rng.standard_normal(n) + 1j * rng.standard_normal(n)).astype(
         np.dtype(dtype))
-    y = np.asarray(wire_decode(wire_encode(jnp.asarray(x), wire_dtype),
-                               dtype))
+    tiles = 8
+    parts = codec.encode(jnp.asarray(x), tile_axis=0, tiles=tiles)
+    y = np.asarray(codec.decode(parts, dtype, tile_axis=0, tiles=tiles))
     err = float(np.max(np.abs(y - x)) / np.max(np.abs(x)))
     _WIRE_ERR_CACHE[key] = err
     return err
@@ -254,11 +434,15 @@ def exchange(
     byte-identical to the pre-compression HLO.
     """
     if wire_dtype is not None:
-        w = wire_encode(x, wire_dtype)
-        y = exchange(w, axis_name, split_axis=split_axis,
+        codec = wire_codec(wire_dtype)
+        parts = codec.encode(x, tile_axis=split_axis, tiles=axis_size)
+        outs = tuple(
+            exchange(w, axis_name, split_axis=split_axis,
                      concat_axis=concat_axis, axis_size=axis_size,
                      algorithm=algorithm, axis_sizes=axis_sizes)
-        return wire_decode(y, x.dtype)
+            for w in parts)
+        return codec.decode(outs, x.dtype, tile_axis=concat_axis,
+                            tiles=axis_size)
     if algorithm == "alltoall":
         return lax.all_to_all(
             x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=True
@@ -305,14 +489,22 @@ def exchange_uneven(
     exchange (both hierarchical legs ride one encoded payload) in the
     on-wire cast pair; ``axis_sizes`` as in :func:`exchange`.
     """
-    if wire_dtype is not None:
-        w = wire_encode(x, wire_dtype)
-        y = exchange_uneven(w, axis_name, split_axis=split_axis,
-                            concat_axis=concat_axis, axis_size=axis_size,
-                            algorithm=algorithm, platform=platform,
-                            axis_sizes=axis_sizes)
-        return wire_decode(y, x.dtype)
     if algorithm == "alltoallv":
+        if wire_dtype is not None:
+            # The ragged transport takes the unpadded split axis: encode
+            # on it directly (the codec's ceil-tile blocks match the
+            # ragged ownership tables) and ship every wire part — the
+            # int8 sidecar's split extent is axis_size, always even.
+            codec = wire_codec(wire_dtype)
+            parts = codec.encode(x, tile_axis=split_axis, tiles=axis_size)
+            outs = tuple(
+                ragged_all_to_all_exchange(
+                    w, axis_name, split_axis=split_axis,
+                    concat_axis=concat_axis, p=axis_size,
+                    platform=platform)
+                for w in parts)
+            return codec.decode(outs, x.dtype, tile_axis=concat_axis,
+                                tiles=axis_size)
         return ragged_all_to_all_exchange(
             x, axis_name, split_axis=split_axis, concat_axis=concat_axis,
             p=axis_size, platform=platform,
@@ -320,7 +512,8 @@ def exchange_uneven(
     x = _pad_axis(x, split_axis, pad_to(x.shape[split_axis], axis_size))
     return exchange(x, axis_name, split_axis=split_axis,
                     concat_axis=concat_axis, axis_size=axis_size,
-                    algorithm=algorithm, axis_sizes=axis_sizes)
+                    algorithm=algorithm, axis_sizes=axis_sizes,
+                    wire_dtype=wire_dtype)
 
 
 # ----------------------------------------------- hierarchical (ICI/DCN)
@@ -579,6 +772,94 @@ def ring_all_to_all(
 
 # --------------------------------------------------- pipelined t2/t3 overlap
 
+def _hierarchical_pipelined(
+    x,
+    axis_name,
+    *,
+    split_axis: int,
+    concat_axis: int,
+    axis_size: int,
+    axis_sizes: tuple[int, int],
+    wire_dtype: str | None,
+    bounds: list[tuple[int, int]],
+    chunk_axis: int,
+    compute=None,
+    compute_name: str = "t3_fft",
+    compute_takes_bounds: bool = False,
+):
+    """Leg-level pipelined hierarchical exchange over K > 1 chunks: a
+    two-deep software pipeline in which chunk ``i``'s intra-slice ICI
+    all-to-all is issued while chunk ``i-1``'s inter-slice DCN
+    all-to-all and downstream ``compute`` run — so the cheap fast-fabric
+    leg hides under the slow-fabric leg plus the t3 FFT of the previous
+    chunk, instead of the two legs of every chunk serializing in flat
+    chunk order.
+
+    Per chunk the math is exactly ``pad -> encode -> leg_ici -> leg_dcn
+    -> decode`` — the same ops :func:`hierarchical_all_to_all` fuses
+    (its legs compose bit-identically), so the pipelined schedule is
+    bit-identical to the monolithic hierarchical exchange at every K;
+    only the issue order changes. Each leg carries a per-chunk span
+    (``t2a_exchange_<ici>[k]`` / ``t2b_exchange_<dcn>[k]``, both
+    normalizing to the ``t2`` stage key) so the staged view shows the
+    interleave. ``compute=None`` is the staged tier: exchange-only,
+    chunks concatenated back."""
+    tree = jax.tree_util
+    dcn_name, ici_name, _, _ = _hier_names_sizes(axis_name, axis_sizes)
+    leg_ici, leg_dcn = hierarchical_legs(
+        axis_name, split_axis=split_axis, concat_axis=concat_axis,
+        axis_sizes=axis_sizes)
+    codec = wire_codec(wire_dtype) if wire_dtype is not None else None
+    a_name = f"t2a_exchange_{_axis_label(ici_name)}"
+    b_name = f"t2b_exchange_{_axis_label(dcn_name)}"
+    leaves, treedef = tree.tree_flatten(x)
+    dtypes = [u.dtype for u in leaves]
+
+    def take(lo, hi):
+        return [lax.slice_in_dim(u, lo, hi, axis=chunk_axis)
+                for u in leaves]
+
+    def leg_a(k, chunk_leaves):
+        with add_trace(f"{a_name}[{k}]"):
+            out = []
+            for u in chunk_leaves:
+                u = _pad_axis(u, split_axis,
+                              pad_to(u.shape[split_axis], axis_size))
+                parts = (codec.encode(u, tile_axis=split_axis,
+                                      tiles=axis_size)
+                         if codec else (u,))
+                out.append(tuple(leg_ici(w) for w in parts))
+            return out
+
+    def leg_b(k, enc_leaves):
+        with add_trace(f"{b_name}[{k}]"):
+            out = []
+            for parts, dt in zip(enc_leaves, dtypes):
+                done = tuple(leg_dcn(w) for w in parts)
+                out.append(codec.decode(done, dt, tile_axis=concat_axis,
+                                        tiles=axis_size)
+                           if codec else done[0])
+            return tree.tree_unflatten(treedef, out)
+
+    def run_chunk(k, y):
+        if compute is None:
+            return y
+        with add_trace(f"{compute_name}[{k}]"):
+            return (compute(y, *bounds[k]) if compute_takes_bounds
+                    else compute(y))
+
+    parts_out = []
+    inflight = leg_a(0, take(*bounds[0]))
+    for k in range(1, len(bounds)):
+        nxt = leg_a(k, take(*bounds[k]))  # chunk k's ICI leg issues
+        parts_out.append(run_chunk(k - 1, leg_b(k - 1, inflight)))
+        inflight = nxt                    # ... before chunk k-1's DCN+t3
+    last = len(bounds) - 1
+    parts_out.append(run_chunk(last, leg_b(last, inflight)))
+    return tree.tree_map(
+        lambda *ps: jnp.concatenate(ps, axis=chunk_axis), *parts_out)
+
+
 def overlap_chunk_bounds(extent: int, k: int) -> list[tuple[int, int]]:
     """Static (start, stop) bounds of the overlap chunks along the
     bystander axis: balanced splits (``numpy.array_split`` semantics —
@@ -641,6 +922,11 @@ def exchange_overlapped(
     monolithic exchange + compute with today's HLO and the original
     un-suffixed trace spans; K > 1 emits ``{exchange_name}[k]`` /
     ``{compute_name}[k]`` spans so the PR 1 timeline shows the interleave.
+    The hierarchical transport at K > 1 pipelines one level deeper
+    (:func:`_hierarchical_pipelined`): chunk ``i``'s ICI leg is issued
+    while chunk ``i-1``'s DCN leg and compute run, with per-leg
+    ``t2a[k]``/``t2b[k]`` spans — bit-identical to the fused two-leg
+    exchange per chunk.
 
     ``compute_takes_bounds=True`` calls ``compute(chunk, lo, hi)`` with
     the chunk's static (start, stop) bounds along ``chunk_axis`` — the
@@ -665,6 +951,17 @@ def exchange_overlapped(
         with add_trace(compute_name):
             return (compute(y, 0, extent) if compute_takes_bounds
                     else compute(y))
+    if algorithm == "hierarchical":
+        # Leg-level two-deep pipeline: chunk i's ICI leg issues while
+        # chunk i-1's DCN leg and downstream compute run — bit-identical
+        # to the per-chunk fused hierarchical exchange, with per-leg
+        # per-chunk spans replacing the flat chunk order.
+        return _hierarchical_pipelined(
+            x, axis_name, split_axis=split_axis, concat_axis=concat_axis,
+            axis_size=axis_size, axis_sizes=axis_sizes,
+            wire_dtype=wire_dtype, bounds=bounds, chunk_axis=chunk_axis,
+            compute=compute, compute_name=compute_name,
+            compute_takes_bounds=compute_takes_bounds)
 
     def take(lo, hi):
         return tree.tree_map(
@@ -736,6 +1033,16 @@ def exchange_chunked(
         one = lambda u: exchange(u, axis_name, **kw)
     if len(bounds) <= 1:
         return tree.tree_map(one, x)
+    if algorithm == "hierarchical":
+        # The staged tier of the leg-level pipeline: K per-leg chunked
+        # collectives inside ONE stage jit, issued in the same two-deep
+        # order as the fused chain (chunk i's ICI leg before chunk
+        # i-1's DCN leg) with the same t2a[k]/t2b[k] spans — replacing
+        # the old flat-order per-chunk fallback.
+        return _hierarchical_pipelined(
+            x, axis_name, split_axis=split_axis, concat_axis=concat_axis,
+            axis_size=axis_size, axis_sizes=axis_sizes,
+            wire_dtype=wire_dtype, bounds=bounds, chunk_axis=chunk_axis)
     parts = []
     for i, (lo, hi) in enumerate(bounds):
         chunk = tree.tree_map(

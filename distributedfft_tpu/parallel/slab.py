@@ -53,7 +53,7 @@ from ..utils.trace import add_trace, trace_stages
 # the ragged path) and are re-exported here for the other chain builders.
 from .exchange import (
     _axis_label, _crop_axis, _pad_axis, exchange_chunked,
-    exchange_overlapped, hierarchical_legs, wire_decode, wire_encode,
+    exchange_overlapped, hierarchical_legs, wire_codec,
 )
 
 _L = "xyz"  # axis index -> stage-name letter (t0_fft_yz taxonomy)
@@ -595,8 +595,11 @@ def build_slab_stages(
     ``algorithm="hierarchical"`` (hybrid mesh; ``axis_name`` a (dcn, ici)
     tuple) splits the t2 stage into its two axis-local legs — separately
     jitted ``t2a``/``t2b`` stages, so the per-stage harness times each
-    fabric's leg on its own (overlap_chunks > 1 keeps one chunked t2
-    stage: the leg boundary would multiply stage dispatches per chunk).
+    fabric's leg on its own. overlap_chunks > 1 keeps ONE t2 stage (a
+    per-chunk leg boundary would multiply stage dispatches), but inside
+    it the K chunks run the leg-level pipeline — chunk i's ICI leg
+    issued before chunk i-1's DCN leg, with per-chunk ``t2a[k]`` /
+    ``t2b[k]`` spans (:func:`.exchange.exchange_chunked`).
     ``wire_dtype`` compresses each exchange stage's wire exactly like the
     fused chain (the t2 stage boundary still carries the decoded complex
     array, so stage I/O shapes are unchanged).
@@ -626,21 +629,35 @@ def build_slab_stages(
                 axis_sizes=axis_sizes)
             dcn_name, ici_name = axis_name
 
-            def wrap(leg):
+            def wrap(leg, tile_axis_out):
                 if wire_dtype is None:
                     return leg
-                # Per-leg wire casts: bf16 round-trips are idempotent, so
-                # leg-boundary decode/encode is bit-identical to the
-                # fused chain's single cast pair around both legs.
-                return lambda u: wire_decode(
-                    leg(wire_encode(u, wire_dtype)), u.dtype)
+                # Per-leg wire casts: every registered codec round-trips
+                # idempotently (bf16 by value, int8 by its power-of-two
+                # steps), so leg-boundary decode/re-encode is
+                # bit-identical to the fused chain's single cast pair
+                # around both legs. The legs permute peer tiles and
+                # sidecar slots identically, so decode aligns on the
+                # axis the tiles sit on at the leg's exit
+                # (``tile_axis_out``).
+                codec = wire_codec(wire_dtype)
+
+                def run(u):
+                    parts = codec.encode(u, tile_axis=split_axis,
+                                         tiles=p)
+                    done = tuple(leg(w) for w in parts)
+                    return codec.decode(done, u.dtype,
+                                        tile_axis=tile_axis_out,
+                                        tiles=p)
+
+                return run
 
             return [
                 (f"t2a_exchange_{_axis_label(ici_name)}", jax.jit(
-                    smap(wrap(leg_ici), ins, ins),
+                    smap(wrap(leg_ici, split_axis), ins, ins),
                     in_shardings=in_sh, out_shardings=in_sh)),
                 (f"t2b_exchange_{_axis_label(dcn_name)}", jax.jit(
-                    smap(wrap(leg_dcn), ins, outs),
+                    smap(wrap(leg_dcn, concat_axis), ins, outs),
                     in_shardings=in_sh, out_shardings=out_sh)),
             ]
         return [

@@ -90,10 +90,14 @@ class PlanOptions:
     pruned tournament on a miss and records the winner. ``None`` (the
     default) defers to the ``DFFT_TUNE`` env var (unset -> ``"off"``).
     See ``docs/TUNING.md``.
-    ``wire_dtype``: on-wire compression of the t2 exchange payload —
-    ``"bf16"`` casts the complex payload to (real, imag) bfloat16 pairs
-    immediately before each collective and back after, halving t2 wire
-    bytes for c64 at a bounded precision cost
+    ``wire_dtype``: on-wire compression of the t2 exchange payload,
+    one of the registered wire codecs
+    (:data:`..parallel.exchange.WIRE_DTYPES`): ``"bf16"`` casts the
+    complex payload to (real, imag) bfloat16 pairs immediately before
+    each collective and back after (half the c64 wire bytes);
+    ``"int8"`` quantizes the (real, imag) planes per exchange tile with
+    power-of-two steps riding as a tiny f32 sidecar (~quarter the c64
+    wire bytes). Both at a bounded, measured precision cost
     (:func:`..parallel.exchange.wire_roundtrip_error`). ``"none"`` pins
     the exact wire; ``None`` (the default) defers to the
     ``DFFT_WIRE_DTYPE`` env var at plan time (unset -> exact,
@@ -271,7 +275,8 @@ def resolve_overlap_chunks(
 
 def resolve_wire_dtype(value: str | None) -> str | None:
     """Resolve a ``PlanOptions.wire_dtype`` value to a concrete wire
-    mode: ``None`` (exact) or ``"bf16"``.
+    mode: ``None`` (exact) or a registered codec name
+    (:data:`..parallel.exchange.WIRE_DTYPES` — ``"bf16"``, ``"int8"``).
 
     ``None`` reads the ``DFFT_WIRE_DTYPE`` env var at plan time (unset
     -> exact); ``"none"`` pins the exact wire regardless of the env.
@@ -1062,18 +1067,42 @@ def model_stage_seconds(
         half = 0.5 * (out["t_mid"]["seconds"] + out["t3"]["seconds"])
         hide = {"t2": half, "t2a": half, "t2b": half}
     t2 = out["t2"]
+    # Leg-level pipelining of the hierarchical transport at K > 1:
+    # chunk i's ICI leg issues while chunk i-1's DCN leg and downstream
+    # FFT run (exchange._hierarchical_pipelined), so the ICI leg's hide
+    # budget additionally includes the DCN leg's raw transfer — the
+    # per-leg overlap exposure the tuner's auto-K and pruning must
+    # price. Computed from the t2b entry's raw (K-independent) time.
+    leg_pipelined = alg == "hierarchical" and k > 1
+    dcn_raw = 0.0
+    if leg_pipelined:
+        for e in payloads:
+            if e["stage"] == "t2b":
+                gb = (dcn_gbps if e.get("link") == "dcn" and dcn_gbps
+                      else wire_gbps)
+                wb = (e[WIRE_BYTE_KEYS[alg]] * e.get("wire_factor", 1.0)
+                      / ndev)
+                dcn_raw = exchange_model_seconds(
+                    wb, e["parts"], alg, wire_gbps=gb,
+                    launch_seconds=launch_seconds)["seconds"]
+                break
     for e in payloads:
         # Per-leg link bandwidth: the DCN leg of a hierarchical (or
         # hybrid-mesh pencil) exchange is priced at the calibrated DCN
         # figure, not the ICI one. wire_factor scales for the plan's
-        # on-wire compression (bf16 pairs halve c64 wire bytes).
+        # on-wire compression (bf16 pairs halve c64 wire bytes; int8
+        # block-scaled pairs quarter them).
         gbps = (dcn_gbps if e.get("link") == "dcn" and dcn_gbps
                 else wire_gbps)
         wire = e[WIRE_BYTE_KEYS[alg]] * e.get("wire_factor", 1.0) / ndev
+        hide_s = hide.get(e["stage"], 0.0)
+        pipelined = leg_pipelined and e["stage"] == "t2a"
+        if pipelined:
+            hide_s += dcn_raw
         m = exchange_model_seconds(
             wire, e["parts"], alg, wire_gbps=gbps,
             launch_seconds=launch_seconds, overlap_chunks=k,
-            hide_seconds=hide.get(e["stage"], 0.0))
+            hide_seconds=hide_s)
         t2["seconds"] += m["exposed_seconds"] * exchange_correction
         t2["wire_bytes"] += wire
         t2.setdefault("raw_seconds", 0.0)
@@ -1081,13 +1110,15 @@ def model_stage_seconds(
         t2.setdefault("steps", 0)
         t2["steps"] += m["steps"]
         # Per-leg attribution rows (the t2a/t2b join axis of explain):
-        # one entry per exchange/leg with its own modeled time.
+        # one entry per exchange/leg with its own modeled time, hide
+        # budget, and whether the leg pipeline hides it.
         t2.setdefault("legs", []).append({
             "stage": e["stage"], "mesh_axis": str(e["mesh_axis"]),
             "link": e.get("link", "ici"), "parts": e["parts"],
             "wire_bytes": wire, "wire_gbps": gbps,
             "seconds": m["exposed_seconds"] * exchange_correction,
             "raw_seconds": m["seconds"] * exchange_correction,
+            "hide_seconds": hide_s, "leg_pipelined": pipelined,
         })
     return out
 

@@ -233,11 +233,12 @@ def normalize_bench_line(
     # by bench.py only when a calibrated profile was live, so that
     # calibrated-model runs and default-constant runs never share a
     # baseline; default rows keep the old schema AND the old groups).
-    # "wire_dtype" (on-wire compressed exchange) and "transport" (a
-    # non-default exchange algorithm, hierarchical included) are keyed
-    # for the same reason: a bf16-wire or two-leg run compiles a
-    # different collective program than the exact flat exchange, so
-    # compressed and exact runs never share a baseline; default rows
+    # "wire_dtype" (on-wire compressed exchange — any registered codec,
+    # bf16 or int8) and "transport" (a non-default exchange algorithm,
+    # hierarchical included) are keyed for the same reason: a
+    # compressed-wire or two-leg run compiles a different collective
+    # program than the exact flat exchange, so compressed and exact
+    # runs (and different codecs) never share a baseline; default rows
     # (exact wire, alltoall) keep the old schema and groups.
     # "op" is the fused spectral-operator name (DFFT_BENCH_OP /
     # speed3d -op): an operator run executes a different program class

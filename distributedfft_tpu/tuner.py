@@ -81,6 +81,7 @@ __all__ = [
     "load_wisdom",
     "lookup_wisdom",
     "record_wisdom",
+    "stale_wisdom_entries",
     "tuned_plan",
     "tuned_label",
 ]
@@ -274,8 +275,10 @@ def enumerate_candidates(
     :func:`..parallel.multihost.is_hybrid_mesh`) adds the hierarchical
     two-leg slab transport next to the flat-transport pencil chains.
     ``wire_dtypes`` is the on-wire compression axis — ``(None,)`` by
-    default; the tuned planner widens it to ``(None, "bf16")`` only for
-    plans that declare a ``max_roundtrip_err`` budget. ``mm_tiers`` is
+    default; the tuned planner widens it to the full registered codec
+    menu (``exchange.WIRE_DTYPES``: exact, bf16 pairs, int8
+    block-scaled) only for plans that declare a ``max_roundtrip_err``
+    budget. ``mm_tiers`` is
     the matmul precision axis, crossed with the matmul-family executors
     only (``None`` = the bare label; ``"bf16"`` -> ``matmul:bf16``, a
     distinct executor whose accuracy the same budget admits — the
@@ -373,6 +376,24 @@ def model_cost(
     payloads = exchange_payloads(lp, shape, itemsize)
     # Downstream FFT time each exchange can hide under: one chain stage.
     t_stage = t_fft / (len(payloads) + 1)
+    # Leg-level pipelining of the hierarchical transport at K > 1: the
+    # ICI leg additionally hides under the previous chunk's DCN leg
+    # (exchange._hierarchical_pipelined), mirroring
+    # plan_logic.model_stage_seconds so pruning and explain agree.
+    leg_pipelined = (cand.algorithm == "hierarchical"
+                     and cand.overlap_chunks > 1)
+    dcn_raw = 0.0
+    if leg_pipelined:
+        for e in payloads:
+            if e["stage"] == "t2b":
+                wb = (e[WIRE_BYTE_KEYS[cand.algorithm]]
+                      * e.get("wire_factor", 1.0) / ndev)
+                gb = (MODEL_DCN_GBPS if e.get("link") == "dcn"
+                      else MODEL_WIRE_GBPS)
+                dcn_raw = exchange_model_seconds(
+                    wb, e["parts"], cand.algorithm, wire_gbps=gb,
+                    launch_seconds=MODEL_LAUNCH_SECONDS)["seconds"]
+                break
     total = t_fft
     for e in payloads:
         # Per-leg pricing: the DCN leg of a hierarchical (or hybrid-mesh
@@ -382,12 +403,15 @@ def model_cost(
                 else MODEL_WIRE_GBPS)
         wire = (e[WIRE_BYTE_KEYS[cand.algorithm]]
                 * e.get("wire_factor", 1.0) / ndev)
+        hide = t_stage
+        if leg_pipelined and e["stage"] == "t2a":
+            hide += dcn_raw
         total += exchange_model_seconds(
             wire, e["parts"], cand.algorithm,
             wire_gbps=gbps,
             launch_seconds=MODEL_LAUNCH_SECONDS,
             overlap_chunks=cand.overlap_chunks,
-            hide_seconds=t_stage)["exposed_seconds"] * corr
+            hide_seconds=hide)["exposed_seconds"] * corr
     return total
 
 
@@ -729,11 +753,47 @@ def load_wisdom(path: str | None) -> tuple[dict[str, dict], int]:
     return entries, dropped
 
 
+#: Key fields every CURRENT wisdom entry carries (the wisdom_key
+#: schema). An entry recorded before a key field existed (PR 8 added
+#: err_budget, PR 12 mm_precision) can never match a current lookup —
+#: the diagnostic below counts those instead of silently never
+#: matching, so a store orphaned by a schema change says so once.
+_CURRENT_KEY_FIELDS = frozenset((
+    "kind", "shape", "dtype", "direction", "ndev", "mesh", "layouts",
+    "batch", "err_budget", "mm_precision", "device_kind", "platform",
+    "x64", "version", "jax",
+))
+
+_STALE_KEY_WARNED: set = set()
+
+
+def stale_wisdom_entries(entries: dict[str, dict]) -> int:
+    """Count loaded entries whose key is missing current
+    :func:`wisdom_key` fields (recorded under an older key schema —
+    they will never match a lookup until re-measured)."""
+    return sum(
+        1 for e in entries.values()
+        if not _CURRENT_KEY_FIELDS <= set(e.get("key", {})))
+
+
 def _read_wisdom(path: str | None) -> dict[str, dict]:
     entries, dropped = load_wisdom(path)
     if dropped:
         print(f"tuner: {path}: skipped {dropped} malformed wisdom line(s)",
               file=sys.stderr)
+    stale = stale_wisdom_entries(entries)
+    if stale and path not in _STALE_KEY_WARNED:
+        # Warn once per store per process: these entries are not
+        # corrupt, they just predate a key-schema change (e.g. the
+        # mm_precision field) and can never match — re-measuring
+        # repopulates them under the current key.
+        _STALE_KEY_WARNED.add(path)
+        print(
+            f"tuner: {path}: {stale} wisdom entr"
+            f"{'y' if stale == 1 else 'ies'} recorded under an older "
+            f"key schema (missing current wisdom_key fields); they "
+            f"will never match — re-measure to repopulate",
+            file=sys.stderr)
     return entries
 
 
@@ -1008,7 +1068,12 @@ def tuned_plan(kind: str, shape, mesh, options: PlanOptions,
     wire_dtypes: tuple = (None,)
     mm_tiers: tuple = (None,)
     if err_budget is not None:
-        wire_dtypes = (None, "bf16")
+        # Every registered wire codec enters the budgeted search
+        # (exchange.WIRE_DTYPES: exact, bf16, int8 block-scaled, ...);
+        # prune_candidates filters the ones the budget can never admit.
+        from .parallel.exchange import WIRE_DTYPES
+
+        wire_dtypes = tuple(WIRE_DTYPES)
         mm_tiers = (None, "bf16", "f32")
     if options.mm_precision is not None:
         mm_tiers = (options.mm_precision,)

@@ -7,11 +7,12 @@
 # The MPI rank count becomes the device-mesh size; on a machine without that
 # many accelerators, add -cpu to provision a virtual CPU mesh.
 #
-# WIRE=bf16 (or none) sweeps the on-wire exchange compression column
-# without editing the invocation: the value is forwarded as -wire, so a
+# WIRE=bf16|int8|none sweeps the on-wire exchange codec column without
+# editing the invocation: the value is forwarded as -wire, so a
 # campaign runner can do `WIRE=none ./speedTest.sh ...` then
-# `WIRE=bf16 ./speedTest.sh ...` and the CSV algorithm column keys the
-# two rows apart ('alltoall' vs 'alltoall+wbf16').
+# `WIRE=bf16 ./speedTest.sh ...` then `WIRE=int8 ./speedTest.sh ...`
+# and the CSV algorithm column keys the rows apart ('alltoall' vs
+# 'alltoall+wbf16' vs 'alltoall+wint8').
 set -euo pipefail
 if [ $# -lt 4 ]; then
     echo "usage: $0 <ndev> <NX> <NY> <NZ> [flags...]" >&2

@@ -119,7 +119,7 @@ def test_wire_encode_decode_roundtrip():
     with pytest.raises(TypeError, match="complex"):
         wire_encode(jnp.zeros((3,), jnp.float32), "bf16")
     with pytest.raises(ValueError, match="wire_dtype"):
-        wire_encode(x, "int8")
+        wire_encode(x, "fp8")  # unregistered codec
 
 
 def test_wire_roundtrip_error_measured_and_cached():

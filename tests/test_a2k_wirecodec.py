@@ -1,0 +1,470 @@
+"""Pluggable wire-codec registry (PR 13): block-scaled int8 next to
+bf16, through the payload accounting / tuner / drivers loop.
+
+Contracts pinned on the 8-way CPU mesh:
+
+1. **The registry is the menu** — `exchange.WIRE_CODECS` drives
+   `WIRE_DTYPES`, `wire_itemsize`, validation messages (unknown codec
+   strings fail at plan time with the registered menu), and every
+   registered codec has a `pair_bytes` figure, a measured-error path,
+   and a documented TUNING.md table row (registry completeness).
+2. **int8 quarters the wire** — `WIRE_BYTE_KEYS`-accounted wire bytes
+   are exactly quartered for c64 across all three flat transports x
+   slab/pencil x K in {1,2} x batch in {None, B}, and the lowered HLO's
+   collective operand bytes land at ~1/4 of the exact plan's (the f32
+   scale sidecar riding the same collective stage is the small
+   remainder).
+3. **Accuracy is measured and idempotent** — int8 c64 round-trip error
+   is bounded (<= 1e-2 on unit-scale data; power-of-two steps), the
+   cast pair is exactly idempotent (the staged per-leg boundary
+   contract), and the tuner admits/replays int8 winners strictly under
+   the one `max_roundtrip_err` budget.
+4. **Wisdom schema staleness is diagnosed** — entries recorded under an
+   older key schema (missing current `wisdom_key` fields) are counted
+   and warned about once, instead of silently never matching.
+
+NOTE on the filename: this module must collect BEFORE
+``test_alltoallv.py`` (alphabetical collection) — the XLA:CPU fft-thunk
+poisoning rule; see ``tests/test_a2g_wire.py``.
+"""
+
+import json
+import math
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu import regress, tuner
+from distributedfft_tpu.parallel.exchange import (
+    FLAT_ALGORITHMS,
+    WIRE_CODECS,
+    WIRE_DTYPES,
+    wire_codec,
+    wire_encode,
+    wire_itemsize,
+    wire_roundtrip_error,
+)
+from distributedfft_tpu.plan_logic import (
+    PlanOptions,
+    exchange_payloads,
+    resolve_wire_dtype,
+)
+
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the virtual 8-device mesh"
+)
+
+SHAPE = (16, 16, 8)
+HLO_SHAPE = (32, 16, 16)  # big enough that the scale sidecar is small
+CDT = jnp.complex64
+ERR_BOUND = 1e-2  # int8 acceptance bound for c64 unit-scale data
+
+
+def _world(shape=SHAPE, seed=7):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(shape)
+            + 1j * rng.standard_normal(shape)).astype(np.complex64)
+
+
+@pytest.fixture
+def wisdom_path(tmp_path, monkeypatch):
+    monkeypatch.setenv("DFFT_WISDOM", str(tmp_path / "wisdom.jsonl"))
+    monkeypatch.setenv("DFFT_COMPILE_CACHE", str(tmp_path / "xla_cache"))
+    return str(tmp_path / "wisdom.jsonl")
+
+
+# --------------------------------------------------------- the registry
+
+def test_registry_menu_and_itemsize():
+    assert WIRE_DTYPES[0] is None
+    assert "bf16" in WIRE_DTYPES and "int8" in WIRE_DTYPES
+    assert set(WIRE_CODECS) == set(w for w in WIRE_DTYPES if w)
+    assert wire_itemsize(8, "int8") == 2    # c64 -> int8 pair: quarter
+    assert wire_itemsize(16, "int8") == 2   # c128 -> int8 pair: eighth
+    assert wire_itemsize(8, "bf16") == 4
+    with pytest.raises(ValueError, match="wire_dtype"):
+        wire_itemsize(8, "fp8")
+    with pytest.raises(ValueError, match="int8"):
+        wire_codec("fp8")  # the menu is in the message
+
+
+def test_registry_completeness():
+    """Every registered codec carries its accounting figure, a measured
+    round-trip error, and a documented TUNING.md table row — the CI
+    check that a new codec cannot land half-wired."""
+    import os
+
+    tuning = open(os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "TUNING.md")).read()
+    for name, codec in WIRE_CODECS.items():
+        assert codec.pair_bytes > 0, name
+        assert wire_itemsize(8, name) == codec.pair_bytes, name
+        err = wire_roundtrip_error(np.complex64, name)
+        assert 0.0 < err <= 1e-1, (name, err)
+        assert f"`{name}`" in tuning, f"no TUNING.md row for {name!r}"
+
+
+def test_unknown_codec_fails_at_plan_time_with_menu():
+    with pytest.raises(ValueError) as ei:
+        PlanOptions(wire_dtype="fp8")
+    assert "bf16" in str(ei.value) and "int8" in str(ei.value)
+    with pytest.raises(ValueError, match="DFFT_WIRE_DTYPE"):
+        resolve_wire_dtype("fp8")
+    with pytest.raises(ValueError, match="wire_dtype"):
+        dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT, wire_dtype="fp8")
+
+
+# ------------------------------------------------------- the int8 codec
+
+def test_int8_encode_decode_roundtrip_and_idempotent():
+    codec = wire_codec("int8")
+    x = jnp.asarray(_world((8, 12, 5)))
+    q, scales = codec.encode(x, tile_axis=1, tiles=4)
+    assert q.dtype == jnp.int8 and q.shape == x.shape + (2,)
+    # One f32 power-of-two step per (peer tile, component plane).
+    assert scales.dtype == jnp.float32
+    assert scales.shape == (1, 4, 1, 2)
+    s = np.asarray(scales)
+    assert np.all(np.exp2(np.round(np.log2(s))) == s)  # powers of two
+    y = codec.decode((q, scales), x.dtype, tile_axis=1, tiles=4)
+    assert y.dtype == x.dtype and y.shape == x.shape
+    err = float(np.max(np.abs(np.asarray(y) - np.asarray(x)))
+                / np.max(np.abs(np.asarray(x))))
+    assert err <= ERR_BOUND
+    # Exact idempotence (power-of-two steps): the staged per-leg
+    # decode/re-encode boundary must be bit-identical to one cast pair.
+    q2, s2 = codec.encode(y, tile_axis=1, tiles=4)
+    assert np.array_equal(np.asarray(q2), np.asarray(q))
+    assert np.array_equal(np.asarray(s2), np.asarray(scales))
+    y2 = codec.decode((q2, s2), x.dtype, tile_axis=1, tiles=4)
+    assert np.array_equal(np.asarray(y2), np.asarray(y))
+    # The legacy single-array API rejects the multi-part wire form.
+    with pytest.raises(ValueError, match="sidecar"):
+        wire_encode(x, "int8")
+    with pytest.raises(TypeError, match="complex"):
+        codec.encode(jnp.zeros((3,), jnp.float32), tile_axis=0, tiles=1)
+
+
+def test_int8_roundtrip_error_measured_and_cached():
+    e64 = wire_roundtrip_error(np.complex64, "int8")
+    assert 0.0 < e64 <= ERR_BOUND
+    e128 = wire_roundtrip_error(np.complex128, "int8")
+    assert 0.0 < e128 <= ERR_BOUND
+    assert wire_roundtrip_error(np.complex64, "int8") == e64
+
+
+def test_plan_options_accept_int8():
+    assert PlanOptions(wire_dtype="int8").wire_dtype == "int8"
+    assert PlanOptions(wire_dtype="INT8").wire_dtype == "int8"
+    assert resolve_wire_dtype("int8") == "int8"
+
+
+def test_int8_env_resolves(monkeypatch):
+    monkeypatch.setenv("DFFT_WIRE_DTYPE", "int8")
+    assert resolve_wire_dtype(None) == "int8"
+    assert resolve_wire_dtype("none") is None
+
+
+# ---------------------------------------------------- byte accounting
+
+def test_payload_wire_factor_int8():
+    mesh_lp = dfft.plan_dft_c2c_3d(SHAPE, 8, dtype=CDT,
+                                   wire_dtype="int8").logic
+    entries = exchange_payloads(mesh_lp, SHAPE, 8)
+    assert entries and all(e["wire_factor"] == 0.25 for e in entries)
+    # c128 payloads: 2 wire bytes against 16 -> 0.125.
+    assert all(e["wire_factor"] == 0.125
+               for e in exchange_payloads(mesh_lp, SHAPE, 16))
+
+
+@needs_mesh
+@pytest.mark.parametrize("alg", FLAT_ALGORITHMS)
+@pytest.mark.parametrize("mesh_shape", [8, (2, 4)])
+@pytest.mark.parametrize("k", [1, 2])
+@pytest.mark.parametrize("batch", [None, 3])
+def test_int8_wire_bytes_quartered(alg, mesh_shape, k, batch):
+    """The acceptance matrix: c64 wire bytes exactly quartered (per the
+    shared WIRE_BYTE_KEYS accounting) on all three flat transports x
+    slab/pencil x K in {1,2} x batch in {None, B}."""
+    from distributedfft_tpu.api import _plan_exchange_bytes
+
+    mesh = dfft.make_mesh(mesh_shape)
+    kw = dict(dtype=CDT, algorithm=alg, overlap_chunks=k, batch=batch)
+    exact = dfft.plan_dft_c2c_3d(SHAPE, mesh, **kw)
+    comp = dfft.plan_dft_c2c_3d(SHAPE, mesh, wire_dtype="int8", **kw)
+    t_e, w_e = _plan_exchange_bytes(exact)
+    t_c, w_c = _plan_exchange_bytes(comp)
+    assert t_c == t_e                  # true information is unchanged
+    assert w_c * 4 == w_e              # wire bytes exactly quartered
+
+
+_TENSOR = re.compile(
+    r"tensor<((?:\d+x)*)(complex<f32>|complex<f64>|f64|f32|bf16|f16"
+    r"|i8|i16|i32|i64|ui8)>")
+_TBYTES = {"complex<f32>": 8, "complex<f64>": 16, "f64": 8, "f32": 4,
+           "bf16": 2, "f16": 2, "i8": 1, "i16": 2, "i32": 4, "i64": 8,
+           "ui8": 1}
+
+
+def _collective_operand_bytes(txt: str) -> int:
+    """Sum the operand bytes of every collective op in a lowered
+    StableHLO text — the HLO-level wire-byte pin."""
+    total = 0
+    for line in txt.splitlines():
+        if ("stablehlo.all_to_all" not in line
+                and "stablehlo.collective_permute" not in line):
+            continue
+        sig = line.rsplit(":", 1)[-1].split("->")[0]
+        for m in _TENSOR.finditer(sig):
+            dims = [int(d) for d in m.group(1).split("x") if d]
+            total += math.prod(dims or [1]) * _TBYTES[m.group(2)]
+    return total
+
+
+@needs_mesh
+@pytest.mark.parametrize("alg", FLAT_ALGORITHMS)
+def test_int8_hlo_collective_bytes_quartered(alg):
+    """The HLO collective-byte pin: the lowered program's collective
+    operands carry ~1/4 of the exact plan's bytes (int8 payload plus
+    the small f32 scale sidecar riding the same collective stage)."""
+    mesh = dfft.make_mesh(8)
+    exact = dfft.plan_dft_c2c_3d(HLO_SHAPE, mesh, dtype=CDT,
+                                 algorithm=alg)
+    comp = dfft.plan_dft_c2c_3d(HLO_SHAPE, mesh, dtype=CDT,
+                                algorithm=alg, wire_dtype="int8")
+    t_e = exact.fn.lower(
+        jax.ShapeDtypeStruct(exact.in_shape, exact.in_dtype)).as_text()
+    t_c = comp.fn.lower(
+        jax.ShapeDtypeStruct(comp.in_shape, comp.in_dtype)).as_text()
+    b_e = _collective_operand_bytes(t_e)
+    b_c = _collective_operand_bytes(t_c)
+    assert b_e > 0 and b_c > 0
+    ratio = b_c / b_e
+    assert 0.2 <= ratio <= 0.32, (alg, ratio)
+    assert "i8" in t_c  # the int8 collective is really on the wire
+
+
+@needs_mesh
+def test_default_hlo_unchanged_by_registry(monkeypatch):
+    """wire_dtype=None (env unset) after the registry refactor still IS
+    the exact plan: byte-identical lowered HLO to an explicit
+    wire_dtype='none' build, no compressed collective."""
+    monkeypatch.delenv("DFFT_WIRE_DTYPE", raising=False)
+    mesh = dfft.make_mesh(8)
+    base = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT)
+    pinned = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT,
+                                  wire_dtype="none")
+    t_base = base.fn.lower(
+        jax.ShapeDtypeStruct(base.in_shape, base.in_dtype)).as_text()
+    t_pin = pinned.fn.lower(
+        jax.ShapeDtypeStruct(pinned.in_shape, pinned.in_dtype)).as_text()
+    assert t_base == t_pin
+    assert "bf16" not in t_base and "i8" not in t_base
+
+
+@needs_mesh
+@pytest.mark.parametrize("alg", FLAT_ALGORITHMS)
+@pytest.mark.parametrize("mesh_shape", [8, (2, 4)])
+def test_int8_accuracy_through_plans(alg, mesh_shape):
+    mesh = dfft.make_mesh(mesh_shape)
+    exact = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT, algorithm=alg)
+    comp = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT, algorithm=alg,
+                                wire_dtype="int8")
+    x = jnp.asarray(_world())
+    ref = np.asarray(exact(x))
+    err = float(np.max(np.abs(np.asarray(comp(x)) - ref))
+                / np.max(np.abs(ref)))
+    # x2 slack: two exchanges on the pencil mesh + FFT accumulation.
+    assert err <= 2 * ERR_BOUND, (alg, mesh_shape, err)
+
+
+# ------------------------------------------------------ tuner integration
+
+def test_enumerate_budget_widens_to_registry():
+    cands = tuner.enumerate_candidates(
+        SHAPE, 8, executors=("xla",), wire_dtypes=WIRE_DTYPES)
+    assert {c.wire_dtype for c in cands} == {None, "bf16", "int8"}
+    comp = next(c for c in cands if c.wire_dtype == "int8")
+    assert comp.label.endswith("+wint8")
+
+
+def test_prune_budget_orders_codecs():
+    """One budget governs every codec: a budget between the bf16 and
+    int8 measured errors admits bf16 and filters int8; a loose budget
+    keeps both; a tight one keeps exact only."""
+    e_bf16 = wire_roundtrip_error(np.complex64, "bf16")
+    e_int8 = wire_roundtrip_error(np.complex64, "int8")
+    assert e_bf16 < e_int8  # the premise of the mid-budget case
+    cands = tuner.enumerate_candidates(
+        SHAPE, 8, executors=("xla",), wire_dtypes=WIRE_DTYPES)
+    mid = tuner.prune_candidates(
+        cands, SHAPE, 8, limit=64, dtype=np.complex64,
+        max_err=(e_bf16 + e_int8) / 2)
+    assert any(c.wire_dtype == "bf16" for c in mid)
+    assert all(c.wire_dtype != "int8" for c in mid)
+    loose = tuner.prune_candidates(cands, SHAPE, 8, limit=64,
+                                   max_err=1e-1, dtype=np.complex64)
+    assert any(c.wire_dtype == "int8" for c in loose)
+    tight = tuner.prune_candidates(cands, SHAPE, 8, limit=64,
+                                   max_err=1e-9, dtype=np.complex64)
+    assert tight and all(c.wire_dtype is None for c in tight)
+
+
+def test_record_wisdom_stamps_int8_compression_err(wisdom_path):
+    key = tuner.wisdom_key(kind="c2c", shape=SHAPE, dtype=np.complex64,
+                           direction=-1, ndev=8, mesh_dims=None,
+                           device_kind="cpu", platform="cpu",
+                           err_budget=1e-2)
+    cand = tuner.Candidate("slab", "alltoall", "xla", 1, "int8")
+    entry = tuner.record_wisdom(key, cand, 0.001, path=wisdom_path)
+    assert entry["schema"] == tuner.WISDOM_SCHEMA
+    assert entry["winner"]["wire_dtype"] == "int8"
+    assert entry["compression_err"] == wire_roundtrip_error(
+        np.complex64, "int8")
+
+
+def _replay_entry(wisdom_path, err_budget, compression_err):
+    key = tuner.wisdom_key(kind="c2c", shape=SHAPE, dtype=np.complex64,
+                           direction=dfft.FORWARD, ndev=8,
+                           mesh_dims=None, err_budget=err_budget)
+    entry = {
+        "schema": tuner.WISDOM_SCHEMA,
+        "recorded_at": "2026-08-01T00:00:00", "key": key,
+        "winner": {"decomposition": "slab", "algorithm": "alltoall",
+                   "executor": "xla", "overlap_chunks": 1,
+                   "wire_dtype": "int8"},
+        "seconds": 0.001, "compression_err": compression_err,
+    }
+    with open(wisdom_path, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+
+
+@needs_mesh
+def test_int8_winner_replay_admission(wisdom_path):
+    """A stored int8 winner replays only into plans whose budget admits
+    its recorded error — with zero timing executions; over budget, the
+    tuple rebuilds on the exact wire."""
+    from distributedfft_tpu.utils import metrics as m
+
+    dfft.clear_plan_cache()
+    m.metrics_reset()
+    m.enable_metrics()
+    try:
+        _replay_entry(wisdom_path, err_budget=1e-2, compression_err=6e-3)
+        ok = dfft.plan_dft_c2c_3d(SHAPE, 8, dtype=CDT, tune="wisdom",
+                                  max_roundtrip_err=1e-2)
+        assert ok.options.wire_dtype == "int8"
+        assert m.counter_total("tune_timing_executions") == 0
+    finally:
+        m.enable_metrics(False)
+        m.metrics_reset()
+        dfft.clear_plan_cache()
+
+
+@needs_mesh
+def test_int8_winner_rejected_over_budget(wisdom_path):
+    dfft.clear_plan_cache()
+    try:
+        _replay_entry(wisdom_path, err_budget=1e-4, compression_err=6e-3)
+        plan = dfft.plan_dft_c2c_3d(SHAPE, 8, dtype=CDT, tune="wisdom",
+                                    max_roundtrip_err=1e-4)
+        assert plan.options.wire_dtype is None
+        assert plan.decomposition == "slab"
+    finally:
+        dfft.clear_plan_cache()
+
+
+# -------------------------------------------------- wisdom schema lint
+
+def test_wisdom_stale_key_warning(tmp_path, capsys):
+    """Entries recorded under an older key schema (missing current
+    wisdom_key fields) are counted and warned about once per store —
+    never silently unmatched (the PR 12 mm_precision lesson)."""
+    path = str(tmp_path / "w.jsonl")
+    old_key = tuner.wisdom_key(kind="c2c", shape=SHAPE,
+                               dtype=np.complex64, direction=-1, ndev=8,
+                               device_kind="cpu", platform="cpu")
+    del old_key["mm_precision"]  # a pre-PR12 store
+    stale = {"schema": 1, "key": old_key,
+             "winner": {"decomposition": "slab", "algorithm": "alltoall",
+                        "executor": "xla", "overlap_chunks": 1},
+             "seconds": 0.001}
+    fresh = dict(stale, key=tuner.wisdom_key(
+        kind="c2c", shape=SHAPE, dtype=np.complex64, direction=1,
+        ndev=8, device_kind="cpu", platform="cpu"))
+    with open(path, "w") as f:
+        f.write(json.dumps(stale) + "\n")
+        f.write(json.dumps(fresh) + "\n")
+    entries = tuner._read_wisdom(path)
+    assert len(entries) == 2
+    assert tuner.stale_wisdom_entries(entries) == 1
+    err = capsys.readouterr().err
+    assert "older key schema" in err and "1 wisdom entry" in err
+    # Once per store: a second read does not repeat the warning.
+    tuner._read_wisdom(path)
+    assert "older key schema" not in capsys.readouterr().err
+    # Fully-current stores never warn.
+    path2 = str(tmp_path / "w2.jsonl")
+    with open(path2, "w") as f:
+        f.write(json.dumps(fresh) + "\n")
+    assert tuner.stale_wisdom_entries(tuner._read_wisdom(path2)) == 0
+
+
+def test_record_wisdom_keys_are_current():
+    """What record_wisdom writes today must never trip the staleness
+    diagnostic — the two sides of the schema contract stay in sync."""
+    key = tuner.wisdom_key(kind="c2c", shape=SHAPE, dtype=np.complex64,
+                           direction=-1, ndev=8, device_kind="cpu",
+                           platform="cpu")
+    assert tuner._CURRENT_KEY_FIELDS <= set(key)
+
+
+# --------------------------------------------------- driver / regress tier
+
+def test_regress_int8_baseline_group():
+    base = {"metric": "fft3d_c2c_512_forward_gflops", "value": 100.0,
+            "dtype": "complex64", "devices": 8, "decomposition": "slab",
+            "backend": "tpu", "device_kind": "TPU v5 lite"}
+    r0 = regress.normalize_bench_line(dict(base), source="test")
+    r8 = regress.normalize_bench_line(dict(base, wire_dtype="int8"),
+                                      source="test")
+    rb = regress.normalize_bench_line(dict(base, wire_dtype="bf16"),
+                                      source="test")
+    assert r8["config"]["wire_dtype"] == "int8"
+    keys = {regress.group_key(r) for r in (r0, r8, rb)}
+    assert len(keys) == 3  # exact / int8 / bf16 never share a baseline
+
+
+def test_bench_emit_stamps_int8(capsys):
+    import os
+    import sys
+    TESTS = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.dirname(TESTS))
+    import bench
+
+    out = bench._emit(16, 1e-4, 1e-7, "xla", 8, "slab", {"xla": 1e-4},
+                      wire_dtype="int8")
+    capsys.readouterr()
+    assert out["wire_dtype"] == "int8"
+
+
+def test_speed3d_wire_label_int8():
+    import os
+    import sys
+    TESTS = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(os.path.dirname(TESTS), "benchmarks"))
+    from speed3d import _algorithm_label
+
+    assert _algorithm_label("alltoall", 1, wire="int8") == "alltoall+wint8"
+    assert _algorithm_label("alltoall", 2, batch=4,
+                            wire="int8") == "alltoall+ov2+b4+wint8"
+
+
+def test_tuned_label_carries_int8():
+    cand = tuner.Candidate("slab", "alltoall", "xla", 1, "int8")
+    assert cand.label == "slab/alltoall/xla/ov1+wint8"
