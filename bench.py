@@ -281,7 +281,7 @@ def _plan_wire_kw(plan) -> dict:
 def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
           all_times, donated=False, stages=None, overlap=None, tuned=None,
           cost=None, batch=None, wire_dtype=None, transport=None,
-          precision=None, op=None, degraded=False):
+          precision=None, op=None, degraded=False, concurrent=None):
     import jax
 
     from distributedfft_tpu.utils.metrics import metrics_snapshot
@@ -289,11 +289,13 @@ def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
 
     shape = (shape_n,) * 3
     b = batch if batch and batch > 1 else 1
+    cc = concurrent if concurrent and concurrent > 1 else 1
+    total = b * cc  # one concurrent dispatch computes cc x b transforms
     # One batched execution computes b transforms; GFlops and the
     # throughput stamp both count all of them. A fused spectral-operator
     # run (op) computes forward + inverse per solve — 2x the transform
     # flops — and stamps solves/s instead of transforms/s.
-    gf = gflops(shape, seconds) * b * (2 if op else 1)
+    gf = gflops(shape, seconds) * total * (2 if op else 1)
     metric = (f"spectral_{op}_{shape_n}_gflops" if op
               else f"fft3d_c2c_{shape_n}_forward_gflops")
     out = {
@@ -319,14 +321,24 @@ def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
         # group, so operator runs never share baselines with bare
         # transforms. Transform rows keep the old schema exactly.
         out["op"] = op
-        out["solves_per_s"] = round(b / seconds, 3)
+        out["solves_per_s"] = round(total / seconds, 3)
     else:
         # Throughput as a first-class metric (transforms per second, not
         # just GFlop/s): the serving tier's gated number. Unbatched runs
         # stamp 1/seconds, batched runs B/seconds; the run-record store
         # lifts it into rates and compare --gate treats *_per_s as
         # larger-is-better.
-        out["transforms_per_s"] = round(b / seconds, 3)
+        out["transforms_per_s"] = round(total / seconds, 3)
+    if cc > 1:
+        # Concurrent-schedule run (DFFT_BENCH_CONCURRENT / speed3d
+        # -concurrent): N independent transforms merged into ONE
+        # interleaved program (stagegraph.schedule_concurrent — one
+        # transform's t2 wire hides under another's FFT compute). The
+        # run-record store keys "concurrent" into the baseline config
+        # group and gates concurrent_transforms_per_s as a rate;
+        # sequential rows keep the old schema.
+        out["concurrent"] = cc
+        out["concurrent_transforms_per_s"] = round(total / seconds, 3)
     if b > 1:
         # Batched multi-request run (DFFT_BENCH_BATCH): part of the
         # baseline group — a B=8 coalesced run must never be judged
@@ -570,6 +582,69 @@ def _worker_op(shape_n, shape, mesh, dtype, n_dev, opname: str,
           **_plan_wire_kw(plan))
 
 
+def _worker_concurrent(shape_n, shape, mesh, dtype, n_dev, cc: int,
+                       b: int | None) -> None:
+    """The concurrent-schedule measurement (``DFFT_BENCH_CONCURRENT=N``,
+    composable with ``DFFT_BENCH_BATCH=B``): N independent transforms
+    merged into ONE interleaved device program
+    (``stagegraph.schedule_concurrent`` — transform A's t2 collectives
+    issue while transform B's t0/t3 FFTs run). Verified bit-identical
+    against sequential per-plan execution; the result line stamps
+    ``concurrent`` + ``concurrent_transforms_per_s`` so the run-record
+    store gates concurrent throughput in its own baseline group."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import distributedfft_tpu as dfft
+    from distributedfft_tpu.stagegraph import schedule_concurrent
+    from distributedfft_tpu.utils.timing import (
+        max_rel_err, sync, time_fn_amortized,
+    )
+
+    executor = os.environ.get("DFFT_BENCH_EXECUTORS", "xla").split(",")[0]
+    with _precision_env(executor.strip()) as base:
+        plan = dfft.plan_dft_c2c_3d(shape, mesh, direction=dfft.FORWARD,
+                                    dtype=dtype, executor=base, batch=b)
+        if plan.graph is None:
+            raise RuntimeError(
+                "DFFT_BENCH_CONCURRENT needs a stage-graph (slab/pencil) "
+                "plan; single-device plans cannot be co-scheduled")
+        cp = schedule_concurrent([plan] * cc)
+
+        mk_kw = {}
+        if plan.in_sharding is not None:
+            mk_kw["out_shardings"] = plan.in_sharding
+
+        @functools.partial(jax.jit, **mk_kw, static_argnums=0)
+        def make_input(seed: int):
+            k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+            re = jax.random.normal(k1, plan.in_shape, jnp.float32)
+            im = jax.random.normal(k2, plan.in_shape, jnp.float32)
+            return (re + 1j * im).astype(dtype)
+
+        xs = [make_input(4242 + i) for i in range(cc)]
+        sync(xs)
+        # Bit-parity gate: the interleaved schedule must produce exactly
+        # the sequential plans' outputs (the schedule moves issue order,
+        # never math).
+        ys = cp(*xs)
+        seq = [plan(x) for x in xs]
+        max_err = max(float(max_rel_err(a, r)) for a, r in zip(ys, seq))
+        if not all(bool(jnp.all(a == r)) for a, r in zip(ys, seq)):
+            raise AssertionError(
+                "concurrent schedule diverged from sequential execution")
+        seconds, _ = time_fn_amortized(lambda: cp(*xs), iters=10,
+                                       repeats=3)
+    _emit(shape_n, seconds, float(max_err), executor, n_dev,
+          plan.decomposition, {f"{executor}+cc{cc}": round(seconds, 6)},
+          overlap=getattr(plan.options, "overlap_chunks", None),
+          batch=b, concurrent=cc, cost=_plan_cost_block(plan),
+          **_plan_wire_kw(plan))
+
+
 def _worker(shape_n: int) -> None:
     """Measure and print result JSON lines (runs in a subprocess). A line
     is printed after EVERY improvement — the first candidate's number is
@@ -618,6 +693,15 @@ def _worker(shape_n: int) -> None:
     if op_env:
         return _worker_op(shape_n, shape, mesh, dtype, n_dev, op_env,
                           batch_b)
+
+    # Concurrent-schedule mode: N independent transforms as ONE
+    # interleaved program (concurrent_transforms_per_s is the number
+    # under test; composes with DFFT_BENCH_BATCH).
+    cc_env = os.environ.get("DFFT_BENCH_CONCURRENT", "").strip()
+    cc_n = int(cc_env) if cc_env and cc_env not in ("0", "1") else None
+    if cc_n is not None:
+        return _worker_concurrent(shape_n, shape, mesh, dtype, n_dev,
+                                  cc_n, batch_b)
     if batch_b is not None:
         return _worker_batched(shape_n, shape, mesh, dtype, n_dev,
                                batch_b)

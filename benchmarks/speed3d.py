@@ -69,7 +69,22 @@ def parse_args(argv):
                         "'<alg>+mmbf16' (mirroring '+wbf16') so "
                         "reduced-precision sweep rows never mix with "
                         "exact baselines. Matmul-family executors only")
-    p.add_argument("-op", default=None, choices=("poisson", "grad", "gauss"),
+    p.add_argument("-concurrent", type=int, default=None, metavar="N",
+                   help="co-scheduled transform count: N independent "
+                        "transforms merged into ONE interleaved device "
+                        "program (stagegraph.schedule_concurrent — "
+                        "transform A's t2 collectives issue while "
+                        "transform B's t0/t3 FFTs run; the DaggerFFT "
+                        "stage-DAG scheduling play). Bit-identical to "
+                        "sequential execution; GFlops and the printed "
+                        "transforms/s count all N. Rows label the CSV "
+                        "algorithm column '<alg>+ccN' (mirroring "
+                        "'+bB'), so concurrent sweeps never share a "
+                        "regress baseline with sequential rows. "
+                        "Stage-graph (slab/pencil) chain plans only")
+    p.add_argument("-op", default=None,
+                   choices=("poisson", "grad", "gauss", "biharm",
+                            "helmholtz"),
                    help="run the fused spectral OPERATOR instead of a "
                         "bare transform: one FFT -> pointwise -> iFFT "
                         "program whose multiplier applies in the "
@@ -276,6 +291,16 @@ def main(argv=None) -> None:
                              "driver; use the planner API "
                              "(plan_spectral_op(..., tune=...)) for "
                              "tuned operator plans")
+
+    if args.concurrent is not None:
+        if args.concurrent < 1:
+            raise SystemExit(f"-concurrent must be >= 1, "
+                             f"got {args.concurrent}")
+        if (args.bricks or args.precision == "dd" or args.ingrid
+                or args.outgrid or args.tune not in (None, "off")):
+            raise SystemExit("-concurrent schedules stage-graph chain "
+                             "plans; brick, dd, layout (-ingrid/"
+                             "-outgrid), and -tune runs do not take it")
 
     if args.precision == "dd":
         # Emulated-double tier: the CLI meaning of "double precision" on
@@ -578,15 +603,31 @@ def main(argv=None) -> None:
 
     import contextlib
 
+    ccn = args.concurrent if (args.concurrent or 0) > 1 else None
+    cc_plan = None
+    if ccn is not None:
+        from distributedfft_tpu.stagegraph import schedule_concurrent
+
+        if fwd.graph is None:
+            raise SystemExit("-concurrent needs a stage-graph (slab/"
+                             "pencil) chain plan; this plan has none")
+        cc_plan = schedule_concurrent([fwd] * ccn)
+
     prof = jax.profiler.trace(args.profile) if args.profile else contextlib.nullcontext()
     with prof:
-        seconds, _ = time_fn_amortized(lambda: fwd(x), iters=args.iters,
-                                       repeats=2)
+        if cc_plan is not None:
+            cc_xs = [x] * ccn
+            seconds, _ = time_fn_amortized(
+                lambda: cc_plan(*cc_xs), iters=args.iters, repeats=2)
+        else:
+            seconds, _ = time_fn_amortized(lambda: fwd(x),
+                                           iters=args.iters, repeats=2)
     is_real = args.kind == "r2c"
-    # One batched execution computes bsz transforms: GFlops and the
-    # throughput line count all of them. A fused operator run pays
-    # forward + inverse per solve (2x the transform flops).
-    gf = (gflops(shape, seconds, real=is_real) * (bsz or 1)
+    # One batched execution computes bsz transforms (times ccn
+    # co-scheduled programs): GFlops and the throughput line count all
+    # of them. A fused operator run pays forward + inverse per solve
+    # (2x the transform flops).
+    gf = (gflops(shape, seconds, real=is_real) * (bsz or 1) * (ccn or 1)
           * (2 if args.op else 1))
 
     print(result_block(shape, ndev, seconds, max_err, stage_times, real=is_real))
@@ -596,6 +637,10 @@ def main(argv=None) -> None:
     if bsz is not None and args.op is None:
         print(f"batch: {bsz} coalesced transforms -> "
               f"{bsz / seconds:.2f} transforms/s")
+    if ccn is not None:
+        print(f"concurrent: {ccn} co-scheduled transforms -> "
+              f"{(ccn * (bsz or 1)) / seconds:.2f} "
+              f"concurrent transforms/s")
 
     exp_rec = None
     if args.explain:
@@ -633,6 +678,11 @@ def main(argv=None) -> None:
             algorithm, overlap, batch=bsz,
             wire=getattr(fwd.options, "wire_dtype", None), op=args.op,
             mm=getattr(fwd.options, "mm_precision", None))
+        if ccn is not None:
+            # Concurrent rows compile a merged N-transform program —
+            # never comparable to sequential rows (same separation rule
+            # as '+bB').
+            alg_label += f"+cc{ccn}"
         if tuned_lbl is not None:
             # Tuned rows must never be indistinguishable from rows that
             # pinned the same knobs by hand (the tuple can move between

@@ -61,6 +61,11 @@ from .operators import (  # noqa: F401
     solve_poisson,
     spectral_gradient,
 )
+from .stagegraph import (  # noqa: F401
+    ConcurrentPlan,
+    StageGraph,
+    schedule_concurrent,
+)
 from .api import OpPlan3D  # noqa: F401
 from .serving import (  # noqa: F401
     CoalescingQueue,

@@ -134,6 +134,18 @@ class Plan3D:
         return self.direction == FORWARD
 
     @property
+    def graph(self):
+        """The declarative :class:`~.stagegraph.StageGraph` this plan's
+        chain was compiled from (rides the compiled callable), or None
+        for plans below the IR tier (single-device, dd, brick-wrapped,
+        user-layout-wrapped chains) — the feature-detection hook of
+        :func:`~.stagegraph.schedule_concurrent` and the serving tier's
+        multi-group flush."""
+        from .stagegraph import graph_of
+
+        return graph_of(self.fn)
+
+    @property
     def world_size(self) -> int:
         return math.prod(self.shape)
 

@@ -70,10 +70,13 @@ from .utils.trace import add_trace
 __all__ = [
     "SpectralOp",
     "poisson",
+    "biharmonic",
+    "helmholtz",
     "gradient",
     "gaussian",
     "convolve",
     "custom",
+    "chain",
     "named_op",
     "OP_NAMES",
     "multiplier_grid",
@@ -108,6 +111,10 @@ class SpectralOp:
         ``gradient0``, ...)."""
         if self.kind == "gradient":
             return f"gradient{self.params[0]}"
+        if self.kind == "helmholtz":
+            return f"helmholtz{self.params[0]:g}"
+        if self.kind == "chain":
+            return "chain(" + "+".join(o.name for o in self.payload) + ")"
         return self.kind
 
 
@@ -116,6 +123,55 @@ def poisson() -> SpectralOp:
     ``-1/|k|^2`` with the zero mode nulled (the solution is mean-free —
     the k=0 compatibility convention every spectral solver uses)."""
     return SpectralOp("poisson")
+
+
+def biharmonic() -> SpectralOp:
+    """Biharmonic solve ``laplacian(laplacian(u)) = f`` on the unit
+    torus: multiplier ``1/|k|^4`` with the zero mode nulled (the symbol
+    of the squared Laplacian is ``|k|^4``; the solution is mean-free).
+    Exactly the composition of two Poisson solves —
+    ``biharmonic == chain([poisson, poisson])`` multiplier-for-
+    multiplier (the parity pin of ``tests/test_a2h_operators.py``) —
+    but priced and fused as ONE t_mid multiply."""
+    return SpectralOp("biharmonic")
+
+
+def helmholtz(shift: float) -> SpectralOp:
+    """Helmholtz solve ``(shift - laplacian) u = f`` on the unit torus:
+    multiplier ``1/(shift + |k|^2)``. ``shift > 0`` is the screened
+    (modified) Helmholtz operator — well-posed at every mode, identity
+    parity ``(shift + |k|^2) * multiplier == 1``. ``shift == 0``
+    degenerates to the negative Poisson solve (zero mode nulled, the
+    mean-free convention)."""
+    s = float(shift)
+    if not s >= 0.0:
+        raise ValueError(f"helmholtz shift must be >= 0, got {shift!r}")
+    return SpectralOp("helmholtz", (s,))
+
+
+def chain(ops: Sequence["SpectralOp"]) -> SpectralOp:
+    """Operator chaining: compose N diagonal multipliers into ONE
+    fused plan — one forward transform, the *product* of the
+    multipliers at the single t_mid midpoint, one inverse transform
+    per set. Because every op is pointwise-diagonal in wavenumber
+    space, composition is just multiplication — the chained plan
+    compiles exactly the collective count of a single-op fused plan
+    (pinned), where running the ops as separate plans would pay the
+    full exchange round trip per op.
+
+    Identity lives in the member ops' identities (kind + params in
+    order — chains over different kernels/callables never collide)."""
+    ops = tuple(ops)
+    if not ops:
+        raise ValueError("chain() takes at least one SpectralOp")
+    for o in ops:
+        if not isinstance(o, SpectralOp):
+            raise TypeError(
+                f"chain() composes SpectralOp instances, got {o!r}")
+    if len(ops) == 1:
+        return ops[0]
+    return SpectralOp("chain", tuple((o.kind, o.params) for o in ops),
+                      payload=ops)
 
 
 def gradient(axis: int = 0) -> SpectralOp:
@@ -159,13 +215,14 @@ def custom(name: str, fn: Callable) -> SpectralOp:
 
 
 #: Driver-tier operator menu (``speed3d -op``, ``DFFT_BENCH_OP``).
-OP_NAMES = ("poisson", "grad", "gauss")
+OP_NAMES = ("poisson", "grad", "gauss", "biharm", "helmholtz")
 
 
 def named_op(name: str, **kw) -> SpectralOp:
     """The driver-tier operator spelled by name: ``poisson``,
     ``grad``/``gradient`` (axis via ``axis=``, default 0), ``gauss``/
-    ``gaussian`` (``sigma=``, default 1.0)."""
+    ``gaussian`` (``sigma=``, default 1.0), ``biharm``/``biharmonic``,
+    ``helmholtz`` (``shift=``, default 1.0)."""
     n = name.strip().lower()
     if n == "poisson":
         return poisson()
@@ -173,6 +230,10 @@ def named_op(name: str, **kw) -> SpectralOp:
         return gradient(kw.pop("axis", 0))
     if n in ("gauss", "gaussian"):
         return gaussian(kw.pop("sigma", 1.0))
+    if n in ("biharm", "biharmonic"):
+        return biharmonic()
+    if n == "helmholtz":
+        return helmholtz(kw.pop("shift", 1.0))
     raise ValueError(
         f"unknown operator {name!r}; expected one of {OP_NAMES}")
 
@@ -203,6 +264,42 @@ def _multiplier_fn(op: SpectralOp, shape, cdtype) -> Callable:
             ksq = k0 * k0 + k1 * k1 + k2 * k2
             nz = ksq > 0
             return jnp.where(nz, -1.0 / jnp.where(nz, ksq, 1.0), 0.0)
+
+        return mult
+    if op.kind == "biharmonic":
+
+        def mult(i0, i1, i2):
+            k0, k1, k2 = (k_of(i0, shape[0]), k_of(i1, shape[1]),
+                          k_of(i2, shape[2]))
+            ksq = k0 * k0 + k1 * k1 + k2 * k2
+            nz = ksq > 0
+            return jnp.where(
+                nz, 1.0 / jnp.where(nz, ksq * ksq, 1.0), 0.0)
+
+        return mult
+    if op.kind == "helmholtz":
+        shift = op.params[0]
+
+        def mult(i0, i1, i2):
+            k0, k1, k2 = (k_of(i0, shape[0]), k_of(i1, shape[1]),
+                          k_of(i2, shape[2]))
+            ksq = shift + k0 * k0 + k1 * k1 + k2 * k2
+            if shift > 0:
+                return 1.0 / ksq
+            nz = ksq > 0  # shift==0: the mean-free Poisson convention
+            return jnp.where(nz, 1.0 / jnp.where(nz, ksq, 1.0), 0.0)
+
+        return mult
+    if op.kind == "chain":
+        # Diagonal ops compose by multiplication: ONE t_mid multiply
+        # carries the whole set (one forward, one inverse per set).
+        fns = [_multiplier_fn(o, shape, cdtype) for o in op.payload]
+
+        def mult(i0, i1, i2):
+            m = fns[0](i0, i1, i2)
+            for f in fns[1:]:
+                m = m * f(i0, i1, i2)
+            return m
 
         return mult
     if op.kind == "gradient":
@@ -299,10 +396,15 @@ def plan_spectral_op(
     transform winners never cross-replay; see ``docs/TUNING.md``).
     """
     shape, _ = _api._check_direction(shape, FORWARD)
+    if isinstance(op, (list, tuple)):
+        # Operator chaining: a sequence composes its diagonal
+        # multipliers at ONE t_mid — one forward, one inverse per SET
+        # (collective count pinned equal to a single-op fused plan).
+        op = chain(op)
     if not isinstance(op, SpectralOp):
         raise TypeError(
-            f"op must be a SpectralOp (poisson(), gradient(), ...); "
-            f"got {op!r}")
+            f"op must be a SpectralOp (poisson(), gradient(), ...) or "
+            f"a sequence of them (operator chaining); got {op!r}")
     batch = _api._norm_batch(batch)
     opts = _api._resolve_options(
         decomposition, executor, donate, algorithm, options,

@@ -19,31 +19,36 @@ exchange stays on its ring — the property heFFTe's min-surface processor grid
 chases (``heffte_geometry.h:589``). Uneven extents use the same
 ceil-pad/crop scheme as :mod:`.slab` (pads only ever touch an axis while it
 is *not* being transformed at its true length).
+
+**Stage-graph IR**: every builder here emits a declarative stage graph
+(:mod:`..stagegraph`) — t0 | t2a | t1 | t2b | t3 with each exchange's
+downstream FFT as its fused per-chunk compute — compiled by ONE
+executor, byte-identical to the pre-migration hand-threaded chains
+(pinned in ``tests/test_a2m_stagegraph.py``).
 """
 
 from __future__ import annotations
 
-import functools
 from dataclasses import dataclass
 from typing import Callable
 
-import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-try:
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..geometry import pad_to
-from ..ops.executors import get_c2r, get_executor, get_r2c
-from ..utils.trace import add_trace
-from .exchange import exchange_overlapped
+from ..ops.executors import get_executor
+from ..stagegraph import (
+    StageGraph, apply_midpoint, compile_fused, exchange_node, local_node,
+)
 from .slab import (
     _L, _crop_axis, _pad_axis, apply_multiplier, batch_pspec, check_batch,
 )
+
+__all__ = [
+    "PencilSpec", "chain_geometry", "build_pencil_general",
+    "build_pencil_spectral_op", "build_pencil_fft3d", "build_pencil_rfft3d",
+]
 
 
 @dataclass(frozen=True)
@@ -154,13 +159,12 @@ def build_pencil_general(
     true length).
 
     ``overlap_chunks > 1`` pipelines each exchange under the FFT stage
-    that follows it, chunked along that exchange's bystander axis
-    (:func:`.exchange.exchange_overlapped`); both t2a and t2b overlap.
-
-    ``batch=B`` prepends a leading batch axis (``[B, N0, N1, N2]`` of B
-    independent transforms): batched FFT stages and ONE shared collective
-    per (chunk, exchange) with the batch riding as a bystander dim —
-    exactly the :func:`..slab.build_slab_general` convention.
+    that follows it, chunked along that exchange's bystander axis; both
+    t2a and t2b overlap. ``batch=B`` prepends a leading batch axis
+    (``[B, N0, N1, N2]`` of B independent transforms): batched FFT stages
+    and ONE shared collective per (chunk, exchange) with the batch riding
+    as a bystander dim — exactly the :func:`..slab.build_slab_general`
+    convention.
 
     ``midpoint`` is the spectral-operator fusion hook (the
     stop-at-transposed / start-from-transposed mode): the chain stops in
@@ -188,71 +192,49 @@ def build_pencil_general(
     rows, cols = mesh.shape[row_axis], mesh.shape[col_axis]
     spec = PencilSpec(tuple(int(s) for s in shape), rows, cols,
                       row_axis, col_axis, tuple(perm), order)
-    ex = get_executor(executor) if isinstance(executor, str) else executor
     n = spec.shape
     seq, last_fft, in_pads, out_crops = chain_geometry(
         perm, order, rows, cols, row_axis, col_axis, n)
     bo = 0 if batch is None else 1  # leading-batch axis offset
 
-    # Stage spans: the reference taxonomy with the two pencil exchanges
-    # split out as t2a/t2b (the staged-pipeline naming of .staged).
+    # Stage nodes: the reference taxonomy with the two pencil exchanges
+    # split out as t2a/t2b; the FFT following each exchange runs along
+    # that exchange's concat axis (the axis that just became local), so
+    # each exchange pipelines under its own downstream fft stage.
     fft_names = (f"t0_fft_{_L[seq[0][2]]}", f"t1_fft_{_L[seq[1][2]]}")
     exch_names = (f"t2a_exchange_{seq[0][0]}", f"t2b_exchange_{seq[1][0]}")
     t3_name = f"t3_fft_{_L[last_fft]}"
 
-    def local_fn(x):
-        with add_trace(fft_names[0]):
-            x = ex(x, (seq[0][2] + bo,), forward)        # t0: first fft
-        for i, (mesh_ax, parts, split, concat) in enumerate(seq):
-            # The FFT following each exchange runs along that exchange's
-            # concat axis (the axis that just became local), so each
-            # exchange pipelines under its own downstream fft stage.
-            def post_fft(v, concat=concat):
-                v = _crop_axis(v, concat + bo, n[concat])
-                return ex(v, (concat + bo,), forward)
+    nodes = [local_node("t0", fft_names[0],
+                        ("fft", (seq[0][2] + bo,), forward))]
+    for i, (mesh_ax, parts, split, concat) in enumerate(seq):
+        nodes.append(exchange_node(
+            "t2a" if i == 0 else "t2b", exch_names[i], mesh_axis=mesh_ax,
+            parts=parts, split=split + bo, concat=concat + bo,
+            chunk_axis=3 - split - concat + bo))
+        nodes.append(local_node(
+            "t1" if i == 0 else "t3",
+            fft_names[1] if i == 0 else t3_name,
+            ("crop", concat + bo, n[concat]),
+            ("fft", (concat + bo,), forward), fuse=True))
 
-            x = exchange_overlapped(
-                x, mesh_ax, split_axis=split + bo, concat_axis=concat + bo,
-                axis_size=parts, algorithm=algorithm, compute=post_fft,
-                wire_dtype=wire_dtype,
-                overlap_chunks=overlap_chunks,
-                chunk_axis=3 - split - concat + bo,
-                exchange_name=exch_names[i],
-                compute_name=fft_names[1] if i == 0 else t3_name)
-        return x
-
-    in_spec = batch_pspec(spec.in_spec, batch)
-    out_spec = batch_pspec(spec.out_spec, batch)
-
-    def pre(x):
-        for ax, to in in_pads:
-            x = _pad_axis(x, ax + bo, to)
-        return x
-
-    def post(y):
-        for ax, to in out_crops:
-            y = _crop_axis(y, ax + bo, to)
-        return y
-
-    mapped = _shard_map(local_fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec)
-
-    in_sh = NamedSharding(mesh, in_spec)
-    out_sh = NamedSharding(mesh, out_spec)
-    # Even iff every pad in the chain is a no-op: the two input-side pads
-    # and each exchange's split-axis pad.
-    even = all(to == n[ax] for ax, to in in_pads) and all(
-        pad_to(n[split], parts) == n[split] for _, parts, split, _ in seq
+    graph = StageGraph(
+        mesh=mesh, nodes=tuple(nodes),
+        in_pspec=batch_pspec(spec.in_spec, batch),
+        out_pspec=batch_pspec(spec.out_spec, batch),
+        pre=tuple(("pad", ax + bo, to) for ax, to in in_pads),
+        post=tuple(("crop", ax + bo, to) for ax, to in out_crops),
+        # Even iff every pad in the chain is a no-op: the two input-side
+        # pads and each exchange's split-axis pad.
+        even=all(to == n[ax] for ax, to in in_pads) and all(
+            pad_to(n[split], parts) == n[split]
+            for _, parts, split, _ in seq),
+        donate=donate, algorithm=algorithm, wire_dtype=wire_dtype,
+        overlap_chunks=overlap_chunks, executor=executor,
+        meta=dict(shape=spec.shape, batch=batch, forward=forward,
+                  decomposition="pencil", kind="c2c"),
     )
-    jit_kw: dict = {"donate_argnums": 0} if donate else {}
-    if even:
-        jit_kw |= {"in_shardings": in_sh, "out_shardings": out_sh}
-
-    @functools.partial(jax.jit, **jit_kw)
-    def fn(x):
-        x = lax.with_sharding_constraint(pre(x), in_sh)
-        return post(mapped(x))
-
-    return fn, spec
+    return compile_fused(graph), spec
 
 
 def build_pencil_spectral_op(
@@ -294,89 +276,63 @@ def build_pencil_spectral_op(
     c1 = n1pr // rows  # midpoint local k1 extent (row shard)
     c2 = n2p // cols   # midpoint local k2 extent (col shard)
 
-    def local_fn(x):  # z-pencil shard [(B,) n0p/rows, n1pc/cols, N2]
-        with add_trace("t0_fft_z"):
-            x = ex(x, (2 + bo,), True)                   # t0: Z lines
-
-        def fft_y(v):
-            v = _crop_axis(v, 1 + bo, n1)
-            return ex(v, (1 + bo,), True)                # t1: Y lines
-
-        x = exchange_overlapped(
-            x, col_axis, split_axis=2 + bo, concat_axis=1 + bo,
-            axis_size=cols, algorithm=algorithm, compute=fft_y,
-            wire_dtype=wire_dtype,
-            overlap_chunks=overlap_chunks, chunk_axis=bo,
-            exchange_name=f"t2a_exchange_{col_axis}",
-            compute_name="t1_fft_y")
+    def mid_factory():
+        # Transposed x-pencil midpoint: final forward FFT, the
+        # wavenumber-diagonal multiply, first inverse FFT — all local
+        # (bounds are this chunk's slice of the col shard).
         k1_lo = lax.axis_index(row_axis) * c1
         k2_lo = lax.axis_index(col_axis) * c2
 
         def mid_chunk(u, lo, hi):
-            # Transposed x-pencil midpoint: final forward FFT, the
-            # wavenumber-diagonal multiply, first inverse FFT — all
-            # local (bounds are this chunk's slice of the col shard).
             u = _crop_axis(u, bo, n0)
             u = ex(u, (bo,), True)                       # t3 of fwd half
-            with add_trace("t_mid_pointwise"):
-                m = multiplier(
-                    jnp.arange(n0, dtype=jnp.int32)[:, None, None],
-                    (k1_lo + jnp.arange(c1, dtype=jnp.int32))[None, :, None],
-                    (k2_lo + jnp.arange(lo, hi,
-                                        dtype=jnp.int32))[None, None, :])
-                u = apply_multiplier(u, m)
+            u = apply_midpoint(u, multiplier, (
+                jnp.arange(n0, dtype=jnp.int32)[:, None, None],
+                (k1_lo + jnp.arange(c1, dtype=jnp.int32))[None, :, None],
+                (k2_lo + jnp.arange(lo, hi,
+                                    dtype=jnp.int32))[None, None, :]))
             return ex(u, (bo,), False)                   # inverse X lines
 
-        x = exchange_overlapped(
-            x, row_axis, split_axis=1 + bo, concat_axis=bo,
-            axis_size=rows, algorithm=algorithm,
-            compute=mid_chunk, compute_takes_bounds=True,
-            wire_dtype=wire_dtype,
-            overlap_chunks=overlap_chunks, chunk_axis=2 + bo,
-            exchange_name=f"t2b_exchange_{row_axis}",
-            compute_name="t_mid")
+        return mid_chunk
 
-        def inv_y(v):
-            v = _crop_axis(v, 1 + bo, n1)
-            return ex(v, (1 + bo,), False)               # inverse Y lines
-
-        x = exchange_overlapped(
-            x, row_axis, split_axis=bo, concat_axis=1 + bo,
-            axis_size=rows, algorithm=algorithm, compute=inv_y,
-            wire_dtype=wire_dtype,
-            overlap_chunks=overlap_chunks, chunk_axis=2 + bo,
-            exchange_name=f"t2b_exchange_{row_axis}",
-            compute_name="t3_ifft_y")
-
-        def inv_z(v):
-            v = _crop_axis(v, 2 + bo, n2)
-            return ex(v, (2 + bo,), False)               # inverse Z lines
-
-        return exchange_overlapped(
-            x, col_axis, split_axis=1 + bo, concat_axis=2 + bo,
-            axis_size=cols, algorithm=algorithm, compute=inv_z,
-            wire_dtype=wire_dtype,
-            overlap_chunks=overlap_chunks, chunk_axis=bo,
-            exchange_name=f"t2a_exchange_{col_axis}",
-            compute_name="t3_ifft_z")
-
+    nodes = (
+        local_node("t0", "t0_fft_z", ("fft", (2 + bo,), True)),
+        exchange_node("t2a", f"t2a_exchange_{col_axis}", mesh_axis=col_axis,
+                      parts=cols, split=2 + bo, concat=1 + bo,
+                      chunk_axis=bo),
+        local_node("t1", "t1_fft_y",
+                   ("crop", 1 + bo, n1), ("fft", (1 + bo,), True),
+                   fuse=True),
+        exchange_node("t2b", f"t2b_exchange_{row_axis}", mesh_axis=row_axis,
+                      parts=rows, split=1 + bo, concat=bo,
+                      chunk_axis=2 + bo),
+        local_node("t_mid", "t_mid", fuse=True, takes_bounds=True,
+                   factory=mid_factory),
+        exchange_node("t2b", f"t2b_exchange_{row_axis}", mesh_axis=row_axis,
+                      parts=rows, split=bo, concat=1 + bo,
+                      chunk_axis=2 + bo),
+        local_node("t3", "t3_ifft_y",
+                   ("crop", 1 + bo, n1), ("fft", (1 + bo,), False),
+                   fuse=True),
+        exchange_node("t2a", f"t2a_exchange_{col_axis}", mesh_axis=col_axis,
+                      parts=cols, split=1 + bo, concat=2 + bo,
+                      chunk_axis=bo),
+        local_node("t3", "t3_ifft_z",
+                   ("crop", 2 + bo, n2), ("fft", (2 + bo,), False),
+                   fuse=True),
+    )
     io_spec = batch_pspec(spec.in_spec, batch)
-    mapped = _shard_map(local_fn, mesh=mesh, in_specs=(io_spec,),
-                        out_specs=io_spec)
-    io_sh = NamedSharding(mesh, io_spec)
-    even = n0p == n0 and n1pc == n1
-    jit_kw: dict = {"donate_argnums": 0} if donate else {}
-    if even:
-        jit_kw |= {"in_shardings": io_sh, "out_shardings": io_sh}
-
-    @functools.partial(jax.jit, **jit_kw)
-    def fn(x):
-        x = _pad_axis(_pad_axis(x, bo, n0p), 1 + bo, n1pc)
-        x = lax.with_sharding_constraint(x, io_sh)
-        y = mapped(x)
-        return _crop_axis(_crop_axis(y, bo, n0), 1 + bo, n1)
-
-    return fn, spec
+    graph = StageGraph(
+        mesh=mesh, nodes=nodes, in_pspec=io_spec, out_pspec=io_spec,
+        pre=(("pad", bo, n0p), ("pad", 1 + bo, n1pc)),
+        post=(("crop", bo, n0), ("crop", 1 + bo, n1)),
+        even=n0p == n0 and n1pc == n1, donate=donate,
+        algorithm=algorithm, wire_dtype=wire_dtype,
+        overlap_chunks=overlap_chunks, executor=executor,
+        meta=dict(shape=spec.shape, batch=batch, forward=True,
+                  decomposition="pencil", kind="op"),
+    )
+    return compile_fused(graph), spec
 
 
 def build_pencil_fft3d(
@@ -449,94 +405,66 @@ def build_pencil_rfft3d(
         perm=(0, 1, 2) if forward else (1, 2, 0),
         order="col_first" if forward else "row_first",
     )
-    ex = get_executor(executor)
-    r2c, c2r = get_r2c(executor), get_c2r(executor)
     n0, n1, n2 = spec.shape
     n0p, n1pc, n1pr = spec.n0p, spec.n1p_col, spec.n1p_row
     n2h = n2 // 2 + 1
     n2hp = pad_to(n2h, cols)
     bo = 0 if batch is None else 1  # leading-batch axis offset
-    in_spec = batch_pspec(spec.in_spec, batch)
-    out_spec = batch_pspec(spec.out_spec, batch)
 
     if forward:
-
-        def fft_y(v):
-            return ex(_crop_axis(v, 1 + bo, n1), (1 + bo,), True)  # Y lines
-
-        def fft_x(v):
-            return ex(_crop_axis(v, bo, n0), (bo,), True)  # t3: X lines
-
-        def local_fn(x):  # real [n0p/rows, n1pc/cols, N2]
-            with add_trace("t0_r2c_z"):
-                y = r2c(x, 2 + bo)                      # t0: real Z lines
-            y = exchange_overlapped(
-                y, col_axis, split_axis=2 + bo, concat_axis=1 + bo,
-                axis_size=cols, algorithm=algorithm, compute=fft_y,
-                wire_dtype=wire_dtype,
-                overlap_chunks=overlap_chunks, chunk_axis=bo,
-                exchange_name=f"t2a_exchange_{col_axis}",
-                compute_name="t1_fft_y")
-            return exchange_overlapped(
-                y, row_axis, split_axis=1 + bo, concat_axis=bo,
-                axis_size=rows, algorithm=algorithm, compute=fft_x,
-                wire_dtype=wire_dtype,
-                overlap_chunks=overlap_chunks, chunk_axis=2 + bo,
-                exchange_name=f"t2b_exchange_{row_axis}",
-                compute_name="t3_fft_x")
-
-        pre = lambda x: _pad_axis(_pad_axis(x, bo, n0p), 1 + bo, n1pc)
-        post = lambda y: _crop_axis(_crop_axis(y, 1 + bo, n1), 2 + bo, n2h)
+        nodes = (
+            local_node("t0", "t0_r2c_z", ("r2c", 2 + bo)),
+            exchange_node("t2a", f"t2a_exchange_{col_axis}",
+                          mesh_axis=col_axis, parts=cols, split=2 + bo,
+                          concat=1 + bo, chunk_axis=bo),
+            local_node("t1", "t1_fft_y",
+                       ("crop", 1 + bo, n1), ("fft", (1 + bo,), True),
+                       fuse=True),
+            exchange_node("t2b", f"t2b_exchange_{row_axis}",
+                          mesh_axis=row_axis, parts=rows, split=1 + bo,
+                          concat=bo, chunk_axis=2 + bo),
+            local_node("t3", "t3_fft_x",
+                       ("crop", bo, n0), ("fft", (bo,), True), fuse=True),
+        )
+        pre = (("pad", bo, n0p), ("pad", 1 + bo, n1pc))
+        post = (("crop", 1 + bo, n1), ("crop", 2 + bo, n2h))
     else:
-
-        def ifft_y(v):
-            return ex(_crop_axis(v, 1 + bo, n1), (1 + bo,), False)
-
-        def crop_h(v):
+        nodes = (
+            local_node("t3", "t3_ifft_x", ("fft", (bo,), False)),
+            exchange_node("t2b", f"t2b_exchange_{row_axis}",
+                          mesh_axis=row_axis, parts=rows, split=bo,
+                          concat=1 + bo, chunk_axis=2 + bo),
+            local_node("t1", "t1_ifft_y",
+                       ("crop", 1 + bo, n1), ("fft", (1 + bo,), False),
+                       fuse=True),
             # Per-chunk work after the last exchange is the crop only:
             # chunking the c2r itself trips XLA:CPU's fft-thunk layout
             # RET_CHECK (irfft on a sliced, non-dim0-major operand), so
             # the real Z transform runs monolithically after the merge —
             # the same structure as the slab c2r chain.
-            return _crop_axis(v, 2 + bo, n2h)
+            exchange_node("t2a", f"t2a_exchange_{col_axis}",
+                          mesh_axis=col_axis, parts=cols, split=1 + bo,
+                          concat=2 + bo, chunk_axis=bo),
+            local_node("t1", "t1_crop", ("crop", 2 + bo, n2h), fuse=True),
+            local_node("t0", "t0_c2r_z", ("c2r", n2, 2 + bo)),
+        )
+        # Direction-true spec: perm (1,2,0) row_first makes spec.in_spec
+        # the complex x-pencils and spec.out_spec the real z-pencils.
+        pre = (("pad", 1 + bo, n1pr), ("pad", 2 + bo, n2hp))
+        post = (("crop", bo, n0), ("crop", 1 + bo, n1))
 
-        def local_fn(y):  # complex [N0, n1pr/rows, n2hp/cols]
-            with add_trace("t3_ifft_x"):
-                x = ex(y, (bo,), False)                 # inverse X lines
-            x = exchange_overlapped(
-                x, row_axis, split_axis=bo, concat_axis=1 + bo,
-                axis_size=rows, algorithm=algorithm, compute=ifft_y,
-                wire_dtype=wire_dtype,
-                overlap_chunks=overlap_chunks, chunk_axis=2 + bo,
-                exchange_name=f"t2b_exchange_{row_axis}",
-                compute_name="t1_ifft_y")
-            x = exchange_overlapped(
-                x, col_axis, split_axis=1 + bo, concat_axis=2 + bo,
-                axis_size=cols, algorithm=algorithm, compute=crop_h,
-                wire_dtype=wire_dtype,
-                overlap_chunks=overlap_chunks, chunk_axis=bo,
-                exchange_name=f"t2a_exchange_{col_axis}",
-                compute_name="t1_crop")
-            with add_trace("t0_c2r_z"):
-                return c2r(x, n2, 2 + bo)               # real Z lines
-
-        # Direction-true spec: perm (1,2,0) row_first makes spec.in_spec the
-        # complex x-pencils and spec.out_spec the real z-pencils.
-        pre = lambda y: _pad_axis(_pad_axis(y, 1 + bo, n1pr), 2 + bo, n2hp)
-        post = lambda x: _crop_axis(_crop_axis(x, bo, n0), 1 + bo, n1)
-
-    mapped = _shard_map(local_fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec)
-    in_sh = NamedSharding(mesh, in_spec)
-    jit_kw: dict = {"donate_argnums": 0} if donate else {}
-    # The complex extent n2h = n2//2+1 rarely divides the col axis even when
-    # n2 does, so sharding pinning additionally requires n2hp == n2h.
-    if n0p == n0 and n1pc == n1 and n1pr == n1 and n2hp == n2h:
-        jit_kw |= {"in_shardings": in_sh,
-                   "out_shardings": NamedSharding(mesh, out_spec)}
-
-    @functools.partial(jax.jit, **jit_kw)
-    def fn(x):
-        x = lax.with_sharding_constraint(pre(x), in_sh)
-        return post(mapped(x))
-
-    return fn, spec
+    graph = StageGraph(
+        mesh=mesh, nodes=nodes,
+        in_pspec=batch_pspec(spec.in_spec, batch),
+        out_pspec=batch_pspec(spec.out_spec, batch),
+        pre=pre, post=post,
+        # The complex extent n2h = n2//2+1 rarely divides the col axis
+        # even when n2 does, so sharding pinning additionally requires
+        # n2hp == n2h.
+        even=(n0p == n0 and n1pc == n1 and n1pr == n1 and n2hp == n2h),
+        donate=donate, algorithm=algorithm, wire_dtype=wire_dtype,
+        overlap_chunks=overlap_chunks, executor=executor,
+        meta=dict(shape=spec.shape, batch=batch, forward=forward,
+                  decomposition="pencil", kind="r2c"),
+    )
+    return compile_fused(graph), spec

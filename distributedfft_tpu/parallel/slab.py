@@ -27,34 +27,41 @@ Data layout convention: the forward input is X-slabs (global array sharded
 along axis 0) and the forward output is Y-slabs (sharded along axis 1) in
 *natural index order* — the reference's physically-transposed output layout
 is a GPU-memory-coalescing concern that XLA's layout assignment subsumes.
+
+**Stage-graph IR**: since the chain-IR migration, every builder in this
+module *emits a declarative stage graph* (:mod:`..stagegraph`) instead of
+hand-threading stages; ONE compiler executes it (trace spans, donation,
+overlap-K interleaving, sharding pins). The emitted graphs compile
+byte-identical HLO to the pre-migration chains — pinned in
+``tests/test_a2m_stagegraph.py``.
 """
 
 from __future__ import annotations
 
-import functools
 import math
-from dataclasses import dataclass, field
-from typing import Any, Callable
+from typing import Callable
 
-import jax
 import jax.numpy as jnp
+from dataclasses import dataclass
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-try:  # jax >= 0.6 exposes shard_map at top level
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..geometry import pad_to
-from ..ops.executors import get_c2r, get_executor, get_r2c
-from ..utils.trace import add_trace, trace_stages
+from ..ops.executors import get_executor
+from ..stagegraph import (
+    StagedGraph, StagedStage, StageGraph, apply_midpoint, apply_multiplier,
+    compile_fused, compile_staged, exchange_node, local_node,
+)
 # _pad_axis/_crop_axis live in exchange.py (single definition shared with
 # the ragged path) and are re-exported here for the other chain builders.
-from .exchange import (
-    _axis_label, _crop_axis, _pad_axis, exchange_chunked,
-    exchange_overlapped, hierarchical_legs, wire_codec,
-)
+from .exchange import _axis_label, _crop_axis, _pad_axis  # noqa: F401
+
+__all__ = [
+    "SlabSpec", "build_slab_general", "build_slab_spectral_op",
+    "build_slab_fft3d", "build_slab_rfft3d", "build_slab_stages",
+    "apply_multiplier", "batch_pspec", "check_batch",
+    "combined_axis_index",
+]
 
 _L = "xyz"  # axis index -> stage-name letter (t0_fft_yz taxonomy)
 
@@ -175,24 +182,23 @@ def build_slab_general(
     ``(in_axis, out_axis) = (0, 1)`` (the reference engine's only mode,
     ``fft_mpi_3d_api.cpp:181-214``), backward is (1, 0).
 
-    ``overlap_chunks > 1`` pipelines t2 under t3 along the bystander axis
-    (:func:`.exchange.exchange_overlapped`); 1 is today's monolithic chain.
-
-    ``batch=B`` prepends a leading batch axis: the input is ``[B, N0, N1,
-    N2]`` carrying B independent transforms, t0/t3 run as batched FFTs,
-    and the t2 global transpose is ONE shared collective per (chunk,
-    exchange) with the batch riding as a bystander dim — B transforms pay
-    one collective latency. ``None`` is the unbatched 3D chain, today's
-    HLO exactly.
+    ``overlap_chunks > 1`` pipelines t2 under t3 along the bystander axis;
+    1 is today's monolithic chain. ``batch=B`` prepends a leading batch
+    axis: the input is ``[B, N0, N1, N2]`` carrying B independent
+    transforms, t0/t3 run as batched FFTs, and the t2 global transpose is
+    ONE shared collective per (chunk, exchange) with the batch riding as a
+    bystander dim. ``None`` is the unbatched 3D chain, today's HLO exactly.
 
     ``midpoint`` is the spectral-operator fusion hook (the
     stop-at-transposed / start-from-transposed mode): a wavenumber-
     indexed pointwise multiplier generator applied at the chain's
     transposed full-spectrum midpoint, after which the chain continues
-    with the INVERSE legs back to the input layout — the whole fused
-    FFT -> pointwise -> iFFT round trip as one program
-    (:func:`build_slab_spectral_op`; canonical forward orientation
-    only).
+    with the INVERSE legs back to the input layout
+    (:func:`build_slab_spectral_op`; canonical forward orientation only).
+
+    The chain is emitted as a stage graph — t0 | t1 pack | t2 exchange
+    with the t3 lines as its fused per-chunk compute — and compiled by
+    :func:`..stagegraph.compile_fused`.
     """
     if midpoint is not None:
         if not forward or (in_axis, out_axis) != (0, 1):
@@ -210,7 +216,6 @@ def build_slab_general(
     p, axis_sizes = _axis_parts(mesh, axis_name)
     spec = SlabSpec(tuple(int(s) for s in shape), p, axis_name,
                     in_axis, out_axis)
-    ex = get_executor(executor) if isinstance(executor, str) else executor
     n_in, n_out = spec.shape[in_axis], spec.shape[out_axis]
     n_inp, n_outp = spec.in_padded_extent, spec.out_padded_extent
     local_axes = tuple(a for a in range(3) if a != in_axis)
@@ -221,57 +226,31 @@ def build_slab_general(
     ax_in, ax_out = in_axis + bo, out_axis + bo
     chunk_axis = 3 - in_axis - out_axis + bo  # spatial bystander
 
-    # Stage spans of the reference taxonomy (fft_mpi_3d_api.cpp:184-201):
-    # recorded dispatch-side when the jit first traces, and passed through
-    # to the device timeline as profiler annotations.
-    t0_name = f"t0_fft_{''.join(_L[a] for a in local_axes)}"
-    t2_name = f"t2_exchange_{_axis_label(axis_name)}"
-    t3_name = f"t3_fft_{_L[in_axis]}"
-
-    def t3_chunk(y):
-        y = _crop_axis(y, ax_in, n_in)                   # drop in-axis padding
-        return ex(y, (ax_in,), forward)                  # t3: final lines
-
-    def local_fn(x):  # in_axis extent n_inp/p per device, others full
-        with add_trace(t0_name):
-            y = ex(x, tuple(a + bo for a in local_axes), forward)  # t0
-        with add_trace("t1_pack"):
-            # exchange prep: dense algorithms ceil-pad the split axis
-            # (alltoallv ships the true slices; the pad below is then a
-            # no-op inside exchange_uneven, which skips it)
-            if algorithm != "alltoallv":
-                y = _pad_axis(y, ax_out, n_outp)
-        # t2 + t3: monolithic exchange-then-fft at overlap_chunks=1, the
-        # chunked pipelined interleave above it.
-        return exchange_overlapped(
-            y, axis_name, split_axis=ax_out, concat_axis=ax_in,
-            axis_size=p, algorithm=algorithm, platform=platform,
-            axis_sizes=axis_sizes, wire_dtype=wire_dtype,
-            compute=t3_chunk, overlap_chunks=overlap_chunks,
-            chunk_axis=chunk_axis,
-            exchange_name=t2_name, compute_name=t3_name)
-
-    in_spec = batch_pspec(spec.in_pspec, batch)
-    out_spec = batch_pspec(spec.out_pspec, batch)
-    mapped = _shard_map(local_fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec)
-
-    in_sh = NamedSharding(mesh, in_spec)
-    out_sh = NamedSharding(mesh, out_spec)
-    # jit-level shardings require divisible extents; when the plan pads, the
-    # constraint moves inside (after the pad / before the crop) instead.
-    even = n_inp == n_in and n_outp == n_out
-    jit_kw: dict = {"donate_argnums": 0} if donate else {}
-    if even:
-        jit_kw |= {"in_shardings": in_sh, "out_shardings": out_sh}
-
-    @functools.partial(jax.jit, **jit_kw)
-    def fn(x):
-        x = _pad_axis(x, ax_in, n_inp)
-        x = lax.with_sharding_constraint(x, in_sh)
-        y = mapped(x)
-        return _crop_axis(y, ax_out, n_out)
-
-    return fn, spec
+    # Stage nodes of the reference taxonomy (fft_mpi_3d_api.cpp:184-201).
+    nodes = (
+        local_node("t0", f"t0_fft_{''.join(_L[a] for a in local_axes)}",
+                   ("fft", tuple(a + bo for a in local_axes), forward)),
+        local_node("t1", "t1_pack", ("pack", ax_out, n_outp)),
+        exchange_node("t2", f"t2_exchange_{_axis_label(axis_name)}",
+                      mesh_axis=axis_name, parts=p, split=ax_out,
+                      concat=ax_in, chunk_axis=chunk_axis,
+                      axis_sizes=axis_sizes),
+        local_node("t3", f"t3_fft_{_L[in_axis]}",
+                   ("crop", ax_in, n_in), ("fft", (ax_in,), forward),
+                   fuse=True),
+    )
+    graph = StageGraph(
+        mesh=mesh, nodes=nodes,
+        in_pspec=batch_pspec(spec.in_pspec, batch),
+        out_pspec=batch_pspec(spec.out_pspec, batch),
+        pre=(("pad", ax_in, n_inp),), post=(("crop", ax_out, n_out),),
+        even=n_inp == n_in and n_outp == n_out, donate=donate,
+        algorithm=algorithm, platform=platform, wire_dtype=wire_dtype,
+        overlap_chunks=overlap_chunks, executor=executor,
+        meta=dict(shape=spec.shape, batch=batch, forward=forward,
+                  decomposition="slab", kind="c2c"),
+    )
+    return compile_fused(graph), spec
 
 
 def combined_axis_index(mesh: Mesh, axis_name):
@@ -286,18 +265,6 @@ def combined_axis_index(mesh: Mesh, axis_name):
             idx = idx * mesh.shape[a] + lax.axis_index(a)
         return idx
     return lax.axis_index(axis_name)
-
-
-def apply_multiplier(u: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
-    """Pointwise spectral multiply without dtype surprises: a real
-    multiplier casts to the payload's component dtype (f64 constants
-    must not promote a c64 chain to c128), a complex one to the payload
-    dtype. ``m`` is rank-3 (spatial) and broadcasts over any leading
-    batch axis."""
-    if jnp.issubdtype(m.dtype, jnp.complexfloating):
-        return u * m.astype(u.dtype)
-    rdt = jnp.float64 if u.dtype == jnp.dtype(jnp.complex128) else jnp.float32
-    return u * m.astype(rdt)
 
 
 def build_slab_spectral_op(
@@ -347,6 +314,11 @@ def build_slab_spectral_op(
     I/O is the canonical X-slab layout on both sides (in == out
     sharding); forward transform unnormalized, inverse scaled 1/N —
     i.e. a unit multiplier is the identity.
+
+    The midpoint rides the graph as a *factory* node: the per-shard
+    wavenumber offset (``combined_axis_index``) is emitted at trace
+    time right before the outbound exchange issues — the position the
+    hand-threaded chain emitted it, part of the HLO byte-parity pin.
     """
     check_batch(batch)
     p, axis_sizes = _axis_parts(mesh, axis_name)
@@ -359,76 +331,57 @@ def build_slab_spectral_op(
     c1 = n1p // p  # transposed-midpoint local extent of the k1 axis
     t2_name = f"t2_exchange_{_axis_label(axis_name)}"
 
-    def local_fn(x):  # X-slab shard [(B,) n0p/p, N1, N2]
-        with add_trace("t0_fft_yz"):
-            y = ex(x, (1 + bo, 2 + bo), True)            # t0: YZ planes
-        with add_trace("t1_pack"):
-            if algorithm != "alltoallv":
-                y = _pad_axis(y, 1 + bo, n1p)
+    def mid_factory():
+        # The transposed-space midpoint: final forward FFT, the
+        # wavenumber-diagonal multiply, and the first inverse FFT — all
+        # local in the Y-slab layout (k0 full, k1 this shard's slice,
+        # k2 the overlap chunk's slice).
         k1_lo = combined_axis_index(mesh, axis_name) * c1
 
         def mid_chunk(u, lo, hi):
-            # The transposed-space midpoint: final forward FFT, the
-            # wavenumber-diagonal multiply, and the first inverse FFT —
-            # all local in the Y-slab layout (k0 full, k1 this shard's
-            # slice, k2 this overlap chunk's slice).
             u = _crop_axis(u, bo, n0)
             u = ex(u, (bo,), True)                       # t3 of fwd half
-            with add_trace("t_mid_pointwise"):
-                m = multiplier(
-                    jnp.arange(n0, dtype=jnp.int32)[:, None, None],
-                    (k1_lo + jnp.arange(c1, dtype=jnp.int32))[None, :, None],
-                    jnp.arange(lo, hi, dtype=jnp.int32)[None, None, :])
-                u = apply_multiplier(u, m)
+            u = apply_midpoint(u, multiplier, (
+                jnp.arange(n0, dtype=jnp.int32)[:, None, None],
+                (k1_lo + jnp.arange(c1, dtype=jnp.int32))[None, :, None],
+                jnp.arange(lo, hi, dtype=jnp.int32)[None, None, :]))
             return ex(u, (bo,), False)                   # inverse X lines
 
-        y = exchange_overlapped(
-            y, axis_name, split_axis=1 + bo, concat_axis=bo,
-            axis_size=p, algorithm=algorithm, platform=platform,
-            axis_sizes=axis_sizes, wire_dtype=wire_dtype,
-            compute=mid_chunk, compute_takes_bounds=True,
-            overlap_chunks=overlap_chunks, chunk_axis=2 + bo,
-            exchange_name=t2_name, compute_name="t_mid")
-        with add_trace("t1_pack"):
-            if algorithm != "alltoallv":
-                y = _pad_axis(y, bo, n0p)
+        return mid_chunk
 
-        def inv_chunk(v):
-            v = _crop_axis(v, 1 + bo, n1)
-            return ex(v, (1 + bo,), False)               # inverse Y lines
-
-        # The inverse Z pass transforms the bystander (chunk) axis, so it
-        # runs monolithically after the chunked exchange/ifft-Y merge —
-        # the same discipline as the c2r chains' final real transform.
-        y = exchange_overlapped(
-            y, axis_name, split_axis=bo, concat_axis=1 + bo,
-            axis_size=p, algorithm=algorithm, platform=platform,
-            axis_sizes=axis_sizes, wire_dtype=wire_dtype,
-            compute=inv_chunk,
-            overlap_chunks=overlap_chunks, chunk_axis=2 + bo,
-            exchange_name=t2_name, compute_name="t3_ifft_y")
-        with add_trace("t3_ifft_z"):
-            return ex(y, (2 + bo,), False)               # inverse Z lines
-
+    nodes = (
+        local_node("t0", "t0_fft_yz", ("fft", (1 + bo, 2 + bo), True)),
+        local_node("t1", "t1_pack", ("pack", 1 + bo, n1p)),
+        exchange_node("t2", t2_name, mesh_axis=axis_name, parts=p,
+                      split=1 + bo, concat=bo, chunk_axis=2 + bo,
+                      axis_sizes=axis_sizes),
+        local_node("t_mid", "t_mid", fuse=True, takes_bounds=True,
+                   factory=mid_factory),
+        local_node("t1", "t1_pack", ("pack", bo, n0p)),
+        exchange_node("t2", t2_name, mesh_axis=axis_name, parts=p,
+                      split=bo, concat=1 + bo, chunk_axis=2 + bo,
+                      axis_sizes=axis_sizes),
+        local_node("t3", "t3_ifft_y",
+                   ("crop", 1 + bo, n1), ("fft", (1 + bo,), False),
+                   fuse=True),
+        # The inverse Z pass transforms the bystander (chunk) axis, so
+        # it runs monolithically after the chunked exchange/ifft-Y merge
+        # — the same discipline as the c2r chains' final real transform.
+        local_node("t3", "t3_ifft_z", ("fft", (2 + bo,), False)),
+    )
     io_spec = batch_pspec(spec.in_pspec, batch)
-    mapped = _shard_map(local_fn, mesh=mesh, in_specs=(io_spec,),
-                        out_specs=io_spec)
-    io_sh = NamedSharding(mesh, io_spec)
-    # Only axis 0 is sharded at the jit boundary (in == out layout), so
-    # the sharding pin needs only the in-axis to divide.
-    even = n0p == n0
-    jit_kw: dict = {"donate_argnums": 0} if donate else {}
-    if even:
-        jit_kw |= {"in_shardings": io_sh, "out_shardings": io_sh}
-
-    @functools.partial(jax.jit, **jit_kw)
-    def fn(x):
-        x = _pad_axis(x, bo, n0p)
-        x = lax.with_sharding_constraint(x, io_sh)
-        y = mapped(x)
-        return _crop_axis(y, bo, n0)
-
-    return fn, spec
+    graph = StageGraph(
+        mesh=mesh, nodes=nodes, in_pspec=io_spec, out_pspec=io_spec,
+        pre=(("pad", bo, n0p),), post=(("crop", bo, n0),),
+        # Only axis 0 is sharded at the jit boundary (in == out layout),
+        # so the sharding pin needs only the in-axis to divide.
+        even=n0p == n0, donate=donate, algorithm=algorithm,
+        platform=platform, wire_dtype=wire_dtype,
+        overlap_chunks=overlap_chunks, executor=executor,
+        meta=dict(shape=spec.shape, batch=batch, forward=True,
+                  decomposition="slab", kind="op"),
+    )
+    return compile_fused(graph), spec
 
 
 def build_slab_fft3d(
@@ -498,77 +451,52 @@ def build_slab_rfft3d(
         tuple(int(s) for s in shape), p, axis_name,
         in_axis=0 if forward else 1, out_axis=1 if forward else 0,
     )
-    ex = get_executor(executor)
-    r2c, c2r = get_r2c(executor), get_c2r(executor)
     n0, n1, n2 = spec.shape
     n0p, n1p = spec.n0p, spec.n1p
     bo = 0 if batch is None else 1  # leading-batch axis offset
-    in_spec = batch_pspec(spec.in_pspec, batch)
-    out_spec = batch_pspec(spec.out_pspec, batch)
+    t2_name = f"t2_exchange_{axis_name}"
 
     if forward:
-
-        def t3_chunk(y):
-            y = _crop_axis(y, bo, n0)
-            return ex(y, (bo,), True)                    # t3: X lines
-
-        def local_fn(x):  # real [n0p/p, N1, N2] per device
-            with add_trace("t0_r2c_zy"):
-                y = r2c(x, 2 + bo)                       # t0a: real Z lines
-                y = ex(y, (1 + bo,), True)               # t0b: Y lines
-            with add_trace("t1_pack"):
-                if algorithm != "alltoallv":
-                    y = _pad_axis(y, 1 + bo, n1p)
-            return exchange_overlapped(
-                y, axis_name, split_axis=1 + bo, concat_axis=bo,
-                axis_size=p, algorithm=algorithm, compute=t3_chunk,
-                wire_dtype=wire_dtype,
-                overlap_chunks=overlap_chunks, chunk_axis=2 + bo,
-                exchange_name=f"t2_exchange_{axis_name}",
-                compute_name="t3_fft_x")
-
-        pre = lambda x: _pad_axis(x, bo, n0p)
-        post = lambda y: _crop_axis(y, 1 + bo, n1)
+        nodes = (
+            local_node("t0", "t0_r2c_zy",
+                       ("r2c", 2 + bo),          # t0a: real Z lines
+                       ("fft", (1 + bo,), True)),  # t0b: Y lines
+            local_node("t1", "t1_pack", ("pack", 1 + bo, n1p)),
+            exchange_node("t2", t2_name, mesh_axis=axis_name, parts=p,
+                          split=1 + bo, concat=bo, chunk_axis=2 + bo),
+            local_node("t3", "t3_fft_x",
+                       ("crop", bo, n0), ("fft", (bo,), True), fuse=True),
+        )
+        pre = (("pad", bo, n0p),)
+        post = (("crop", 1 + bo, n1),)
     else:
+        nodes = (
+            local_node("t3", "t3_ifft_x", ("fft", (bo,), False)),
+            local_node("t1", "t1_pack", ("pack", bo, n0p)),
+            exchange_node("t2", t2_name, mesh_axis=axis_name, parts=p,
+                          split=bo, concat=1 + bo, chunk_axis=2 + bo),
+            local_node("t0", "t0_ifft_y",
+                       ("crop", 1 + bo, n1), ("fft", (1 + bo,), False),
+                       fuse=True),
+            # The c2r (real Z lines) transforms the bystander axis, so
+            # it runs monolithically after the chunked merge.
+            local_node("t0", "t0_c2r_z", ("c2r", n2, 2 + bo)),
+        )
+        pre = (("pad", 1 + bo, n1p),)
+        post = (("crop", bo, n0),)
 
-        def t0_chunk(x):
-            x = _crop_axis(x, 1 + bo, n1)
-            return ex(x, (1 + bo,), False)               # inverse Y lines
-
-        def local_fn(y):  # complex [N0, n1p/p, n2h] per device
-            with add_trace("t3_ifft_x"):
-                x = ex(y, (bo,), False)                  # inverse X lines
-            with add_trace("t1_pack"):
-                if algorithm != "alltoallv":
-                    x = _pad_axis(x, bo, n0p)
-            # The c2r (real Z lines) transforms the bystander axis, so it
-            # runs monolithically after the chunked exchange/ifft-Y merge.
-            x = exchange_overlapped(
-                x, axis_name, split_axis=bo, concat_axis=1 + bo,
-                axis_size=p, algorithm=algorithm, compute=t0_chunk,
-                wire_dtype=wire_dtype,
-                overlap_chunks=overlap_chunks, chunk_axis=2 + bo,
-                exchange_name=f"t2_exchange_{axis_name}",
-                compute_name="t0_ifft_y")
-            with add_trace("t0_c2r_z"):
-                return c2r(x, n2, 2 + bo)                # real Z lines
-
-        pre = lambda y: _pad_axis(y, 1 + bo, n1p)
-        post = lambda x: _crop_axis(x, bo, n0)
-
-    mapped = _shard_map(local_fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec)
-    in_sh = NamedSharding(mesh, in_spec)
-    jit_kw: dict = {"donate_argnums": 0} if donate else {}
-    if spec.n0p == n0 and spec.n1p == n1:
-        jit_kw |= {"in_shardings": in_sh,
-                   "out_shardings": NamedSharding(mesh, out_spec)}
-
-    @functools.partial(jax.jit, **jit_kw)
-    def fn(x):
-        x = lax.with_sharding_constraint(pre(x), in_sh)
-        return post(mapped(x))
-
-    return fn, spec
+    graph = StageGraph(
+        mesh=mesh, nodes=nodes,
+        in_pspec=batch_pspec(spec.in_pspec, batch),
+        out_pspec=batch_pspec(spec.out_pspec, batch),
+        pre=pre, post=post,
+        even=n0p == n0 and n1p == n1, donate=donate,
+        algorithm=algorithm, wire_dtype=wire_dtype,
+        overlap_chunks=overlap_chunks, executor=executor,
+        meta=dict(shape=spec.shape, batch=batch, forward=forward,
+                  decomposition="slab", kind="r2c"),
+    )
+    return compile_fused(graph), spec
 
 
 def build_slab_stages(
@@ -588,9 +516,9 @@ def build_slab_stages(
     (``fft_mpi_3d_api.cpp:184-201``). Fusing everything under one jit hides
     the ICI cost (SURVEY.md §7 "hard parts"), so benchmarking keeps this
     staged mode alongside the fused one. ``overlap_chunks > 1`` keeps the
-    overlapped chains' K-collective transport shape inside the t2 stage
-    (:func:`.exchange.exchange_chunked`). ``batch=B`` runs the stages over
-    ``[B, ...]`` arrays with one shared exchange per chunk.
+    overlapped chains' K-collective transport shape inside the t2 stage.
+    ``batch=B`` runs the stages over ``[B, ...]`` arrays with one shared
+    exchange per chunk.
 
     ``algorithm="hierarchical"`` (hybrid mesh; ``axis_name`` a (dcn, ici)
     tuple) splits the t2 stage into its two axis-local legs — separately
@@ -599,105 +527,88 @@ def build_slab_stages(
     per-chunk leg boundary would multiply stage dispatches), but inside
     it the K chunks run the leg-level pipeline — chunk i's ICI leg
     issued before chunk i-1's DCN leg, with per-chunk ``t2a[k]`` /
-    ``t2b[k]`` spans (:func:`.exchange.exchange_chunked`).
-    ``wire_dtype`` compresses each exchange stage's wire exactly like the
-    fused chain (the t2 stage boundary still carries the decoded complex
-    array, so stage I/O shapes are unchanged).
+    ``t2b[k]`` spans. ``wire_dtype`` compresses each exchange stage's
+    wire exactly like the fused chain (the t2 stage boundary still
+    carries the decoded complex array, so stage I/O shapes are
+    unchanged).
+
+    Emitted as a :class:`..stagegraph.StagedGraph` and compiled by
+    :func:`..stagegraph.compile_staged`.
     """
     check_batch(batch)
     p, axis_sizes = _axis_parts(mesh, axis_name)
     spec = SlabSpec(tuple(int(s) for s in shape), p, axis_name)
-    ex = get_executor(executor) if isinstance(executor, str) else executor
-    n0, n1, n2 = spec.shape
+    n0, n1, _ = spec.shape
     n0p, n1p = spec.n0p, spec.n1p
     bo = 0 if batch is None else 1  # leading-batch axis offset
 
     xs = batch_pspec(P(axis_name, None, None), batch)
     ys = batch_pspec(P(None, axis_name, None), batch)
-    x_slab = NamedSharding(mesh, xs)
-    y_slab = NamedSharding(mesh, ys)
 
-    def smap(f, ins, outs):
-        return _shard_map(f, mesh=mesh, in_specs=(ins,), out_specs=outs)
-
-    def t2_stages(split_axis, concat_axis, ins, outs, in_sh, out_sh):
+    def t2_stages(split, concat, ins, outs):
         """The t2 tier: one chunked exchange stage, or the hierarchical
         transport's two per-leg stages (K=1 only — see docstring)."""
         if algorithm == "hierarchical" and overlap_chunks <= 1:
-            leg_ici, leg_dcn = hierarchical_legs(
-                axis_name, split_axis=split_axis, concat_axis=concat_axis,
-                axis_sizes=axis_sizes)
             dcn_name, ici_name = axis_name
-
-            def wrap(leg, tile_axis_out):
-                if wire_dtype is None:
-                    return leg
-                # Per-leg wire casts: every registered codec round-trips
-                # idempotently (bf16 by value, int8 by its power-of-two
-                # steps), so leg-boundary decode/re-encode is
-                # bit-identical to the fused chain's single cast pair
-                # around both legs. The legs permute peer tiles and
-                # sidecar slots identically, so decode aligns on the
-                # axis the tiles sit on at the leg's exit
-                # (``tile_axis_out``).
-                codec = wire_codec(wire_dtype)
-
-                def run(u):
-                    parts = codec.encode(u, tile_axis=split_axis,
-                                         tiles=p)
-                    done = tuple(leg(w) for w in parts)
-                    return codec.decode(done, u.dtype,
-                                        tile_axis=tile_axis_out,
-                                        tiles=p)
-
-                return run
-
+            leg = dict(mesh_axis=axis_name, split=split, concat=concat,
+                       axis_sizes=axis_sizes, parts=p)
+            jn = "run" if wire_dtype is not None else None
             return [
-                (f"t2a_exchange_{_axis_label(ici_name)}", jax.jit(
-                    smap(wrap(leg_ici, split_axis), ins, ins),
-                    in_shardings=in_sh, out_shardings=in_sh)),
-                (f"t2b_exchange_{_axis_label(dcn_name)}", jax.jit(
-                    smap(wrap(leg_dcn, concat_axis), ins, outs),
-                    in_shardings=in_sh, out_shardings=out_sh)),
+                StagedStage(
+                    kind="t2a",
+                    name=f"t2a_exchange_{_axis_label(ici_name)}",
+                    jit_name=jn or "leg_ici", smap_in=ins, smap_out=ins,
+                    leg=dict(leg, which="ici", tile_axis_out=split),
+                    pin_in=ins, pin_out=ins),
+                StagedStage(
+                    kind="t2b",
+                    name=f"t2b_exchange_{_axis_label(dcn_name)}",
+                    jit_name=jn or "leg_dcn", smap_in=ins, smap_out=outs,
+                    leg=dict(leg, which="dcn", tile_axis_out=concat),
+                    pin_in=ins, pin_out=outs),
             ]
         return [
-            ("t2_all_to_all", jax.jit(
-                smap(lambda v: exchange_chunked(
-                    v, axis_name, split_axis=split_axis,
-                    concat_axis=concat_axis, axis_size=p,
-                    algorithm=algorithm, axis_sizes=axis_sizes,
-                    wire_dtype=wire_dtype,
-                    overlap_chunks=overlap_chunks, chunk_axis=2 + bo),
-                    ins, outs),
-                in_shardings=in_sh, out_shardings=out_sh)),
+            StagedStage(
+                kind="t2", name="t2_all_to_all", smap_in=ins,
+                smap_out=outs,
+                exchange=dict(mesh_axis=axis_name, parts=p, split=split,
+                              concat=concat, chunk_axis=2 + bo,
+                              axis_sizes=axis_sizes),
+                pin_in=ins, pin_out=outs),
         ]
 
     if forward:
         stages = [
-            ("t0_fft_yz", jax.jit(
-                lambda x: _pad_axis(smap(
-                    lambda v: ex(v, (1 + bo, 2 + bo), True), xs, xs)(
-                    _pad_axis(x, bo, n0p)), 1 + bo, n1p),
-                in_shardings=x_slab, out_shardings=x_slab)),
-            *t2_stages(1 + bo, bo, xs, ys, x_slab, y_slab),
-            ("t3_fft_x", jax.jit(
-                lambda v: _crop_axis(smap(
-                    lambda u: ex(_crop_axis(u, bo, n0), (bo,), True),
-                    ys, ys)(v), 1 + bo, n1),
-                in_shardings=y_slab, out_shardings=y_slab)),
+            StagedStage(
+                kind="t0", name="t0_fft_yz", smap_in=xs, smap_out=xs,
+                local=(("fft", (1 + bo, 2 + bo), True),),
+                pre=(("pad", bo, n0p),), post=(("pad", 1 + bo, n1p),),
+                pin_in=xs, pin_out=xs),
+            *t2_stages(1 + bo, bo, xs, ys),
+            StagedStage(
+                kind="t3", name="t3_fft_x", smap_in=ys, smap_out=ys,
+                local=(("crop", bo, n0), ("fft", (bo,), True)),
+                post=(("crop", 1 + bo, n1),), pin_in=ys, pin_out=ys),
         ]
     else:
         stages = [
-            ("t3_ifft_x", jax.jit(
-                lambda v: _pad_axis(smap(
-                    lambda u: ex(u, (bo,), False), ys, ys)(
-                    _pad_axis(v, 1 + bo, n1p)), bo, n0p),
-                in_shardings=y_slab, out_shardings=y_slab)),
-            *t2_stages(bo, 1 + bo, ys, xs, y_slab, x_slab),
-            ("t0_ifft_yz", jax.jit(
-                lambda v: _crop_axis(smap(
-                    lambda u: ex(_crop_axis(u, 1 + bo, n1), (1 + bo, 2 + bo),
-                                 False), xs, xs)(v), bo, n0),
-                in_shardings=x_slab, out_shardings=x_slab)),
+            StagedStage(
+                kind="t3", name="t3_ifft_x", smap_in=ys, smap_out=ys,
+                local=(("fft", (bo,), False),),
+                pre=(("pad", 1 + bo, n1p),), post=(("pad", bo, n0p),),
+                pin_in=ys, pin_out=ys),
+            *t2_stages(bo, 1 + bo, ys, xs),
+            StagedStage(
+                kind="t0", name="t0_ifft_yz", smap_in=xs, smap_out=xs,
+                local=(("crop", 1 + bo, n1),
+                       ("fft", (1 + bo, 2 + bo), False)),
+                post=(("crop", bo, n0),), pin_in=xs, pin_out=xs),
         ]
-    return trace_stages(stages), spec
+    graph = StagedGraph(
+        mesh=mesh, stages=tuple(stages), algorithm=algorithm,
+        wire_dtype=wire_dtype, overlap_chunks=overlap_chunks,
+        executor=executor,
+        meta=dict(shape=spec.shape, batch=batch, forward=forward,
+                  decomposition="slab", kind="c2c"),
+    )
+    return compile_staged(graph), spec

@@ -14,6 +14,16 @@ ceil-padded global arrays; shardings are established with
 ``with_sharding_constraint`` inside each stage (not pinned on the jits), so
 uneven extents — e.g. the r2c half-spectrum n2//2+1, which almost never
 divides the mesh — work in staged mode too.
+
+**Stage-graph IR**: every staged builder here emits a
+:class:`..stagegraph.StagedGraph` — per-stage nodes carrying their
+boundary layouts, pads/crops, and exchange transport — compiled by
+:func:`..stagegraph.compile_staged` into the ``[(name, jit), ...]``
+pipeline, byte-identical to the pre-migration hand-threaded stages
+(pinned in ``tests/test_a2m_stagegraph.py``). The pipelines stay
+tree-generic over the stage value (the dd tier's (hi, lo) pair rides
+:func:`build_pencil_stages` unchanged through
+``ddslab.build_dd_pencil_stages``).
 """
 
 from __future__ import annotations
@@ -21,21 +31,13 @@ from __future__ import annotations
 from typing import Callable
 
 import jax
-import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-try:
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..geometry import pad_to
-from ..ops.executors import (
-    get_c2r, get_executor, get_r2c, thunk_guard_substitute,
-)
+from ..ops.executors import get_executor, thunk_guard_substitute
+from ..stagegraph import StagedGraph, StagedStage, compile_staged
 from ..utils.trace import trace_stages
-from .exchange import exchange_chunked
 from .pencil import PencilSpec
 from .slab import SlabSpec, _crop_axis, _pad_axis, batch_pspec, check_batch
 
@@ -60,7 +62,8 @@ def build_single_stages(
     one rank (``fft_mpi_3d_api.cpp:184-201``; t1/t2 are identically zero
     without a transpose/exchange). With the pallas executor, t0 is the
     fused 2D plane kernel and t3 the strided axis-0 kernel. ``batch=B``
-    runs the stages over ``[B, ...]`` arrays."""
+    runs the stages over ``[B, ...]`` arrays. (No mesh, no exchange —
+    the one staged pipeline below the stage-graph IR's mesh tier.)"""
     check_batch(batch)
     bo = 0 if batch is None else 1
     ex = get_executor(executor) if isinstance(executor, str) else executor
@@ -74,19 +77,6 @@ _AXIS_LETTER = "xyz"
 
 def _pspec(mapping: dict[int, str]) -> P:
     return P(*[mapping.get(d) for d in range(3)])
-
-
-# Tree-aware stage primitives: the pencil pipeline below is generic over
-# the stage value — a single c64 array, or any pytree of same-shape
-# arrays (the dd tier's (hi, lo) pair rides through unchanged; specs and
-# shardings broadcast as pytree prefixes). The exchanges themselves go
-# through the tree-generic :func:`.exchange.exchange_chunked`.
-def _tpad(x, ax: int, to: int):
-    return jax.tree_util.tree_map(lambda u: _pad_axis(u, ax, to), x)
-
-
-def _tcrop(x, ax: int, to: int):
-    return jax.tree_util.tree_map(lambda u: _crop_axis(u, ax, to), x)
 
 
 def build_pencil_stages(
@@ -108,9 +98,9 @@ def build_pencil_stages(
     t0 (first fft) | t2a (first exchange) | t1 (mid fft) | t2b (second
     exchange) | t3 (last fft) — the reference's taxonomy with the two
     pencil exchanges split out as t2a/t2b. ``overlap_chunks > 1`` keeps
-    the overlapped chains' K-collective shape inside each exchange stage
-    (:func:`.exchange.exchange_chunked`). ``batch=B`` runs the stages
-    over ``[B, ...]`` arrays with one shared exchange per chunk.
+    the overlapped chains' K-collective transport shape inside each
+    exchange stage. ``batch=B`` runs the stages over ``[B, ...]`` arrays
+    with one shared exchange per chunk.
 
     Generic over the stage value: ``executor`` may be a callable taking
     any pytree of same-shape arrays (the dd tier passes a (hi, lo) pair
@@ -142,7 +132,6 @@ def build_pencil_stages(
         uneven=bool(n[a] % rows or n[b] % cols
                     or n[seq[0][2]] % seq[0][1]
                     or n[seq[1][2]] % seq[1][1]))
-    ex = get_executor(executor) if isinstance(executor, str) else executor
 
     in_lay = {a: row_axis, b: col_axis}
     mid_lay = ({a: row_axis, c: col_axis} if order == "col_first"
@@ -151,78 +140,61 @@ def build_pencil_stages(
     out_lay = {op[0]: row_axis, op[1]: col_axis}
 
     bspec = lambda lay: batch_pspec(_pspec(lay), batch)
-    sh = lambda lay: NamedSharding(mesh, bspec(lay))
-    in_sh, mid_sh, out_sh = sh(in_lay), sh(mid_lay), sh(out_lay)
+    ins, mid, outs = bspec(in_lay), bspec(mid_lay), bspec(out_lay)
     pads = {a: pad_to(n[a], rows), b: pad_to(n[b], cols)}
     # each exchange's split axis is padded to its part count before it runs
     pads[seq[0][2]] = pad_to(n[seq[0][2]], seq[0][1])
     mid_pad = pad_to(n[seq[1][2]], seq[1][1])
 
-    def smap(f, lay_in, lay_out):
-        return _shard_map(f, mesh=mesh, in_specs=(bspec(lay_in),),
-                          out_specs=bspec(lay_out))
-
-    def t0(x):
-        x = _tpad(_tpad(x, a + bo, pads[a]), b + bo, pads[b])
-        x = lax.with_sharding_constraint(x, in_sh)
-        y = smap(lambda v: ex(v, (c + bo,), forward), in_lay, in_lay)(x)
-        y = _tpad(y, seq[0][2] + bo, pads[seq[0][2]])
-        return lax.with_sharding_constraint(y, in_sh)
-
-    def t2a(x):
-        x = lax.with_sharding_constraint(x, in_sh)
-        mesh_ax, parts, split, concat = seq[0]
-        y = smap(lambda v: exchange_chunked(
-            v, mesh_ax, split_axis=split + bo, concat_axis=concat + bo,
-            axis_size=parts, algorithm=algorithm,
-            wire_dtype=wire_dtype,
-            overlap_chunks=overlap_chunks,
-            chunk_axis=3 - split - concat + bo,
-            exchange_name=f"t2a_exchange_{mesh_ax}"),
-                 in_lay, mid_lay)(x)
-        return lax.with_sharding_constraint(y, mid_sh)
-
-    def t1(x):
-        x = lax.with_sharding_constraint(x, mid_sh)
-        concat0 = seq[0][3]
-        y = smap(lambda v: _tpad(
-            ex(_tcrop(v, concat0 + bo, n[concat0]), (mid_fft + bo,),
-               forward),
-            seq[1][2] + bo, mid_pad), mid_lay, mid_lay)(x)
-        return lax.with_sharding_constraint(y, mid_sh)
-
-    def t2b(x):
-        x = lax.with_sharding_constraint(x, mid_sh)
-        mesh_ax, parts, split, concat = seq[1]
-        y = smap(lambda v: exchange_chunked(
-            v, mesh_ax, split_axis=split + bo, concat_axis=concat + bo,
-            axis_size=parts, algorithm=algorithm,
-            wire_dtype=wire_dtype,
-            overlap_chunks=overlap_chunks,
-            chunk_axis=3 - split - concat + bo,
-            exchange_name=f"t2b_exchange_{mesh_ax}"),
-                 mid_lay, out_lay)(x)
-        return lax.with_sharding_constraint(y, out_sh)
-
-    def t3(x):
-        x = lax.with_sharding_constraint(x, out_sh)
-        concat1 = seq[1][3]
-        y = smap(lambda v: ex(_tcrop(v, concat1 + bo, n[concat1]),
-                              (last_fft + bo,), forward),
-                 out_lay, out_lay)(x)
-        for ax in op:
-            y = _tcrop(y, ax + bo, n[ax])
-        return y
-
     L = _AXIS_LETTER
-    stages = [
-        (f"t0_fft_{L[c]}", jax.jit(t0)),
-        (f"t2a_exchange_{seq[0][0]}", jax.jit(t2a)),
-        (f"t1_fft_{L[mid_fft]}", jax.jit(t1)),
-        (f"t2b_exchange_{seq[1][0]}", jax.jit(t2b)),
-        (f"t3_fft_{L[last_fft]}", jax.jit(t3)),
-    ]
-    return trace_stages(stages), spec
+    concat0, concat1 = seq[0][3], seq[1][3]
+    stages = (
+        StagedStage(
+            kind="t0", name=f"t0_fft_{L[c]}", jit_name="t0",
+            smap_in=ins, smap_out=ins,
+            local=(("fft", (c + bo,), forward),),
+            pre=(("pad", a + bo, pads[a]), ("pad", b + bo, pads[b])),
+            post=(("pad", seq[0][2] + bo, pads[seq[0][2]]),),
+            wsc_in=ins, wsc_out=ins),
+        StagedStage(
+            kind="t2a", name=f"t2a_exchange_{seq[0][0]}", jit_name="t2a",
+            smap_in=ins, smap_out=mid,
+            exchange=dict(mesh_axis=seq[0][0], parts=seq[0][1],
+                          split=seq[0][2] + bo, concat=seq[0][3] + bo,
+                          chunk_axis=3 - seq[0][2] - seq[0][3] + bo,
+                          exchange_name=f"t2a_exchange_{seq[0][0]}"),
+            wsc_in=ins, wsc_out=mid),
+        StagedStage(
+            kind="t1", name=f"t1_fft_{L[mid_fft]}", jit_name="t1",
+            smap_in=mid, smap_out=mid,
+            local=(("crop", concat0 + bo, n[concat0]),
+                   ("fft", (mid_fft + bo,), forward),
+                   ("pad", seq[1][2] + bo, mid_pad)),
+            wsc_in=mid, wsc_out=mid),
+        StagedStage(
+            kind="t2b", name=f"t2b_exchange_{seq[1][0]}", jit_name="t2b",
+            smap_in=mid, smap_out=outs,
+            exchange=dict(mesh_axis=seq[1][0], parts=seq[1][1],
+                          split=seq[1][2] + bo, concat=seq[1][3] + bo,
+                          chunk_axis=3 - seq[1][2] - seq[1][3] + bo,
+                          exchange_name=f"t2b_exchange_{seq[1][0]}"),
+            wsc_in=mid, wsc_out=outs),
+        StagedStage(
+            kind="t3", name=f"t3_fft_{L[last_fft]}", jit_name="t3",
+            smap_in=outs, smap_out=outs,
+            local=(("crop", concat1 + bo, n[concat1]),
+                   ("fft", (last_fft + bo,), forward)),
+            post=tuple(("crop", ax + bo, n[ax]) for ax in op),
+            wsc_in=outs),
+    )
+    graph = StagedGraph(
+        mesh=mesh, stages=stages, algorithm=algorithm,
+        wire_dtype=wire_dtype, overlap_chunks=overlap_chunks,
+        executor=executor,
+        meta=dict(shape=spec.shape, batch=batch, forward=forward,
+                  decomposition="pencil", kind="c2c"),
+    )
+    return compile_staged(graph), spec
 
 
 def build_slab_op_stages(
@@ -251,9 +223,10 @@ def build_slab_op_stages(
     ``multiplier(i0, i1, i2)`` follows the fused builder's contract
     (int32 global index grids, per-shard offsets applied here).
     ``overlap_chunks > 1`` keeps the K-collective transport shape
-    inside each exchange stage (:func:`.exchange.exchange_chunked`);
-    flat transports and a plain 1D mesh axis only (the hierarchical
-    two-leg chain measures fused)."""
+    inside each exchange stage; flat transports and a plain 1D mesh
+    axis only (the hierarchical two-leg chain measures fused)."""
+    import jax.numpy as jnp
+
     from .slab import apply_multiplier
 
     check_batch(batch)
@@ -266,66 +239,56 @@ def build_slab_op_stages(
     c1 = n1p // p  # transposed-midpoint local extent of the k1 axis
     xs = batch_pspec(P(axis_name, None, None), batch)
     ys = batch_pspec(P(None, axis_name, None), batch)
-    x_sh, y_sh = NamedSharding(mesh, xs), NamedSharding(mesh, ys)
 
-    def smap(f, i, o):
-        return _shard_map(f, mesh=mesh, in_specs=(i,), out_specs=o)
+    def mid_local(u):
+        u = _crop_axis(u, bo, n0)
+        u = ex(u, (bo,), True)                   # final forward X
+        k1_lo = lax.axis_index(axis_name) * c1
+        m = multiplier(
+            jnp.arange(n0, dtype=jnp.int32)[:, None, None],
+            (k1_lo + jnp.arange(c1, dtype=jnp.int32))[None, :, None],
+            jnp.arange(n2, dtype=jnp.int32)[None, None, :])
+        u = apply_multiplier(u, m)
+        return _pad_axis(ex(u, (bo,), False), bo, n0p)  # inverse X
 
-    def t0(x):
-        x = lax.with_sharding_constraint(_pad_axis(x, bo, n0p), x_sh)
-        y = smap(lambda v: _pad_axis(
-            ex(v, (1 + bo, 2 + bo), True), 1 + bo, n1p), xs, xs)(x)
-        return lax.with_sharding_constraint(y, x_sh)
-
-    def exch(y, split, concat, i, o, out_sh):
-        y = smap(lambda v: exchange_chunked(
-            v, axis_name, split_axis=split, concat_axis=concat,
-            axis_size=p, algorithm=algorithm, wire_dtype=wire_dtype,
-            overlap_chunks=overlap_chunks, chunk_axis=2 + bo), i, o)(y)
-        return lax.with_sharding_constraint(y, out_sh)
-
-    def t2_out(y):
-        y = lax.with_sharding_constraint(y, x_sh)
-        return exch(y, 1 + bo, bo, xs, ys, y_sh)
-
-    def t_mid(y):
-        y = lax.with_sharding_constraint(y, y_sh)
-
-        def local(u):
-            u = _crop_axis(u, bo, n0)
-            u = ex(u, (bo,), True)                   # final forward X
-            k1_lo = lax.axis_index(axis_name) * c1
-            m = multiplier(
-                jnp.arange(n0, dtype=jnp.int32)[:, None, None],
-                (k1_lo + jnp.arange(c1, dtype=jnp.int32))[None, :, None],
-                jnp.arange(n2, dtype=jnp.int32)[None, None, :])
-            u = apply_multiplier(u, m)
-            return _pad_axis(ex(u, (bo,), False), bo, n0p)  # inverse X
-
-        y = smap(local, ys, ys)(y)
-        return lax.with_sharding_constraint(y, y_sh)
-
-    def t2_back(y):
-        y = lax.with_sharding_constraint(y, y_sh)
-        return exch(y, bo, 1 + bo, ys, xs, x_sh)
-
-    def t3(y):
-        y = lax.with_sharding_constraint(y, x_sh)
-        y = smap(lambda v: ex(_crop_axis(v, 1 + bo, n1),
-                              (1 + bo, 2 + bo), False), xs, xs)(y)
-        return _crop_axis(y, bo, n0)
-
-    stages = [
+    exch = dict(mesh_axis=axis_name, parts=p, chunk_axis=2 + bo)
+    stages = (
         # Both exchange stages normalize to the t2 key (stage_key), so
         # the explain join sums them per pass; the distinct names keep
         # the driver-tier breakdown showing each leg on its own row.
-        ("t0_fft_yz", jax.jit(t0)),
-        ("t2_exchange_out", jax.jit(t2_out)),
-        ("t_mid", jax.jit(t_mid)),
-        ("t2_exchange_back", jax.jit(t2_back)),
-        ("t3_ifft_yz", jax.jit(t3)),
-    ]
-    return trace_stages(stages), spec
+        StagedStage(
+            kind="t0", name="t0_fft_yz", jit_name="t0",
+            smap_in=xs, smap_out=xs,
+            local=(("fft", (1 + bo, 2 + bo), True), ("pad", 1 + bo, n1p)),
+            pre=(("pad", bo, n0p),), wsc_in=xs, wsc_out=xs),
+        StagedStage(
+            kind="t2", name="t2_exchange_out", jit_name="t2_out",
+            smap_in=xs, smap_out=ys,
+            exchange=dict(exch, split=1 + bo, concat=bo),
+            wsc_in=xs, wsc_out=ys),
+        StagedStage(
+            kind="t_mid", name="t_mid", jit_name="t_mid",
+            smap_in=ys, smap_out=ys,
+            local=(("call", mid_local),), wsc_in=ys, wsc_out=ys),
+        StagedStage(
+            kind="t2", name="t2_exchange_back", jit_name="t2_back",
+            smap_in=ys, smap_out=xs,
+            exchange=dict(exch, split=bo, concat=1 + bo),
+            wsc_in=ys, wsc_out=xs),
+        StagedStage(
+            kind="t3", name="t3_ifft_yz", jit_name="t3",
+            smap_in=xs, smap_out=xs,
+            local=(("crop", 1 + bo, n1), ("fft", (1 + bo, 2 + bo), False)),
+            post=(("crop", bo, n0),), wsc_in=xs),
+    )
+    graph = StagedGraph(
+        mesh=mesh, stages=stages, algorithm=algorithm,
+        wire_dtype=wire_dtype, overlap_chunks=overlap_chunks,
+        executor=executor,
+        meta=dict(shape=spec.shape, batch=batch, forward=True,
+                  decomposition="slab", kind="op"),
+    )
+    return compile_staged(graph), spec
 
 
 def build_slab_rfft_stages(
@@ -348,75 +311,58 @@ def build_slab_rfft_stages(
     p = mesh.shape[axis_name]
     spec = SlabSpec(tuple(int(s) for s in shape), p, axis_name,
                     in_axis=0 if forward else 1, out_axis=1 if forward else 0)
-    ex = get_executor(executor)
-    r2c, c2r = get_r2c(executor), get_c2r(executor)
     n0, n1, n2 = spec.shape
     n0p, n1p = spec.n0p, spec.n1p
     xs = batch_pspec(P(axis_name, None, None), batch)
     ys = batch_pspec(P(None, axis_name, None), batch)
-    x_sh, y_sh = NamedSharding(mesh, xs), NamedSharding(mesh, ys)
-
-    def smap(f, i, o):
-        return _shard_map(f, mesh=mesh, in_specs=(i,), out_specs=o)
+    exch = dict(mesh_axis=axis_name, parts=p, chunk_axis=2 + bo)
 
     if forward:
-
-        def t0(x):  # real [n0, n1, n2] -> complex [n0p, n1p, n2h]
-            x = lax.with_sharding_constraint(_pad_axis(x, bo, n0p), x_sh)
-            y = smap(lambda v: _pad_axis(
-                ex(r2c(v, 2 + bo), (1 + bo,), True), 1 + bo, n1p),
-                xs, xs)(x)
-            return lax.with_sharding_constraint(y, x_sh)
-
-        def t2(y):
-            y = lax.with_sharding_constraint(y, x_sh)
-            z = smap(lambda v: exchange_chunked(
-                v, axis_name, split_axis=1 + bo, concat_axis=bo,
-                axis_size=p, algorithm=algorithm,
-                wire_dtype=wire_dtype,
-                overlap_chunks=overlap_chunks, chunk_axis=2 + bo),
-                xs, ys)(y)
-            return lax.with_sharding_constraint(z, y_sh)
-
-        def t3(z):
-            z = lax.with_sharding_constraint(z, y_sh)
-            w = smap(lambda v: ex(_crop_axis(v, bo, n0), (bo,), True),
-                     ys, ys)(z)
-            return _crop_axis(w, 1 + bo, n1)
-
-        stages = [("t0_r2c_zy", jax.jit(t0)),
-                  ("t2_exchange", jax.jit(t2)),
-                  ("t3_fft_x", jax.jit(t3))]
+        stages = (
+            StagedStage(
+                kind="t0", name="t0_r2c_zy", jit_name="t0",
+                smap_in=xs, smap_out=xs,
+                local=(("r2c", 2 + bo), ("fft", (1 + bo,), True),
+                       ("pad", 1 + bo, n1p)),
+                pre=(("pad", bo, n0p),), wsc_in=xs, wsc_out=xs),
+            StagedStage(
+                kind="t2", name="t2_exchange", jit_name="t2",
+                smap_in=xs, smap_out=ys,
+                exchange=dict(exch, split=1 + bo, concat=bo),
+                wsc_in=xs, wsc_out=ys),
+            StagedStage(
+                kind="t3", name="t3_fft_x", jit_name="t3",
+                smap_in=ys, smap_out=ys,
+                local=(("crop", bo, n0), ("fft", (bo,), True)),
+                post=(("crop", 1 + bo, n1),), wsc_in=ys),
+        )
     else:
-
-        def t3i(z):  # complex [n0, n1, n2h] y-slabs
-            z = lax.with_sharding_constraint(
-                _pad_axis(z, 1 + bo, n1p), y_sh)
-            w = smap(lambda v: _pad_axis(ex(v, (bo,), False), bo, n0p),
-                     ys, ys)(z)
-            return lax.with_sharding_constraint(w, y_sh)
-
-        def t2(w):
-            w = lax.with_sharding_constraint(w, y_sh)
-            u = smap(lambda v: exchange_chunked(
-                v, axis_name, split_axis=bo, concat_axis=1 + bo,
-                axis_size=p, algorithm=algorithm,
-                wire_dtype=wire_dtype,
-                overlap_chunks=overlap_chunks, chunk_axis=2 + bo),
-                ys, xs)(w)
-            return lax.with_sharding_constraint(u, x_sh)
-
-        def t0i(u):
-            u = lax.with_sharding_constraint(u, x_sh)
-            w = smap(lambda v: c2r(
-                ex(_crop_axis(v, 1 + bo, n1), (1 + bo,), False),
-                n2, 2 + bo), xs, xs)(u)
-            return _crop_axis(w, bo, n0)
-
-        stages = [("t3_ifft_x", jax.jit(t3i)),
-                  ("t2_exchange", jax.jit(t2)),
-                  ("t0_ifft_y_c2r", jax.jit(t0i))]
-    return trace_stages(stages), spec
+        stages = (
+            StagedStage(
+                kind="t3", name="t3_ifft_x", jit_name="t3i",
+                smap_in=ys, smap_out=ys,
+                local=(("fft", (bo,), False), ("pad", bo, n0p)),
+                pre=(("pad", 1 + bo, n1p),), wsc_in=ys, wsc_out=ys),
+            StagedStage(
+                kind="t2", name="t2_exchange", jit_name="t2",
+                smap_in=ys, smap_out=xs,
+                exchange=dict(exch, split=bo, concat=1 + bo),
+                wsc_in=ys, wsc_out=xs),
+            StagedStage(
+                kind="t0", name="t0_ifft_y_c2r", jit_name="t0i",
+                smap_in=xs, smap_out=xs,
+                local=(("crop", 1 + bo, n1), ("fft", (1 + bo,), False),
+                       ("c2r", n2, 2 + bo)),
+                post=(("crop", bo, n0),), wsc_in=xs),
+        )
+    graph = StagedGraph(
+        mesh=mesh, stages=stages, algorithm=algorithm,
+        wire_dtype=wire_dtype, overlap_chunks=overlap_chunks,
+        executor=executor,
+        meta=dict(shape=spec.shape, batch=batch, forward=forward,
+                  decomposition="slab", kind="r2c"),
+    )
+    return compile_staged(graph), spec
 
 
 def build_pencil_rfft_stages(
@@ -450,8 +396,6 @@ def build_pencil_rfft_stages(
         uneven=bool(spec.shape[0] % rows or spec.shape[1] % cols
                     or spec.shape[1] % rows
                     or (spec.shape[2] // 2 + 1) % cols))
-    ex = get_executor(executor)
-    r2c, c2r = get_r2c(executor), get_c2r(executor)
     n0, n1, n2 = spec.shape
     n0p, n1pc, n1pr = spec.n0p, spec.n1p_col, spec.n1p_row
     n2h = n2 // 2 + 1
@@ -459,103 +403,76 @@ def build_pencil_rfft_stages(
     zs, ysp, xs = (batch_pspec(P(row_axis, col_axis, None), batch),
                    batch_pspec(P(row_axis, None, col_axis), batch),
                    batch_pspec(P(None, row_axis, col_axis), batch))
-    z_sh, y_sh, x_sh = (NamedSharding(mesh, s) for s in (zs, ysp, xs))
-
-    def smap(f, i, o):
-        return _shard_map(f, mesh=mesh, in_specs=(i,), out_specs=o)
+    exch_a = dict(mesh_axis=col_axis, parts=cols, chunk_axis=bo)
+    exch_b = dict(mesh_axis=row_axis, parts=rows, chunk_axis=2 + bo)
 
     if forward:
-
-        def t0(x):  # real z-pencils -> half-spectrum, padded for exch
-            x = _pad_axis(_pad_axis(x, bo, n0p), 1 + bo, n1pc)
-            x = lax.with_sharding_constraint(x, z_sh)
-            y = smap(lambda v: _pad_axis(r2c(v, 2 + bo), 2 + bo, n2hp),
-                     zs, zs)(x)
-            return lax.with_sharding_constraint(y, z_sh)
-
-        def t2a(y):
-            y = lax.with_sharding_constraint(y, z_sh)
-            z = smap(lambda v: exchange_chunked(
-                v, col_axis, split_axis=2 + bo, concat_axis=1 + bo,
-                axis_size=cols, algorithm=algorithm,
-                wire_dtype=wire_dtype,
-                overlap_chunks=overlap_chunks, chunk_axis=bo),
-                zs, ysp)(y)
-            return lax.with_sharding_constraint(z, y_sh)
-
-        def t1(z):
-            z = lax.with_sharding_constraint(z, y_sh)
-            w = smap(lambda v: _pad_axis(
-                ex(_crop_axis(v, 1 + bo, n1), (1 + bo,), True),
-                1 + bo, n1pr), ysp, ysp)(z)
-            return lax.with_sharding_constraint(w, y_sh)
-
-        def t2b(w):
-            w = lax.with_sharding_constraint(w, y_sh)
-            u = smap(lambda v: exchange_chunked(
-                v, row_axis, split_axis=1 + bo, concat_axis=bo,
-                axis_size=rows, algorithm=algorithm,
-                wire_dtype=wire_dtype,
-                overlap_chunks=overlap_chunks, chunk_axis=2 + bo),
-                ysp, xs)(w)
-            return lax.with_sharding_constraint(u, x_sh)
-
-        def t3(u):
-            u = lax.with_sharding_constraint(u, x_sh)
-            w = smap(lambda v: ex(_crop_axis(v, bo, n0), (bo,), True),
-                     xs, xs)(u)
-            return _crop_axis(_crop_axis(w, 1 + bo, n1), 2 + bo, n2h)
-
-        stages = [("t0_r2c_z", jax.jit(t0)),
-                  ("t2a_exchange_col", jax.jit(t2a)),
-                  ("t1_fft_y", jax.jit(t1)),
-                  ("t2b_exchange_row", jax.jit(t2b)),
-                  ("t3_fft_x", jax.jit(t3))]
+        stages = (
+            StagedStage(
+                kind="t0", name="t0_r2c_z", jit_name="t0",
+                smap_in=zs, smap_out=zs,
+                local=(("r2c", 2 + bo), ("pad", 2 + bo, n2hp)),
+                pre=(("pad", bo, n0p), ("pad", 1 + bo, n1pc)),
+                wsc_in=zs, wsc_out=zs),
+            StagedStage(
+                kind="t2a", name="t2a_exchange_col", jit_name="t2a",
+                smap_in=zs, smap_out=ysp,
+                exchange=dict(exch_a, split=2 + bo, concat=1 + bo),
+                wsc_in=zs, wsc_out=ysp),
+            StagedStage(
+                kind="t1", name="t1_fft_y", jit_name="t1",
+                smap_in=ysp, smap_out=ysp,
+                local=(("crop", 1 + bo, n1), ("fft", (1 + bo,), True),
+                       ("pad", 1 + bo, n1pr)),
+                wsc_in=ysp, wsc_out=ysp),
+            StagedStage(
+                kind="t2b", name="t2b_exchange_row", jit_name="t2b",
+                smap_in=ysp, smap_out=xs,
+                exchange=dict(exch_b, split=1 + bo, concat=bo),
+                wsc_in=ysp, wsc_out=xs),
+            StagedStage(
+                kind="t3", name="t3_fft_x", jit_name="t3",
+                smap_in=xs, smap_out=xs,
+                local=(("crop", bo, n0), ("fft", (bo,), True)),
+                post=(("crop", 1 + bo, n1), ("crop", 2 + bo, n2h)),
+                wsc_in=xs),
+        )
     else:
-
-        def t3i(u):  # complex x-pencils [n0, n1, n2h]
-            u = _pad_axis(_pad_axis(u, 1 + bo, n1pr), 2 + bo, n2hp)
-            u = lax.with_sharding_constraint(u, x_sh)
-            w = smap(lambda v: _pad_axis(ex(v, (bo,), False), bo, n0p),
-                     xs, xs)(u)
-            return lax.with_sharding_constraint(w, x_sh)
-
-        def t2b(w):
-            w = lax.with_sharding_constraint(w, x_sh)
-            z = smap(lambda v: exchange_chunked(
-                v, row_axis, split_axis=bo, concat_axis=1 + bo,
-                axis_size=rows, algorithm=algorithm,
-                wire_dtype=wire_dtype,
-                overlap_chunks=overlap_chunks, chunk_axis=2 + bo),
-                xs, ysp)(w)
-            return lax.with_sharding_constraint(z, y_sh)
-
-        def t1i(z):
-            z = lax.with_sharding_constraint(z, y_sh)
-            w = smap(lambda v: _pad_axis(
-                ex(_crop_axis(v, 1 + bo, n1), (1 + bo,), False),
-                1 + bo, n1pc), ysp, ysp)(z)
-            return lax.with_sharding_constraint(w, y_sh)
-
-        def t2a(w):
-            w = lax.with_sharding_constraint(w, y_sh)
-            z = smap(lambda v: exchange_chunked(
-                v, col_axis, split_axis=1 + bo, concat_axis=2 + bo,
-                axis_size=cols, algorithm=algorithm,
-                wire_dtype=wire_dtype,
-                overlap_chunks=overlap_chunks, chunk_axis=bo),
-                ysp, zs)(w)
-            return lax.with_sharding_constraint(z, z_sh)
-
-        def t0i(z):
-            z = lax.with_sharding_constraint(z, z_sh)
-            w = smap(lambda v: c2r(_crop_axis(v, 2 + bo, n2h), n2, 2 + bo),
-                     zs, zs)(z)
-            return _crop_axis(_crop_axis(w, bo, n0), 1 + bo, n1)
-
-        stages = [("t3_ifft_x", jax.jit(t3i)),
-                  ("t2b_exchange_row", jax.jit(t2b)),
-                  ("t1_ifft_y", jax.jit(t1i)),
-                  ("t2a_exchange_col", jax.jit(t2a)),
-                  ("t0_c2r_z", jax.jit(t0i))]
-    return trace_stages(stages), spec
+        stages = (
+            StagedStage(
+                kind="t3", name="t3_ifft_x", jit_name="t3i",
+                smap_in=xs, smap_out=xs,
+                local=(("fft", (bo,), False), ("pad", bo, n0p)),
+                pre=(("pad", 1 + bo, n1pr), ("pad", 2 + bo, n2hp)),
+                wsc_in=xs, wsc_out=xs),
+            StagedStage(
+                kind="t2b", name="t2b_exchange_row", jit_name="t2b",
+                smap_in=xs, smap_out=ysp,
+                exchange=dict(exch_b, split=bo, concat=1 + bo),
+                wsc_in=xs, wsc_out=ysp),
+            StagedStage(
+                kind="t1", name="t1_ifft_y", jit_name="t1i",
+                smap_in=ysp, smap_out=ysp,
+                local=(("crop", 1 + bo, n1), ("fft", (1 + bo,), False),
+                       ("pad", 1 + bo, n1pc)),
+                wsc_in=ysp, wsc_out=ysp),
+            StagedStage(
+                kind="t2a", name="t2a_exchange_col", jit_name="t2a",
+                smap_in=ysp, smap_out=zs,
+                exchange=dict(exch_a, split=1 + bo, concat=2 + bo),
+                wsc_in=ysp, wsc_out=zs),
+            StagedStage(
+                kind="t0", name="t0_c2r_z", jit_name="t0i",
+                smap_in=zs, smap_out=zs,
+                local=(("crop", 2 + bo, n2h), ("c2r", n2, 2 + bo)),
+                post=(("crop", bo, n0), ("crop", 1 + bo, n1)),
+                wsc_in=zs),
+        )
+    graph = StagedGraph(
+        mesh=mesh, stages=stages, algorithm=algorithm,
+        wire_dtype=wire_dtype, overlap_chunks=overlap_chunks,
+        executor=executor,
+        meta=dict(shape=spec.shape, batch=batch, forward=forward,
+                  decomposition="pencil", kind="r2c"),
+    )
+    return compile_staged(graph), spec

@@ -938,6 +938,7 @@ def model_stage_seconds(
     exchange_correction: float = 1.0,
     dcn_gbps: float | None = None,
     mm_tflops: float | None = None,
+    concurrent_hide_seconds: float = 0.0,
 ) -> dict:
     """Per-stage analytical prediction of one execution, keyed exactly
     ``t0..t3`` — the model side of the explain/attribution join. A fused
@@ -980,7 +981,16 @@ def model_stage_seconds(
     ``mm_flops``, so the explain join and the pruning model both rank
     bf16 vs f32 vs exact tiers before any compile. ``None`` (the
     default, and every non-matmul executor) keeps the pure HBM
-    roofline — byte-identical model output."""
+    roofline — byte-identical model output.
+
+    ``concurrent_hide_seconds`` adds OTHER transforms' compute to every
+    exchange's hide budget — the cross-transform hide of a
+    :func:`..stagegraph.schedule_concurrent` program, priced exactly
+    the way the leg pipeline prices the DCN leg under the ICI leg's
+    hide: extra downstream work the wire transfer can overlap with.
+    :func:`model_concurrent_seconds` derives it per transform from its
+    co-scheduled peers; 0.0 (the default) is the single-transform
+    model, numerically unchanged."""
     shape = tuple(int(s) for s in shape)
     ndev = 1 if lp.mesh is None else math.prod(lp.mesh.devices.shape)
     bsz = getattr(lp, "batch", None) or 1
@@ -1066,6 +1076,12 @@ def model_stage_seconds(
         # each exchange hides under half the downstream compute.
         half = 0.5 * (out["t_mid"]["seconds"] + out["t3"]["seconds"])
         hide = {"t2": half, "t2a": half, "t2b": half}
+    if concurrent_hide_seconds:
+        # Cross-transform hide: a co-scheduled transform's FFT compute
+        # is available to run under this transform's wire time — the
+        # same shape as the leg pipeline's dcn_raw hide bonus below.
+        hide = {k: v + float(concurrent_hide_seconds)
+                for k, v in hide.items()}
     t2 = out["t2"]
     # Leg-level pipelining of the hierarchical transport at K > 1:
     # chunk i's ICI leg issues while chunk i-1's DCN leg and downstream
@@ -1121,6 +1137,74 @@ def model_stage_seconds(
             "hide_seconds": hide_s, "leg_pipelined": pipelined,
         })
     return out
+
+
+def model_concurrent_seconds(
+    transforms: Sequence[tuple],
+    *,
+    hbm_gbps: float,
+    wire_gbps: float,
+    launch_seconds: float,
+    dcn_gbps: float | None = None,
+    **model_kw,
+) -> dict:
+    """Analytical price of a :func:`..stagegraph.schedule_concurrent`
+    program over N independent transforms — the cross-transform-hide
+    model of the DaggerFFT scheduling framing, built from
+    :func:`model_stage_seconds` the way the leg pipeline prices the
+    ICI leg under the DCN leg.
+
+    ``transforms`` is a sequence of ``(lp, shape, itemsize)`` triples
+    (one per co-scheduled transform). Each transform's exchanges are
+    re-priced with ``concurrent_hide_seconds`` = the OTHER transforms'
+    total FFT compute: the staggered schedule places peer compute
+    between a transform's collective issue and its consumption, so the
+    wire transfer overlaps it (there is no cross-transform data
+    dependency). Returns::
+
+        {"sequential_seconds": sum of solo models,
+         "concurrent_seconds": compute sum + re-priced exposed wire,
+         "hidden_seconds":     what the schedule removed,
+         "speedup":            sequential / concurrent,
+         "per_transform":      the N re-priced stage dicts}
+
+    ``concurrent_seconds`` never exceeds ``sequential_seconds`` (a
+    schedule can be priced as no worse than running serially), and with
+    one transform the two are equal — the degenerate case IS the solo
+    model."""
+    transforms = list(transforms)
+    kw = dict(hbm_gbps=hbm_gbps, wire_gbps=wire_gbps,
+              launch_seconds=launch_seconds, dcn_gbps=dcn_gbps,
+              **model_kw)
+
+    def compute_s(m: dict) -> float:
+        return sum(m[k]["seconds"] for k in m if k != "t2")
+
+    def exposed_s(m: dict) -> float:
+        return m["t2"]["seconds"]
+
+    solo = [model_stage_seconds(lp, shape, itemsize, **kw)
+            for lp, shape, itemsize in transforms]
+    comp = [compute_s(m) for m in solo]
+    total_comp = sum(comp)
+    priced = [
+        model_stage_seconds(
+            lp, shape, itemsize,
+            concurrent_hide_seconds=total_comp - comp[i], **kw)
+        for i, (lp, shape, itemsize) in enumerate(transforms)
+    ]
+    sequential = sum(comp[i] + exposed_s(solo[i])
+                     for i in range(len(solo)))
+    concurrent = min(
+        sequential,
+        total_comp + sum(exposed_s(m) for m in priced))
+    return {
+        "sequential_seconds": sequential,
+        "concurrent_seconds": concurrent,
+        "hidden_seconds": sequential - concurrent,
+        "speedup": (sequential / concurrent) if concurrent > 0 else 1.0,
+        "per_transform": priced,
+    }
 
 
 def io_boxes(lp: LogicPlan, world_in: geo.Box3, world_out: geo.Box3) -> tuple:

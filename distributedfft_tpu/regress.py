@@ -88,7 +88,8 @@ AUX_COST_METRICS = ("peak_hbm_bytes", "compile_seconds")
 #: additionally live in their own baseline group: the operator name is
 #: keyed into the record config (``op``), so operator runs never share
 #: baselines with bare transforms.
-AUX_RATE_METRICS = ("transforms_per_s", "solves_per_s")
+AUX_RATE_METRICS = ("transforms_per_s", "solves_per_s",
+                    "concurrent_transforms_per_s")
 
 _MAD_SCALE = 1.4826       # MAD -> sigma under a normal noise model
 
@@ -257,9 +258,16 @@ def normalize_bench_line(
     # suffix): a reduced-precision run trades accuracy for MXU rate and
     # must never share a baseline with exact runs (nor its faster
     # numbers poison them); full-precision rows keep the old schema.
+    # "concurrent" is the multi-transform schedule width (DFFT_BENCH_
+    # CONCURRENT / speed3d -concurrent): a schedule_concurrent run
+    # executes N merged stage DAGs as one interleaved program — a
+    # different program class than N sequential dispatches — so
+    # concurrent rows form their own baseline group and their
+    # concurrent_transforms_per_s rate never compares against
+    # sequential rows; sequential rows keep the old schema.
     for k in ("dtype", "devices", "decomposition", "overlap", "tuned",
               "batch", "profile", "wire_dtype", "transport", "op",
-              "degraded", "precision"):
+              "degraded", "precision", "concurrent"):
         if obj.get(k) is not None:
             config[k] = obj[k]
     ex: dict = {}

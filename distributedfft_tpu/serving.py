@@ -305,6 +305,21 @@ class CoalescingQueue:
     label. ``None`` (the default) keeps today's behavior: groups wait
     for max_batch, an explicit ``flush()``, or a ``result()``.
 
+    ``concurrent_groups`` (env ``DFFT_CONCURRENT_GROUPS``) arms the
+    multi-group flush: a flush draining more than one pending group
+    schedules up to this many compatible-mesh groups as ONE interleaved
+    device program (:func:`..stagegraph.schedule_concurrent` — the
+    DaggerFFT framing), so group A's t2 collectives issue while group
+    B's t0/t3 FFTs run and exchange wire time hides under *another*
+    tenant's compute. Bit-identical outputs to per-group flushes
+    (pinned); groups whose plans sit below the stage-graph IR
+    (single-device, dd) or that fail to schedule fall back to the
+    per-group path, which owns the fault-tolerance chain. ``None``/1
+    (default) keeps today's per-group flushes. Metrics grow
+    ``serving_concurrent_dispatches`` / ``serving_concurrent_
+    transforms`` / ``serving_concurrent_groups``; bench stamps
+    ``concurrent_transforms_per_s`` (``DFFT_BENCH_CONCURRENT``).
+
     Robustness knobs (docs/ROBUSTNESS.md; all default-off — the queue
     is byte-identical to the pre-robustness tier without them):
 
@@ -345,10 +360,19 @@ class CoalescingQueue:
         retry_max: int | None = None,
         retry_backoff_s: float | None = None,
         fallback_executor: str | None = None,
+        concurrent_groups: int | None = None,
         **plan_kw,
     ):
         if kind not in ("c2c", "r2c"):
             raise ValueError(f"kind must be c2c|r2c, got {kind!r}")
+        if concurrent_groups is None:
+            concurrent_groups = _env_int("DFFT_CONCURRENT_GROUPS")
+        if concurrent_groups is not None and (
+                isinstance(concurrent_groups, bool)
+                or not isinstance(concurrent_groups, int)
+                or concurrent_groups < 1):
+            raise ValueError(f"concurrent_groups must be an int >= 1 or "
+                             f"None, got {concurrent_groups!r}")
         if not isinstance(max_batch, int) or max_batch < 1:
             raise ValueError(f"max_batch must be an int >= 1, "
                              f"got {max_batch!r}")
@@ -402,6 +426,7 @@ class CoalescingQueue:
         self._retry_max = retry_max          # None = legacy dispatch
         self._retry_backoff = float(retry_backoff_s)
         self._fallback_executor = fallback_executor
+        self.concurrent_groups = concurrent_groups
         self.plan_kw = dict(plan_kw)
         self._lock = threading.RLock()
         # Admission waiters park here; notified whenever a flush or an
@@ -644,9 +669,20 @@ class CoalescingQueue:
                       if self._pending.get(k)]
             if groups:
                 self._space.notify_all()  # admission waiters: depth fell
-            for k, group in groups:
-                done += self._execute_group(k, group, reason=reason,
-                                            flushed_at=flushed_at)
+            ncc = self.concurrent_groups or 1
+            if ncc > 1 and len(groups) > 1:
+                # Multi-group flush: drain up to concurrent_groups
+                # compatible-mesh groups into ONE scheduled dispatch
+                # (schedule_concurrent interleaves their stage DAGs so
+                # one group's t2 wire hides under another's FFTs).
+                for i in range(0, len(groups), ncc):
+                    done += self._execute_concurrent(
+                        groups[i:i + ncc], reason=reason,
+                        flushed_at=flushed_at)
+            else:
+                for k, group in groups:
+                    done += self._execute_group(k, group, reason=reason,
+                                                flushed_at=flushed_at)
             if recording and _metrics._enabled and groups:
                 _metrics.set_gauge(
                     "serving_queue_depth",
@@ -654,9 +690,9 @@ class CoalescingQueue:
                     kind=self.kind)
         return done
 
-    def _execute_group(self, key: tuple, group: list, *,
-                       reason: str = "manual",
-                       flushed_at: float = 0.0) -> int:
+    def _live(self, group: list) -> list:
+        """Expiry filter of one popped group: fail every request whose
+        deadline passed while it waited; return the survivors."""
         now = time.perf_counter()
         live = []
         for r in group:
@@ -664,27 +700,114 @@ class CoalescingQueue:
                 self._fail_expired(r, now)
             else:
                 live.append(r)
-        group = live
+        return live
+
+    def _note_waits(self, group: list, flushed_at: float,
+                    tracing: bool) -> None:
+        """Close every request's queue-wait interval: enqueue -> flush.
+        Retroactive (record_span) because only now is the wait's end —
+        and the batch it coalesced into — known."""
+        for r in group:
+            if r.handle._enqueued is None:
+                continue
+            if tracing and r.handle._req_id is not None:
+                record_span(f"serve_wait[{r.handle._req_id}]",
+                            r.handle._enqueued, flushed_at)
+            if _metrics._enabled:
+                _metrics.observe(
+                    "serving_wait_seconds",
+                    max(0.0, flushed_at - r.handle._enqueued),
+                    kind=self.kind)
+
+    def _execute_concurrent(self, chunk: list, *, reason: str,
+                            flushed_at: float) -> int:
+        """Execute up to ``concurrent_groups`` popped groups as ONE
+        interleaved device program (:func:`..stagegraph
+        .schedule_concurrent`): each group becomes its (batched) plan,
+        the plans' stage DAGs merge into one staggered schedule, and
+        group A's t2 collectives issue while group B's t0/t3 FFTs run.
+        Falls back to per-group execution — which owns the full
+        fault-tolerance chain — whenever the chunk cannot be scheduled
+        (plans below the IR tier, mesh mismatch, scheduling or
+        execution failure). Concurrent dispatch never donates (plans
+        build donate=False; the per-group path keeps the queue's
+        donation policy on fallback... and fallback after a failed
+        execution re-plans, so no buffer was consumed)."""
+        live_groups = [(k, self._live(g)) for k, g in chunk]
+        live_groups = [(k, g) for k, g in live_groups if g]
+
+        def sequential() -> int:
+            return sum(self._execute_group(k, g, reason=reason,
+                                           flushed_at=flushed_at)
+                       for k, g in live_groups)
+
+        if len(live_groups) < 2:
+            return sequential()
+        tracing = tracing_enabled()
+        try:
+            from .stagegraph import schedule_concurrent
+
+            plans = [self._plan(k, len(g) if len(g) > 1 else None, False)
+                     for k, g in live_groups]
+            if any(p.graph is None for p in plans):
+                return sequential()
+            cp = schedule_concurrent(plans)
+        except Exception:  # noqa: BLE001 — per-group path owns failures
+            return sequential()
+        for _, g in live_groups:
+            self._note_waits(g, flushed_at, tracing)
+        inputs = []
+        from .api import _spec_divides
+
+        for plan, (_, g) in zip(plans, live_groups):
+            x = g[0].x if len(g) == 1 else jnp.stack([r.x for r in g])
+            if plan.in_sharding is not None and _spec_divides(
+                    plan.in_sharding.mesh, plan.in_sharding.spec, x.shape):
+                x = jax.device_put(x, plan.in_sharding)
+            inputs.append(x)
+        b_total = sum(len(g) for _, g in live_groups)
+        tag = f"{self.kind}:g{len(live_groups)}:b{b_total}:{reason}"
+        try:
+            with _span(f"serve_flush[concurrent:{tag}]", tracing):
+                ys = cp(*inputs)
+        except Exception:  # noqa: BLE001 — no handle touched yet: the
+            return sequential()  # per-group path re-runs with its own
+        #                          retry/degraded/bisect chain.
+        from .ops.executors import apply_scale
+
+        for plan, y, (_, g) in zip(plans, ys, live_groups):
+            for i, r in enumerate(g):
+                out = y if len(g) == 1 else y[i]
+                if r.scale != Scale.NONE:
+                    out = apply_scale(out, r.scale, plan.world_size)
+                r.handle._set(out)
+            if _metrics._enabled:
+                _metrics.inc("serving_flushes", kind=self.kind)
+                _metrics.inc("serving_flush_reasons", kind=self.kind,
+                             reason=reason)
+                _metrics.inc("serving_transforms", float(len(g)),
+                             kind=self.kind)
+                _metrics.observe("serving_batch_size", float(len(g)),
+                                 kind=self.kind)
+        if _metrics._enabled:
+            _metrics.inc("serving_concurrent_dispatches", kind=self.kind)
+            _metrics.inc("serving_concurrent_transforms", float(b_total),
+                         kind=self.kind)
+            _metrics.observe("serving_concurrent_groups",
+                             float(len(live_groups)), kind=self.kind)
+        return b_total
+
+    def _execute_group(self, key: tuple, group: list, *,
+                       reason: str = "manual",
+                       flushed_at: float = 0.0) -> int:
+        group = self._live(group)
         if not group:
             return 0
         b = len(group)
         tracing = tracing_enabled()
         tag = f"{self.kind}:b{b}:{reason}"
         if tracing or _metrics._enabled:
-            # Close every request's queue-wait interval: enqueue ->
-            # flush. Retroactive (record_span) because only now is the
-            # wait's end — and the batch it coalesced into — known.
-            for r in group:
-                if r.handle._enqueued is None:
-                    continue
-                if tracing and r.handle._req_id is not None:
-                    record_span(f"serve_wait[{r.handle._req_id}]",
-                                r.handle._enqueued, flushed_at)
-                if _metrics._enabled:
-                    _metrics.observe(
-                        "serving_wait_seconds",
-                        max(0.0, flushed_at - r.handle._enqueued),
-                        kind=self.kind)
+            self._note_waits(group, flushed_at, tracing)
         if self._retry_max is None:
             # Legacy dispatch: one try, a failure fails every co-batched
             # handle and re-raises (byte-identical to the pre-robustness

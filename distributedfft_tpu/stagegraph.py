@@ -1,0 +1,721 @@
+"""Stage-graph chain IR: declarative t0..t3 graphs + ONE compiler/executor.
+
+Every chain builder in :mod:`.parallel` used to hand-thread the same
+four concerns — the t0..t3 stage taxonomy with its trace spans, the
+ceil-pad/crop geometry, the exchange transport (with overlap-K chunk
+interleaving and the hierarchical leg pipeline), and the jit wrapper
+(donation, sharding pins) — through near-duplicate code, so every new
+feature cost one edit per builder. This module is the refactor the
+ROADMAP names: builders now *emit a small declarative stage graph*
+(nodes: stage kind, axes, transport, codec, chunking, dependencies) and
+ONE compiler executes it. DaggerFFT (arXiv 2601.12209) is the model for
+the second half: a stage graph is a schedulable DAG, so N *independent*
+transforms' graphs can be merged into one interleaved program
+(:func:`schedule_concurrent`) that issues transform A's t2 collectives
+while transform B's t0/t3 FFTs run — cross-transform exchange hiding,
+the same play the overlap-K chunk pipeline makes within one transform.
+
+The compiler has two backends sharing the node vocabulary:
+
+- :func:`compile_fused` — the end-to-end jitted program (one
+  ``shard_map`` + jit): exchanges fuse with their downstream compute
+  through :func:`..parallel.exchange.exchange_overlapped` (per-chunk
+  interleaving, leg pipelining, wire codecs all live there).
+- :func:`compile_staged` — the separately-jitted per-stage pipeline of
+  the timing harness (:func:`..utils.timing.time_staged`), stage
+  boundaries carrying global arrays, exchanges through
+  :func:`..parallel.exchange.exchange_chunked`.
+
+**Migration safety net** (the PR 3 discipline): the graphs the migrated
+builders emit compile *byte-identical* StableHLO to the pre-migration
+hand-threaded chains — pinned against on-disk captures in
+``tests/test_a2m_stagegraph.py`` / ``tests/_hlo_pin_cases.py``. The op
+interpreter therefore mirrors the historical trace order exactly (pads
+as no-op-when-even ``_pad_axis`` calls, spans entered even around
+skipped packs, midpoint ``axis_index`` offsets emitted at their
+original trace position via node *factories*).
+
+Not yet migrated (the named remainder): ``parallel/ddslab.py`` (the
+double-double tier) and ``parallel/bricks.py`` (brick-I/O edges).
+
+See ``docs/ARCHITECTURE.md`` ("Stage-graph chain IR") for the node
+schema, the compiler contract, and the concurrent-scheduler policy.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from .utils.trace import add_trace, trace_stages
+
+__all__ = [
+    "STAGE_KINDS",
+    "LocalNode",
+    "ExchangeNode",
+    "StageGraph",
+    "StagedStage",
+    "StagedGraph",
+    "local_node",
+    "exchange_node",
+    "compile_fused",
+    "compile_staged",
+    "apply_multiplier",
+    "apply_midpoint",
+    "graph_of",
+    "ConcurrentPlan",
+    "schedule_concurrent",
+]
+
+#: The stage-kind registry — every node kind a chain graph may carry.
+#: ``docs/ARCHITECTURE.md``'s stage-node table must be a superset of
+#: this tuple (pinned by the conftest-tier lint in
+#: ``tests/test_a2m_stagegraph.py``).
+STAGE_KINDS = ("t0", "t1", "t2", "t2a", "t2b", "t_mid", "t3")
+
+#: Kinds an :class:`ExchangeNode` may carry (⊂ STAGE_KINDS).
+EXCHANGE_KINDS = ("t2", "t2a", "t2b")
+
+
+# --------------------------------------------------------------- nodes
+
+@dataclass(frozen=True)
+class LocalNode:
+    """One local (per-shard, collective-free) stage of a chain.
+
+    ``ops`` is the declarative op list the interpreter executes in
+    order: ``("fft", axes, forward)``, ``("r2c", axis)``,
+    ``("c2r", n, axis)``, ``("pad", axis, to)``, ``("crop", axis, to)``,
+    ``("pack", axis, to)`` (a pad the ragged transport skips — dense
+    algorithms ship ceil-padded splits, alltoallv ships true slices),
+    or ``("call", fn)`` (an opaque per-shard callable — the midpoint
+    escape hatch).
+
+    ``fuse=True`` marks this node as the *per-chunk compute* of the
+    exchange node immediately before it: the fused compiler hands it to
+    :func:`..parallel.exchange.exchange_overlapped` as the ``compute``
+    callback (pipelined under the exchange at overlap-K), the staged
+    compiler gives it its own stage jit. ``factory`` (exclusive with
+    ``ops``) is a zero-arg callable invoked at trace time right before
+    the exchange issues, returning the compute callable — the hook that
+    lets midpoint closures emit their per-shard wavenumber offsets
+    (``lax.axis_index``) at the exact trace position the hand-threaded
+    chains did. ``takes_bounds`` adds the chunk's static (lo, hi)
+    bounds along the exchange's chunk axis to the call.
+    """
+
+    kind: str
+    name: str
+    ops: tuple = ()
+    fuse: bool = False
+    takes_bounds: bool = False
+    factory: Callable | None = field(default=None, compare=False)
+
+    def __post_init__(self):
+        if self.kind not in STAGE_KINDS:
+            raise ValueError(
+                f"unknown stage kind {self.kind!r}; use one of "
+                f"{STAGE_KINDS}")
+
+
+@dataclass(frozen=True)
+class ExchangeNode:
+    """One global-transpose (t2-tier) stage of a chain.
+
+    ``mesh_axis`` is the mesh axis name (or the (dcn, ici) tuple of the
+    hierarchical transport), ``parts`` its total extent, ``split`` /
+    ``concat`` the tiled-all-to-all axes, ``chunk_axis`` the bystander
+    axis overlap-K chunks along. The transport algorithm, wire codec,
+    and K live on the graph (one chain = one transport policy); per-node
+    ``axis_sizes`` carries the hierarchical (dcn, ici) factor pair.
+    """
+
+    kind: str
+    name: str
+    mesh_axis: Any
+    parts: int
+    split: int
+    concat: int
+    chunk_axis: int
+    axis_sizes: tuple | None = None
+
+    def __post_init__(self):
+        if self.kind not in EXCHANGE_KINDS:
+            raise ValueError(
+                f"exchange node kind must be one of {EXCHANGE_KINDS}, "
+                f"got {self.kind!r}")
+
+
+def local_node(kind: str, name: str, *ops, fuse: bool = False,
+               takes_bounds: bool = False,
+               factory: Callable | None = None) -> LocalNode:
+    return LocalNode(kind=kind, name=name, ops=tuple(ops), fuse=fuse,
+                     takes_bounds=takes_bounds, factory=factory)
+
+
+def exchange_node(kind: str, name: str, *, mesh_axis, parts: int,
+                  split: int, concat: int, chunk_axis: int,
+                  axis_sizes: tuple | None = None) -> ExchangeNode:
+    return ExchangeNode(kind=kind, name=name, mesh_axis=mesh_axis,
+                        parts=int(parts), split=split, concat=concat,
+                        chunk_axis=chunk_axis, axis_sizes=axis_sizes)
+
+
+@dataclass(frozen=True)
+class StageGraph:
+    """One fused chain as a declarative stage DAG (a linear chain with
+    each exchange's fused compute as its dependent node — the general
+    DAG form shows up when :func:`schedule_concurrent` merges graphs).
+
+    ``pre`` / ``post`` are the jit-boundary global ops (ceil pads in,
+    crops out); ``in_pspec`` / ``out_pspec`` the (batch-adjusted) chain
+    endpoint layouts; ``even`` pins them as jit shardings (uneven
+    chains move the constraint inside, after the pad). ``executor`` is
+    a registered executor name or a callable; ``platform`` feeds the
+    ragged transport's CPU-mirror routing. ``meta`` carries planner
+    metadata (shape, batch, direction, decomposition) for scheduling
+    and pricing — never read by the compiler itself.
+    """
+
+    mesh: Mesh
+    nodes: tuple
+    in_pspec: P
+    out_pspec: P
+    pre: tuple = ()
+    post: tuple = ()
+    even: bool = True
+    donate: bool = False
+    algorithm: str = "alltoall"
+    platform: str | None = None
+    wire_dtype: str | None = None
+    overlap_chunks: int = 1
+    executor: Any = "xla"
+    meta: dict = field(default_factory=dict, compare=False)
+
+    def validate(self) -> "StageGraph":
+        nodes = self.nodes
+        for i, n in enumerate(nodes):
+            if isinstance(n, ExchangeNode):
+                if i + 1 >= len(nodes) or not isinstance(
+                        nodes[i + 1], LocalNode) or not nodes[i + 1].fuse:
+                    raise ValueError(
+                        f"exchange node {n.name!r} must be followed by "
+                        f"its fused compute node (LocalNode(fuse=True))")
+            elif n.fuse and (i == 0 or not isinstance(
+                    nodes[i - 1], ExchangeNode)):
+                raise ValueError(
+                    f"fused node {n.name!r} has no preceding exchange")
+        return self
+
+    @property
+    def stage_kinds(self) -> tuple:
+        return tuple(n.kind for n in self.nodes)
+
+
+# ------------------------------------------------------ op interpreter
+
+def _tree_pad(x, axis: int, to: int):
+    from .parallel.exchange import _pad_axis
+
+    return jax.tree_util.tree_map(
+        lambda u: _pad_axis(u, axis, to), x)
+
+
+def _tree_crop(x, axis: int, to: int):
+    from .parallel.exchange import _crop_axis
+
+    return jax.tree_util.tree_map(
+        lambda u: _crop_axis(u, axis, to), x)
+
+
+class _Interp:
+    """The shared op interpreter: executor resolution done once, ops
+    applied in declared order. Tree-generic for pads/crops (the staged
+    pencil pipeline carries the dd tier's (hi, lo) pytree); ``fft``
+    hands the whole value to the executor (a callable executor owns its
+    own pytree handling, exactly as the hand-threaded stages did)."""
+
+    def __init__(self, executor, algorithm: str):
+        from .ops.executors import get_c2r, get_executor, get_r2c
+
+        if isinstance(executor, str):
+            self.ex = get_executor(executor)
+            self._r2c = get_r2c(executor)
+            self._c2r = get_c2r(executor)
+        else:
+            self.ex = executor
+            self._r2c = self._c2r = None
+        self.algorithm = algorithm
+
+    def run(self, ops, y, bounds=None):
+        for op in ops:
+            tag = op[0]
+            if tag == "fft":
+                y = self.ex(y, op[1], op[2])
+            elif tag == "pack":
+                if self.algorithm != "alltoallv":
+                    y = _tree_pad(y, op[1], op[2])
+            elif tag == "pad":
+                y = _tree_pad(y, op[1], op[2])
+            elif tag == "crop":
+                y = _tree_crop(y, op[1], op[2])
+            elif tag == "r2c":
+                y = self._r2c(y, op[1])
+            elif tag == "c2r":
+                y = self._c2r(y, op[1], op[2])
+            elif tag == "call":
+                y = op[1](y, *bounds) if bounds is not None else op[1](y)
+            else:
+                raise ValueError(f"unknown stage op {tag!r}")
+        return y
+
+
+def apply_multiplier(u: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
+    """Pointwise spectral multiply without dtype surprises: a real
+    multiplier casts to the payload's component dtype (f64 constants
+    must not promote a c64 chain to c128), a complex one to the payload
+    dtype. ``m`` is rank-3 (spatial) and broadcasts over any leading
+    batch axis."""
+    if jnp.issubdtype(m.dtype, jnp.complexfloating):
+        return u * m.astype(u.dtype)
+    rdt = jnp.float64 if u.dtype == jnp.dtype(jnp.complex128) else jnp.float32
+    return u * m.astype(rdt)
+
+
+def apply_midpoint(u, multiplier: Callable, grids: tuple):
+    """The ``t_mid`` pointwise stage: generate the wavenumber-diagonal
+    multiplier over the shard/chunk's global index ``grids`` and apply
+    it, under the ``t_mid_pointwise`` sub-span (mapped to no stage key
+    by :func:`..utils.trace.stage_key` — nested inside ``t_mid``, never
+    double-counted). The ONE place operator chains emit the span, so
+    migrated builders never hand-thread it."""
+    with add_trace("t_mid_pointwise"):
+        return apply_multiplier(u, multiplier(*grids))
+
+
+# ------------------------------------------------------ fused compiler
+
+def compile_fused(graph: StageGraph):
+    """Compile a :class:`StageGraph` into the fused end-to-end jitted
+    program (the contract every fused chain builder used to hand-write):
+    one ``shard_map`` over the chain's local program — non-fused local
+    nodes run under their own trace span, each exchange node runs
+    through :func:`..parallel.exchange.exchange_overlapped` with its
+    fused successor as the per-chunk compute (overlap-K interleaving,
+    leg pipelining, wire codec all inherited) — wrapped in a jit doing
+    the boundary pads, the input sharding constraint, and the output
+    crops, with donation and even-shape sharding pins from the graph.
+
+    The compiled callable carries the graph as ``fn.stage_graph`` (the
+    handle :func:`schedule_concurrent` and the plan layer read back)."""
+    from .parallel.exchange import exchange_overlapped
+
+    graph.validate()
+    interp = _Interp(graph.executor, graph.algorithm)
+    nodes = graph.nodes
+
+    def local_fn(x):
+        y = x
+        i = 0
+        while i < len(nodes):
+            n = nodes[i]
+            if isinstance(n, ExchangeNode):
+                nxt = nodes[i + 1]
+                if nxt.factory is not None:
+                    compute = nxt.factory()
+                elif nxt.takes_bounds:
+                    compute = (lambda v, lo, hi, _n=nxt: interp.run(
+                        _n.ops, v, bounds=(lo, hi)))
+                else:
+                    compute = (lambda v, _n=nxt: interp.run(_n.ops, v))
+                y = exchange_overlapped(
+                    y, n.mesh_axis, split_axis=n.split,
+                    concat_axis=n.concat, axis_size=n.parts,
+                    algorithm=graph.algorithm, platform=graph.platform,
+                    axis_sizes=n.axis_sizes,
+                    wire_dtype=graph.wire_dtype, compute=compute,
+                    compute_takes_bounds=nxt.takes_bounds,
+                    overlap_chunks=graph.overlap_chunks,
+                    chunk_axis=n.chunk_axis, exchange_name=n.name,
+                    compute_name=nxt.name)
+                i += 2
+            else:
+                with add_trace(n.name):
+                    y = interp.run(n.ops, y)
+                i += 1
+        return y
+
+    mapped = _shard_map(local_fn, mesh=graph.mesh,
+                        in_specs=(graph.in_pspec,),
+                        out_specs=graph.out_pspec)
+    in_sh = NamedSharding(graph.mesh, graph.in_pspec)
+    out_sh = NamedSharding(graph.mesh, graph.out_pspec)
+    jit_kw: dict = {"donate_argnums": 0} if graph.donate else {}
+    if graph.even:
+        jit_kw |= {"in_shardings": in_sh, "out_shardings": out_sh}
+
+    @functools.partial(jax.jit, **jit_kw)
+    def fn(x):
+        for op in graph.pre:
+            x = _tree_pad(x, op[1], op[2])
+        x = lax.with_sharding_constraint(x, in_sh)
+        y = mapped(x)
+        for op in graph.post:
+            y = _tree_crop(y, op[1], op[2])
+        return y
+
+    fn.stage_graph = graph
+    return fn
+
+
+def graph_of(fn) -> StageGraph | None:
+    """The :class:`StageGraph` a compiled chain callable carries, or
+    None for chains not (yet) built through the IR — the feature-
+    detection hook of the plan layer and the concurrent scheduler."""
+    return getattr(fn, "stage_graph", None)
+
+
+# ----------------------------------------------------- staged compiler
+
+@dataclass(frozen=True)
+class StagedStage:
+    """One separately-jitted stage of a staged pipeline.
+
+    Execution order inside the stage jit:
+    ``pre`` global ops -> ``wsc_in`` sharding constraint -> the
+    ``shard_map``'d body (``local`` ops, an ``exchange``, or a
+    hierarchical ``leg``) over ``smap_in``/``smap_out`` -> ``post``
+    global ops -> ``wsc_out``. ``pin_in``/``pin_out`` instead pin the
+    boundary shardings on the jit itself (the slab-staged convention;
+    the pencil/r2c pipelines constrain inside — both orders are kept
+    verbatim for the HLO pins). ``jit_name`` is the ``__name__`` the
+    stage function is given before jit (the lowered module's name, part
+    of the byte-identity contract)."""
+
+    kind: str
+    name: str
+    jit_name: str = "<lambda>"
+    smap_in: Any = None
+    smap_out: Any = None
+    local: tuple | None = None
+    exchange: dict | None = None
+    leg: dict | None = None
+    pre: tuple = ()
+    post: tuple = ()
+    wsc_in: Any = None
+    wsc_out: Any = None
+    pin_in: Any = None
+    pin_out: Any = None
+
+    def __post_init__(self):
+        if self.kind not in STAGE_KINDS:
+            raise ValueError(
+                f"unknown stage kind {self.kind!r}; use one of "
+                f"{STAGE_KINDS}")
+
+
+@dataclass(frozen=True)
+class StagedGraph:
+    """A staged pipeline: the per-stage twin of :class:`StageGraph`,
+    consumed by :func:`compile_staged` into the ``[(name, jit), ...]``
+    stage list of the timing harness."""
+
+    mesh: Mesh
+    stages: tuple
+    algorithm: str = "alltoall"
+    platform: str | None = None
+    wire_dtype: str | None = None
+    overlap_chunks: int = 1
+    executor: Any = "xla"
+    meta: dict = field(default_factory=dict, compare=False)
+
+
+def _leg_body(stage: StagedStage, graph: StagedGraph):
+    """The hierarchical staged tier's per-leg body (K=1 only): ONE leg
+    of :func:`..parallel.exchange.hierarchical_legs`, wrapped in the
+    per-leg wire cast pair when the graph compresses the wire. Every
+    registered codec round-trips idempotently (bf16 by value, int8 by
+    its power-of-two steps), so leg-boundary decode/re-encode is
+    bit-identical to the fused chain's single cast pair around both
+    legs; the legs permute peer tiles and sidecar slots identically, so
+    decode aligns on the axis the tiles sit on at the leg's exit
+    (``tile_axis_out``)."""
+    from .parallel.exchange import hierarchical_legs, wire_codec
+
+    cfg = stage.leg
+    leg_ici, leg_dcn = hierarchical_legs(
+        cfg["mesh_axis"], split_axis=cfg["split"], concat_axis=cfg["concat"],
+        axis_sizes=cfg["axis_sizes"])
+    leg = leg_ici if cfg["which"] == "ici" else leg_dcn
+    if graph.wire_dtype is None:
+        return leg
+    codec = wire_codec(graph.wire_dtype)
+    p, split, out_ax = cfg["parts"], cfg["split"], cfg["tile_axis_out"]
+
+    def run(u):
+        parts = codec.encode(u, tile_axis=split, tiles=p)
+        done = tuple(leg(w) for w in parts)
+        return codec.decode(done, u.dtype, tile_axis=out_ax, tiles=p)
+
+    return run
+
+
+def compile_staged(graph: StagedGraph):
+    """Compile a :class:`StagedGraph` into the traced
+    ``[(name, stage_jit), ...]`` list of the per-stage timing harness
+    (each stage wrapped by :func:`..utils.trace.traced_stage`, its
+    underlying jit reachable via ``__wrapped__`` for the explain
+    layer's per-stage lowering)."""
+    from .parallel.exchange import exchange_chunked
+
+    interp = _Interp(graph.executor, graph.algorithm)
+    mesh = graph.mesh
+
+    def build_stage(stage: StagedStage):
+        def smap(f):
+            return _shard_map(f, mesh=mesh, in_specs=(stage.smap_in,),
+                              out_specs=stage.smap_out)
+
+        if stage.exchange is not None:
+            cfg = dict(stage.exchange)
+            body = smap(lambda v: exchange_chunked(
+                v, cfg["mesh_axis"], split_axis=cfg["split"],
+                concat_axis=cfg["concat"], axis_size=cfg["parts"],
+                algorithm=graph.algorithm,
+                axis_sizes=cfg.get("axis_sizes"),
+                wire_dtype=graph.wire_dtype,
+                overlap_chunks=graph.overlap_chunks,
+                chunk_axis=cfg["chunk_axis"],
+                uneven=cfg.get("uneven", False),
+                platform=graph.platform,
+                **({"exchange_name": cfg["exchange_name"]}
+                   if "exchange_name" in cfg else {})))
+        elif stage.leg is not None:
+            body = smap(_leg_body(stage, graph))
+        else:
+            body = smap(lambda v: interp.run(stage.local, v))
+
+        def run(x):
+            for op in stage.pre:
+                x = _tree_pad(x, op[1], op[2]) if op[0] in (
+                    "pad", "pack") else _tree_crop(x, op[1], op[2])
+            if stage.wsc_in is not None:
+                x = lax.with_sharding_constraint(
+                    x, NamedSharding(mesh, stage.wsc_in))
+            y = body(x)
+            for op in stage.post:
+                y = _tree_pad(y, op[1], op[2]) if op[0] in (
+                    "pad", "pack") else _tree_crop(y, op[1], op[2])
+            if stage.wsc_out is not None:
+                y = lax.with_sharding_constraint(
+                    y, NamedSharding(mesh, stage.wsc_out))
+            return y
+
+        run.__name__ = stage.jit_name
+        jit_kw: dict = {}
+        if stage.pin_in is not None:
+            jit_kw["in_shardings"] = NamedSharding(mesh, stage.pin_in)
+        if stage.pin_out is not None:
+            jit_kw["out_shardings"] = NamedSharding(mesh, stage.pin_out)
+        return jax.jit(run, **jit_kw)
+
+    return trace_stages(
+        [(s.name, build_stage(s)) for s in graph.stages])
+
+
+# ----------------------------------------------- concurrent scheduling
+
+@dataclass
+class ConcurrentPlan:
+    """N independent transforms scheduled as ONE interleaved program.
+
+    ``fn`` takes the N input arrays (one per plan, each plan's own
+    ``in_shape``) and returns the N outputs; calling the object does
+    the same. ``plans`` are the source plans in schedule order. The
+    program's dispatch-side trace spans carry ``cc<j>:`` prefixes
+    (transform j's stage), so the interleave is visible on the PR 1
+    timeline; :func:`..utils.trace.stage_key` strips the prefix, so
+    rollups attribute each span to its t0..t3 key as usual."""
+
+    fn: Callable
+    plans: tuple
+    mesh: Mesh
+    in_shardings: tuple
+    out_shardings: tuple
+
+    def __call__(self, *xs):
+        if len(xs) == 1 and isinstance(xs[0], (list, tuple)):
+            xs = tuple(xs[0])
+        if len(xs) != len(self.plans):
+            raise ValueError(
+                f"concurrent schedule of {len(self.plans)} transforms "
+                f"takes {len(self.plans)} inputs, got {len(xs)}")
+        return self.fn(*xs)
+
+
+def _graph_steps(graph: StageGraph, interp: _Interp):
+    """The chain's local program as a list of ``(kind, name, run)``
+    schedulable steps — stage granularity: each exchange is its own
+    step (its overlap-K chunking preserved through
+    :func:`..parallel.exchange.exchange_chunked`), each local stage
+    its own step. The per-step math is exactly the fused chain's, so
+    any interleave of two graphs' steps is bit-identical to executing
+    the chains back-to-back; only the issue order changes — which is
+    the whole point."""
+    from .parallel.exchange import exchange_chunked
+
+    steps = []
+    nodes = graph.nodes
+    i = 0
+    while i < len(nodes):
+        n = nodes[i]
+        if isinstance(n, ExchangeNode):
+            def ex_run(y, _n=n):
+                return exchange_chunked(
+                    y, _n.mesh_axis, split_axis=_n.split,
+                    concat_axis=_n.concat, axis_size=_n.parts,
+                    algorithm=graph.algorithm,
+                    overlap_chunks=graph.overlap_chunks,
+                    chunk_axis=_n.chunk_axis, exchange_name=_n.name,
+                    uneven=True, platform=graph.platform,
+                    axis_sizes=_n.axis_sizes,
+                    wire_dtype=graph.wire_dtype)
+
+            steps.append((n.kind, n.name, ex_run))
+            nxt = nodes[i + 1]
+
+            def co_run(y, _n=nxt, _ax=n.chunk_axis):
+                if _n.factory is not None:
+                    fn = _n.factory()
+                    extent = jax.tree_util.tree_leaves(y)[0].shape[_ax]
+                    return fn(y, 0, extent)
+                if _n.takes_bounds:
+                    extent = jax.tree_util.tree_leaves(y)[0].shape[_ax]
+                    return interp.run(_n.ops, y, bounds=(0, extent))
+                return interp.run(_n.ops, y)
+
+            steps.append((nxt.kind, nxt.name, co_run))
+            i += 2
+        else:
+            steps.append((n.kind, n.name,
+                          lambda y, _n=n: interp.run(_n.ops, y)))
+            i += 1
+    return steps
+
+
+#: Memoized concurrent programs: same plan tuple -> same compiled
+#: schedule (the serving tier flushes the same group pattern over and
+#: over; plans themselves are plan-cache memoized, so identity keys are
+#: stable). Values hold the plan refs, keeping the ids valid.
+_CONCURRENT_CACHE: dict = {}
+
+
+def schedule_concurrent(plans: Sequence) -> ConcurrentPlan:
+    """Merge N independent transforms' stage graphs into ONE interleaved
+    device program — the DaggerFFT scheduling framing: each transform's
+    chain is a schedulable stage DAG, and merging them lets transform
+    A's t2 collectives issue while transform B's t0/t3 FFTs run, so
+    exchange wire time hides under *another* transform's compute even
+    when each transform alone has nothing left to hide it under.
+
+    Schedule policy (documented in docs/ARCHITECTURE.md): transform
+    ``j``'s steps are issued staggered ``j`` waves behind transform
+    ``j-1``'s, and within a wave later-stage steps issue first — so in
+    the canonical 2-transform slab case the trace order is ``A.t0,
+    A.t2, B.t0, A.t3, B.t2, B.t3``: A's exchange is in flight exactly
+    while B's t0 runs (XLA's async collectives are free to overlap
+    them; there is no data dependency between transforms).
+
+    Requirements: every plan was built through the stage-graph IR
+    (``plan.graph`` is set) on the SAME mesh. Bit-identity: each
+    transform's per-step math is exactly its fused chain's (pinned in
+    ``tests/test_a2m_stagegraph.py``'s parity matrix), so outputs are
+    bit-identical to executing the plans sequentially.
+
+    Programs are memoized per plan tuple: a serving tier flushing the
+    same group combination replays the compiled schedule warm."""
+    plans = tuple(plans)
+    if len(plans) < 1:
+        raise ValueError("schedule_concurrent takes at least one plan")
+    key = tuple(id(p) for p in plans)
+    hit = _CONCURRENT_CACHE.get(key)
+    if hit is not None:
+        return hit[1]
+    graphs = []
+    for p in plans:
+        g = getattr(p, "graph", None) or graph_of(getattr(p, "fn", p))
+        if g is None:
+            raise ValueError(
+                "schedule_concurrent needs plans built through the "
+                "stage-graph IR (slab/pencil chains); got a plan "
+                f"without a stage graph: {p!r}")
+        graphs.append(g)
+    mesh = graphs[0].mesh
+    for g in graphs[1:]:
+        if g.mesh is not mesh and not (
+                g.mesh.shape == mesh.shape
+                and list(g.mesh.devices.flat) == list(mesh.devices.flat)
+                and g.mesh.axis_names == mesh.axis_names):
+            raise ValueError(
+                "schedule_concurrent requires one shared mesh; got "
+                f"{g.mesh} vs {mesh}")
+    progs = [
+        _graph_steps(g, _Interp(g.executor, g.algorithm)) for g in graphs
+    ]
+    lens = [len(p) for p in progs]
+    n = len(progs)
+
+    def local_fn(*xs):
+        states = list(xs)
+        # Staggered wave order: transform j runs its step (wave - j);
+        # within a wave, lower j (= deeper into its chain) issues
+        # first, so exchanges enter the trace before the younger
+        # transforms' compute of the same wave.
+        for wave in range(max(lens) + n - 1):
+            for j in range(n):
+                k = wave - j
+                if 0 <= k < lens[j]:
+                    kind, name, run = progs[j][k]
+                    with add_trace(f"cc{j}:{name}"):
+                        states[j] = run(states[j])
+        return tuple(states)
+
+    mapped = _shard_map(
+        local_fn, mesh=mesh,
+        in_specs=tuple(g.in_pspec for g in graphs),
+        out_specs=tuple(g.out_pspec for g in graphs))
+    in_shs = tuple(NamedSharding(mesh, g.in_pspec) for g in graphs)
+    out_shs = tuple(NamedSharding(mesh, g.out_pspec) for g in graphs)
+
+    @jax.jit
+    def fn(*xs):
+        staged = []
+        for g, sh, x in zip(graphs, in_shs, xs):
+            for op in g.pre:
+                x = _tree_pad(x, op[1], op[2])
+            staged.append(lax.with_sharding_constraint(x, sh))
+        ys = mapped(*staged)
+        outs = []
+        for g, y in zip(graphs, ys):
+            for op in g.post:
+                y = _tree_crop(y, op[1], op[2])
+            outs.append(y)
+        return tuple(outs)
+
+    cp = ConcurrentPlan(fn=fn, plans=plans, mesh=mesh,
+                        in_shardings=in_shs, out_shardings=out_shs)
+    if len(_CONCURRENT_CACHE) >= 64:  # bound the program memo
+        _CONCURRENT_CACHE.pop(next(iter(_CONCURRENT_CACHE)))
+    _CONCURRENT_CACHE[key] = (plans, cp)
+    return cp

@@ -251,7 +251,15 @@ def stage_key(name: str) -> str | None:
     explain/attribution layer and the regress localization agree on one
     stage taxonomy regardless of which builder produced the span.
     ``t_mid_pointwise`` (the multiply sub-span nested inside ``t_mid``)
-    maps to None so device-trace attribution never double-counts it."""
+    maps to None so device-trace attribution never double-counts it.
+    Concurrent-schedule spans (``cc<j>:t2_exchange_...`` — transform j
+    of a :func:`~..stagegraph.schedule_concurrent` program) drop the
+    transform prefix first, so rollups attribute each interleaved span
+    to its t0..t3 key like any other."""
+    if name.startswith("cc"):
+        head, sep, rest = name.partition(":")
+        if sep and head[2:].isdigit():
+            name = rest
     if name.startswith("t_mid"):
         rest = name[5:]
         return "t_mid" if (not rest or rest[0] == "[") else None

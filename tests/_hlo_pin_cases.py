@@ -1,0 +1,294 @@
+"""HLO byte-identity pin cases for the stage-graph IR migration.
+
+The chain builders in ``parallel/{slab,pencil,staged}.py`` were migrated
+onto the declarative stage-graph IR (``distributedfft_tpu/stagegraph.py``)
+with the PR 3 safety net: default plans must compile **byte-identical**
+StableHLO before vs after the migration. This module is the single
+source of truth for the pinned case matrix — every migrated builder at
+its default knobs plus the variant axes (bf16/int8 wire, hierarchical
+transport, overlap-K, batch, uneven extents, r2c, fused operators,
+staged pipelines).
+
+Two consumers:
+
+- ``python tests/_hlo_pin_cases.py write`` — run against the
+  PRE-refactor builders, captures every case's lowered text into
+  ``tests/data/hlo_pins/`` plus a manifest recording the jax version
+  and environment fingerprint.
+- ``tests/test_a2m_stagegraph.py`` — run against the migrated builders,
+  compares each case byte-for-byte against the stored capture (skipping
+  when the environment fingerprint no longer matches: the pins describe
+  THIS container's jax/XLA, not every future one).
+
+Cases lower at the **builder** level (not the plan layer) so the pins
+keep meaning even as plan-layer plumbing moves around them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+
+# Mirror tests/conftest.py for standalone (capture-time) runs; under
+# pytest the conftest already did all of this before we import.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("DFFT_HW_PROFILE", "0")
+os.environ.setdefault("DFFT_THUNK_GUARD", "matmul")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+PIN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "data", "hlo_pins")
+MANIFEST = os.path.join(PIN_DIR, "manifest.json")
+
+EVEN = (16, 16, 16)
+UNEVEN = (12, 10, 9)
+CDT = np.complex128
+RDT = np.float64
+
+
+def _mesh8() -> Mesh:
+    from distributedfft_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(8)
+
+
+def _mesh24() -> Mesh:
+    from distributedfft_tpu.parallel.mesh import make_mesh
+
+    return make_mesh((2, 4))
+
+
+def _hybrid() -> Mesh:
+    return Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dcn", "ici"))
+
+
+def _poisson_mult(shape, cdtype=CDT):
+    from distributedfft_tpu.operators import _multiplier_fn, poisson
+
+    return _multiplier_fn(poisson(), shape, cdtype)
+
+
+def _lower(fn, shape, dtype) -> str:
+    return fn.lower(jax.ShapeDtypeStruct(shape, dtype)).as_text()
+
+
+def _fused(build, in_shape, in_dtype):
+    """One fused-builder case: the jitted end-to-end program's text."""
+
+    def run():
+        fn, _ = build()
+        return [("fn", _lower(fn, in_shape, in_dtype))]
+
+    return run
+
+
+def _staged(build, in_shape, in_dtype):
+    """One staged-builder case: every stage jit's text, chained through
+    ``eval_shape`` so each stage lowers on its true boundary shape."""
+
+    def run():
+        stages, _ = build()
+        out = []
+        spec = jax.ShapeDtypeStruct(in_shape, in_dtype)
+        for name, fn in stages:
+            inner = getattr(fn, "__wrapped__", fn)
+            out.append((name, _lower(inner, spec.shape, spec.dtype)))
+            spec = jax.eval_shape(inner, spec)
+        return out
+
+    return run
+
+
+def build_cases() -> dict:
+    """name -> zero-arg callable returning ``[(subname, text), ...]``."""
+    from distributedfft_tpu.parallel.pencil import (
+        build_pencil_fft3d, build_pencil_rfft3d, build_pencil_spectral_op,
+    )
+    from distributedfft_tpu.parallel.slab import (
+        build_slab_fft3d, build_slab_rfft3d, build_slab_spectral_op,
+        build_slab_stages,
+    )
+    from distributedfft_tpu.parallel.staged import (
+        build_pencil_rfft_stages, build_pencil_stages,
+        build_slab_op_stages, build_slab_rfft_stages,
+    )
+
+    m8, m24 = _mesh8(), _mesh24()
+    hy = _hybrid()
+    n2h = EVEN[2] // 2 + 1
+    cases = {
+        # ---- fused slab c2c -------------------------------------------
+        "slab_c2c_fwd_even": _fused(
+            lambda: build_slab_fft3d(m8, EVEN), EVEN, CDT),
+        "slab_c2c_bwd_even": _fused(
+            lambda: build_slab_fft3d(m8, EVEN, forward=False), EVEN, CDT),
+        "slab_c2c_fwd_uneven": _fused(
+            lambda: build_slab_fft3d(m8, UNEVEN), UNEVEN, CDT),
+        "slab_c2c_fwd_k4": _fused(
+            lambda: build_slab_fft3d(m8, EVEN, overlap_chunks=4), EVEN, CDT),
+        "slab_c2c_fwd_b3": _fused(
+            lambda: build_slab_fft3d(m8, EVEN, batch=3), (3,) + EVEN, CDT),
+        "slab_c2c_fwd_bf16": _fused(
+            lambda: build_slab_fft3d(m8, EVEN, wire_dtype="bf16"),
+            EVEN, CDT),
+        "slab_c2c_fwd_int8": _fused(
+            lambda: build_slab_fft3d(m8, EVEN, wire_dtype="int8"),
+            EVEN, CDT),
+        "slab_c2c_fwd_a2av_uneven": _fused(
+            lambda: build_slab_fft3d(m8, UNEVEN, algorithm="alltoallv"),
+            UNEVEN, CDT),
+        "slab_c2c_fwd_ppermute": _fused(
+            lambda: build_slab_fft3d(m8, EVEN, algorithm="ppermute"),
+            EVEN, CDT),
+        "slab_c2c_fwd_hier": _fused(
+            lambda: build_slab_fft3d(hy, EVEN, axis_name=("dcn", "ici"),
+                                     algorithm="hierarchical"), EVEN, CDT),
+        "slab_c2c_fwd_hier_k2": _fused(
+            lambda: build_slab_fft3d(hy, EVEN, axis_name=("dcn", "ici"),
+                                     algorithm="hierarchical",
+                                     overlap_chunks=2), EVEN, CDT),
+        "slab_c2c_fwd_donate": _fused(
+            lambda: build_slab_fft3d(m8, EVEN, donate=True), EVEN, CDT),
+        # ---- fused slab r2c / operator --------------------------------
+        "slab_rfft_fwd": _fused(
+            lambda: build_slab_rfft3d(m8, EVEN), EVEN, RDT),
+        "slab_rfft_bwd": _fused(
+            lambda: build_slab_rfft3d(m8, EVEN, forward=False),
+            EVEN[:2] + (n2h,), CDT),
+        "slab_op_poisson": _fused(
+            lambda: build_slab_spectral_op(m8, EVEN, _poisson_mult(EVEN)),
+            EVEN, CDT),
+        "slab_op_poisson_k2_bf16": _fused(
+            lambda: build_slab_spectral_op(
+                m8, EVEN, _poisson_mult(EVEN), overlap_chunks=2,
+                wire_dtype="bf16"), EVEN, CDT),
+        # ---- fused pencil ---------------------------------------------
+        "pencil_c2c_fwd_even": _fused(
+            lambda: build_pencil_fft3d(m24, EVEN), EVEN, CDT),
+        "pencil_c2c_bwd_even": _fused(
+            lambda: build_pencil_fft3d(m24, EVEN, forward=False), EVEN, CDT),
+        "pencil_c2c_fwd_uneven": _fused(
+            lambda: build_pencil_fft3d(m24, UNEVEN), UNEVEN, CDT),
+        "pencil_c2c_fwd_k2": _fused(
+            lambda: build_pencil_fft3d(m24, EVEN, overlap_chunks=2),
+            EVEN, CDT),
+        "pencil_c2c_fwd_b2": _fused(
+            lambda: build_pencil_fft3d(m24, EVEN, batch=2), (2,) + EVEN,
+            CDT),
+        "pencil_c2c_fwd_int8": _fused(
+            lambda: build_pencil_fft3d(m24, EVEN, wire_dtype="int8"),
+            EVEN, CDT),
+        "pencil_rfft_fwd": _fused(
+            lambda: build_pencil_rfft3d(m24, EVEN), EVEN, RDT),
+        "pencil_rfft_bwd": _fused(
+            lambda: build_pencil_rfft3d(m24, EVEN, forward=False),
+            EVEN[:2] + (n2h,), CDT),
+        "pencil_op_poisson": _fused(
+            lambda: build_pencil_spectral_op(m24, EVEN,
+                                             _poisson_mult(EVEN)),
+            EVEN, CDT),
+        # ---- staged pipelines -----------------------------------------
+        "slab_stages_fwd": _staged(
+            lambda: build_slab_stages(m8, EVEN), EVEN, CDT),
+        "slab_stages_fwd_k4": _staged(
+            lambda: build_slab_stages(m8, EVEN, overlap_chunks=4),
+            EVEN, CDT),
+        "slab_stages_bwd": _staged(
+            lambda: build_slab_stages(m8, EVEN, forward=False), EVEN, CDT),
+        "slab_stages_hier": _staged(
+            lambda: build_slab_stages(hy, EVEN, axis_name=("dcn", "ici"),
+                                      algorithm="hierarchical"), EVEN, CDT),
+        "slab_stages_hier_k2": _staged(
+            lambda: build_slab_stages(hy, EVEN, axis_name=("dcn", "ici"),
+                                      algorithm="hierarchical",
+                                      overlap_chunks=2), EVEN, CDT),
+        "pencil_stages_fwd": _staged(
+            lambda: build_pencil_stages(m24, EVEN), EVEN, CDT),
+        "pencil_stages_bwd": _staged(
+            lambda: build_pencil_stages(m24, EVEN, forward=False),
+            EVEN, CDT),
+        "pencil_stages_fwd_b2": _staged(
+            lambda: build_pencil_stages(m24, EVEN, batch=2), (2,) + EVEN,
+            CDT),
+        "slab_rfft_stages_fwd": _staged(
+            lambda: build_slab_rfft_stages(m8, EVEN), EVEN, RDT),
+        "slab_rfft_stages_bwd": _staged(
+            lambda: build_slab_rfft_stages(m8, EVEN, forward=False),
+            EVEN[:2] + (n2h,), CDT),
+        "pencil_rfft_stages_fwd": _staged(
+            lambda: build_pencil_rfft_stages(m24, EVEN), EVEN, RDT),
+        "pencil_rfft_stages_bwd": _staged(
+            lambda: build_pencil_rfft_stages(m24, EVEN, forward=False),
+            EVEN[:2] + (n2h,), CDT),
+        "slab_op_stages_poisson": _staged(
+            lambda: build_slab_op_stages(m8, EVEN, _poisson_mult(EVEN)),
+            EVEN, CDT),
+    }
+    return cases
+
+
+def env_fingerprint() -> dict:
+    """What the captures are pinned to: a byte-level HLO pin only means
+    something on the same jax/numpy/x64/device-count stack."""
+    return {
+        "jax": jax.__version__,
+        "numpy": np.__version__,
+        "x64": bool(jax.config.jax_enable_x64),
+        "devices": len(jax.devices()),
+        "platform": jax.default_backend(),
+    }
+
+
+def _case_path(name: str, sub: str) -> str:
+    return os.path.join(PIN_DIR, f"{name}__{sub}.txt")
+
+
+def write_captures() -> None:
+    os.makedirs(PIN_DIR, exist_ok=True)
+    manifest = {"env": env_fingerprint(), "cases": {}}
+    for name, run in sorted(build_cases().items()):
+        subs = {}
+        for sub, text in run():
+            path = _case_path(name, sub)
+            with open(path, "w") as f:
+                f.write(text)
+            subs[sub] = hashlib.sha256(text.encode()).hexdigest()
+            print(f"captured {name}__{sub}: {len(text)} bytes")
+        manifest["cases"][name] = subs
+    with open(MANIFEST, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    print(f"wrote {MANIFEST}")
+
+
+def read_manifest() -> dict | None:
+    try:
+        with open(MANIFEST) as f:
+            return json.load(f)
+    except OSError:
+        return None
+
+
+def load_capture(name: str, sub: str) -> str:
+    with open(_case_path(name, sub)) as f:
+        return f.read()
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "write":
+        write_captures()
+    else:
+        print(__doc__)

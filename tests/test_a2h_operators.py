@@ -533,3 +533,105 @@ def test_speed3d_algorithm_label_stamps_op():
     assert _algorithm_label("alltoall", 2, batch=4, op="gauss") == \
         "alltoall+ov2+b4+opgauss"
     assert _algorithm_label("alltoall", 1) == "alltoall"
+
+
+# ------------------------- higher-order operators & chaining (PR 14)
+
+def test_biharmonic_parity_with_composed_poisson():
+    """biharmonic() is multiplier-identical to two composed Poisson
+    solves (1/|k|^4 == (-1/|k|^2)^2, zero mode nulled) — the ROADMAP's
+    "trivial multiplier add" parity pin, at the multiplier level AND
+    through the fused chain."""
+    m_bi = np.asarray(operators.multiplier_grid(
+        operators.biharmonic(), SHAPE, CDT))
+    m_po = np.asarray(operators.multiplier_grid(
+        operators.poisson(), SHAPE, CDT))
+    np.testing.assert_allclose(m_bi, m_po * m_po, rtol=1e-13, atol=0)
+    mesh = dfft.make_mesh(8)
+    x = _world(seed=31)
+    plan = operators.plan_spectral_op(
+        SHAPE, mesh, op=operators.biharmonic(), dtype=CDT)
+    solve_p = operators.plan_spectral_op(
+        SHAPE, mesh, op=operators.poisson(), dtype=CDT)
+    ref = np.asarray(solve_p(np.asarray(solve_p(x))))
+    assert _relerr(plan(x), ref) < TOL
+
+
+def test_helmholtz_identity_and_zero_shift_parity():
+    """(shift + |k|^2) * helmholtz multiplier == 1 (the solve inverts
+    the screened operator exactly, every mode); shift == 0 degenerates
+    to the NEGATIVE Poisson solve (mean-free convention)."""
+    shift = 2.5
+    m_h = np.asarray(operators.multiplier_grid(
+        operators.helmholtz(shift), SHAPE, CDT))
+    i0, i1, i2 = np.meshgrid(*(np.arange(n) for n in SHAPE),
+                             indexing="ij")
+
+    def k_of(i, n):
+        f = np.where(i < (n + 1) // 2, i, i - n).astype(float)
+        return 2.0 * np.pi * f
+
+    ksq = sum(k_of(i, n) ** 2
+              for i, n in zip((i0, i1, i2), SHAPE))
+    np.testing.assert_allclose(m_h * (shift + ksq),
+                               np.ones(SHAPE), rtol=1e-12)
+    m_h0 = np.asarray(operators.multiplier_grid(
+        operators.helmholtz(0.0), SHAPE, CDT))
+    m_po = np.asarray(operators.multiplier_grid(
+        operators.poisson(), SHAPE, CDT))
+    np.testing.assert_allclose(m_h0, -m_po, rtol=1e-13, atol=0)
+    with pytest.raises(ValueError, match="shift"):
+        operators.helmholtz(-1.0)
+    # Operator-level inversion: (shift - laplacian) applied spectrally
+    # to the fused solve's output recovers f.
+    mesh = dfft.make_mesh(8)
+    f = _world(seed=33)
+    u = operators.plan_spectral_op(
+        SHAPE, mesh, op=operators.helmholtz(shift), dtype=CDT)(f)
+    fwd = dfft.plan_dft_c2c_3d(SHAPE, mesh, dtype=CDT)
+    bwd = dfft.plan_dft_c2c_3d(SHAPE, mesh, direction=dfft.BACKWARD,
+                               dtype=CDT)
+    back = np.asarray(bwd((shift + ksq) * np.asarray(fwd(u))))
+    assert _relerr(back, np.asarray(f)) < 1e-10
+
+
+def test_chain_composes_multipliers_at_one_t_mid():
+    """plan_spectral_op(op=[op1, op2]) == applying the ops in sequence,
+    while compiling EXACTLY the collective count of a single-op fused
+    plan — one forward, one multiplied t_mid, one inverse per SET."""
+    mesh = dfft.make_mesh(8)
+    ops = [operators.gaussian(0.4), operators.gradient(1)]
+    chained = operators.plan_spectral_op(SHAPE, mesh, op=ops, dtype=CDT)
+    single = operators.plan_spectral_op(
+        SHAPE, mesh, op=operators.poisson(), dtype=CDT)
+    assert (_collectives_of(chained.fn, chained.in_shape,
+                            chained.in_dtype)
+            == _collectives_of(single.fn, single.in_shape,
+                               single.in_dtype))
+    x = _world(seed=35)
+    g = operators.plan_spectral_op(SHAPE, mesh,
+                                   op=operators.gaussian(0.4), dtype=CDT)
+    d = operators.plan_spectral_op(SHAPE, mesh,
+                                   op=operators.gradient(1), dtype=CDT)
+    ref = np.asarray(d(np.asarray(g(x))))
+    assert _relerr(chained(x), ref) < TOL
+    # Identity & cache metadata: a chain is its own op label/kind.
+    assert chained.op == "chain(gaussian+gradient1)"
+    c1 = operators.chain(ops)
+    assert c1 == operators.chain(
+        [operators.gaussian(0.4), operators.gradient(1)])
+    assert c1 != operators.chain(
+        [operators.gradient(1), operators.gaussian(0.4)])
+    assert operators.chain([operators.poisson()]) == operators.poisson()
+    with pytest.raises(ValueError, match="at least one"):
+        operators.chain([])
+    with pytest.raises(TypeError, match="SpectralOp"):
+        operators.chain([operators.poisson(), "nope"])
+
+
+def test_named_op_higher_order_menu():
+    assert operators.named_op("biharm") == operators.biharmonic()
+    assert (operators.named_op("helmholtz", shift=3.0)
+            == operators.helmholtz(3.0))
+    assert "biharm" in operators.OP_NAMES
+    assert "helmholtz" in operators.OP_NAMES
