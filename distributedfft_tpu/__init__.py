@@ -75,6 +75,11 @@ from .serving import (  # noqa: F401
     submit,
     warm_pool,
 )
+# Multi-tenant QoS (docs/SERVING_QOS.md): the module is the API surface
+# (dfft.qos.parse_qos / .write_ledger); the policy/tenant types and the
+# quota-shed error are lifted for ctor calls and except clauses.
+from . import qos  # noqa: F401
+from .qos import QosPolicy, QuotaExceeded, Tenant  # noqa: F401
 # Deterministic fault injection (docs/ROBUSTNESS.md): the module is the
 # API surface (dfft.faults.inject / .injected / .check / .classify);
 # the fault error type is lifted for except clauses.

@@ -284,11 +284,28 @@ def _ring_reshape(
     steps: Sequence[_Step],
     in_pad: tuple[int, int, int],
     out_pad: tuple[int, int, int],
+    batch: int | None = None,
 ) -> jnp.ndarray:
     """The overlap-map ppermute ring over one local 3D brick (inside
-    shard_map). All geometry comes from the plan-time ``steps`` tables."""
+    shard_map). All geometry comes from the plan-time ``steps`` tables.
+    ``batch=B`` runs B independent bricks ``[B, *in_pad]`` through the
+    SAME ring — the batch rides every ppermute as a leading bystander
+    dim (the PR 6 leading-axis pattern: B transforms, one collective
+    latency per step); ``None`` keeps the unbatched trace exactly."""
     i = lax.axis_index(axis_names)
-    acc = jnp.zeros(out_pad, x.dtype)
+
+    def _at(idx):
+        # Slice starts with the leading batch axis prepended (the zero
+        # matches the table dtype — x64 promotes the clamp arithmetic).
+        if not batch:
+            return tuple(idx)
+        return (jnp.zeros((), idx.dtype),) + tuple(idx)
+
+    def _ext(sz):
+        return ((batch,) + tuple(sz)) if batch else tuple(sz)
+
+    bo = 1 if batch else 0
+    acc = jnp.zeros(_ext(out_pad), x.dtype)
     for st in steps:
         block = st.block
         sstart = jnp.asarray(st.send_start)
@@ -301,7 +318,7 @@ def _ring_reshape(
         my_st = sstart[i]
         clamp_s = jnp.minimum(
             my_st, jnp.asarray(in_pad, jnp.int32) - jnp.asarray(block))
-        blk = lax.dynamic_slice(x, tuple(clamp_s), block)
+        blk = lax.dynamic_slice(x, _at(clamp_s), _ext(block))
         if st.shift:
             blk = lax.ppermute(
                 blk, axis_names,
@@ -319,16 +336,17 @@ def _ring_reshape(
             my_r, jnp.asarray(out_pad, jnp.int32) - jnp.asarray(block))
         d2 = my_r - clamp_r
         # Align the overlap to its destination offset inside the block,
-        # mask everything else, and merge read-modify-write.
+        # mask everything else, and merge read-modify-write. The 3D
+        # mask broadcasts over the leading batch axis.
         for ax in range(3):
-            blk = jnp.roll(blk, d2[ax] - d[ax], axis=ax)
+            blk = jnp.roll(blk, d2[ax] - d[ax], axis=ax + bo)
         mask = jnp.ones(block, bool)
         for ax in range(3):
             idx = lax.broadcasted_iota(jnp.int32, block, ax)
             mask &= (idx >= d2[ax]) & (idx < d2[ax] + true[ax])
-        region = lax.dynamic_slice(acc, tuple(clamp_r), block)
+        region = lax.dynamic_slice(acc, _at(clamp_r), _ext(block))
         acc = lax.dynamic_update_slice(
-            acc, jnp.where(mask, blk, region), tuple(clamp_r))
+            acc, jnp.where(mask, blk, region), _at(clamp_r))
     return acc
 
 
@@ -512,6 +530,7 @@ def _a2av_reshape(
     t: _A2AVTables,
     out_pad: tuple[int, int, int],
     platform: str,
+    batch: int | None = None,
 ) -> jnp.ndarray:
     """The exact-count reshape of one local brick (inside shard_map).
     Every per-device table arrives as a SHARDED OPERAND (one row per
@@ -524,13 +543,20 @@ def _a2av_reshape(
     exercise every run map, and only the collective itself differs on
     hardware. ``platform`` is the mesh devices' platform, resolved at
     plan time (a CPU-device mesh under a non-CPU default backend must
-    still take the emulation path)."""
+    still take the emulation path). ``batch=B`` reshapes B bricks
+    ``[B, *pad]`` through ONE collective — the batch rides the run
+    buffers as a trailing dim (the ragged axis must stay leading), so
+    the index tables are expanded once and shared by all B."""
     from ..utils.compat import force_real_lowering
 
     scap = max(t.send_cap, 1)
     rcap = max(t.recv_cap, 1)
     pack_idx = _expand_runs(pack_rows[0][0], pack_rows[1][0], scap, 0)
-    sendbuf = x.reshape(-1)[pack_idx]  # [send_cap]
+    if batch:
+        # [B, *pad] -> [send_cap, B]: run slots lead, batch trails.
+        sendbuf = x.reshape(batch, -1)[:, pack_idx].T
+    else:
+        sendbuf = x.reshape(-1)[pack_idx]  # [send_cap]
 
     if platform == "cpu" and not force_real_lowering():
         # Emulation: gather every sender's buffer, then assemble my
@@ -541,19 +567,22 @@ def _a2av_reshape(
         rr, off, valid = _run_slots(gend, rcap)
         row = jnp.where(valid, grow[rr], 0)
         col = jnp.where(valid, goff[rr] + off, 0)
-        ag = lax.all_gather(sendbuf, axis_names)  # [P, send_cap]
+        ag = lax.all_gather(sendbuf, axis_names)  # [P, send_cap(, B)]
         y = ag[row, col]
     else:
-        out = jnp.zeros((rcap,), x.dtype)
+        out = jnp.zeros((rcap, batch) if batch else (rcap,), x.dtype)
         soff, ssz, ooff, rsz = (a[0] for a in count_rows)
         y = lax.ragged_all_to_all(
             sendbuf, out, soff, ssz, ooff, rsz, axis_name=axis_names)
     sentinel = jnp.int32(math.prod(out_pad))
     unpack_idx = _expand_runs(
         unpack_rows[0][0], unpack_rows[1][0], rcap, sentinel)
-    accf = jnp.zeros((math.prod(out_pad),), x.dtype)
+    accf = jnp.zeros((math.prod(out_pad), batch) if batch
+                     else (math.prod(out_pad),), x.dtype)
     # Sentinel indices on padding slots fall out of bounds and drop.
     accf = accf.at[unpack_idx].set(y, mode="drop")
+    if batch:
+        return accf.T.reshape((batch,) + tuple(out_pad))
     return accf.reshape(out_pad)
 
 
@@ -567,11 +596,14 @@ def _a2av_mapped(
     data_out_spec: P,
     squeeze_in: bool,
     expand_out: bool,
+    batch: int | None = None,
 ) -> Callable:
     """Build ``fn(x)`` for the a2av transport: every per-device table —
     RLE run rows AND the ragged off/size rows — travels as a shard_map
     operand sharded one row per device (the emulation gather rows only
-    on CPU meshes, where the ragged op cannot lower)."""
+    on CPU meshes, where the ragged op cannot lower). ``batch=B``
+    expects the caller's data specs batch-adjusted (leading replicated
+    axis); the tables stay unbatched — one run map serves all B."""
     platform = mesh.devices.flat[0].platform
     row = P(names, None)
     sz32 = tables.sizes.astype(np.int32)
@@ -588,10 +620,16 @@ def _a2av_mapped(
                      jnp.asarray(tables.gather_end)]
 
     def _local(x, ps, pe, us, ue, soff, ssz, ooff, rsz, *g):
-        v = x[0] if squeeze_in else x
+        if squeeze_in:
+            v = x[:, 0] if batch else x[0]
+        else:
+            v = x
         y = _a2av_reshape(v, (ps, pe), (us, ue), (soff, ssz, ooff, rsz),
-                          g or None, names, tables, out_pad, platform)
-        return y[None] if expand_out else y
+                          g or None, names, tables, out_pad, platform,
+                          batch=batch)
+        if expand_out:
+            return y[:, None] if batch else y[None]
+        return y
 
     mapped = _shard_map(
         _local, mesh=mesh,
@@ -724,6 +762,7 @@ def plan_bricks_to_spec(
     *,
     jit: bool = False,
     algorithm: str = "ring",
+    batch: int | None = None,
 ) -> tuple[Callable, BrickSpec]:
     """Arbitrary in-bricks -> a true global array sharded by ``to_spec``.
 
@@ -732,8 +771,20 @@ def plan_bricks_to_spec(
     out_specs reassemble the true (unpadded) global — which requires
     ``to_spec`` to divide the world evenly. ``algorithm`` as in
     :func:`plan_brick_reshape`.
-    """
+
+    ``batch=B`` (the PR 6 leading-axis pattern) maps a batched brick
+    stack ``[B, P, *pad]`` to ``[B, *world]``: B independent reshapes
+    through the SAME collectives, the batch riding every ppermute /
+    ragged exchange as a bystander dim (one collective latency per
+    ring step for all B). ``batch=1`` normalizes to the unbatched plan
+    — byte-identical HLO, pinned. ``spec`` accounting stays per
+    transform (the wire ships ``payload x B``)."""
     _check_algorithm(algorithm)
+    from .slab import batch_pspec, check_batch
+
+    batch = check_batch(batch)
+    if batch == 1:
+        batch = None
     world = find_world(in_boxes)
     _validate(in_boxes, world, "input")
     out_boxes, shard_shape = _even_spec_boxes(mesh, to_spec, world, "target")
@@ -741,6 +792,8 @@ def plan_bricks_to_spec(
     if len(in_boxes) != p:
         raise ValueError(f"need {p} input bricks, got {len(in_boxes)}")
     in_pad = pad_shape_for(in_boxes)
+    in_spec = batch_pspec(P(names), batch)
+    out_spec = batch_pspec(to_spec, batch)
     if algorithm == "a2av":
         tables = _a2av_tables(in_boxes, out_boxes, in_pad, shard_shape)
         spec = BrickSpec(tuple(in_boxes), tuple(out_boxes), world, in_pad,
@@ -748,18 +801,20 @@ def plan_bricks_to_spec(
                          payload_override=_a2av_payload(tables),
                          a2av_table_bytes=tables.table_bytes_per_device)
         fn = _a2av_mapped(mesh, names, p, tables, shard_shape,
-                          P(names), to_spec,
-                          squeeze_in=True, expand_out=False)
+                          in_spec, out_spec,
+                          squeeze_in=True, expand_out=False, batch=batch)
     else:
         steps = _overlap_steps(in_boxes, out_boxes)
         spec = BrickSpec(tuple(in_boxes), tuple(out_boxes), world, in_pad,
                          shard_shape, tuple(steps), algorithm)
 
         def _local(x: jnp.ndarray) -> jnp.ndarray:
-            return _ring_reshape(x[0], names, p, steps, in_pad, shard_shape)
+            v = x[:, 0] if batch else x[0]
+            return _ring_reshape(v, names, p, steps, in_pad, shard_shape,
+                                 batch=batch)
 
-        fn = _shard_map(_local, mesh=mesh, in_specs=P(names),
-                        out_specs=to_spec)
+        fn = _shard_map(_local, mesh=mesh, in_specs=in_spec,
+                        out_specs=out_spec)
     if jit:
         fn = jax.jit(fn)
     return fn, spec
@@ -772,11 +827,20 @@ def plan_spec_to_bricks(
     *,
     jit: bool = False,
     algorithm: str = "ring",
+    batch: int | None = None,
 ) -> tuple[Callable, BrickSpec]:
     """A true global array sharded by ``from_spec`` -> arbitrary out-bricks
     (the exit edge of a brick-I/O FFT plan). ``from_spec`` must divide the
-    world evenly. ``algorithm`` as in :func:`plan_brick_reshape`."""
+    world evenly. ``algorithm`` as in :func:`plan_brick_reshape`;
+    ``batch`` as in :func:`plan_bricks_to_spec` (``[B, *world]`` ->
+    ``[B, P, *pad]``; ``batch=1`` = the unbatched plan, byte-identical
+    HLO)."""
     _check_algorithm(algorithm)
+    from .slab import batch_pspec, check_batch
+
+    batch = check_batch(batch)
+    if batch == 1:
+        batch = None
     world = find_world(out_boxes)
     _validate(out_boxes, world, "output")
     in_boxes, shard_shape = _even_spec_boxes(mesh, from_spec, world, "source")
@@ -784,6 +848,8 @@ def plan_spec_to_bricks(
     if len(out_boxes) != p:
         raise ValueError(f"need {p} output bricks, got {len(out_boxes)}")
     out_pad = pad_shape_for(out_boxes)
+    in_spec = batch_pspec(from_spec, batch)
+    out_spec = batch_pspec(P(names), batch)
     if algorithm == "a2av":
         tables = _a2av_tables(in_boxes, out_boxes, shard_shape, out_pad)
         spec = BrickSpec(tuple(in_boxes), tuple(out_boxes), world,
@@ -791,19 +857,20 @@ def plan_spec_to_bricks(
                          payload_override=_a2av_payload(tables),
                          a2av_table_bytes=tables.table_bytes_per_device)
         fn = _a2av_mapped(mesh, names, p, tables, out_pad,
-                          from_spec, P(names),
-                          squeeze_in=False, expand_out=True)
+                          in_spec, out_spec,
+                          squeeze_in=False, expand_out=True, batch=batch)
     else:
         steps = _overlap_steps(in_boxes, out_boxes)
         spec = BrickSpec(tuple(in_boxes), tuple(out_boxes), world,
                          shard_shape, out_pad, tuple(steps), algorithm)
 
         def _local(x: jnp.ndarray) -> jnp.ndarray:
-            return _ring_reshape(x, names, p, steps, shard_shape,
-                                 out_pad)[None]
+            y = _ring_reshape(x, names, p, steps, shard_shape,
+                              out_pad, batch=batch)
+            return y[:, None] if batch else y[None]
 
-        fn = _shard_map(_local, mesh=mesh, in_specs=from_spec,
-                        out_specs=P(names))
+        fn = _shard_map(_local, mesh=mesh, in_specs=in_spec,
+                        out_specs=out_spec)
     if jit:
         fn = jax.jit(fn)
     return fn, spec

@@ -146,6 +146,7 @@ def make_run_record(
     cost: dict | None = None,
     rates: dict | None = None,
     explain: dict | None = None,
+    qos: dict | None = None,
     source: str = "",
     commit: str | None = None,
     recorded_at: str | None = None,
@@ -191,6 +192,10 @@ def make_run_record(
                         if isinstance(v, (int, float))}
     if explain:
         rec["explain"] = explain
+    if qos:
+        # The serving tier's per-tenant SLO ledger (QosPolicy
+        # .slo_report()) — surfaced offline by ``report qos``.
+        rec["qos"] = qos
     if extra:
         rec["extra"] = extra
     return rec
@@ -265,9 +270,15 @@ def normalize_bench_line(
     # concurrent rows form their own baseline group and their
     # concurrent_transforms_per_s rate never compares against
     # sequential rows; sequential rows keep the old schema.
+    # "tenant_class" is the QoS priority class a serving-tier run was
+    # measured under (docs/SERVING_QOS.md): a realtime run drains ahead
+    # of the backlog while a batch run waits out its promotion clock —
+    # different latency/throughput regimes by construction — so
+    # realtime and batch runs never share a compare baseline;
+    # policy-free rows keep the old schema and groups.
     for k in ("dtype", "devices", "decomposition", "overlap", "tuned",
               "batch", "profile", "wire_dtype", "transport", "op",
-              "degraded", "precision", "concurrent"):
+              "degraded", "precision", "concurrent", "tenant_class"):
         if obj.get(k) is not None:
             config[k] = obj[k]
     ex: dict = {}
@@ -290,6 +301,9 @@ def normalize_bench_line(
     explain = obj.get("explain")
     if not isinstance(explain, dict):
         explain = None
+    qos = obj.get("qos")
+    if not isinstance(qos, dict):
+        qos = None
     rates = {k: obj[k] for k in AUX_RATE_METRICS
              if isinstance(obj.get(k), (int, float))}
     return make_run_record(
@@ -307,6 +321,7 @@ def normalize_bench_line(
         cost=cost,
         rates=rates or None,
         explain=explain,
+        qos=qos,
         source=source,
         commit=commit,
         recorded_at=recorded_at,

@@ -1031,6 +1031,89 @@ def _main_wisdom(argv: list[str]) -> int:
     return 1 if (args.gate and stale) else 0
 
 
+def _format_qos_table(doc: dict) -> str:
+    """The SLO-ledger table of ``report qos``: one row per tenant with
+    the declaration (class/weight/rate), the intake/drain/shed/miss
+    counters, the p50/p99 queue wait, and the SLO verdict when the
+    tenant declared a target."""
+    head = ("tenant", "class", "weight", "rate/s", "submits",
+            "transforms", "shed", "misses", "wait_p50", "wait_p99",
+            "slo", "verdict")
+    rows = [head]
+
+    def s(v, fmt="{:g}"):
+        return "-" if v is None else fmt.format(v)
+
+    for name, t in sorted((doc.get("tenants") or {}).items()):
+        verdict = "-"
+        if t.get("slo_wait_s") is not None:
+            verdict = "ok" if t.get("slo_ok") else "MISSED"
+        rows.append((
+            name, str(t.get("class", "-")), s(t.get("weight")),
+            s(t.get("rate")), str(t.get("submits", 0)),
+            str(t.get("transforms", 0)), str(t.get("quota_shed", 0)),
+            str(t.get("deadline_misses", 0)),
+            s(t.get("wait_p50_s"), "{:.6f}"),
+            s(t.get("wait_p99_s"), "{:.6f}"),
+            s(t.get("slo_wait_s")), verdict))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(head))]
+    return "\n".join(
+        "  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+        for r in rows)
+
+
+def _main_qos(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m distributedfft_tpu.report qos",
+        description="Per-tenant QoS/SLO ledger (docs/SERVING_QOS.md): "
+                    "submits, drained transforms, quota sheds, deadline "
+                    "misses, and p50/p99 queue wait against each "
+                    "tenant's declared SLO target. Reads a ledger JSON "
+                    "written by qos.write_ledger / "
+                    "QosPolicy.ledger_json (--ledger), or the newest "
+                    "history run record carrying a 'qos' block.")
+    p.add_argument("--ledger", default=None, metavar="FILE",
+                   help="SLO-ledger JSON file (qos.write_ledger)")
+    _history_arg(p)
+    p.add_argument("--json", action="store_true",
+                   help="print the ledger document as JSON")
+    p.add_argument("--gate", action="store_true",
+                   help="exit 1 when any tenant with a declared SLO "
+                        "target currently misses it")
+    args = p.parse_args(argv)
+
+    if args.ledger:
+        try:
+            with open(args.ledger) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"report qos: {e}", file=sys.stderr)
+            return 2
+    else:
+        history = _resolve_history(args)
+        records = regress.load_history(history)[0] if history else []
+        doc = next((r["qos"] for r in reversed(records)
+                    if isinstance(r.get("qos"), dict)), None)
+        if doc is None:
+            print("report qos: no --ledger given and no history record "
+                  "carries a qos block", file=sys.stderr)
+            return 2
+    if not isinstance(doc.get("tenants"), dict):
+        print("report qos: document has no tenants table",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        print(_format_qos_table(doc))
+    missed = [name for name, t in doc["tenants"].items()
+              if t.get("slo_wait_s") is not None and not t.get("slo_ok")]
+    if missed and not args.json:
+        print(f"{len(missed)} tenant(s) missing their SLO: "
+              f"{sorted(missed)}", file=sys.stderr)
+    return 1 if (args.gate and missed) else 0
+
+
 _SUBCOMMANDS = {
     "merge": _main_merge,
     "record": _main_record,
@@ -1039,6 +1122,7 @@ _SUBCOMMANDS = {
     "wisdom": _main_wisdom,
     "explain": _main_explain,
     "calibrate": _main_calibrate,
+    "qos": _main_qos,
 }
 
 

@@ -40,6 +40,27 @@ throughput play. This module is that tier, four pieces:
    overload degrades predictably (:class:`QueueFull`). With none of
    these knobs set the queue's behavior is byte-identical to the
    pre-robustness tier — one classification-free try/except per flush.
+5. **Multi-tenant QoS** (docs/SERVING_QOS.md): with a
+   :class:`..qos.QosPolicy` armed (``policy=`` / the ``DFFT_QOS`` spec
+   string) every request belongs to a registered :class:`..qos.Tenant`
+   (``submit(..., tenant=)``; groups then key per tenant) and the
+   policy decides three things — **admission** (an over-quota submit is
+   shed with :class:`..qos.QuotaExceeded` under ``admission="raise"``
+   or parked until its token bucket refills under ``"block"``; realtime
+   tenants never shed before batch ones), **drain order** (strict
+   priority class, weighted-fair queueing across tenants within a
+   class, a starvation clock that promotes any group older than
+   ``max_wait_s x starvation_factor``), and **concurrent-wave
+   placement** (higher classes take the earliest waves of a merged
+   schedule; a realtime group never rides a batch cohort). Retries and
+   degraded rebuilds are charged to the owning tenant's bucket.
+   Accounting rides the flight recorder: ``serving_tenant_*`` metrics,
+   ``tenant=`` attributes on the ``serve_submit``/``serve_flush`` span
+   names, and the policy's SLO ledger (``report qos``). With no policy
+   configured everything below is byte-identical to the policy-free
+   tier, and the flush drain order is the documented FIFO: oldest
+   formed group first (an explicit per-group formation stamp, not a
+   dict-iteration accident).
 
 Throughput accounting: every flush observes ``serving_batch_size`` and
 bumps ``serving_transforms`` in the metrics registry; bench.py stamps
@@ -86,11 +107,12 @@ import jax.numpy as jnp
 from . import faults as _faults
 from .local import FORWARD
 from .ops.executors import Scale
+from .qos import QosPolicy, QuotaExceeded
 from .utils import metrics as _metrics
 from .utils.trace import add_trace, record_span, tracing_enabled
 
 __all__ = ["Handle", "submit", "CoalescingQueue", "warm_pool",
-           "DeadlineExceeded", "QueueFull"]
+           "DeadlineExceeded", "QueueFull", "QuotaExceeded"]
 
 #: Process-global request ids — the correlation key of one request's
 #: submit/wait/result spans across threads (the MPI-tag role).
@@ -242,19 +264,23 @@ def submit(plan, x, *, scale: Scale = Scale.NONE) -> Handle:
 
 class _Req:
     """One pending request of a coalescing group: the coerced array, its
-    handle, the scale to apply at resolve, and — deadline requests
-    only — the absolute expiry stamp (perf_counter axis)."""
+    handle, the scale to apply at resolve, the owning tenant (QoS-armed
+    queues only), and — deadline requests only — the absolute expiry
+    stamp (perf_counter axis)."""
 
-    __slots__ = ("x", "handle", "scale", "expires", "deadline_s")
+    __slots__ = ("x", "handle", "scale", "expires", "deadline_s",
+                 "tenant")
 
     def __init__(self, x, handle: Handle, scale: Scale,
                  expires: float | None = None,
-                 deadline_s: float | None = None):
+                 deadline_s: float | None = None,
+                 tenant: str | None = None):
         self.x = x
         self.handle = handle
         self.scale = scale
         self.expires = expires
         self.deadline_s = deadline_s
+        self.tenant = tenant
 
 
 def _env_int(name: str) -> int | None:
@@ -319,6 +345,29 @@ class CoalescingQueue:
     ``serving_concurrent_dispatches`` / ``serving_concurrent_
     transforms`` / ``serving_concurrent_groups``; bench stamps
     ``concurrent_transforms_per_s`` (``DFFT_BENCH_CONCURRENT``).
+    ``concurrent_groups="auto"`` picks the width per flush from the
+    analytic schedule model (:func:`..plan_logic
+    .model_concurrent_seconds` over widths 1..4 — the width with the
+    highest modeled transforms/s wins; plans below the IR tier fall
+    back to sequential flushes).
+
+    ``policy`` (default: parsed from the ``DFFT_QOS`` spec string) arms
+    the multi-tenant QoS tier (docs/SERVING_QOS.md): requests carry
+    ``submit(..., tenant=)``, groups key per tenant, and the
+    :class:`..qos.QosPolicy` governs admission (token-bucket quotas;
+    over-quota submits shed with :class:`..qos.QuotaExceeded` under
+    ``admission="raise"`` or park until the bucket refills under
+    ``"block"``), the flush drain order (strict priority class >
+    weighted-fair within a class > starvation promotion), and
+    concurrent-wave placement (a realtime group never rides a batch
+    cohort). ``policy="off"`` forces the policy-free tier even when
+    ``DFFT_QOS`` is set. With no policy the queue is byte-identical to
+    the anonymous tier (pinned) and ``flush()`` drains groups
+    oldest-formed-first — the documented FIFO contract (an explicit
+    per-group formation stamp, not dict-iteration order).
+    ``flush(limit=N)`` bounds one call to N transforms (the last group
+    splits at the boundary; the rest stay queued) — the drain quantum
+    the weighted-fair shares are measured over.
 
     Robustness knobs (docs/ROBUSTNESS.md; all default-off — the queue
     is byte-identical to the pre-robustness tier without them):
@@ -360,19 +409,29 @@ class CoalescingQueue:
         retry_max: int | None = None,
         retry_backoff_s: float | None = None,
         fallback_executor: str | None = None,
-        concurrent_groups: int | None = None,
+        concurrent_groups: int | str | None = None,
+        policy: "QosPolicy | str | None" = None,
         **plan_kw,
     ):
         if kind not in ("c2c", "r2c"):
             raise ValueError(f"kind must be c2c|r2c, got {kind!r}")
         if concurrent_groups is None:
-            concurrent_groups = _env_int("DFFT_CONCURRENT_GROUPS")
-        if concurrent_groups is not None and (
-                isinstance(concurrent_groups, bool)
-                or not isinstance(concurrent_groups, int)
-                or concurrent_groups < 1):
-            raise ValueError(f"concurrent_groups must be an int >= 1 or "
-                             f"None, got {concurrent_groups!r}")
+            raw = os.environ.get("DFFT_CONCURRENT_GROUPS", "").strip()
+            concurrent_groups = ("auto" if raw == "auto"
+                                 else _env_int("DFFT_CONCURRENT_GROUPS"))
+        if concurrent_groups is not None and concurrent_groups != "auto" \
+                and (isinstance(concurrent_groups, bool)
+                     or not isinstance(concurrent_groups, int)
+                     or concurrent_groups < 1):
+            raise ValueError(f"concurrent_groups must be an int >= 1, "
+                             f"'auto', or None, got {concurrent_groups!r}")
+        if policy is None:
+            policy = QosPolicy.from_env()
+        elif policy == "off" or policy is False:
+            policy = None
+        elif not isinstance(policy, QosPolicy):
+            raise ValueError(f"policy must be a QosPolicy, 'off', or "
+                             f"None, got {policy!r}")
         if not isinstance(max_batch, int) or max_batch < 1:
             raise ValueError(f"max_batch must be an int >= 1, "
                              f"got {max_batch!r}")
@@ -427,13 +486,24 @@ class CoalescingQueue:
         self._retry_backoff = float(retry_backoff_s)
         self._fallback_executor = fallback_executor
         self.concurrent_groups = concurrent_groups
+        self.policy = policy
         self.plan_kw = dict(plan_kw)
         self._lock = threading.RLock()
         # Admission waiters park here; notified whenever a flush or an
         # expiry frees queue depth.
         self._space = threading.Condition(self._lock)
-        # (shape, dtype str, direction) -> list of _Req
+        # (shape, dtype str, direction[, tenant]) -> list of _Req (the
+        # tenant element exists only on QoS-armed queues).
         self._pending: dict[tuple, list[_Req]] = {}
+        # Group-formation stamps: key -> (sequence, perf_counter at
+        # formation). The sequence is the policy-free FIFO drain order
+        # (documented contract: oldest formed group flushes first); the
+        # timestamp feeds the QoS starvation clock. Popped with the
+        # group.
+        self._order = itertools.count()
+        self._formed: dict[tuple, tuple[int, float]] = {}
+        # concurrent_groups="auto": modeled width per plan tuple.
+        self._auto_widths: dict[tuple, int] = {}
 
     # ------------------------------------------------------------ intake
 
@@ -445,7 +515,10 @@ class CoalescingQueue:
 
     def _plan(self, key: tuple, batch: int | None, donate: bool,
               executor: str | None = None):
-        shape, dtype, direction = key
+        # QoS-armed group keys carry the tenant as a 4th element; the
+        # plan identity is the first three (tenancy never changes what
+        # a plan compiles to).
+        shape, dtype, direction = key[:3]
         kw = dict(self.plan_kw, direction=direction, batch=batch,
                   donate=donate)
         if executor is not None:
@@ -481,9 +554,44 @@ class CoalescingQueue:
                         deadline_s=deadline_s, stage="admission")
             self._space.wait(timeout)
 
+    def _quota_admit(self, tenant: str, deadline_s: float | None) -> None:
+        """Token-bucket admission gate of one QoS-armed submit (called
+        outside the queue lock — a quota park must not block peers).
+        ``admission="raise"`` sheds an over-quota submit with
+        :class:`..qos.QuotaExceeded`; ``"block"`` parks until the
+        tenant's bucket can cover it, bounded by the request's own
+        deadline (overrun -> :class:`DeadlineExceeded`,
+        ``stage="admission"``, counted as the tenant's deadline miss)."""
+        pol = self.policy
+        start = time.perf_counter()
+        while True:
+            wait = pol.admit(tenant)
+            if wait <= 0:
+                return
+            if self.admission == "raise":
+                if _metrics._enabled:
+                    _metrics.inc("serving_rejected", kind=self.kind)
+                    _metrics.inc("serving_tenant_quota_shed",
+                                 kind=self.kind, tenant=tenant)
+                pol.note_shed(tenant)
+                raise QuotaExceeded(tenant, wait)
+            if deadline_s is not None:
+                waited = time.perf_counter() - start
+                if waited + wait > deadline_s:
+                    if _metrics._enabled:
+                        _metrics.inc("serving_rejected", kind=self.kind)
+                        _metrics.inc("serving_tenant_deadline_misses",
+                                     kind=self.kind, tenant=tenant)
+                    pol.note_miss(tenant)
+                    raise DeadlineExceeded(
+                        waited_s=waited, deadline_s=deadline_s,
+                        stage="admission")
+            time.sleep(wait)
+
     def submit(self, x, *, direction: int = FORWARD,
                scale: Scale = Scale.NONE,
-               deadline_s: float | None = None) -> Handle:
+               deadline_s: float | None = None,
+               tenant: str | None = None) -> Handle:
         """Enqueue one transform of ``x`` (the plan's unbatched input
         shape: the 3D world for c2c / forward r2c, the half-spectrum
         world for backward r2c). Returns immediately; the group executes
@@ -492,19 +600,38 @@ class CoalescingQueue:
         ``deadline_s`` bounds this request's total queue time: a
         request that has not begun executing within it is cancelled —
         its handle raises :class:`DeadlineExceeded` with the queue-wait
-        breakdown — while its group's survivors stay queued."""
+        breakdown — while its group's survivors stay queued.
+
+        ``tenant`` names the request's owner (docs/SERVING_QOS.md).
+        With a :class:`..qos.QosPolicy` armed it must be a registered
+        tenant (``None`` maps to the implicit ``default`` tenant) and
+        the policy's quota/fairness machinery applies; without a policy
+        it is an accounting label only (``serving_tenant_*`` metrics +
+        span attribute) and changes no behavior."""
         if deadline_s is not None and (
                 isinstance(deadline_s, bool)
                 or not isinstance(deadline_s, (int, float))
                 or not deadline_s > 0):
             raise ValueError(f"deadline_s must be a positive number or "
                              f"None, got {deadline_s!r}")
+        if tenant is not None and not isinstance(tenant, str):
+            raise ValueError(f"tenant must be a string or None, "
+                             f"got {tenant!r}")
+        pol = self.policy
+        tname = tenant
+        if pol is not None:
+            tname = pol.resolve(tenant).name
+            pol.note_submit(tname)
         tracing = tracing_enabled()
         recording = tracing or _metrics._enabled
         rid = next(_REQ_IDS) if recording else None
-        with _span(f"serve_submit[{rid}]", tracing):
+        ttag = f":tenant={tname}" if tname is not None else ""
+        with _span(f"serve_submit[{rid}{ttag}]", tracing):
             shape, dtype, x = self._coerce(x, direction)
             key = (shape, dtype, direction)
+            if pol is not None:
+                key = key + (tname,)
+                self._quota_admit(tname, deadline_s)
             handle = Handle(queue=self)
             handle._key = key
             if recording:
@@ -512,11 +639,22 @@ class CoalescingQueue:
                 handle._enqueued = time.perf_counter()
             if _metrics._enabled:
                 _metrics.inc("serving_submits", kind=self.kind)
+                if tname is not None:
+                    _metrics.inc("serving_tenant_submits",
+                                 kind=self.kind, tenant=tname)
             with self._lock:
                 self._admit(deadline_s)
                 group = self._pending.setdefault(key, [])
                 first = not group
-                req = _Req(x, handle, scale)
+                if first:
+                    self._formed[key] = (next(self._order),
+                                         time.perf_counter())
+                req = _Req(x, handle, scale, tenant=tname)
+                if pol is not None and handle._enqueued is None:
+                    # The QoS ledger's wait/starvation clocks need the
+                    # enqueue stamp even with the recorder off (the
+                    # deadline-timer precedent: behavior, not telemetry).
+                    handle._enqueued = time.perf_counter()
                 if deadline_s is not None:
                     # The deadline clock needs the enqueue stamp even
                     # with the recorder off (behavior, not telemetry).
@@ -574,6 +712,11 @@ class CoalescingQueue:
                   if req.handle._enqueued is not None else 0.0)
         if _metrics._enabled:
             _metrics.inc("serving_expired", kind=self.kind)
+            if req.tenant is not None:
+                _metrics.inc("serving_tenant_deadline_misses",
+                             kind=self.kind, tenant=req.tenant)
+        if self.policy is not None and req.tenant is not None:
+            self.policy.note_miss(req.tenant)
         if (tracing_enabled() and req.handle._req_id is not None
                 and req.handle._enqueued is not None):
             record_span(f"serve_expire[{req.handle._req_id}]",
@@ -599,6 +742,7 @@ class CoalescingQueue:
                 self._pending[key] = live
             else:
                 self._pending.pop(key, None)
+                self._formed.pop(key, None)
             for r in expired:
                 self._fail_expired(r, now)
             if _metrics._enabled:
@@ -646,39 +790,115 @@ class CoalescingQueue:
         with self._lock:
             return sum(len(g) for g in self._pending.values())
 
+    def _tenant_of(self, key: tuple) -> str | None:
+        """The owning tenant of a group key (QoS-armed keys carry it as
+        the 4th element); None on the anonymous tier."""
+        return key[3] if len(key) > 3 else None
+
+    def _drain_order(self, now: float) -> list[tuple]:
+        """Pending group keys in drain order (caller holds the lock).
+        Policy-free: the documented FIFO — oldest formed group first,
+        by the explicit formation sequence (never dict-iteration
+        order). With a policy: strict class > weighted-fair within a
+        class > starvation promotion (:meth:`..qos.QosPolicy
+        .order_groups`)."""
+        keys = [k for k, g in self._pending.items() if g]
+        if self.policy is None:
+            return sorted(keys,
+                          key=lambda k: self._formed.get(k, (0, 0.0))[0])
+        infos = []
+        for k in keys:
+            g = self._pending[k]
+            _, t0 = self._formed.get(k, (0, now))
+            oldest = min((r.handle._enqueued for r in g
+                          if r.handle._enqueued is not None), default=t0)
+            infos.append({"key": k, "tenant": self._tenant_of(k),
+                          "n": len(g), "age_s": max(0.0, now - oldest)})
+        ordered = self.policy.order_groups(infos,
+                                           max_wait_s=self.max_wait_s)
+        return [i["key"] for i in ordered]
+
+    def _concurrent_chunks(self, groups: list, ncc: int) -> list:
+        """Partition drained groups into the cohorts one concurrent
+        dispatch merges. Policy-free: plain runs of ``ncc``. With a
+        policy: class-compatible runs — a realtime group never rides a
+        batch cohort (:meth:`..qos.QosPolicy.concurrent_chunks`), and
+        drain order = schedule order, so higher classes keep the
+        earliest waves."""
+        if self.policy is None:
+            return [groups[i:i + ncc]
+                    for i in range(0, len(groups), ncc)]
+        by_key = {k: g for k, g in groups}
+        infos = [{"key": k, "tenant": self._tenant_of(k), "n": len(g)}
+                 for k, g in groups]
+        return [[(i["key"], by_key[i["key"]]) for i in chunk]
+                for chunk in self.policy.concurrent_chunks(infos, ncc)]
+
     def flush(self, key: tuple | None = None, *,
-              reason: str = "manual") -> int:
-        """Execute every pending group (or just ``key``'s) as batched
+              reason: str = "manual", limit: int | None = None) -> int:
+        """Execute pending groups (or just ``key``'s) as batched
         programs; returns the number of transforms dispatched. Handles
         resolve to async in-flight arrays (result() blocks on device).
         ``reason`` tags the flight-recorder spans/metrics with what
         triggered the flush: ``full`` (a group reached max_batch),
         ``manual`` (this call), ``result`` (a caller's await outran
         the coalescer), or ``deadline`` (the oldest request aged past
-        ``max_wait_s``). With the retry machinery armed
-        (``retry_max=``/``DFFT_RETRY_MAX``), flush errors are recovered
-        per docs/ROBUSTNESS.md and surface only through the failed
-        requests' handles; without it a failed group fails every handle
-        and re-raises (the legacy contract)."""
+        ``max_wait_s``).
+
+        Drain order is the documented FIFO (oldest formed group first)
+        on the policy-free tier, the QoS order with a policy armed.
+        ``limit`` bounds this call to at most that many transforms —
+        groups are taken in drain order and the last one splits at the
+        boundary (the remainder stays queued under its original
+        formation stamp); ``None`` drains everything. With the retry
+        machinery armed (``retry_max=``/``DFFT_RETRY_MAX``), flush
+        errors are recovered per docs/ROBUSTNESS.md and surface only
+        through the failed requests' handles; without it a failed group
+        fails every handle and re-raises (the legacy contract)."""
+        if limit is not None and (
+                isinstance(limit, bool) or not isinstance(limit, int)
+                or limit < 1):
+            raise ValueError(f"limit must be an int >= 1 or None, "
+                             f"got {limit!r}")
         done = 0
         recording = tracing_enabled() or _metrics._enabled
-        flushed_at = time.perf_counter() if recording else 0.0
+        flushed_at = (time.perf_counter()
+                      if recording or self.policy is not None else 0.0)
         with self._lock:
-            keys = [key] if key is not None else list(self._pending)
-            groups = [(k, self._pending.pop(k)) for k in keys
-                      if self._pending.get(k)]
+            keys = ([key] if key is not None
+                    else self._drain_order(flushed_at))
+            groups = []
+            budget = limit
+            for k in keys:
+                g = self._pending.get(k)
+                if not g:
+                    continue
+                if budget is not None and len(g) > budget:
+                    # Split at the drain quantum: the taken slice
+                    # executes now, the remainder keeps the group's
+                    # formation stamp (and its own deadline timers).
+                    self._pending[k] = g[budget:]
+                    groups.append((k, g[:budget]))
+                    budget = 0
+                    break
+                self._pending.pop(k)
+                self._formed.pop(k, None)
+                groups.append((k, g))
+                if budget is not None:
+                    budget -= len(g)
+                    if budget <= 0:
+                        break
             if groups:
                 self._space.notify_all()  # admission waiters: depth fell
-            ncc = self.concurrent_groups or 1
+            ncc = self._concurrent_width(groups)
             if ncc > 1 and len(groups) > 1:
                 # Multi-group flush: drain up to concurrent_groups
                 # compatible-mesh groups into ONE scheduled dispatch
                 # (schedule_concurrent interleaves their stage DAGs so
                 # one group's t2 wire hides under another's FFTs).
-                for i in range(0, len(groups), ncc):
+                for chunk in self._concurrent_chunks(groups, ncc):
                     done += self._execute_concurrent(
-                        groups[i:i + ncc], reason=reason,
-                        flushed_at=flushed_at)
+                        chunk, reason=reason, flushed_at=flushed_at)
             else:
                 for k, group in groups:
                     done += self._execute_group(k, group, reason=reason,
@@ -689,6 +909,61 @@ class CoalescingQueue:
                     float(sum(len(g) for g in self._pending.values())),
                     kind=self.kind)
         return done
+
+    def _concurrent_width(self, groups: list) -> int:
+        """The concurrent-flush width of this drain: the configured
+        int, or — ``concurrent_groups="auto"`` (the model-driven
+        default) — the width in 1..4 whose
+        :func:`..plan_logic.model_concurrent_seconds` price yields the
+        highest modeled transforms/s for the groups at hand. Plans
+        below the IR tier (no stage graph / logic skeleton) and any
+        modeling failure fall back to sequential flushes; widths are
+        memoized per plan tuple (the steady-state queue re-flushes the
+        same group pattern)."""
+        ncc = self.concurrent_groups
+        if ncc is None:
+            return 1
+        if ncc != "auto":
+            return ncc
+        if len(groups) < 2:
+            return 1
+        try:
+            plans, counts = [], []
+            for k, g in groups[:4]:
+                p = self._plan(k, len(g) if len(g) > 1 else None, False)
+                if p.graph is None or p.logic is None:
+                    return 1
+                plans.append(p)
+                counts.append(len(g))
+            memo_key = tuple(id(p) for p in plans)
+            hit = self._auto_widths.get(memo_key)
+            if hit is not None:
+                return hit
+            from .explain import _model_shape_itemsize, device_profile
+            from .plan_logic import model_concurrent_seconds
+
+            hw = device_profile()
+            triples = []
+            for p in plans:
+                shape, itemsize = _model_shape_itemsize(p)
+                triples.append((p.logic, shape, itemsize))
+            best_w, best_rate = 1, -1.0
+            for w in range(1, len(plans) + 1):
+                m = model_concurrent_seconds(
+                    triples[:w], hbm_gbps=hw["hbm_gbps"],
+                    wire_gbps=hw["wire_gbps"],
+                    launch_seconds=hw["launch_seconds"],
+                    dcn_gbps=hw.get("dcn_gbps"))
+                secs = m["concurrent_seconds"]
+                rate = sum(counts[:w]) / secs if secs > 0 else 0.0
+                if rate > best_rate:
+                    best_w, best_rate = w, rate
+            if len(self._auto_widths) >= 64:
+                self._auto_widths.pop(next(iter(self._auto_widths)))
+            self._auto_widths[memo_key] = best_w
+            return best_w
+        except Exception:  # noqa: BLE001 — the model must never block
+            return 1       # a drain; sequential is always correct
 
     def _live(self, group: list) -> list:
         """Expiry filter of one popped group: fail every request whose
@@ -706,18 +981,25 @@ class CoalescingQueue:
                     tracing: bool) -> None:
         """Close every request's queue-wait interval: enqueue -> flush.
         Retroactive (record_span) because only now is the wait's end —
-        and the batch it coalesced into — known."""
+        and the batch it coalesced into — known. QoS-armed queues also
+        feed the per-tenant wait histogram and the policy's SLO
+        ledger."""
+        pol = self.policy
         for r in group:
             if r.handle._enqueued is None:
                 continue
+            wait = max(0.0, flushed_at - r.handle._enqueued)
             if tracing and r.handle._req_id is not None:
                 record_span(f"serve_wait[{r.handle._req_id}]",
                             r.handle._enqueued, flushed_at)
             if _metrics._enabled:
-                _metrics.observe(
-                    "serving_wait_seconds",
-                    max(0.0, flushed_at - r.handle._enqueued),
-                    kind=self.kind)
+                _metrics.observe("serving_wait_seconds", wait,
+                                 kind=self.kind)
+                if r.tenant is not None:
+                    _metrics.observe("serving_tenant_wait_seconds", wait,
+                                     kind=self.kind, tenant=r.tenant)
+            if pol is not None and r.tenant is not None:
+                pol.note_wait(r.tenant, wait)
 
     def _execute_concurrent(self, chunk: list, *, reason: str,
                             flushed_at: float) -> int:
@@ -766,7 +1048,10 @@ class CoalescingQueue:
                 x = jax.device_put(x, plan.in_sharding)
             inputs.append(x)
         b_total = sum(len(g) for _, g in live_groups)
-        tag = f"{self.kind}:g{len(live_groups)}:b{b_total}:{reason}"
+        tnames = [self._tenant_of(k) for k, _ in live_groups]
+        ttag = ("" if all(t is None for t in tnames) else
+                ":tenants=" + "+".join(t or "-" for t in tnames))
+        tag = f"{self.kind}:g{len(live_groups)}:b{b_total}:{reason}{ttag}"
         try:
             with _span(f"serve_flush[concurrent:{tag}]", tracing):
                 ys = cp(*inputs)
@@ -775,7 +1060,8 @@ class CoalescingQueue:
         #                          retry/degraded/bisect chain.
         from .ops.executors import apply_scale
 
-        for plan, y, (_, g) in zip(plans, ys, live_groups):
+        for plan, y, (k, g) in zip(plans, ys, live_groups):
+            gt = self._tenant_of(k)
             for i, r in enumerate(g):
                 out = y if len(g) == 1 else y[i]
                 if r.scale != Scale.NONE:
@@ -789,6 +1075,12 @@ class CoalescingQueue:
                              kind=self.kind)
                 _metrics.observe("serving_batch_size", float(len(g)),
                                  kind=self.kind)
+                if gt is not None:
+                    _metrics.inc("serving_tenant_transforms",
+                                 float(len(g)), kind=self.kind,
+                                 tenant=gt)
+            if self.policy is not None and gt is not None:
+                self.policy.account_drain(gt, len(g))
         if _metrics._enabled:
             _metrics.inc("serving_concurrent_dispatches", kind=self.kind)
             _metrics.inc("serving_concurrent_transforms", float(b_total),
@@ -804,9 +1096,11 @@ class CoalescingQueue:
         if not group:
             return 0
         b = len(group)
+        tname = self._tenant_of(key)
         tracing = tracing_enabled()
-        tag = f"{self.kind}:b{b}:{reason}"
-        if tracing or _metrics._enabled:
+        tag = (f"{self.kind}:b{b}:{reason}"
+               + (f":tenant={tname}" if tname is not None else ""))
+        if tracing or _metrics._enabled or self.policy is not None:
             self._note_waits(group, flushed_at, tracing)
         if self._retry_max is None:
             # Legacy dispatch: one try, a failure fails every co-batched
@@ -828,6 +1122,11 @@ class CoalescingQueue:
                          reason=reason)
             _metrics.inc("serving_transforms", float(b), kind=self.kind)
             _metrics.observe("serving_batch_size", float(b), kind=self.kind)
+            if tname is not None:
+                _metrics.inc("serving_tenant_transforms", float(b),
+                             kind=self.kind, tenant=tname)
+        if self.policy is not None and tname is not None:
+            self.policy.account_drain(tname, b)
         return b
 
     def _run_group(self, key: tuple, group: list, tag: str, tracing: bool,
@@ -940,6 +1239,11 @@ class CoalescingQueue:
             attempt += 1
             if _metrics._enabled:
                 _metrics.inc("serving_retries", kind=self.kind)
+            if self.policy is not None and group and group[0].tenant:
+                # Recovery work is traffic: the retry re-executes the
+                # whole group on the owning tenant's behalf, so its
+                # bucket pays for it (docs/SERVING_QOS.md).
+                self.policy.charge(group[0].tenant, len(group))
             if delay > 0:
                 time.sleep(delay)
             delay *= 2
@@ -965,6 +1269,10 @@ class CoalescingQueue:
         if _metrics._enabled:
             _metrics.inc("serving_degraded", float(len(group)),
                          kind=self.kind, executor=fb)
+        if self.policy is not None and group and group[0].tenant:
+            # The degraded rebuild re-ran the whole group: charge the
+            # owning tenant's bucket (recovery work is traffic).
+            self.policy.charge(group[0].tenant, len(group))
         self._annotate_degraded(key, plan, len(group))
         return True
 
@@ -980,7 +1288,7 @@ class CoalescingQueue:
 
             from . import tuner
 
-            shape, dtype, direction = key
+            shape, dtype, direction = key[:3]
             if isinstance(self.mesh, int):
                 ndev = self.mesh
             elif self.mesh is None:

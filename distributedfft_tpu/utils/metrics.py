@@ -62,6 +62,19 @@ see docs/ROBUSTNESS.md):
 - ``serving_warm_pool_skipped`` (counter) — stale wisdom tuples
   skipped during pool warm-up.
 
+Multi-tenant QoS series (wired in :mod:`..serving`; see
+docs/SERVING_QOS.md):
+
+- ``serving_tenant_submits`` / ``serving_tenant_transforms`` (counter;
+  kind/tenant) — per-tenant intake and drained transforms.
+- ``serving_tenant_quota_shed`` (counter; kind/tenant) — submits shed
+  with ``QuotaExceeded`` (over-quota under ``admission="raise"``).
+- ``serving_tenant_deadline_misses`` (counter; kind/tenant) — deadline
+  cancellations charged to the owning tenant.
+- ``serving_tenant_wait_seconds`` (histogram; kind/tenant) — the
+  per-tenant queue-wait distribution (the SLO ledger's p50/p99 ride
+  the policy's in-process reservoir; ``report qos``).
+
 Disabled-path discipline: everything is gated on one module-level flag
 (the ``tracing_enabled()`` pattern of :mod:`.trace`) — with metrics off
 (the default) every hook is a single attribute check and early return,

@@ -1,0 +1,714 @@
+"""Multi-tenant QoS subsystem (PR 15, docs/SERVING_QOS.md).
+
+Contracts pinned here:
+
+1. **Default pin** — with no policy configured the queue's flush
+   behavior, span names, and metrics are identical to the anonymous
+   tier (``tenant=`` is a label-only no-op), and the policy-free drain
+   order is the documented FIFO: oldest formed group first, by the
+   explicit formation stamp — NOT dict-iteration order (regression:
+   a reshuffled pending dict still drains oldest-first).
+2. **Admission** — token-bucket quotas: over-quota submits shed with
+   ``QuotaExceeded`` under ``admission="raise"`` and park under
+   ``"block"`` (bounded by the request's deadline); a realtime tenant
+   overdraws one extra burst before either applies, so realtime never
+   sheds before batch under equal configs. Retries and degraded
+   rebuilds are charged to the owning tenant's bucket.
+3. **Weighted-fair drain** — under saturation a 3:1 weight ratio
+   drains as a 3:1 transform share (within 15%), strict class order
+   across classes, and the starvation clock promotes aged batch groups
+   ahead of everything (zero starvation past the promotion age). Every
+   request still completes bit-correct, including under multi-threaded
+   submit contention (2 tenants x 2 classes).
+4. **Concurrent-wave placement** — drain order = schedule order
+   (higher classes take the earliest waves) and a realtime group never
+   rides a cohort containing batch groups; ``concurrent_groups="auto"``
+   picks the width from ``model_concurrent_seconds`` (1..4).
+5. **Accounting** — ``serving_tenant_*`` metrics, ``tenant=`` span
+   attributes, the SLO ledger (p50/p99 vs declared target), and the
+   ``report qos`` subcommand (``--ledger``/history/``--json``/
+   ``--gate``).
+
+NOTE on the filename: must collect BEFORE ``test_alltoallv.py``
+(alphabetical clean-backend tier; see ``tests/conftest.py``).
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu import qos, report, serving
+from distributedfft_tpu.qos import QosPolicy, QuotaExceeded, Tenant
+from distributedfft_tpu.utils import metrics as m
+from distributedfft_tpu.utils import trace as tr
+
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the virtual 8-device mesh"
+)
+
+SHAPE = (8, 8, 8)
+CDT = jnp.complex128
+
+
+def _world(seed=0, shape=SHAPE):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal(shape) + 1j * rng.standard_normal(shape)
+
+
+@pytest.fixture
+def metrics_on():
+    dfft.enable_metrics()
+    m.metrics_reset()
+    yield
+    m.metrics_reset()
+    dfft.enable_metrics(False)
+
+
+def _queue(policy=None, **kw):
+    kw.setdefault("dtype", CDT)
+    kw.setdefault("max_batch", 64)
+    return dfft.CoalescingQueue(None, policy=policy, **kw)
+
+
+def _two_class_policy(**kw):
+    return QosPolicy([
+        Tenant("rt", "realtime", weight=1.0),
+        Tenant("it", "interactive", weight=1.0),
+        Tenant("bt", "batch", weight=1.0),
+    ], **kw)
+
+
+# ------------------------------------------------------------ spec/units
+
+def test_parse_qos_grammar():
+    ts = qos.parse_qos("acme:class=realtime,weight=3,rate=100,burst=20,"
+                       "slo=0.05;bulk:class=batch,rate=10")
+    assert [t.name for t in ts] == ["acme", "bulk"]
+    a, b = ts
+    assert a.klass == "realtime" and a.weight == 3.0 and a.rate == 100.0
+    assert a.burst == 20.0 and a.slo_wait_s == 0.05
+    assert b.klass == "batch" and b.rate == 10.0 and b.burst is None
+    assert b.bucket_burst == 10.0  # default max(rate, 1)
+    assert qos.parse_qos("") == [] and qos.parse_qos("  ;  ") == []
+
+
+@pytest.mark.parametrize("bad", [
+    "noclause", "x:class=warp", "x:weight=-1", "x:rate=0",
+    "x:unknown=1", "x:weight", "x:burst=5",  # burst without rate
+])
+def test_parse_qos_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        QosPolicy(qos.parse_qos(bad))
+
+
+def test_tenant_validation():
+    with pytest.raises(ValueError, match="class"):
+        Tenant("x", "urgent")
+    with pytest.raises(ValueError, match="weight"):
+        Tenant("x", weight=0)
+    with pytest.raises(ValueError, match="name"):
+        Tenant("")
+
+
+def test_policy_resolve_and_unknown_tenant():
+    pol = QosPolicy([Tenant("a")])
+    assert pol.resolve("a").name == "a"
+    assert pol.resolve(None).name == "default"
+    assert pol.resolve(None).klass == "interactive"
+    with pytest.raises(ValueError, match="unknown tenant"):
+        pol.resolve("ghost")
+    q = _queue(policy=pol)
+    with pytest.raises(ValueError, match="unknown tenant"):
+        q.submit(jnp.asarray(_world(1)), tenant="ghost")
+
+
+def test_queue_policy_validation():
+    with pytest.raises(ValueError, match="policy"):
+        dfft.CoalescingQueue(None, policy=42)
+    with pytest.raises(ValueError, match="concurrent_groups"):
+        dfft.CoalescingQueue(None, concurrent_groups="fast")
+    q = dfft.CoalescingQueue(None)
+    with pytest.raises(ValueError, match="limit"):
+        q.flush(limit=0)
+    with pytest.raises(ValueError, match="tenant"):
+        q.submit(jnp.zeros(SHAPE, CDT), tenant=7)
+
+
+def test_dfft_qos_env_arms_policy(monkeypatch):
+    monkeypatch.setenv("DFFT_QOS", "acme:class=realtime,weight=2")
+    q = dfft.CoalescingQueue(None, dtype=CDT)
+    assert q.policy is not None
+    assert q.policy.tenant("acme").klass == "realtime"
+    # policy="off" forces the anonymous tier even with the env set.
+    q2 = dfft.CoalescingQueue(None, dtype=CDT, policy="off")
+    assert q2.policy is None
+    monkeypatch.setenv("DFFT_QOS", "")
+    assert dfft.CoalescingQueue(None, dtype=CDT).policy is None
+
+
+def test_starve_factor_env(monkeypatch):
+    monkeypatch.setenv("DFFT_QOS_STARVE_FACTOR", "2.5")
+    pol = QosPolicy([])
+    assert pol.starvation_factor == 2.5
+    assert pol.starvation_s(0.2) == pytest.approx(0.5)
+    assert pol.starvation_s(None) == pytest.approx(
+        2.5 * qos.DEFAULT_STARVE_WAIT_S)
+
+
+# ----------------------------------------------------------- default pin
+
+def test_no_policy_is_byte_identical_to_anonymous_tier():
+    """Acceptance pin: with no policy, tenant-less traffic produces the
+    exact pre-QoS observable surface — no tenant metrics, no tenant
+    span suffixes, 3-tuple group keys, identical results."""
+    assert not tr.tracing_enabled()
+    m.enable_metrics(False)
+    m.metrics_reset()
+    q = _queue()
+    assert q.policy is None
+    xs = [_world(s) for s in (1, 2)]
+    hs = [q.submit(jnp.asarray(v)) for v in xs]
+    (key,) = set(h._key for h in hs)
+    assert len(key) == 3  # no tenant element
+    assert q.flush() == 2
+    ref = dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT)
+    for v, h in zip(xs, hs):
+        assert np.array_equal(np.asarray(h.result()),
+                              np.asarray(ref(jnp.asarray(v))))
+    assert dfft.metrics_snapshot()["counters"] == {}
+    assert q._pending == {} and q._formed == {}
+
+
+def test_no_policy_span_names_unchanged(tmp_path):
+    """The exact pre-QoS span names (the PR 7 contract) survive."""
+    tr.init_tracing(str(tmp_path / "pin"), format="chrome")
+    try:
+        q = _queue()
+        hs = [q.submit(jnp.asarray(_world(s))) for s in (3, 4)]
+        q.flush()
+        for h in hs:
+            h.result()
+    finally:
+        path = tr.finalize_tracing()
+    names = [e["name"] for e in report.load_events(path)]
+    assert "serve_flush[c2c:b2:manual]" in names
+    assert not any("tenant" in n for n in names)
+
+
+def test_tenant_label_without_policy_is_accounting_only(metrics_on):
+    """tenant= on a policy-free queue: metrics + span label only, no
+    behavior change (3-tuple keys, no admission)."""
+    q = _queue()
+    h = q.submit(jnp.asarray(_world(5)), tenant="acme")
+    assert len(h._key) == 3
+    q.flush()
+    h.result()
+    snap = dfft.metrics_snapshot()
+    assert snap["counters"]["serving_tenant_submits"][
+        "kind=c2c,tenant=acme"] == 1.0
+
+
+def test_policy_free_fifo_drain_order_is_formation_order():
+    """Satellite: the policy-free drain order is the EXPLICIT formation
+    FIFO. Regression shape: reshuffling the pending dict (the order a
+    dict rebuild could produce) must not change the drain order —
+    oldest formed group still drains first."""
+    q = _queue()
+    q.submit(jnp.asarray(_world(6)))                       # group A
+    q.submit(jnp.asarray(_world(7, (4, 4, 4))))            # group B
+    q.submit(jnp.asarray(_world(8)), direction=dfft.BACKWARD)  # group C
+    formed = sorted(q._pending, key=lambda k: q._formed[k][0])
+    # Adversarially rebuild the dict in reversed iteration order.
+    with q._lock:
+        items = list(q._pending.items())[::-1]
+        q._pending.clear()
+        q._pending.update(items)
+    assert list(q._pending) != formed  # the shuffle took
+    executed = []
+    real = q._execute_group
+
+    def spy(key, group, **kw):
+        executed.append(key)
+        return real(key, group, **kw)
+
+    q._execute_group = spy
+    assert q.flush() == 3
+    assert executed == formed  # FIFO by formation stamp, not dict order
+
+
+def test_flush_limit_splits_group_and_preserves_remainder():
+    q = _queue()
+    xs = [_world(s) for s in range(10, 15)]
+    hs = [q.submit(jnp.asarray(v)) for v in xs]
+    assert q.flush(limit=2) == 2
+    assert q.pending() == 3
+    assert q.flush(limit=2) == 2
+    assert q.flush() == 1
+    ref = dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT)
+    for v, h in zip(xs, hs):
+        assert np.array_equal(np.asarray(h.result()),
+                              np.asarray(ref(jnp.asarray(v))))
+
+
+# ------------------------------------------------------------- admission
+
+def test_quota_shed_raises_quota_exceeded(metrics_on):
+    pol = QosPolicy([Tenant("bulk", "batch", rate=1000.0, burst=2.0)])
+    q = _queue(policy=pol, admission="raise")
+    clock = {"t": 0.0}
+    pol._clock = lambda: clock["t"]  # frozen bucket clock
+    q.submit(jnp.asarray(_world(20)), tenant="bulk")
+    q.submit(jnp.asarray(_world(21)), tenant="bulk")
+    with pytest.raises(QuotaExceeded) as ei:
+        q.submit(jnp.asarray(_world(22)), tenant="bulk")
+    assert ei.value.tenant == "bulk" and ei.value.retry_after_s > 0
+    snap = dfft.metrics_snapshot()
+    assert snap["counters"]["serving_tenant_quota_shed"][
+        "kind=c2c,tenant=bulk"] == 1.0
+    rep = pol.slo_report()["tenants"]["bulk"]
+    assert rep["quota_shed"] == 1 and rep["submits"] == 3
+    q.flush()
+
+
+def test_quota_park_blocks_until_refill():
+    pol = QosPolicy([Tenant("bulk", "batch", rate=50.0, burst=1.0)])
+    q = _queue(policy=pol)  # admission="block"
+    q.submit(jnp.asarray(_world(23)), tenant="bulk")
+    t0 = time.perf_counter()
+    h = q.submit(jnp.asarray(_world(24)), tenant="bulk")  # parks ~20ms
+    assert time.perf_counter() - t0 >= 0.015
+    q.flush()
+    h.result(timeout=30)
+
+
+def test_quota_park_honors_deadline():
+    pol = QosPolicy([Tenant("bulk", "batch", rate=0.5, burst=1.0)])
+    q = _queue(policy=pol)
+    q.submit(jnp.asarray(_world(25)), tenant="bulk")
+    with pytest.raises(dfft.DeadlineExceeded) as ei:
+        q.submit(jnp.asarray(_world(26)), tenant="bulk", deadline_s=0.05)
+    assert ei.value.stage == "admission"
+    assert pol.slo_report()["tenants"]["bulk"]["deadline_misses"] == 1
+    q.flush()
+
+
+def test_realtime_never_sheds_before_batch():
+    """Equal rate/burst, equal traffic: the batch tenant sheds first —
+    the realtime tenant still admits on overdraft at the point batch is
+    already over quota."""
+    pol = QosPolicy([
+        Tenant("rt", "realtime", rate=1000.0, burst=2.0),
+        Tenant("bt", "batch", rate=1000.0, burst=2.0),
+    ])
+    clock = {"t": 0.0}
+    pol._clock = lambda: clock["t"]
+    q = _queue(policy=pol, admission="raise")
+    for i in range(2):  # both burn their burst
+        q.submit(jnp.asarray(_world(30 + i)), tenant="rt")
+        q.submit(jnp.asarray(_world(40 + i)), tenant="bt")
+    with pytest.raises(QuotaExceeded):
+        q.submit(jnp.asarray(_world(50)), tenant="bt")
+    # Same instant, same config: realtime still admits (overdraft).
+    h = q.submit(jnp.asarray(_world(51)), tenant="rt")
+    # The overdraft is bounded: one extra burst, then realtime sheds too.
+    q.submit(jnp.asarray(_world(52)), tenant="rt")
+    with pytest.raises(QuotaExceeded):
+        q.submit(jnp.asarray(_world(53)), tenant="rt")
+    q.flush()
+    h.result(timeout=30)
+
+
+def test_retry_and_degraded_are_charged_to_tenant_bucket():
+    """Robustness composition: a transient fault's retry re-execution
+    is charged to the owning tenant's bucket (recovery work is
+    traffic)."""
+    from distributedfft_tpu import faults
+
+    pol = QosPolicy([Tenant("acme", "interactive", rate=1000.0,
+                            burst=100.0)])
+    clock = {"t": 0.0}
+    pol._clock = lambda: clock["t"]
+    q = _queue(policy=pol, retry_max=2, retry_backoff_s=0.0)
+    h = q.submit(jnp.asarray(_world(60)), tenant="acme")
+    faults.reset()
+    try:
+        with faults.injected("execute", once=True, kind="transient"):
+            q.flush()
+    finally:
+        faults.reset()
+    ref = dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT)
+    assert np.array_equal(np.asarray(h.result(timeout=30)),
+                          np.asarray(ref(jnp.asarray(_world(60)))))
+    # 1 admission token + 1 retry charge.
+    assert pol._buckets["acme"].tokens == pytest.approx(98.0)
+
+
+# ------------------------------------------------------ drain order / WFQ
+
+def test_order_groups_strict_class_then_promotion():
+    pol = _two_class_policy(starvation_factor=4.0)
+    infos = [
+        {"key": "b", "tenant": "bt", "n": 1, "age_s": 0.0},
+        {"key": "i", "tenant": "it", "n": 1, "age_s": 0.0},
+        {"key": "r", "tenant": "rt", "n": 1, "age_s": 0.0},
+    ]
+    ordered = [i["key"] for i in pol.order_groups(infos, max_wait_s=1.0)]
+    assert ordered == ["r", "i", "b"]  # strict class rank
+    # Starvation: an aged batch group is promoted past everything.
+    infos[0]["age_s"] = 100.0
+    ordered = [i["key"] for i in pol.order_groups(infos, max_wait_s=1.0)]
+    assert ordered == ["b", "r", "i"]
+
+
+def test_weighted_fair_drain_shares_3_to_1():
+    """Acceptance: 3:1 weights drain as a 3:1 transform share (within
+    15%) over the contention window, and every request completes
+    bit-correct."""
+    pol = QosPolicy([
+        Tenant("heavy", "interactive", weight=3.0),
+        Tenant("light", "interactive", weight=1.0),
+    ])
+    q = _queue(policy=pol)
+    n = 48
+    xs = {t: [_world(hash((t, i)) % 2**31, SHAPE) for i in range(n)]
+          for t in ("heavy", "light")}
+    hs = {t: [q.submit(jnp.asarray(v), tenant=t) for v in xs[t]]
+          for t in ("heavy", "light")}
+    drained = []  # (tenant, n) per flush quantum
+    while q.pending():
+        before = {k: len(g) for k, g in q._pending.items()}
+        q.flush(limit=4)
+        after = {k: len(g) for k, g in q._pending.items()}
+        for k, was in before.items():
+            took = was - after.get(k, 0)
+            if took:
+                drained.append((k[3], took))
+    # Contention window: the prefix before either tenant runs dry.
+    heavy = light = 0
+    totals = {"heavy": 0, "light": 0}
+    for t, took in drained:
+        totals[t] += took
+        if totals["heavy"] >= n or totals["light"] >= n:
+            break
+        heavy, light = totals["heavy"], totals["light"]
+    assert light > 0
+    ratio = heavy / light
+    assert abs(ratio - 3.0) <= 0.15 * 3.0, (ratio, drained)
+    ref = dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT)
+    for t in ("heavy", "light"):
+        for v, h in zip(xs[t], hs[t]):
+            assert np.array_equal(np.asarray(h.result(timeout=60)),
+                                  np.asarray(ref(jnp.asarray(v))))
+
+
+def test_starvation_clock_promotes_batch_under_realtime_flood():
+    """Zero batch starvation past the promotion clock: with realtime
+    traffic saturating every drain quantum, an aged batch group is
+    promoted and drains."""
+    pol = _two_class_policy(starvation_factor=0.05)  # promote at ~50ms
+    q = _queue(policy=pol)
+    hb = q.submit(jnp.asarray(_world(70)), tenant="bt")
+    bt_key = hb._key
+    time.sleep(0.08)  # age the batch group past starvation_s(None)=50ms
+    for i in range(6):
+        q.submit(jnp.asarray(_world(71 + i)), tenant="rt")
+    executed = []
+    real = q._execute_group
+
+    def spy(key, group, **kw):
+        executed.append(key)
+        return real(key, group, **kw)
+
+    q._execute_group = spy
+    q.flush(limit=1)
+    assert executed == [bt_key]  # promoted past the realtime backlog
+    q.flush()
+    hb.result(timeout=30)
+
+
+def test_multithreaded_contention_stress():
+    """Satellite: 2 tenants x 2 classes submitting from threads;
+    weighted shares hold within tolerance for the same-class pair, the
+    batch tenant never starves past the promotion clock, and outputs
+    are bit-identical to the sequential reference."""
+    pol = QosPolicy([
+        Tenant("rt-a", "realtime", weight=3.0),
+        Tenant("rt-b", "realtime", weight=1.0),
+        Tenant("bt-a", "batch", weight=1.0),
+        Tenant("bt-b", "batch", weight=1.0),
+    ], starvation_factor=0.2)
+    q = _queue(policy=pol)
+    n = 24
+    results: dict = {}
+    errs: list = []
+
+    def submitter(tenant):
+        try:
+            hs = []
+            for i in range(n):
+                v = _world(hash((tenant, i)) % 2**31)
+                hs.append((v, q.submit(jnp.asarray(v), tenant=tenant)))
+            results[tenant] = hs
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=submitter, args=(t,))
+               for t in ("rt-a", "rt-b", "bt-a", "bt-b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errs
+    drained = []
+    t_start = time.perf_counter()
+    while q.pending():
+        before = {k: len(g) for k, g in q._pending.items()}
+        q.flush(limit=4)
+        after = {k: len(g) for k, g in q._pending.items()}
+        for k, was in before.items():
+            took = was - after.get(k, 0)
+            if took:
+                drained.append((k[3], took))
+        assert time.perf_counter() - t_start < 120
+    # Bit-correct under contention.
+    ref = dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT)
+    for tenant, hs in results.items():
+        for v, h in hs:
+            assert np.array_equal(np.asarray(h.result(timeout=60)),
+                                  np.asarray(ref(jnp.asarray(v))))
+    # Weighted share within the realtime class over its contention
+    # window (prefix before either realtime tenant runs dry).
+    totals = {"rt-a": 0, "rt-b": 0}
+    a = b = 0
+    for t, took in drained:
+        if t in totals:
+            totals[t] += took
+            if totals["rt-a"] >= n or totals["rt-b"] >= n:
+                break
+            a, b = totals["rt-a"], totals["rt-b"]
+    assert b > 0 and abs(a / b - 3.0) <= 0.45 * 3.0, (a, b)
+    # Zero batch starvation: both batch tenants fully drained.
+    assert all(h.done() for _, h in results["bt-a"])
+    assert all(h.done() for _, h in results["bt-b"])
+
+
+# ------------------------------------------- concurrent-wave placement
+
+def test_concurrent_chunks_realtime_never_rides_batch():
+    pol = _two_class_policy()
+    infos = [{"key": k, "tenant": t, "n": 1}
+             for k, t in (("r1", "rt"), ("r2", "rt"), ("i1", "it"),
+                          ("b1", "bt"), ("b2", "bt"))]
+    chunks = pol.concurrent_chunks(infos, 4)
+    keysets = [[i["key"] for i in c] for c in chunks]
+    # Realtime + interactive may cohort; the batch groups split off.
+    assert keysets == [["r1", "r2", "i1"], ["b1", "b2"]]
+    # Width cap still applies; interactive may cohort with batch (only
+    # the realtime/batch pairing is forbidden).
+    chunks = pol.concurrent_chunks(infos, 2)
+    assert [[i["key"] for i in c] for c in chunks] == [
+        ["r1", "r2"], ["i1", "b1"], ["b2"]]
+    for c in pol.concurrent_chunks(infos, 3):
+        klasses = {pol.tenant(i["tenant"]).klass for i in c}
+        assert not ({"realtime", "batch"} <= klasses)
+
+
+@needs_mesh
+def test_concurrent_flush_splits_realtime_from_batch_cohort(metrics_on):
+    """Mesh tier: a flush draining one realtime and one batch group
+    under concurrent_groups=2 dispatches them SEPARATELY (no concurrent
+    merge), while two same-class groups do merge — and results stay
+    bit-correct either way."""
+    mesh = dfft.make_mesh(8)
+    pol = _two_class_policy()
+    q = dfft.CoalescingQueue(mesh, dtype=CDT, max_batch=64,
+                             concurrent_groups=2, policy=pol)
+    a = _world(80, (16, 8, 8))
+    b = _world(81, (8, 16, 8))
+    ha = q.submit(jnp.asarray(a), tenant="rt")
+    hb = q.submit(jnp.asarray(b), tenant="bt")
+    q.flush()
+    assert m.counter_total("serving_concurrent_dispatches") == 0
+    ra = dfft.plan_dft_c2c_3d((16, 8, 8), mesh, dtype=CDT)
+    rb = dfft.plan_dft_c2c_3d((8, 16, 8), mesh, dtype=CDT)
+    assert np.array_equal(np.asarray(ha.result(timeout=60)),
+                          np.asarray(ra(jnp.asarray(a))))
+    assert np.array_equal(np.asarray(hb.result(timeout=60)),
+                          np.asarray(rb(jnp.asarray(b))))
+    # Same class: the merge happens (and realtime leads the waves).
+    h2a = q.submit(jnp.asarray(a), tenant="rt")
+    h2b = q.submit(jnp.asarray(b), tenant="it")
+    q.flush()
+    assert m.counter_total("serving_concurrent_dispatches") == 1.0
+    assert np.array_equal(np.asarray(h2a.result(timeout=60)),
+                          np.asarray(ra(jnp.asarray(a))))
+    assert np.array_equal(np.asarray(h2b.result(timeout=60)),
+                          np.asarray(rb(jnp.asarray(b))))
+
+
+@needs_mesh
+def test_concurrent_auto_width_model_driven(metrics_on):
+    """concurrent_groups='auto' (the PR 14 remainder): the width comes
+    from model_concurrent_seconds over 1..4 — on a mesh whose exchange
+    hides under peer compute the model picks >= 2, the flush merges,
+    and results are bit-correct."""
+    mesh = dfft.make_mesh(8)
+    q = dfft.CoalescingQueue(mesh, dtype=CDT, max_batch=64,
+                             concurrent_groups="auto")
+    a = _world(82, (16, 8, 8))
+    b = _world(83, (8, 16, 8))
+    ha = q.submit(jnp.asarray(a))
+    hb = q.submit(jnp.asarray(b))
+    with q._lock:
+        groups = [(k, g) for k, g in q._pending.items()]
+        w = q._concurrent_width(groups)
+    assert 1 <= w <= 4
+    q.flush()
+    ra = dfft.plan_dft_c2c_3d((16, 8, 8), mesh, dtype=CDT)
+    rb = dfft.plan_dft_c2c_3d((8, 16, 8), mesh, dtype=CDT)
+    assert np.array_equal(np.asarray(ha.result(timeout=60)),
+                          np.asarray(ra(jnp.asarray(a))))
+    assert np.array_equal(np.asarray(hb.result(timeout=60)),
+                          np.asarray(rb(jnp.asarray(b))))
+    if w >= 2:
+        assert m.counter_total("serving_concurrent_dispatches") == 1.0
+    # The width memo holds for the steady-state flush pattern.
+    with q._lock:
+        assert q._concurrent_width(groups) == w
+
+
+def test_concurrent_auto_falls_back_below_ir_tier():
+    """Single-device plans carry no stage graph: 'auto' degrades to
+    sequential flushes (width 1), never an error."""
+    q = _queue(concurrent_groups="auto")
+    ha = q.submit(jnp.asarray(_world(84)))
+    hb = q.submit(jnp.asarray(_world(85, (4, 4, 4))))
+    with q._lock:
+        assert q._concurrent_width(list(q._pending.items())) == 1
+    q.flush()
+    ha.result(timeout=30), hb.result(timeout=30)
+
+
+def test_env_concurrent_auto(monkeypatch):
+    monkeypatch.setenv("DFFT_CONCURRENT_GROUPS", "auto")
+    q = dfft.CoalescingQueue(None, dtype=CDT)
+    assert q.concurrent_groups == "auto"
+
+
+# --------------------------------------------------- accounting / ledger
+
+def test_tenant_metrics_and_span_attributes(tmp_path, metrics_on):
+    pol = QosPolicy([Tenant("acme", "realtime", slo_wait_s=10.0)])
+    tr.init_tracing(str(tmp_path / "qos"), format="chrome")
+    try:
+        q = _queue(policy=pol)
+        h = q.submit(jnp.asarray(_world(90)), tenant="acme")
+        q.flush()
+        h.result(timeout=30)
+    finally:
+        path = tr.finalize_tracing()
+    names = [e["name"] for e in report.load_events(path)]
+    assert any(n.startswith("serve_submit[") and n.endswith(
+        ":tenant=acme]") for n in names)
+    assert "serve_flush[c2c:b1:manual:tenant=acme]" in names
+    snap = dfft.metrics_snapshot()
+    assert snap["counters"]["serving_tenant_submits"][
+        "kind=c2c,tenant=acme"] == 1.0
+    assert snap["counters"]["serving_tenant_transforms"][
+        "kind=c2c,tenant=acme"] == 1.0
+    assert snap["histograms"]["serving_tenant_wait_seconds"][
+        "kind=c2c,tenant=acme"]["count"] == 1
+
+
+def test_deadline_miss_lands_in_tenant_ledger(metrics_on):
+    pol = QosPolicy([Tenant("acme", slo_wait_s=10.0)])
+    q = _queue(policy=pol)
+    doomed = q.submit(jnp.asarray(_world(91)), tenant="acme",
+                      deadline_s=0.05)
+    end = time.time() + 10
+    while not doomed.done() and time.time() < end:
+        time.sleep(0.02)
+    with pytest.raises(dfft.DeadlineExceeded):
+        doomed.result(timeout=10)
+    rep = pol.slo_report()["tenants"]["acme"]
+    assert rep["deadline_misses"] == 1
+    assert rep["slo_ok"] is False  # misses count against the SLO
+    snap = dfft.metrics_snapshot()
+    assert snap["counters"]["serving_tenant_deadline_misses"][
+        "kind=c2c,tenant=acme"] == 1.0
+
+
+def test_slo_ledger_quantiles_and_verdict():
+    pol = QosPolicy([Tenant("a", slo_wait_s=1.0), Tenant("b")])
+    for w in (0.01, 0.02, 0.03, 0.5):
+        pol.note_wait("a", w)
+    pol.account_drain("a", 4)
+    rep = pol.slo_report()["tenants"]["a"]
+    assert rep["transforms"] == 4
+    assert rep["wait_p50_s"] == pytest.approx(0.03)
+    assert rep["wait_p99_s"] == pytest.approx(0.5)
+    assert rep["slo_ok"] is True
+    pol.note_wait("a", 5.0)  # p99 now busts the 1s target
+    assert pol.slo_report()["tenants"]["a"]["slo_ok"] is False
+    # No declared target -> no verdict key.
+    assert "slo_ok" not in pol.slo_report()["tenants"]["b"]
+
+
+def test_report_qos_cli_ledger_table_json_gate(tmp_path, capsys):
+    pol = QosPolicy([Tenant("acme", "realtime", weight=3.0, rate=100.0,
+                            slo_wait_s=1.0),
+                     Tenant("bulk", "batch")])
+    pol.note_wait("acme", 0.01)
+    pol.account_drain("acme", 1)
+    pol.note_submit("acme")
+    path = str(tmp_path / "ledger.json")
+    qos.write_ledger(pol, path)
+    assert report.main(["qos", "--ledger", path]) == 0
+    out = capsys.readouterr().out
+    assert "acme" in out and "realtime" in out and "ok" in out
+    assert "bulk" in out
+    # --json round-trips the document.
+    assert report.main(["qos", "--ledger", path, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["tenants"]["acme"]["transforms"] == 1
+    # --gate trips when a declared SLO is missed.
+    pol.note_wait("acme", 9.0)
+    qos.write_ledger(pol, path)
+    assert report.main(["qos", "--ledger", path, "--gate"]) == 1
+    assert "MISSED" in capsys.readouterr().out
+
+
+def test_report_qos_reads_history_record(tmp_path, capsys):
+    from distributedfft_tpu import regress
+
+    pol = QosPolicy([Tenant("acme", slo_wait_s=1.0)])
+    pol.note_wait("acme", 0.02)
+    pol.account_drain("acme", 1)
+    rec = regress.make_run_record(
+        metric="serving_qos_smoke", value=1.0, backend="cpu",
+        qos=pol.slo_report())
+    hist = str(tmp_path / "history.jsonl")
+    regress.append_records([rec], hist)
+    assert report.main(["qos", "--history", hist]) == 0
+    assert "acme" in capsys.readouterr().out
+    # No qos block anywhere -> exit 2.
+    hist2 = str(tmp_path / "empty.jsonl")
+    regress.append_records([regress.make_run_record(
+        metric="x", value=1.0, backend="cpu")], hist2)
+    assert report.main(["qos", "--history", hist2]) == 2
+
+
+def test_qos_knobs_not_plan_cache_keyed():
+    """DFFT_QOS* never changes what a plan compiles to, so it must NOT
+    fragment the plan cache."""
+    from distributedfft_tpu import api
+
+    assert "DFFT_QOS" not in api._PLAN_ENV_KNOBS
+    assert "DFFT_QOS_STARVE_FACTOR" not in api._PLAN_ENV_KNOBS

@@ -624,3 +624,132 @@ def test_brick_plan_single_device_multiple_boxes_rejected():
     with pytest.raises(ValueError, match="one box per side"):
         dfft.plan_brick_dft_c2c_3d(shape, None, ins, [w],
                                    dtype=np.complex64)
+
+
+# --------------------------------------------------- batched brick edges
+
+def _batched_edges_case():
+    """Uneven slabs (ragged overlap maps) — the geometry that exercises
+    clamps, masks, and shape-skew grouping."""
+    w = world_box((13, 16, 12))
+    return w, make_slabs(w, 8, axis=0, rule=ceil_splits)
+
+
+def _batched_parity(w, boxes, algorithm, B=2):
+    from jax.sharding import PartitionSpec as P
+
+    from distributedfft_tpu.parallel.bricks import (
+        plan_bricks_to_spec, plan_spec_to_bricks,
+    )
+
+    mesh = _mesh()
+    spec = P(None, "slab")
+    rng = np.random.default_rng(11)
+    xs = [(rng.standard_normal(w.shape)
+           + 1j * rng.standard_normal(w.shape)).astype(np.complex64)
+          for _ in range(B)]
+    stacks = np.stack([np.asarray(scatter_bricks(x, boxes))
+                       for x in xs])
+    fwd, _ = plan_bricks_to_spec(mesh, boxes, spec, algorithm=algorithm,
+                                 batch=B, jit=True)
+    fwd1, _ = plan_bricks_to_spec(mesh, boxes, spec, algorithm=algorithm,
+                                  jit=True)
+    y = np.asarray(fwd(jax.numpy.asarray(stacks)))
+    for b in range(B):
+        ref = np.asarray(fwd1(jax.numpy.asarray(stacks[b])))
+        np.testing.assert_array_equal(y[b], ref)
+        np.testing.assert_array_equal(ref, xs[b])
+    inv, _ = plan_spec_to_bricks(mesh, spec, boxes, algorithm=algorithm,
+                                 batch=B, jit=True)
+    z = np.asarray(inv(jax.numpy.asarray(np.stack(xs))))
+    for b in range(B):
+        np.testing.assert_array_equal(gather_bricks(z[b], boxes), xs[b])
+
+
+@pytest.mark.parametrize("algorithm", ["ring", "a2av"])
+def test_bricks_to_spec_batched_parity(algorithm):
+    """batch=B through plan_bricks_to_spec/plan_spec_to_bricks (the
+    PR 6 leading-axis pattern): B independent reshapes bit-match B
+    unbatched executions, both directions (even slabs)."""
+    w = world_box((16, 8, 8))
+    _batched_parity(w, make_slabs(w, 8, axis=0), algorithm)
+
+
+@pytest.mark.parametrize("algorithm", ["ring", "a2av"])
+def test_bricks_to_spec_batched_parity_uneven(algorithm):
+    """The uneven/ragged duplicate: ceil-split tails, shape-skew step
+    groups, an empty brick — the clamp/mask paths under batch."""
+    w, boxes = _batched_edges_case()
+    _batched_parity(w, boxes, algorithm, B=3)
+
+
+@pytest.mark.parametrize("algorithm", ["ring", "a2av"])
+def test_bricks_batch1_hlo_byte_identical(algorithm):
+    """batch=1 normalizes to the unbatched plan — byte-identical
+    lowered text (the PR 6 pin), both edges."""
+    from jax.sharding import PartitionSpec as P
+
+    from distributedfft_tpu.parallel.bricks import (
+        plan_bricks_to_spec, plan_spec_to_bricks,
+    )
+
+    mesh = _mesh()
+    w, boxes = _batched_edges_case()
+    spec = P(None, "slab")
+    rng = np.random.default_rng(12)
+    x = (rng.standard_normal(w.shape)
+         + 1j * rng.standard_normal(w.shape)).astype(np.complex64)
+    stack = jax.numpy.asarray(np.asarray(scatter_bricks(x, boxes)))
+    f0, _ = plan_bricks_to_spec(mesh, boxes, spec, algorithm=algorithm)
+    f1, _ = plan_bricks_to_spec(mesh, boxes, spec, algorithm=algorithm,
+                                batch=1)
+    assert (jax.jit(f0).lower(stack).as_text()
+            == jax.jit(f1).lower(stack).as_text())
+    g0, _ = plan_spec_to_bricks(mesh, spec, boxes, algorithm=algorithm)
+    g1, _ = plan_spec_to_bricks(mesh, spec, boxes, algorithm=algorithm,
+                                batch=1)
+    xg = jax.numpy.asarray(x)
+    assert (jax.jit(g0).lower(xg).as_text()
+            == jax.jit(g1).lower(xg).as_text())
+
+
+def test_bricks_batched_share_collectives():
+    """The batch rides each ring step as a bystander dim: the batched
+    program issues exactly as many collective-permutes (and, on the
+    a2av edge, gathers) as the unbatched one — B transforms, one
+    collective latency per step."""
+    from jax.sharding import PartitionSpec as P
+
+    from distributedfft_tpu.parallel.bricks import plan_bricks_to_spec
+
+    mesh = _mesh()
+    w, boxes = _batched_edges_case()
+    spec = P(None, "slab")
+    rng = np.random.default_rng(13)
+    x = (rng.standard_normal(w.shape)
+         + 1j * rng.standard_normal(w.shape)).astype(np.complex64)
+    stack = np.asarray(scatter_bricks(x, boxes))
+    for algorithm, op in (("ring", "collective_permute"),
+                          ("a2av", "all_gather")):
+        f1, _ = plan_bricks_to_spec(mesh, boxes, spec,
+                                    algorithm=algorithm)
+        fB, _ = plan_bricks_to_spec(mesh, boxes, spec,
+                                    algorithm=algorithm, batch=4)
+        t1 = jax.jit(f1).lower(jax.numpy.asarray(stack)).as_text()
+        tB = jax.jit(fB).lower(
+            jax.numpy.asarray(np.stack([stack] * 4))).as_text()
+        n1, nB = t1.count(op), tB.count(op)
+        assert n1 >= 1 and nB == n1, (algorithm, op, n1, nB)
+
+
+def test_bricks_batch_validation():
+    from jax.sharding import PartitionSpec as P
+
+    from distributedfft_tpu.parallel.bricks import plan_bricks_to_spec
+
+    mesh = _mesh()
+    w, boxes = _batched_edges_case()
+    with pytest.raises(ValueError, match="batch"):
+        plan_bricks_to_spec(mesh, boxes, P(None, "slab"), batch=0)
+    with pytest.raises(ValueError, match="batch"):
+        plan_bricks_to_spec(mesh, boxes, P(None, "slab"), batch=True)

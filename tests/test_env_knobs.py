@@ -31,6 +31,10 @@ DOC_FILES = (
     # way. Index-sensitive consumers below keep using DOC_FILES[1] for
     # TUNING.md — append only.
     os.path.join(REPO, "docs", "ROBUSTNESS.md"),
+    # Multi-tenant QoS knobs (DFFT_QOS*) live in the serving-QoS doc;
+    # none are plan-affecting (tenancy never changes what a plan
+    # compiles to), so none are plan-cache-keyed.
+    os.path.join(REPO, "docs", "SERVING_QOS.md"),
 )
 
 #: Knobs whose value changes what a planner call builds/compiles — these

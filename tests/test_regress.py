@@ -406,3 +406,33 @@ def test_batched_records_never_share_single_transform_baseline():
                                          source="t")
             for v in (199.0, 200.0, 201.0)]
     assert regress.compare_record(single, hist)["verdict"] == "no-baseline"
+
+
+def test_tenant_class_records_never_share_baseline():
+    """The ``tenant_class`` config key (docs/SERVING_QOS.md): a serving
+    run measured under a QoS class forms its own baseline group —
+    realtime and batch runs never compare, and policy-free rows keep
+    the old schema. Records also lift a ``qos`` ledger block for
+    ``report qos``."""
+    line = {"metric": "fft3d_c2c_512_forward_gflops", "value": 200.0,
+            "unit": "GFlops/s", "dtype": "complex64", "devices": 8,
+            "decomposition": "slab", "backend": "tpu"}
+    plain = regress.normalize_bench_line(dict(line), source="t")
+    rt = regress.normalize_bench_line(
+        dict(line, tenant_class="realtime"), source="t")
+    bt = regress.normalize_bench_line(
+        dict(line, tenant_class="batch"), source="t")
+    assert "tenant_class" not in plain["config"]
+    assert rt["config"]["tenant_class"] == "realtime"
+    assert len({regress.group_key(plain), regress.group_key(rt),
+                regress.group_key(bt)}) == 3
+    # A realtime history yields no baseline for batch runs.
+    hist = [regress.normalize_bench_line(
+        dict(line, tenant_class="realtime", value=v), source="t")
+        for v in (199.0, 200.0, 201.0)]
+    assert regress.compare_record(bt, hist)["verdict"] == "no-baseline"
+    # The qos ledger block rides the record when the line carries one.
+    ledger = {"schema": 1, "tenants": {"acme": {"transforms": 3}}}
+    rec = regress.normalize_bench_line(dict(line, qos=ledger), source="t")
+    assert rec["qos"] == ledger
+    assert "qos" not in plain
