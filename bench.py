@@ -414,6 +414,17 @@ def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
             "peak_hbm_bytes": None, "compile_seconds": None,
             "flops": None, "bytes_accessed": None, "temp_bytes": None},
     }
+    try:
+        # Live-monitor health verdict (single-sample: lifetime counters
+        # play the window). The run-record store lifts it next to qos
+        # and regressed_metrics gates on firing alerts, so a bench run
+        # that burned SLOs or stalled its queue trips compare --gate
+        # even when wall time looks fine.
+        from distributedfft_tpu.monitor import health_snapshot
+
+        out["health"] = health_snapshot()
+    except Exception:  # noqa: BLE001 — telemetry, not contract
+        pass
     print(json.dumps(out), flush=True)
     return out
 

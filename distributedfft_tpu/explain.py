@@ -210,6 +210,10 @@ def model_stage_estimates(plan, hw: dict | None = None) -> dict:
         algorithm=plan.options.algorithm,
         overlap_chunks=oc if isinstance(oc, int) else 1,
         exchange_correction=model_correction(plan.options.algorithm),
+        # Measured realized-overlap feedback: the monitor's overlap
+        # attribution persists measured/model hide ratios under this
+        # key (1.0 until a measurement lands).
+        hide_correction=model_correction("leg_hide"),
         # Matmul-family plans price their FFT stages at the executor
         # tier's MXU rate (calibrated mm_*_tflops fields win inside
         # mm_tier_tflops); None for every other executor keeps the pure
@@ -708,6 +712,128 @@ def _median(samples: Sequence[float]) -> float | None:
     return med
 
 
+# -------------------------------------------------- overlap attribution
+
+def _overlap_block(
+    plan,
+    concurrent,
+    model: dict,
+    *,
+    iters: int,
+    mads: float,
+    min_rel: float,
+    min_samples: int,
+) -> dict | None:
+    """Measured overlap attribution of the plan's schedule — the
+    monitor's dispatch-span join (:func:`..monitor.dispatch_spans` /
+    :func:`..monitor.overlap_from_events`) next to the model's hide
+    budget, under the same median+MAD divergence gate as the stage
+    rows.
+
+    ``concurrent`` (an int cohort size >= 2, or a sequence of plans)
+    measures the :func:`..stagegraph.schedule_concurrent` interleave
+    across transforms (kind ``"concurrent"``); otherwise an overlap-K
+    plan (K > 1) measures its per-chunk leg pipeline (kind
+    ``"overlap_k"``); anything else attributes nothing (None). The
+    measured/model ratio is persisted into the calibration profile
+    (:func:`..monitor.update_overlap_correction`) so the auto-width and
+    overlap-K pricing learn from it; plans below the stage-graph tier
+    return None — there is no merged program to re-trace."""
+    from .monitor import (dispatch_spans, overlap_from_events,
+                          update_overlap_correction)
+
+    if concurrent is not None:
+        if isinstance(concurrent, bool) or (
+                isinstance(concurrent, int) and concurrent < 2):
+            raise ValueError(f"concurrent must be an int >= 2 or a "
+                             f"sequence of plans, got {concurrent!r}")
+        cohort = ((plan,) * concurrent if isinstance(concurrent, int)
+                  else tuple(concurrent))
+        if len(cohort) < 2:
+            raise ValueError("a concurrent cohort needs >= 2 plans")
+        kind, join = "concurrent", "concurrent"
+    else:
+        oc = plan.options.overlap_chunks
+        if not (isinstance(oc, int) and oc > 1):
+            return None
+        cohort, kind, join = (plan,), "overlap_k", "legs"
+    if any(getattr(p, "graph", None) is None
+           or getattr(p, "logic", None) is None for p in cohort):
+        return None
+
+    # Model hide ratio on the same 1 - wall/extents scale the measured
+    # join produces: the fraction of the schedule's serial cost the
+    # model prices as hidden.
+    if kind == "concurrent":
+        from .plan_logic import model_concurrent_seconds
+
+        hw = device_profile()
+        triples = []
+        for p in cohort:
+            shape, itemsize = _model_shape_itemsize(p)
+            triples.append((p.logic, shape, itemsize))
+        mcs = model_concurrent_seconds(
+            triples, hbm_gbps=hw["hbm_gbps"], wire_gbps=hw["wire_gbps"],
+            launch_seconds=hw["launch_seconds"],
+            dcn_gbps=hw.get("dcn_gbps"))
+        seq = mcs["sequential_seconds"]
+        model_side = {
+            "hide_seconds": mcs["hidden_seconds"],
+            "hide_ratio": (mcs["hidden_seconds"] / seq
+                           if seq > 0 else None),
+            "speedup": mcs["speedup"],
+        }
+    else:
+        t2 = model.get("t2") or {}
+        raw = t2.get("raw_seconds")
+        legs = t2.get("legs") or []
+        hide_total = sum(leg.get("hide_seconds") or 0.0 for leg in legs)
+        # Hidden-wire over raw-wire (NOT 1 - exposed/raw: chunked launch
+        # overhead can push the exposed price above the monolithic raw
+        # wire, which would read as a negative hide).
+        model_side = {
+            "hide_seconds": hide_total,
+            "hide_ratio": (min(1.0, hide_total / raw)
+                           if isinstance(raw, (int, float)) and raw > 0
+                           else None),
+        }
+
+    samples: list[float] = []
+    groups = None
+    for _ in range(max(1, iters)):
+        try:
+            ov = overlap_from_events(dispatch_spans(cohort))[join]
+        except Exception:  # noqa: BLE001 — attribution, not contract
+            return None
+        if ov is None:
+            break
+        samples.append(ov["hide_ratio"])
+        groups = ov["groups"]
+    block: dict[str, Any] = {
+        "kind": kind,
+        "cohort": len(cohort),
+        "groups": groups,
+        "measured_hide_ratio": _median(samples),
+        "measured_samples": [round(v, 6) for v in samples],
+        "model_hide_seconds": model_side.get("hide_seconds"),
+        "model_hide_ratio": model_side.get("hide_ratio"),
+    }
+    if "speedup" in model_side:
+        block["model_speedup"] = model_side["speedup"]
+    mr = block["model_hide_ratio"]
+    block["divergence"] = stage_divergence(
+        mr if isinstance(mr, (int, float)) else 0.0, samples,
+        mads=mads, min_rel=min_rel, min_samples=min_samples)
+    # Feed the realized ratio back into the calibration profile (the
+    # "concurrent_hide"/"leg_hide" hide_correction keys); a disarmed
+    # profile store (DFFT_HW_PROFILE=0) makes this a no-op.
+    try:
+        update_overlap_correction(block)
+    except Exception:  # noqa: BLE001 — feedback is best-effort
+        pass
+    return block
+
+
 # -------------------------------------------------------------- explain
 
 def explain(
@@ -720,11 +846,20 @@ def explain(
     mads: float = DEFAULT_MADS,
     min_rel: float = DEFAULT_MIN_REL,
     min_samples: int = DEFAULT_MIN_SAMPLES,
+    concurrent: int | Sequence | None = None,
 ) -> dict:
     """One structured attribution record for a built plan: the
     model/compiled/measured join per ``t0..t3`` stage, per-stage MFU and
-    ICI-utilization, whole-program compiled cost/memory, and divergence
-    flags under the median+MAD gate.
+    ICI-utilization, whole-program compiled cost/memory, divergence
+    flags under the median+MAD gate, and — for overlap-K and
+    concurrent schedules — the measured realized-overlap attribution
+    (``record["overlap"]``: the monitor's dispatch-span join next to
+    the model's hide budget; see :func:`_overlap_block`).
+
+    ``concurrent`` (an int cohort size >= 2, or a sequence of plans to
+    co-schedule with this one) switches the overlap attribution to the
+    :func:`..stagegraph.schedule_concurrent` cross-transform interleave
+    instead of the plan's own leg pipeline.
 
     ``measure=False`` skips every execution (model + compiled views
     only — safe on a backend whose dispatch is known-sick); ``iters``
@@ -936,6 +1071,14 @@ def explain(
                                    if any(meds) else None),
     }
     record["divergence"] = {"any": bool(diverged), "stages": diverged}
+    try:
+        record["overlap"] = _overlap_block(
+            plan, concurrent, model, iters=iters, mads=mads,
+            min_rel=min_rel, min_samples=min_samples)
+    except ValueError:
+        raise
+    except Exception:  # noqa: BLE001 — attribution, not contract
+        record["overlap"] = None
     if allgather:
         try:
             record["across_hosts"] = across_hosts_stages(

@@ -1,0 +1,774 @@
+"""Live serving monitor — streaming export, health engine, overlap
+attribution.
+
+Everything the observability stack had so far is per-run and offline:
+telemetry snapshots ride bench result lines, the flight recorder dumps
+at ``finalize_tracing``, the QoS ledger is written when someone asks.
+This module watches a *running* serving tier (docs/OBSERVABILITY.md
+"Live monitoring & health"), in three pillars:
+
+1. **Streaming export** — :class:`Monitor` runs a daemon sampler
+   (``Monitor(queue, interval_s=...)``, or ``DFFT_MONITOR=interval[,path]``
+   which every :class:`..serving.CoalescingQueue` arms at construction)
+   that periodically joins :func:`..utils.metrics.metrics_snapshot`, the
+   queue's depth/pending-age, and the QoS policy's
+   :meth:`..qos.QosPolicy.slo_report` into one sample document,
+   appended as a JSONL time series with the
+   :func:`..utils.atomicio.append_line` discipline (line-atomic under
+   concurrent writers — N serving processes can share one series).
+   :func:`prometheus_from_sample` / :meth:`Monitor.prometheus_text`
+   render a sample in Prometheus text exposition format
+   (``report live --prom`` serves it), the first brick of the ROADMAP's
+   "scale-out serving with shared QoS state".
+
+2. **Health engine** — :func:`health_from_samples` turns a sample
+   series into verdicts: windowed per-tenant SLO burn rate over the
+   ledger counters (fast/slow windows — lifetime counters are diffed
+   across samples, never read as rates), quota-pressure and
+   degraded/isolated-failure deltas from the fault counters, and the
+   queue-stall watchdog (a pending group older than
+   ``stall_factor x max_wait_s`` with no flush progress between samples
+   fires ``serving_stalls`` + a retroactive ``serve_stall`` span).
+   ``report health [--json|--gate]`` exits 1 on firing alerts;
+   bench.py stamps a single-sample verdict into every run record so
+   :func:`..regress.regressed_metrics` gates health alongside
+   cost/rates.
+
+3. **Measured overlap attribution** — :func:`dispatch_spans` re-traces
+   a cohort's merged :func:`..stagegraph.schedule_concurrent` program
+   under :func:`..utils.trace.capture_events` (``jax.eval_shape`` — no
+   compile, no execution) and :func:`overlap_from_events` joins the
+   ``cc<j>:`` / per-chunk ``[k]`` span intervals into realized-overlap
+   ratios: ``1 - wall / sum(per-group extents)`` over the dispatch
+   timeline, 0 for a back-to-back schedule, approaching ``1 - 1/n`` for
+   a perfect n-way interleave. The explain layer stamps the ratio into
+   records as ``overlap.measured_hide_ratio`` next to the model's
+   ``hide_seconds`` and :func:`update_overlap_correction` persists the
+   measured/model ratio (:func:`..calibrate.update_model_correction`
+   keys ``"concurrent_hide"``/``"leg_hide"``) so auto-width and overlap-K
+   pricing learn from the schedule as actually issued.
+
+Dispatch-time caveat (the docs/OBSERVABILITY.md span contract): the
+joined spans are recorded at jit *trace* time, so the ratios measure the
+interleave of the schedule as issued — which transforms' compute the
+scheduler placed inside which exchange's window — not device-clock
+overlap. That is exactly the quantity the model's hide budgets assume;
+device-level confirmation still belongs to the XLA profiler.
+
+Disarmed discipline: a queue without ``DFFT_MONITOR`` (and without an
+explicit Monitor) takes no hook on any hot path — the sampler reads
+queue state from its own thread under the queue lock, and serving
+behavior is pinned byte-identical with the monitor off
+(``tests/test_monitor.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from collections import deque
+
+from .utils import metrics as _metrics
+from .utils.atomicio import append_line
+from .utils.trace import capture_events, record_span
+
+__all__ = [
+    "MONITOR_SCHEMA",
+    "HEALTH_SCHEMA",
+    "Monitor",
+    "load_series",
+    "health_from_samples",
+    "health_snapshot",
+    "prometheus_from_sample",
+    "dispatch_spans",
+    "overlap_from_events",
+    "realized_overlap",
+    "update_overlap_correction",
+]
+
+#: Sample-document format version (stamped into every JSONL sample).
+MONITOR_SCHEMA = 1
+#: Health-verdict format version (stamped into every health block).
+HEALTH_SCHEMA = 1
+
+#: A pending group is judged stalled past ``stall_factor x max_wait_s``
+#: (or ``x stall_grace_s`` on queues without a deadline) with no flush
+#: progress between two consecutive samples.
+DEFAULT_STALL_FACTOR = 4.0
+DEFAULT_STALL_GRACE_S = 1.0
+#: SLO burn windows — the classic fast/slow pair: fast catches an
+#: active incident, slow catches a smolder the fast window forgives.
+DEFAULT_FAST_WINDOW_S = 60.0
+DEFAULT_SLOW_WINDOW_S = 600.0
+#: Fraction of a tenant's windowed submits that may miss (deadline
+#: misses + quota sheds) before ``slo_burn`` fires.
+DEFAULT_BURN_THRESHOLD = 0.1
+
+
+# ------------------------------------------------------------- sampling
+
+
+class Monitor:
+    """Live sampler over one process's serving state.
+
+    ``queue`` (a :class:`..serving.CoalescingQueue`, or None for a
+    metrics-only monitor) is sampled under its own lock; ``interval_s``
+    arms the daemon sampler thread (None leaves the monitor manual —
+    :meth:`sample` / :meth:`prometheus_text` / :meth:`health` still
+    work); ``path`` streams every sample as one JSONL line
+    (line-atomic, multi-process safe). The queue's :meth:`..serving
+    .CoalescingQueue.close` stops an attached monitor's thread.
+
+    ``DFFT_MONITOR=interval[,path]`` arms one per queue at construction
+    (:meth:`from_env`); unset, queues carry no monitor and no hook.
+    """
+
+    def __init__(
+        self,
+        queue=None,
+        *,
+        interval_s: float | None = None,
+        path: str | None = None,
+        stall_factor: float = DEFAULT_STALL_FACTOR,
+        stall_grace_s: float = DEFAULT_STALL_GRACE_S,
+        fast_window_s: float = DEFAULT_FAST_WINDOW_S,
+        slow_window_s: float = DEFAULT_SLOW_WINDOW_S,
+        burn_threshold: float = DEFAULT_BURN_THRESHOLD,
+        history: int = 512,
+    ):
+        if interval_s is not None and (
+                isinstance(interval_s, bool)
+                or not isinstance(interval_s, (int, float))
+                or not interval_s > 0):
+            raise ValueError(f"interval_s must be a positive number or "
+                             f"None, got {interval_s!r}")
+        self.queue = queue
+        self.interval_s = None if interval_s is None else float(interval_s)
+        self.path = path
+        self.stall_factor = float(stall_factor)
+        self.stall_grace_s = float(stall_grace_s)
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.burn_threshold = float(burn_threshold)
+        self._samples: deque = deque(maxlen=max(2, int(history)))
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        # Stall-watchdog state: flush progress at the previous sample,
+        # and the keys already counted this stall episode (one
+        # ``serving_stalls`` bump per group per episode, re-armed when
+        # a flush makes progress).
+        self._last_flush_seq: int | None = None
+        self._stalled_keys: set = set()
+        self._stall_count = 0
+
+    # ------------------------------------------------------- lifecycle
+
+    @classmethod
+    def from_env(cls, queue=None) -> "Monitor | None":
+        """A monitor armed from ``DFFT_MONITOR=interval[,path]``; None
+        when the knob is unset/0 (the zero-overhead default)."""
+        spec = os.environ.get("DFFT_MONITOR", "").strip()
+        if spec in ("", "0"):
+            return None
+        head, _, tail = spec.partition(",")
+        try:
+            interval = float(head)
+        except ValueError:
+            raise ValueError(
+                f"DFFT_MONITOR must be 'interval[,path]' (seconds), "
+                f"got {spec!r}") from None
+        if interval <= 0:
+            return None
+        return cls(queue, interval_s=interval, path=tail.strip() or None)
+
+    def start(self) -> "Monitor":
+        """Arm the daemon sampler thread (no-op without ``interval_s``,
+        idempotent while running)."""
+        with self._lock:
+            if self.interval_s is None:
+                return self
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stop = threading.Event()
+            t = threading.Thread(target=self._run, name="dfft-monitor",
+                                 daemon=True)
+            self._thread = t
+            t.start()
+        return self
+
+    def stop(self) -> None:
+        """Tear the sampler thread down (idempotent; joins the thread
+        so no sample lands after stop returns). Stopping a started
+        sampler takes one final sample first, so a run shorter than
+        ``interval_s`` still leaves its terminal state in the series."""
+        with self._lock:
+            t, self._thread = self._thread, None
+            self._stop.set()
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+        if t is not None:
+            try:
+                self.sample()
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                pass
+
+    def __enter__(self) -> "Monitor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _run(self) -> None:
+        stop = self._stop
+        while not stop.wait(self.interval_s):
+            try:
+                self.sample()
+            except Exception:  # noqa: BLE001 — the sampler must never
+                pass           # take the serving process down
+
+    # -------------------------------------------------------- sampling
+
+    def _watch_queue(self, now: float) -> dict | None:
+        """One reading of the attached queue (under its lock): depth,
+        pending age, and the stall watchdog's verdict. A stall =
+        a pending group older than ``stall_factor x max_wait_s`` (or
+        ``x stall_grace_s`` without a deadline) while the queue's flush
+        sequence has not advanced since the previous sample — counted
+        once per group per episode into ``serving_stalls`` with a
+        retroactive ``serve_stall`` span over the un-flushed wait."""
+        q = self.queue
+        if q is None:
+            return None
+        with q._lock:
+            depth = sum(len(g) for g in q._pending.values())
+            fseq = q._flush_seq
+            infos = []
+            for k, g in q._pending.items():
+                if not g:
+                    continue
+                _, t0 = q._formed.get(k, (0, now))
+                oldest = min((r.handle._enqueued for r in g
+                              if r.handle._enqueued is not None),
+                             default=t0)
+                infos.append((k, max(0.0, now - oldest), oldest))
+        ref = self.stall_factor * (q.max_wait_s if q.max_wait_s is not None
+                                   else self.stall_grace_s)
+        stalled = []
+        if self._last_flush_seq is not None and fseq != self._last_flush_seq:
+            # Progress: the episode ends, every group re-arms.
+            self._stalled_keys.clear()
+        no_progress = (self._last_flush_seq is not None
+                       and fseq == self._last_flush_seq)
+        for k, age, oldest in infos:
+            if not (no_progress and age > ref):
+                continue
+            if k in self._stalled_keys:
+                continue
+            self._stalled_keys.add(k)
+            self._stall_count += 1
+            _metrics.inc("serving_stalls", kind=q.kind)
+            record_span(f"serve_stall[{q.kind}]", oldest, now)
+            stalled.append({
+                "age_s": age,
+                "tenant": k[3] if len(k) > 3 else None,
+            })
+        self._last_flush_seq = fseq
+        self._stalled_keys &= {k for k, _, _ in infos}
+        out = {
+            "kind": q.kind,
+            "depth": depth,
+            "groups": len(infos),
+            "oldest_pending_age_s": max((a for _, a, _ in infos),
+                                        default=0.0),
+            "flush_seq": fseq,
+            "stalls_total": self._stall_count,
+        }
+        if stalled:
+            out["stalled"] = stalled
+        return out
+
+    def sample(self) -> dict:
+        """Take one sample document: metrics snapshot + queue reading
+        (stall watchdog included) + QoS ledger. Appends to the
+        in-memory ring and — with ``path`` set — to the JSONL series."""
+        now = time.perf_counter()
+        doc = {
+            "schema": MONITOR_SCHEMA,
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "seq": self._seq,
+            "metrics": _metrics.metrics_snapshot(),
+            "queue": self._watch_queue(now),
+        }
+        self._seq += 1
+        q = self.queue
+        pol = getattr(q, "policy", None) if q is not None else None
+        doc["qos"] = pol.slo_report() if pol is not None else None
+        self._samples.append(doc)
+        if self.path:
+            append_line(self.path, json.dumps(doc, sort_keys=True))
+        return doc
+
+    @property
+    def samples(self) -> list[dict]:
+        """The in-memory sample ring, oldest first."""
+        return list(self._samples)
+
+    # ------------------------------------------------------------ views
+
+    def prometheus_text(self, sample: dict | None = None) -> str:
+        """Prometheus text-exposition rendering of ``sample`` (default:
+        a fresh one)."""
+        return prometheus_from_sample(sample or self.sample())
+
+    def health(self, samples: list[dict] | None = None) -> dict:
+        """Health verdicts over the in-memory series (or ``samples``);
+        takes a fresh sample first when the ring is empty."""
+        if samples is None:
+            if not self._samples:
+                self.sample()
+            samples = list(self._samples)
+        return health_from_samples(
+            samples, fast_window_s=self.fast_window_s,
+            slow_window_s=self.slow_window_s,
+            burn_threshold=self.burn_threshold)
+
+
+def load_series(path: str) -> list[dict]:
+    """Load a monitor JSONL series, lenient to torn/foreign lines (the
+    history/wisdom loader discipline) and ordered oldest-first by
+    timestamp — concurrent writers interleave whole lines in arbitrary
+    order."""
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(doc, dict) and "ts" in doc:
+                    out.append(doc)
+    except OSError:
+        return []
+    out.sort(key=lambda d: d.get("ts") or 0.0)
+    return out
+
+
+# ------------------------------------------------------- health engine
+
+
+def _counter_sum(snap: dict | None, name: str) -> float:
+    """Sum of one metrics counter across every label row of a
+    snapshot."""
+    rows = ((snap or {}).get("counters") or {}).get(name) or {}
+    return float(sum(v for v in rows.values()
+                     if isinstance(v, (int, float))))
+
+
+def _baseline(samples: list[dict], window_s: float) -> dict | None:
+    """The newest sample OLDER than the window (the delta baseline).
+    None when the series does not reach back that far — then the series
+    start is the baseline, or, for a single-sample series, zero (the
+    bench single-shot semantics: lifetime totals ARE the window)."""
+    end = samples[-1].get("ts") or 0.0
+    base = None
+    for s in samples:
+        if (s.get("ts") or 0.0) < end - window_s:
+            base = s
+        else:
+            break
+    if base is None and len(samples) > 1:
+        base = samples[0]
+    return base
+
+
+def _delta(samples: list[dict], window_s: float, get) -> float:
+    """Windowed counter increase: newest minus the baseline sample
+    (0-baselined for a single-sample series). Clamped at 0 so a
+    counter reset can never read as negative burn."""
+    base = _baseline(samples, window_s)
+    return max(0.0, get(samples[-1]) - (get(base) if base else 0.0))
+
+
+def _tenant_counter(sample: dict, tenant: str, field: str) -> float:
+    t = (((sample.get("qos") or {}).get("tenants") or {}).get(tenant)
+         or {})
+    v = t.get(field)
+    return float(v) if isinstance(v, (int, float)) else 0.0
+
+
+def health_from_samples(
+    samples: list[dict],
+    *,
+    fast_window_s: float = DEFAULT_FAST_WINDOW_S,
+    slow_window_s: float = DEFAULT_SLOW_WINDOW_S,
+    burn_threshold: float = DEFAULT_BURN_THRESHOLD,
+) -> dict:
+    """Health verdicts over a monitor sample series (oldest first).
+
+    Alert severities: ``"alert"`` fires the gate (``report health
+    --gate`` exits 1; :func:`..regress.regressed_metrics` reports it),
+    ``"warn"`` is surfaced but never gates.
+
+    - ``stall`` (alert) — the queue-stall watchdog counted a stalled
+      group within the fast window.
+    - ``slo_burn`` (alert) — a tenant WITH a declared SLO burned more
+      than ``burn_threshold`` of its windowed submits on deadline
+      misses + quota sheds (fast window), or the newest ledger already
+      judges its lifetime p99/misses out of SLO.
+    - ``slo_burn_slow`` (warn) — same burn over the slow window only
+      (a smolder the fast window forgives).
+    - ``quota_pressure`` (warn) — quota sheds within the fast window.
+    - ``degraded`` (warn) — degraded executions or isolated failures
+      within the fast window (the PR 10 fault counters).
+    """
+    if not samples:
+        return {"schema": HEALTH_SCHEMA, "status": "unknown",
+                "alerts": [], "samples": 0,
+                "windows": {"fast_s": fast_window_s,
+                            "slow_s": slow_window_s}}
+    newest = samples[-1]
+    alerts: list[dict] = []
+
+    def stalls_of(s: dict) -> float:
+        qb = s.get("queue") or {}
+        v = qb.get("stalls_total")
+        if isinstance(v, (int, float)):
+            return float(v)
+        return _counter_sum(s.get("metrics"), "serving_stalls")
+
+    stall_d = _delta(samples, fast_window_s, stalls_of)
+    if stall_d > 0:
+        alerts.append({
+            "name": "stall", "severity": "alert",
+            "detail": f"{stall_d:g} stalled group(s) in the fast "
+                      f"window with no flush progress"})
+
+    tenants = ((newest.get("qos") or {}).get("tenants") or {})
+    for tname, t in sorted(tenants.items()):
+        declared = isinstance(t.get("slo_wait_s"), (int, float))
+
+        def bad(s, _t=tname):
+            return (_tenant_counter(s, _t, "deadline_misses")
+                    + _tenant_counter(s, _t, "quota_shed"))
+
+        def submits(s, _t=tname):
+            return _tenant_counter(s, _t, "submits")
+
+        shed_d = _delta(samples, fast_window_s,
+                        lambda s, _t=tname: _tenant_counter(
+                            s, _t, "quota_shed"))
+        if shed_d > 0:
+            alerts.append({
+                "name": "quota_pressure", "severity": "warn",
+                "tenant": tname,
+                "detail": f"{shed_d:g} over-quota shed(s) in the fast "
+                          f"window"})
+        if not declared:
+            continue
+        bad_fast = _delta(samples, fast_window_s, bad)
+        sub_fast = _delta(samples, fast_window_s, submits)
+        burn_fast = bad_fast / max(1.0, sub_fast)
+        bad_slow = _delta(samples, slow_window_s, bad)
+        sub_slow = _delta(samples, slow_window_s, submits)
+        burn_slow = bad_slow / max(1.0, sub_slow)
+        out_of_slo = t.get("slo_ok") is False
+        if (bad_fast > 0 and burn_fast > burn_threshold) or out_of_slo:
+            alerts.append({
+                "name": "slo_burn", "severity": "alert",
+                "tenant": tname,
+                "burn_fast": burn_fast, "burn_slow": burn_slow,
+                "detail": (f"burn {burn_fast:.0%} of submits in the "
+                           f"fast window"
+                           + (" and the lifetime ledger is out of SLO"
+                              if out_of_slo else ""))})
+        elif bad_slow > 0 and burn_slow > burn_threshold:
+            alerts.append({
+                "name": "slo_burn_slow", "severity": "warn",
+                "tenant": tname,
+                "burn_fast": burn_fast, "burn_slow": burn_slow,
+                "detail": f"burn {burn_slow:.0%} of submits over the "
+                          f"slow window"})
+
+    def faults_of(s: dict) -> float:
+        snap = s.get("metrics")
+        return (_counter_sum(snap, "serving_degraded")
+                + _counter_sum(snap, "serving_isolated_failures"))
+
+    fault_d = _delta(samples, fast_window_s, faults_of)
+    if fault_d > 0:
+        alerts.append({
+            "name": "degraded", "severity": "warn",
+            "detail": f"{fault_d:g} degraded execution(s)/isolated "
+                      f"failure(s) in the fast window"})
+
+    firing = [a for a in alerts if a["severity"] == "alert"]
+    fast_n = len(samples) - len(
+        samples[:samples.index(_baseline(samples, fast_window_s))]
+    ) if _baseline(samples, fast_window_s) in samples else len(samples)
+    return {
+        "schema": HEALTH_SCHEMA,
+        "status": ("alert" if firing
+                   else "warn" if alerts else "ok"),
+        "alerts": alerts,
+        "samples": len(samples),
+        "windows": {"fast_s": fast_window_s, "slow_s": slow_window_s,
+                    "fast_samples": fast_n},
+        "totals": {
+            "stalls": stalls_of(newest),
+            "deadline_misses": sum(
+                _tenant_counter(newest, t, "deadline_misses")
+                for t in tenants),
+            "quota_shed": sum(
+                _tenant_counter(newest, t, "quota_shed")
+                for t in tenants),
+            "degraded": _counter_sum(newest.get("metrics"),
+                                     "serving_degraded"),
+            "isolated_failures": _counter_sum(
+                newest.get("metrics"), "serving_isolated_failures"),
+            "expired": _counter_sum(newest.get("metrics"),
+                                    "serving_expired"),
+        },
+    }
+
+
+def health_snapshot(queue=None) -> dict:
+    """Single-shot health verdict from the process's current state (one
+    fresh sample; lifetime totals play the window) — the block bench.py
+    stamps into every run record."""
+    m = Monitor(queue)
+    return health_from_samples([m.sample()])
+
+
+# -------------------------------------------------- Prometheus rendering
+
+# Metrics-snapshot label strings are "k=v,k2=v2" with stringified
+# values; values may themselves contain commas ("(64, 64, 64)" shapes),
+# so split only at commas that start a new key.
+_LABEL_SPLIT = re.compile(r",(?=[A-Za-z_][A-Za-z0-9_]*=)")
+
+
+def _esc(v: str) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _plabels(label_str: str, extra: dict | None = None) -> str:
+    pairs = []
+    if label_str:
+        for part in _LABEL_SPLIT.split(label_str):
+            k, _, v = part.partition("=")
+            pairs.append((k, v))
+    for k, v in (extra or {}).items():
+        pairs.append((k, v))
+    if not pairs:
+        return ""
+    return "{" + ",".join(f'{k}="{_esc(v)}"' for k, v in pairs) + "}"
+
+
+def prometheus_from_sample(sample: dict) -> str:
+    """One monitor sample in Prometheus text exposition format. Series
+    are prefixed ``dfft_``; counters get ``_total``, histograms emit
+    ``_count``/``_sum`` plus ``quantile`` rows where the registry keeps
+    a reservoir; the queue/QoS blocks surface depth, pending age, stall
+    count, and per-tenant SLO standing for scraping."""
+    lines: list[str] = []
+
+    def typed(name: str, kind: str) -> None:
+        lines.append(f"# TYPE {name} {kind}")
+
+    snap = sample.get("metrics") or {}
+    for name, rows in sorted((snap.get("counters") or {}).items()):
+        typed(f"dfft_{name}_total", "counter")
+        for lbl, v in sorted(rows.items()):
+            lines.append(f"dfft_{name}_total{_plabels(lbl)} {v:g}")
+    for name, rows in sorted((snap.get("gauges") or {}).items()):
+        typed(f"dfft_{name}", "gauge")
+        for lbl, v in sorted(rows.items()):
+            lines.append(f"dfft_{name}{_plabels(lbl)} {v:g}")
+    for name, rows in sorted((snap.get("histograms") or {}).items()):
+        typed(f"dfft_{name}", "summary")
+        for lbl, h in sorted(rows.items()):
+            lines.append(f"dfft_{name}_count{_plabels(lbl)} "
+                         f"{h.get('count', 0):g}")
+            lines.append(f"dfft_{name}_sum{_plabels(lbl)} "
+                         f"{h.get('total', 0.0):g}")
+            for q, fld in (("0.5", "p50"), ("0.99", "p99")):
+                if fld in h:
+                    lines.append(
+                        f"dfft_{name}"
+                        f"{_plabels(lbl, {'quantile': q})} {h[fld]:g}")
+
+    qb = sample.get("queue") or None
+    if qb:
+        kind = {"kind": qb.get("kind", "")}
+        typed("dfft_queue_depth", "gauge")
+        lines.append(f"dfft_queue_depth{_plabels('', kind)} "
+                     f"{qb.get('depth', 0):g}")
+        typed("dfft_queue_pending_groups", "gauge")
+        lines.append(f"dfft_queue_pending_groups{_plabels('', kind)} "
+                     f"{qb.get('groups', 0):g}")
+        typed("dfft_queue_oldest_pending_age_seconds", "gauge")
+        lines.append(
+            f"dfft_queue_oldest_pending_age_seconds{_plabels('', kind)} "
+            f"{qb.get('oldest_pending_age_s', 0.0):g}")
+        typed("dfft_queue_stalls_total", "counter")
+        lines.append(f"dfft_queue_stalls_total{_plabels('', kind)} "
+                     f"{qb.get('stalls_total', 0):g}")
+
+    tenants = ((sample.get("qos") or {}).get("tenants") or {})
+    if tenants:
+        fams = (("submits", "dfft_tenant_submits_total", "counter"),
+                ("transforms", "dfft_tenant_transforms_total", "counter"),
+                ("quota_shed", "dfft_tenant_quota_shed_total", "counter"),
+                ("deadline_misses", "dfft_tenant_slo_misses_total",
+                 "counter"))
+        for fld, pname, kind in fams:
+            typed(pname, kind)
+            for tname, t in sorted(tenants.items()):
+                v = t.get(fld)
+                if isinstance(v, (int, float)):
+                    lines.append(
+                        f"{pname}{_plabels('', {'tenant': tname})} {v:g}")
+        typed("dfft_tenant_wait_seconds", "summary")
+        for tname, t in sorted(tenants.items()):
+            for q, fld in (("0.5", "wait_p50_s"), ("0.99", "wait_p99_s")):
+                v = t.get(fld)
+                if isinstance(v, (int, float)):
+                    lines.append(
+                        f"dfft_tenant_wait_seconds"
+                        f"{_plabels('', {'tenant': tname, 'quantile': q})}"
+                        f" {v:g}")
+        typed("dfft_tenant_slo_ok", "gauge")
+        for tname, t in sorted(tenants.items()):
+            if "slo_ok" in t:
+                lines.append(
+                    f"dfft_tenant_slo_ok{_plabels('', {'tenant': tname})}"
+                    f" {1 if t['slo_ok'] else 0}")
+
+    typed("dfft_monitor_sample_timestamp_seconds", "gauge")
+    lines.append(f"dfft_monitor_sample_timestamp_seconds "
+                 f"{sample.get('ts', 0.0):.6f}")
+    return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------- measured overlap attribution
+
+_CC_PREFIX = re.compile(r"^cc(\d+):")
+_CHUNK_SUFFIX = re.compile(r"\[(\d+)\]$")
+
+
+def dispatch_spans(plans) -> list[tuple[str, float, float]]:
+    """The dispatch-order flight-recorder spans of the merged schedule
+    of ``plans`` (1+ stage-graph plans), captured from a FRESH program
+    trace: ``jax.eval_shape`` on an uncached
+    :func:`..stagegraph._build_concurrent` program under
+    :func:`..utils.trace.capture_events` — abstract evaluation runs the
+    staged Python (so every ``cc<j>:`` wave span and per-chunk ``[k]``
+    exchange span fires) without compiling or executing anything.
+    Raises ``ValueError`` for plans below the stage-graph tier."""
+    import jax
+
+    from .stagegraph import _build_concurrent
+
+    plans = tuple(plans)
+    cp = _build_concurrent(plans)
+    sds = [jax.ShapeDtypeStruct(p.in_shape, p.in_dtype) for p in plans]
+    with capture_events() as buf:
+        jax.eval_shape(cp.fn, *sds)
+    return list(buf)
+
+
+def realized_overlap(events, group_of) -> dict | None:
+    """Realized-overlap join over a dispatch span timeline: group every
+    span by ``group_of(name)`` (None = ignore), then
+
+        ``hide_ratio = 1 - wall / sum(per-group extents)``
+
+    where each group's extent runs first-start to last-stop and ``wall``
+    is the whole cohort's. Groups dispatched back-to-back give 0; a
+    perfect n-way interleave (every group's extent spanning the whole
+    schedule) approaches ``1 - 1/n``. None without >= 2 groups."""
+    groups: dict = {}
+    for name, start, stop in events:
+        g = group_of(name)
+        if g is None:
+            continue
+        cur = groups.get(g)
+        if cur is None:
+            groups[g] = [start, stop]
+        else:
+            cur[0] = min(cur[0], start)
+            cur[1] = max(cur[1], stop)
+    if len(groups) < 2:
+        return None
+    extents = sum(hi - lo for lo, hi in groups.values())
+    wall = (max(hi for _, hi in groups.values())
+            - min(lo for lo, _ in groups.values()))
+    if extents <= 0.0:
+        return None
+    return {
+        "groups": len(groups),
+        "wall_seconds": wall,
+        "extent_seconds": extents,
+        "hide_ratio": max(0.0, 1.0 - wall / extents),
+    }
+
+
+def overlap_from_events(events) -> dict:
+    """Both overlap joins of one captured dispatch timeline:
+
+    - ``"concurrent"`` — groups = ``cc<j>:`` transform prefixes (the
+      :func:`..stagegraph.schedule_concurrent` interleave across
+      transforms); None for a single-transform program.
+    - ``"legs"`` — groups = per-chunk ``[k]`` span suffixes (the
+      leg-pipelined / overlap-K interleave across chunks of one
+      exchange); None at K <= 1.
+    """
+    def cc_of(name: str):
+        m = _CC_PREFIX.match(name)
+        return int(m.group(1)) if m else None
+
+    def chunk_of(name: str):
+        m = _CHUNK_SUFFIX.search(_CC_PREFIX.sub("", name))
+        return int(m.group(1)) if m else None
+
+    return {
+        "concurrent": realized_overlap(events, cc_of),
+        "legs": realized_overlap(events, chunk_of),
+    }
+
+
+def update_overlap_correction(
+    overlap: dict | None, path: str | None = None,
+) -> dict | None:
+    """Persist an explain record's measured/model overlap ratio into the
+    calibration profile (:func:`..calibrate.update_model_correction`)
+    under ``"concurrent_hide"`` / ``"leg_hide"`` — the keys
+    :func:`..plan_logic.model_stage_seconds`'s ``hide_correction``
+    reads back for auto-width and overlap-K pricing. No-op (returns
+    None) without a measured ratio, a positive model ratio, or an
+    armed profile store."""
+    if not isinstance(overlap, dict):
+        return None
+    measured = overlap.get("measured_hide_ratio")
+    model = overlap.get("model_hide_ratio")
+    kind = overlap.get("kind")
+    key = {"concurrent": "concurrent_hide",
+           "overlap_k": "leg_hide"}.get(kind)
+    if (key is None
+            or not isinstance(measured, (int, float))
+            or not isinstance(model, (int, float)) or model <= 0.0):
+        return None
+    from .calibrate import update_model_correction
+
+    return update_model_correction({key: measured / model}, path)

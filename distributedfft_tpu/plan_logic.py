@@ -939,6 +939,7 @@ def model_stage_seconds(
     dcn_gbps: float | None = None,
     mm_tflops: float | None = None,
     concurrent_hide_seconds: float = 0.0,
+    hide_correction: float = 1.0,
 ) -> dict:
     """Per-stage analytical prediction of one execution, keyed exactly
     ``t0..t3`` — the model side of the explain/attribution join. A fused
@@ -990,7 +991,16 @@ def model_stage_seconds(
     hide: extra downstream work the wire transfer can overlap with.
     :func:`model_concurrent_seconds` derives it per transform from its
     co-scheduled peers; 0.0 (the default) is the single-transform
-    model, numerically unchanged."""
+    model, numerically unchanged.
+
+    ``hide_correction`` scales every exchange's hide budget — the
+    measured/model *realized-overlap* ratio the monitor's dispatch
+    attribution persists (:func:`..calibrate.model_correction` keys
+    ``"leg_hide"``/``"concurrent_hide"``), so a schedule whose measured
+    interleave achieves less hide than the ideal model assumes is
+    priced — and auto-width/auto-K ranked — at its observed overlap.
+    1.0 (the default) is the uncorrected model, numerically
+    unchanged."""
     shape = tuple(int(s) for s in shape)
     ndev = 1 if lp.mesh is None else math.prod(lp.mesh.devices.shape)
     bsz = getattr(lp, "batch", None) or 1
@@ -1115,6 +1125,7 @@ def model_stage_seconds(
         pipelined = leg_pipelined and e["stage"] == "t2a"
         if pipelined:
             hide_s += dcn_raw
+        hide_s *= hide_correction
         m = exchange_model_seconds(
             wire, e["parts"], alg, wire_gbps=gbps,
             launch_seconds=launch_seconds, overlap_chunks=k,

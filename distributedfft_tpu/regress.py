@@ -147,6 +147,7 @@ def make_run_record(
     rates: dict | None = None,
     explain: dict | None = None,
     qos: dict | None = None,
+    health: dict | None = None,
     source: str = "",
     commit: str | None = None,
     recorded_at: str | None = None,
@@ -196,6 +197,12 @@ def make_run_record(
         # The serving tier's per-tenant SLO ledger (QosPolicy
         # .slo_report()) — surfaced offline by ``report qos``.
         rec["qos"] = qos
+    if health:
+        # The live monitor's health verdict (monitor.health_snapshot()
+        # — stall/SLO-burn/quota alerts); gated by compare_record /
+        # regressed_metrics alongside cost/rates and surfaced offline
+        # by ``report health``.
+        rec["health"] = health
     if extra:
         rec["extra"] = extra
     return rec
@@ -304,6 +311,9 @@ def normalize_bench_line(
     qos = obj.get("qos")
     if not isinstance(qos, dict):
         qos = None
+    health = obj.get("health")
+    if not isinstance(health, dict):
+        health = None
     rates = {k: obj[k] for k in AUX_RATE_METRICS
              if isinstance(obj.get(k), (int, float))}
     return make_run_record(
@@ -322,6 +332,7 @@ def normalize_bench_line(
         rates=rates or None,
         explain=explain,
         qos=qos,
+        health=health,
         source=source,
         commit=commit,
         recorded_at=recorded_at,
@@ -554,6 +565,21 @@ def compare_record(
         "verdict": "no-baseline",
         "localization": [],
     }
+    health = record.get("health")
+    if isinstance(health, dict) and health.get("status") not in (
+            None, "ok", "unknown"):
+        # The live monitor's verdict needs no baseline: a firing alert
+        # (stall, SLO burn) is absolute badness, copied through even
+        # for a no-baseline record so regressed_metrics gates on it
+        # alongside the compare verdicts.
+        out["health"] = {
+            "status": health.get("status"),
+            "alerts": [
+                {"name": a.get("name"), "severity": a.get("severity"),
+                 **({"tenant": a["tenant"]} if a.get("tenant") else {})}
+                for a in health.get("alerts") or []
+                if isinstance(a, dict)],
+        }
     if len(base) < min_samples:
         return out
     med, mad = robust_stats([float(r["value"]) for r in base])
@@ -628,7 +654,8 @@ def _compare_block(
 
 def regressed_metrics(result: dict) -> list[str]:
     """Every regressed metric of one :func:`compare_record` result —
-    the headline plus any aux cost metric. The gate trips when this is
+    the headline, any aux cost/rate metric, and any firing (severity
+    ``alert``) live-monitor health alert. The gate trips when this is
     non-empty (one shared rule for the CLI and any caller)."""
     out = []
     if result.get("verdict") == "regressed":
@@ -636,6 +663,12 @@ def regressed_metrics(result: dict) -> list[str]:
     for row in result.get("aux") or []:
         if row.get("verdict") == "regressed":
             out.append(f"{result.get('metric')}:{row['metric']}")
+    for alert in (result.get("health") or {}).get("alerts") or []:
+        if alert.get("severity") == "alert":
+            name = alert.get("name")
+            if alert.get("tenant"):
+                name = f"{name}[{alert['tenant']}]"
+            out.append(f"health:{name}")
     return out
 
 

@@ -13,6 +13,9 @@ arguments)::
     python -m distributedfft_tpu.report explain --plan 256,256,256 -n 8
     python -m distributedfft_tpu.report explain --trend [--config SUBSTR]
     python -m distributedfft_tpu.report calibrate
+    python -m distributedfft_tpu.report qos [--ledger FILE] [--gate]
+    python -m distributedfft_tpu.report health [--series FILE] [--gate]
+    python -m distributedfft_tpu.report live --series FILE [--prom]
 
 **merge** — the trace tool. The reference writes one trace log per MPI
 rank and leaves correlation to the reader (``heffte_trace.h:98-118``);
@@ -73,6 +76,7 @@ from __future__ import annotations
 import argparse
 import glob as _glob
 import json
+import re
 import sys
 
 from . import regress
@@ -119,6 +123,10 @@ def _parse_text_log(text: str, default_pid: int = 0) -> tuple[list[dict], int]:
             parts = line.split()
             if len(parts) >= 2 and parts[1].isdigit():
                 pid = int(parts[1])
+            continue
+        if line.startswith("dropped_events "):
+            # The writer's ring-eviction banner (DFFT_TRACE_MAX_EVENTS)
+            # — metadata, not a malformed row; ring_dropped() reads it.
             continue
         parts = line.split(None, 2)
         if len(parts) < 3:
@@ -241,6 +249,28 @@ def load_events(path: str) -> list[dict]:
     return events
 
 
+def ring_dropped(path: str) -> int:
+    """Events the writer's in-memory ring evicted before this file was
+    written (``DFFT_TRACE_MAX_EVENTS``): the ``dropped_events N`` text
+    banner, or the chrome document's ``metadata.dropped_events``. 0 on
+    any parse/IO trouble — the count is advisory."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return 0
+    head = text.lstrip()[:1]
+    if head in ("{", "["):
+        m = re.search(r'"dropped_events"\s*:\s*(\d+)', text)
+        return int(m.group(1)) if m else 0
+    for line in text.splitlines():
+        if line.startswith("dropped_events "):
+            parts = line.split()
+            if len(parts) >= 2 and parts[1].isdigit():
+                return int(parts[1])
+    return 0
+
+
 def merge_files(paths: list[str]) -> list[dict]:
     """One timeline from many per-process files, sorted by start time.
     Malformed events across all files are skipped with one total count
@@ -350,6 +380,11 @@ def _main_merge(argv: list[str]) -> int:
     pids = sorted({e["pid"] for e in events})
     print(f"{len(events)} events from {len(paths)} file(s), "
           f"{len(pids)} process(es)")
+    ring = sum(ring_dropped(p) for p in paths)
+    if ring:
+        print(f"{ring} event(s) evicted by the in-memory ring before "
+              f"writing (DFFT_TRACE_MAX_EVENTS) — the aggregate below "
+              f"undercounts by that many")
     print(format_table(aggregate(events), sort=args.sort))
     if args.out:
         write_chrome(events, args.out)
@@ -1114,6 +1149,141 @@ def _main_qos(argv: list[str]) -> int:
     return 1 if (args.gate and missed) else 0
 
 
+def _format_health(verdict: dict) -> str:
+    lines = [f"status: {verdict.get('status', 'unknown')}   "
+             f"(samples={verdict.get('samples', 0)})"]
+    totals = verdict.get("totals") or {}
+    if totals:
+        lines.append("totals: " + "  ".join(
+            f"{k}={v:g}" for k, v in sorted(totals.items())
+            if isinstance(v, (int, float))))
+    alerts = verdict.get("alerts") or []
+    if not alerts:
+        lines.append("no alerts")
+    for a in alerts:
+        tenant = f" tenant={a['tenant']}" if a.get("tenant") else ""
+        lines.append(f"[{a.get('severity', '?'):5s}] "
+                     f"{a.get('name', '?')}{tenant}: "
+                     f"{a.get('detail', '')}")
+    return "\n".join(lines)
+
+
+def _main_health(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m distributedfft_tpu.report health",
+        description="Live-monitor health verdicts (docs/OBSERVABILITY"
+                    ".md 'Live monitoring & health'): windowed SLO "
+                    "burn rate, queue stalls, quota pressure, and "
+                    "degraded-execution alerts. Reads a monitor JSONL "
+                    "series (--series; DFFT_MONITOR=interval,path "
+                    "streams one), or the newest history run record "
+                    "carrying a 'health' block.")
+    p.add_argument("--series", default=None, metavar="FILE",
+                   help="monitor JSONL series (Monitor(path=...) / "
+                        "DFFT_MONITOR=interval,path)")
+    _history_arg(p)
+    p.add_argument("--fast-window", type=float, default=None,
+                   metavar="S", help="fast burn window, seconds")
+    p.add_argument("--slow-window", type=float, default=None,
+                   metavar="S", help="slow burn window, seconds")
+    p.add_argument("--burn-threshold", type=float, default=None,
+                   metavar="FRAC",
+                   help="windowed bad-submit fraction that fires "
+                        "slo_burn")
+    p.add_argument("--json", action="store_true",
+                   help="print the verdict document as JSON")
+    p.add_argument("--gate", action="store_true",
+                   help="exit 1 when any severity-'alert' alert fires "
+                        "(stall, slo_burn)")
+    args = p.parse_args(argv)
+
+    from .monitor import health_from_samples, load_series
+
+    if args.series:
+        samples = load_series(args.series)
+        if not samples:
+            print(f"report health: {args.series}: no monitor samples",
+                  file=sys.stderr)
+            return 2
+        kw = {}
+        if args.fast_window is not None:
+            kw["fast_window_s"] = args.fast_window
+        if args.slow_window is not None:
+            kw["slow_window_s"] = args.slow_window
+        if args.burn_threshold is not None:
+            kw["burn_threshold"] = args.burn_threshold
+        verdict = health_from_samples(samples, **kw)
+    else:
+        history = _resolve_history(args)
+        records = regress.load_history(history)[0] if history else []
+        verdict = next((r["health"] for r in reversed(records)
+                        if isinstance(r.get("health"), dict)), None)
+        if verdict is None:
+            print("report health: no --series given and no history "
+                  "record carries a health block", file=sys.stderr)
+            return 2
+    if args.json:
+        print(json.dumps(verdict, indent=2, sort_keys=True))
+    else:
+        print(_format_health(verdict))
+    firing = [a for a in verdict.get("alerts") or []
+              if a.get("severity") == "alert"]
+    if firing and not args.json:
+        print(f"{len(firing)} alert(s) firing: "
+              f"{sorted(a.get('name', '?') for a in firing)}",
+              file=sys.stderr)
+    return 1 if (args.gate and firing) else 0
+
+
+def _main_live(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m distributedfft_tpu.report live",
+        description="The newest sample of a live-monitor JSONL series "
+                    "(DFFT_MONITOR=interval,path): queue depth and "
+                    "pending age, stall count, per-tenant SLO "
+                    "standing. --prom renders it in Prometheus text "
+                    "exposition format for scraping.")
+    p.add_argument("--series", required=True, metavar="FILE",
+                   help="monitor JSONL series")
+    p.add_argument("--prom", action="store_true",
+                   help="Prometheus text exposition of the newest "
+                        "sample")
+    p.add_argument("--json", action="store_true",
+                   help="print the newest sample document as JSON")
+    args = p.parse_args(argv)
+
+    from .monitor import load_series, prometheus_from_sample
+
+    samples = load_series(args.series)
+    if not samples:
+        print(f"report live: {args.series}: no monitor samples",
+              file=sys.stderr)
+        return 2
+    newest = samples[-1]
+    if args.prom:
+        print(prometheus_from_sample(newest), end="")
+        return 0
+    if args.json:
+        print(json.dumps(newest, indent=2, sort_keys=True))
+        return 0
+    qb = newest.get("queue") or {}
+    print(f"{len(samples)} sample(s); newest seq={newest.get('seq')} "
+          f"pid={newest.get('pid')}")
+    if qb:
+        print(f"queue[{qb.get('kind')}]: depth={qb.get('depth')} "
+              f"groups={qb.get('groups')} "
+              f"oldest_age={qb.get('oldest_pending_age_s', 0.0):.3f}s "
+              f"stalls={qb.get('stalls_total', 0)}")
+    tenants = ((newest.get("qos") or {}).get("tenants") or {})
+    for name, t in sorted(tenants.items()):
+        slo = ("-" if t.get("slo_ok") is None
+               else "ok" if t["slo_ok"] else "MISS")
+        print(f"tenant {name}: submits={t.get('submits', 0)} "
+              f"misses={t.get('deadline_misses', 0)} "
+              f"shed={t.get('quota_shed', 0)} slo={slo}")
+    return 0
+
+
 _SUBCOMMANDS = {
     "merge": _main_merge,
     "record": _main_record,
@@ -1123,6 +1293,8 @@ _SUBCOMMANDS = {
     "explain": _main_explain,
     "calibrate": _main_calibrate,
     "qos": _main_qos,
+    "health": _main_health,
+    "live": _main_live,
 }
 
 

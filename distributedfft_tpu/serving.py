@@ -504,6 +504,20 @@ class CoalescingQueue:
         self._formed: dict[tuple, tuple[int, float]] = {}
         # concurrent_groups="auto": modeled width per plan tuple.
         self._auto_widths: dict[tuple, int] = {}
+        # Flush-progress sequence — bumped whenever a flush pops groups.
+        # The live monitor's stall watchdog compares it across samples;
+        # a plain int bump keeps the disarmed hot path byte-identical.
+        self._flush_seq = 0
+        # DFFT_MONITOR=interval[,path] arms a live sampler per queue
+        # (docs/OBSERVABILITY.md "Live monitoring & health"); unset, the
+        # queue carries no monitor and takes no hook anywhere.
+        self._monitor = None
+        if os.environ.get("DFFT_MONITOR", "").strip() not in ("", "0"):
+            from .monitor import Monitor
+
+            self._monitor = Monitor.from_env(self)
+            if self._monitor is not None:
+                self._monitor.start()
 
     # ------------------------------------------------------------ intake
 
@@ -889,6 +903,7 @@ class CoalescingQueue:
                     if budget <= 0:
                         break
             if groups:
+                self._flush_seq += 1  # stall-watchdog progress marker
                 self._space.notify_all()  # admission waiters: depth fell
             ncc = self._concurrent_width(groups)
             if ncc > 1 and len(groups) > 1:
@@ -939,6 +954,7 @@ class CoalescingQueue:
             hit = self._auto_widths.get(memo_key)
             if hit is not None:
                 return hit
+            from .calibrate import model_correction
             from .explain import _model_shape_itemsize, device_profile
             from .plan_logic import model_concurrent_seconds
 
@@ -947,13 +963,19 @@ class CoalescingQueue:
             for p in plans:
                 shape, itemsize = _model_shape_itemsize(p)
                 triples.append((p.logic, shape, itemsize))
+            # Measured realized-overlap feedback: explain's overlap
+            # attribution persists measured/model hide ratios under
+            # this key, so auto-width pricing learns from dispatch
+            # reality (1.0 until a measurement lands).
+            hide_corr = model_correction("concurrent_hide")
             best_w, best_rate = 1, -1.0
             for w in range(1, len(plans) + 1):
                 m = model_concurrent_seconds(
                     triples[:w], hbm_gbps=hw["hbm_gbps"],
                     wire_gbps=hw["wire_gbps"],
                     launch_seconds=hw["launch_seconds"],
-                    dcn_gbps=hw.get("dcn_gbps"))
+                    dcn_gbps=hw.get("dcn_gbps"),
+                    hide_correction=hide_corr)
                 secs = m["concurrent_seconds"]
                 rate = sum(counts[:w]) / secs if secs > 0 else 0.0
                 if rate > best_rate:
@@ -1326,6 +1348,16 @@ class CoalescingQueue:
                             self.plan_kw.get("dtype"), direction), b, False)
                 n += 1
         return n
+
+    def close(self) -> None:
+        """Drain the queue (a final manual flush) and tear down the
+        attached live monitor's sampler thread, if any. Idempotent;
+        the queue stays usable afterwards (close is a quiesce point,
+        not a poison pill)."""
+        self.flush(reason="manual")
+        m = self._monitor
+        if m is not None:
+            m.stop()
 
 
 def warm_pool(mesh=None, top_n: int = 4, *, path: str | None = None,

@@ -652,6 +652,19 @@ def schedule_concurrent(plans: Sequence) -> ConcurrentPlan:
     hit = _CONCURRENT_CACHE.get(key)
     if hit is not None:
         return hit[1]
+    cp = _build_concurrent(plans)
+    if len(_CONCURRENT_CACHE) >= 64:  # bound the program memo
+        _CONCURRENT_CACHE.pop(next(iter(_CONCURRENT_CACHE)))
+    _CONCURRENT_CACHE[key] = (plans, cp)
+    return cp
+
+
+def _build_concurrent(plans: tuple) -> ConcurrentPlan:
+    """Uncached :func:`schedule_concurrent` body. The monitor's overlap
+    attribution calls this directly: it needs a FRESH ``jax.jit`` object
+    so abstract evaluation re-traces the merged program (and so emits
+    the ``cc<j>:``/per-chunk dispatch spans) even when the memoized
+    schedule has already been traced."""
     graphs = []
     for p in plans:
         g = getattr(p, "graph", None) or graph_of(getattr(p, "fn", p))
@@ -713,9 +726,5 @@ def schedule_concurrent(plans: Sequence) -> ConcurrentPlan:
             outs.append(y)
         return tuple(outs)
 
-    cp = ConcurrentPlan(fn=fn, plans=plans, mesh=mesh,
-                        in_shardings=in_shs, out_shardings=out_shs)
-    if len(_CONCURRENT_CACHE) >= 64:  # bound the program memo
-        _CONCURRENT_CACHE.pop(next(iter(_CONCURRENT_CACHE)))
-    _CONCURRENT_CACHE[key] = (plans, cp)
-    return cp
+    return ConcurrentPlan(fn=fn, plans=plans, mesh=mesh,
+                          in_shardings=in_shs, out_shardings=out_shs)

@@ -75,6 +75,21 @@ docs/SERVING_QOS.md):
   per-tenant queue-wait distribution (the SLO ledger's p50/p99 ride
   the policy's in-process reservoir; ``report qos``).
 
+Live-monitor series (wired in :mod:`..monitor` / :mod:`.trace`; see
+docs/OBSERVABILITY.md "Live monitoring & health"):
+
+- ``serving_stalls`` (counter; kind) — queue-stall watchdog firings: a
+  pending group aged past ``stall_factor × max_wait_s`` with no flush
+  progress between monitor samples.
+- ``trace_dropped_events`` (counter) — flight-recorder events evicted
+  by the in-memory ring cap (``DFFT_TRACE_MAX_EVENTS``).
+
+Wait histograms additionally keep a fixed-size sampling reservoir
+(:data:`RESERVOIR_SERIES`) so snapshots carry p50/p99; the per-series
+``exact`` flag says whether the quantiles were computed over every
+observation or a uniform sample (Algorithm R) once the count outgrows
+the reservoir.
+
 Disabled-path discipline: everything is gated on one module-level flag
 (the ``tracing_enabled()`` pattern of :mod:`.trace`) — with metrics off
 (the default) every hook is a single attribute check and early return,
@@ -85,6 +100,7 @@ no allocation, no lock. Enable with :func:`enable_metrics` or
 from __future__ import annotations
 
 import os
+import random
 import threading
 import time
 
@@ -113,6 +129,17 @@ _lock = threading.Lock()
 _counters: dict[tuple, float] = {}
 _gauges: dict[tuple, float] = {}
 _histograms: dict[tuple, list] = {}  # [count, total, min, max]
+
+#: Histogram series that keep a bounded sampling reservoir for snapshot
+#: quantiles. The wait distributions are the SLO-facing ones; the other
+#: histograms stay pure count/total/min/max aggregates.
+RESERVOIR_SERIES = frozenset(
+    {"serving_wait_seconds", "serving_tenant_wait_seconds"})
+#: Reservoir capacity per labeled series — beyond this many
+#: observations, Algorithm R keeps a uniform sample (exact=False).
+RESERVOIR_SIZE = 2048
+_reservoirs: dict[tuple, list] = {}
+_res_rng = random.Random(0x0FF7)  # deterministic per process
 
 
 def metrics_enabled() -> bool:
@@ -159,12 +186,24 @@ def observe(name: str, value: float, **labels) -> None:
     with _lock:
         h = _histograms.get(k)
         if h is None:
-            _histograms[k] = [1, value, value, value]
+            h = _histograms[k] = [1, value, value, value]
         else:
             h[0] += 1
             h[1] += value
             h[2] = min(h[2], value)
             h[3] = max(h[3], value)
+        if name in RESERVOIR_SERIES:
+            r = _reservoirs.get(k)
+            if r is None:
+                r = _reservoirs[k] = []
+            if len(r) < RESERVOIR_SIZE:
+                r.append(value)
+            else:
+                # Algorithm R: each of the h[0] observations so far ends
+                # up in the sample with probability RESERVOIR_SIZE/h[0].
+                j = _res_rng.randrange(h[0])
+                if j < RESERVOIR_SIZE:
+                    r[j] = value
 
 
 def counter_total(name: str) -> float:
@@ -175,6 +214,16 @@ def counter_total(name: str) -> float:
 
 def _label_str(labels: tuple) -> str:
     return ",".join(f"{k}={v}" for k, v in labels)
+
+
+def _quantile(sorted_vals: list, q: float) -> float:
+    """Linear-interpolated quantile of an already-sorted sample."""
+    if not sorted_vals:
+        return 0.0
+    pos = q * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    return sorted_vals[lo] + (sorted_vals[hi] - sorted_vals[lo]) * (pos - lo)
 
 
 def metrics_snapshot() -> dict:
@@ -199,13 +248,23 @@ def metrics_snapshot() -> dict:
         hists: dict = {}
         for (name, labels), (cnt, total, lo, hi) in sorted(
                 _histograms.items()):
-            hists.setdefault(name, {})[_label_str(labels)] = {
+            entry = {
                 "count": cnt,
                 "total": total,
                 "mean": total / cnt,
                 "min": lo,
                 "max": hi,
             }
+            r = _reservoirs.get((name, labels))
+            if r is not None:
+                s = sorted(r)
+                entry["p50"] = _quantile(s, 0.50)
+                entry["p99"] = _quantile(s, 0.99)
+                # Exact while every observation is still in the sample;
+                # a uniform Algorithm-R estimate once the count outgrew
+                # the reservoir.
+                entry["exact"] = cnt <= RESERVOIR_SIZE
+            hists.setdefault(name, {})[_label_str(labels)] = entry
     return {
         "schema": METRICS_SCHEMA,
         "captured_at_monotonic": time.monotonic(),
@@ -222,3 +281,4 @@ def metrics_reset() -> None:
         _counters.clear()
         _gauges.clear()
         _histograms.clear()
+        _reservoirs.clear()

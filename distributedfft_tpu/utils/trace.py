@@ -39,6 +39,40 @@ _format = "log"  # "log" (heFFTe per-rank text) | "chrome" (Perfetto JSON)
 # pairs; adding _epoch maps them onto the time.time() axis so Chrome
 # traces from different processes of one job share a timeline.
 _epoch = 0.0
+# Tee buffer for capture_events(): spans are appended here IN ADDITION
+# to (or instead of) the session recorder while a capture is active.
+_capture: list[tuple[str, float, float]] | None = None
+
+#: Default ring capacity of the in-memory Python recorder. Generous — a
+#: bench campaign's worth of spans — but finite, so a long-lived serving
+#: process with tracing armed reaches a steady footprint instead of
+#: growing without bound. Override with ``DFFT_TRACE_MAX_EVENTS`` (0 =
+#: unbounded, the pre-ring behavior).
+DEFAULT_TRACE_MAX_EVENTS = 1 << 20
+
+_max_events = DEFAULT_TRACE_MAX_EVENTS
+_dropped = 0  # oldest-events evicted by the ring this session
+
+
+def dropped_events() -> int:
+    """Events evicted by the ring cap in the current trace session."""
+    return _dropped
+
+
+def _push(ev: list, name: str, start: float, stop: float) -> None:
+    """Append one event, evicting the oldest past the ring cap. The ring
+    keeps the NEWEST events (under a stall you want the spans nearest
+    the incident, not the warm-up) and evicts a capacity/16 chunk at a
+    time so the list-shift cost amortizes to O(1) per append."""
+    global _dropped
+    if _max_events and len(ev) >= _max_events:
+        cut = max(1, len(ev) - _max_events + max(1, _max_events // 16))
+        del ev[:cut]
+        _dropped += cut
+        from . import metrics as _metrics
+
+        _metrics.inc("trace_dropped_events", cut)
+    ev.append((name, start, stop))
 
 
 def tracing_enabled() -> bool:
@@ -79,6 +113,7 @@ def init_tracing(root: str = "", format: str | None = None) -> None:
     native recorder is never dropped with events still buffered.
     """
     global _events, _trace_root, _native_rec, _session, _format, _epoch
+    global _max_events, _dropped
     if tracing_enabled():
         finalize_tracing()
     fmt = format or os.environ.get("DFFT_TRACE_FORMAT", "") or "log"
@@ -89,6 +124,13 @@ def init_tracing(root: str = "", format: str | None = None) -> None:
     _trace_root = root or "dfft_trace"
     _format = fmt
     _epoch = time.time() - time.perf_counter()
+    try:
+        _max_events = int(
+            os.environ.get("DFFT_TRACE_MAX_EVENTS", "")
+            or DEFAULT_TRACE_MAX_EVENTS)
+    except ValueError:
+        _max_events = DEFAULT_TRACE_MAX_EVENTS
+    _dropped = 0
     # The C recorder dumps the text format only; chrome sessions use the
     # Python recorder (its event list is what the JSON writer serializes).
     _native_rec = _try_native() if fmt == "log" else None
@@ -112,10 +154,13 @@ def _write_chrome(path: str, events, proc: int, nprocs: int) -> None:
     # inner pairs inside their enclosing span.
     trace_events.sort(key=lambda ev: (ev["ts"], ev["ph"] != "B"))
     with open(path, "w") as f:
+        meta = {"process": proc, "process_count": nprocs}
+        if _dropped:
+            meta["dropped_events"] = _dropped
         json.dump(
             {
                 "displayTimeUnit": "ms",
-                "metadata": {"process": proc, "process_count": nprocs},
+                "metadata": meta,
                 "traceEvents": trace_events,
             },
             f,
@@ -126,7 +171,7 @@ def finalize_tracing() -> str | None:
     """Write ``<root>_<process>.log`` (or ``.json`` for the chrome
     format) and stop tracing (``finalize_tracing``,
     ``heffte_trace.h:98-118``). Returns the path."""
-    global _events, _trace_root, _native_rec, _session
+    global _events, _trace_root, _native_rec, _session, _dropped
     if not tracing_enabled():
         return None
     _session += 1
@@ -147,9 +192,14 @@ def finalize_tracing() -> str | None:
         t0 = _events[0][1] if _events else 0.0
         with open(path, "w") as f:
             f.write(f"process {proc} of {nprocs}\n")
+            if _dropped:
+                # Ring-cap evictions, parsed back out by ``report merge``
+                # so a truncated timeline is never mistaken for a full one.
+                f.write(f"dropped_events {_dropped}\n")
             for name, start, stop in _events:
                 f.write(f"{start - t0:14.6f}  {stop - start:12.6f}  {name}\n")
     _events, _trace_root = None, None
+    _dropped = 0
     return path
 
 
@@ -175,23 +225,31 @@ def add_trace(name: str):
         # list suffices — a stale append goes to the discarded list).
         sess = _session
         rec = _native_rec
+        cap = _capture
         if rec is not None:
             eid = rec.begin(name)
+            start = time.perf_counter() if cap is not None else 0.0
             try:
                 yield
             finally:
+                if cap is not None:
+                    cap.append((name, start, time.perf_counter()))
                 if _session == sess:
                     rec.end(eid)
             return
         ev = _events
-        if ev is None:
+        if ev is None and cap is None:
             yield
             return
         start = time.perf_counter()
         try:
             yield
         finally:
-            ev.append((name, start, time.perf_counter()))
+            stop = time.perf_counter()
+            if ev is not None:
+                _push(ev, name, start, stop)
+            if cap is not None:
+                cap.append((name, start, stop))
 
 
 def record_span(name: str, start: float, stop: float) -> bool:
@@ -210,8 +268,29 @@ def record_span(name: str, start: float, stop: float) -> bool:
     ev = _events
     if ev is None:
         return False
-    ev.append((name, float(start), float(stop)))
+    _push(ev, name, float(start), float(stop))
     return True
+
+
+@contextmanager
+def capture_events():
+    """Tee: while the block is active, every Python-recorder span
+    (:func:`add_trace`) is ALSO appended to the yielded
+    ``(name, start, stop)`` list — even when no trace session is open,
+    and without consuming ring capacity from one that is. The overlap
+    attribution path (:mod:`...monitor`) wraps one fresh program trace
+    in this to read the dispatch interleave without arming or
+    disturbing a global session. Captures nest (inner shadows outer);
+    the buffer is process-global, so concurrent captures from other
+    threads land in the innermost active one."""
+    global _capture
+    prev = _capture
+    buf: list[tuple[str, float, float]] = []
+    _capture = buf
+    try:
+        yield buf
+    finally:
+        _capture = prev
 
 
 @contextmanager

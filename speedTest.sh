@@ -13,11 +13,23 @@
 # `WIRE=bf16 ./speedTest.sh ...` then `WIRE=int8 ./speedTest.sh ...`
 # and the CSV algorithm column keys the rows apart ('alltoall' vs
 # 'alltoall+wbf16' vs 'alltoall+wint8').
+#
+# MONITOR=<interval_s> (e.g. MONITOR=1) arms the live serving monitor
+# (docs/OBSERVABILITY.md "Live monitoring & health"): any serving queue
+# the run constructs streams its JSONL sample series into
+# benchmarks/results/, archived next to the campaign evidence so
+# `report live`/`report health` can replay the run afterwards.
 set -euo pipefail
 if [ $# -lt 4 ]; then
     echo "usage: $0 <ndev> <NX> <NY> <NZ> [flags...]" >&2
     exit 1
 fi
 NDEV=$1; NX=$2; NY=$3; NZ=$4; shift 4
-exec python "$(dirname "$0")/benchmarks/speed3d.py" c2c single \
+HERE="$(dirname "$0")"
+if [ -n "${MONITOR:-}" ] && [ "${MONITOR}" != "0" ]; then
+    MONITOR_SERIES="$HERE/benchmarks/results/monitor_$(date +%Y%m%d_%H%M%S)_$$.jsonl"
+    export DFFT_MONITOR="${MONITOR},${MONITOR_SERIES}"
+    echo "live monitor armed: interval=${MONITOR}s series=${MONITOR_SERIES}" >&2
+fi
+exec python "$HERE/benchmarks/speed3d.py" c2c single \
     "$NX" "$NY" "$NZ" -ndev "$NDEV" ${WIRE:+-wire "$WIRE"} "$@"

@@ -1,0 +1,250 @@
+"""Live-monitor acceptance on the virtual 8-device mesh (PR 16,
+docs/OBSERVABILITY.md "Live monitoring & health").
+
+Contracts pinned here:
+
+1. **Monitored concurrent multi-tenant serving** — a ``DFFT_MONITOR``-
+   armed :class:`CoalescingQueue` under ``concurrent_groups=2``
+   two-tenant load streams a JSONL series whose Prometheus rendering
+   exposes queue depth, per-tenant SLO misses, and the stall count;
+   results stay bit-correct, ``report live --prom`` serves the newest
+   sample, and ``report health --gate`` exits 0 on the healthy run.
+2. **Fault-injected SLO burn trips the gate** — with
+   ``DFFT_FAULT_INJECT`` keeping the drain stuck in transient-retry
+   backoff, a deadlined request expires while queued; the tenant
+   ledger goes out of SLO and ``report health --gate`` exits 1.
+3. **Measured overlap attribution** — ``explain(..., concurrent=2)``
+   and an overlap-K (K=2) leg-pipelined plan both carry
+   ``overlap.measured_hide_ratio`` (the dispatch-span join) next to
+   the model's hide budget; a plain plan carries ``overlap: None``
+   (the disarmed pin) and malformed cohorts raise.
+
+NOTE on the filename: must collect BEFORE ``test_alltoallv.py``
+(alphabetical clean-backend tier; see ``tests/conftest.py``).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu import report
+from distributedfft_tpu.monitor import (
+    Monitor,
+    dispatch_spans,
+    load_series,
+    overlap_from_events,
+    prometheus_from_sample,
+)
+from distributedfft_tpu.qos import QosPolicy, Tenant
+from distributedfft_tpu.utils import metrics as m
+
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the virtual 8-device mesh"
+)
+
+SHAPE = (8, 8, 8)
+CDT = jnp.complex128
+
+
+def _world(seed=0, shape=SHAPE):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal(shape) + 1j * rng.standard_normal(shape)
+
+
+@pytest.fixture
+def metrics_on():
+    dfft.enable_metrics()
+    m.metrics_reset()
+    yield
+    m.metrics_reset()
+    dfft.enable_metrics(False)
+
+
+def _wait_for(pred, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+# ------------------------------------------ monitored serving acceptance
+
+@needs_mesh
+def test_monitored_concurrent_multitenant_acceptance(
+        tmp_path, monkeypatch, metrics_on, capsys):
+    """Acceptance: DFFT_MONITOR-armed queue, concurrent_groups=2, two
+    tenants -> JSONL series; its Prometheus rendering exposes queue
+    depth, tenant SLO misses, and the stall count; the healthy run
+    passes ``report health --gate``."""
+    series = str(tmp_path / "live.jsonl")
+    monkeypatch.setenv("DFFT_MONITOR", f"0.05,{series}")
+    mesh = dfft.make_mesh(8)
+    pol = QosPolicy([
+        Tenant("acme", "interactive", weight=2.0, slo_wait_s=30.0),
+        Tenant("bulk", "batch", slo_wait_s=60.0),
+    ])
+    q = dfft.CoalescingQueue(mesh, dtype=CDT, max_batch=64,
+                             concurrent_groups=2, policy=pol)
+    try:
+        mon = q._monitor
+        assert mon is not None and mon._thread.is_alive()
+        a = _world(1, (16, 8, 8))
+        b = _world(2, (8, 16, 8))
+        ha = q.submit(jnp.asarray(a), tenant="acme")
+        hb = q.submit(jnp.asarray(b), tenant="bulk")
+        pending = mon.sample()  # deterministic mid-load sample
+        assert pending["queue"]["depth"] == 2
+        q.flush()
+        # interactive+bulk may cohort: ONE concurrent dispatch.
+        assert m.counter_total("serving_concurrent_dispatches") == 1.0
+        ra = dfft.plan_dft_c2c_3d((16, 8, 8), mesh, dtype=CDT)
+        rb = dfft.plan_dft_c2c_3d((8, 16, 8), mesh, dtype=CDT)
+        assert np.array_equal(np.asarray(ha.result(timeout=60)),
+                              np.asarray(ra(jnp.asarray(a))))
+        assert np.array_equal(np.asarray(hb.result(timeout=60)),
+                              np.asarray(rb(jnp.asarray(b))))
+        drained = mon.sample()
+        sampler = mon._thread
+    finally:
+        q.close()
+    assert not sampler.is_alive()  # close tears the sampler down
+
+    # The series carries both manual samples (plus any daemon ones).
+    docs = load_series(series)
+    assert len(docs) >= 2
+    # Prometheus rendering of the mid-load sample: depth, SLO standing,
+    # stall count — the three acceptance series.
+    prom = prometheus_from_sample(pending)
+    assert 'dfft_queue_depth{kind="c2c"} 2' in prom
+    assert 'dfft_queue_stalls_total{kind="c2c"} 0' in prom
+    assert 'dfft_tenant_submits_total{tenant="acme"} 1' in prom
+    after = prometheus_from_sample(drained)
+    assert 'dfft_queue_depth{kind="c2c"} 0' in after
+    assert 'dfft_tenant_slo_misses_total{tenant="acme"} 0' in after
+    assert 'dfft_tenant_slo_misses_total{tenant="bulk"} 0' in after
+    assert 'dfft_tenant_slo_ok{tenant="acme"} 1' in after
+    # report live --prom serves the newest sample of the series.
+    assert report.main(["live", "--series", series, "--prom"]) == 0
+    out = capsys.readouterr().out
+    assert "dfft_queue_depth" in out
+    assert "dfft_tenant_slo_misses_total" in out
+    assert "dfft_queue_stalls_total" in out
+    # Healthy load: the gate passes.
+    assert report.main(["health", "--series", series, "--gate"]) == 0
+    assert "status: ok" in capsys.readouterr().out
+
+
+def test_health_gate_trips_on_fault_injected_slo_burn(
+        tmp_path, chaos, metrics_on, capsys):
+    """Acceptance: DFFT_FAULT_INJECT keeps the drain stuck in
+    transient-retry backoff; a deadlined request expires while queued,
+    the tenant ledger goes out of SLO, and ``report health --gate``
+    exits 1 on the streamed series."""
+    pol = QosPolicy([Tenant("acme", "interactive", slo_wait_s=5.0)])
+    q = dfft.CoalescingQueue(None, dtype=CDT, max_batch=64, policy=pol,
+                             retry_max=2, retry_backoff_s=0.2)
+    series = str(tmp_path / "burn.jsonl")
+    mon = Monitor(q, path=series)
+    ha = q.submit(jnp.asarray(_world(1)), tenant="acme")
+    mon.sample()  # healthy baseline sample
+    chaos("execute:every=1,kind=transient")
+    # The drain sticks in fail->backoff->fail: ~0.6s per flush attempt.
+    drain = threading.Thread(target=q.flush)
+    drain.start()
+    try:
+        # Once the stuck flush owns group A, a deadlined request lands
+        # in the queue with nobody left to drain it.
+        assert _wait_for(lambda: not q.pending())
+        hb = q.submit(jnp.asarray(_world(2)), tenant="acme",
+                      deadline_s=0.2)
+        # No result() here before expiry — an await would trigger the
+        # reason="result" rescue flush. The deadline timer owns hb.
+        assert _wait_for(hb.done)
+        with pytest.raises(dfft.DeadlineExceeded):
+            hb.result(timeout=10)
+    finally:
+        drain.join(60)
+    assert not drain.is_alive()
+    with pytest.raises(Exception):
+        ha.result(timeout=30)  # retries exhausted under every=1
+    rep = pol.slo_report()["tenants"]["acme"]
+    assert rep["deadline_misses"] == 1 and rep["slo_ok"] is False
+    assert m.counter_total("serving_expired") == 1.0
+    mon.sample()  # the incident sample
+    verdict = mon.health()
+    assert verdict["status"] == "alert"
+    assert any(a["name"] == "slo_burn" and a["tenant"] == "acme"
+               for a in verdict["alerts"])
+    assert report.main(["health", "--series", series, "--gate"]) == 1
+    err = capsys.readouterr().err
+    assert "slo_burn" in err
+
+
+# ------------------------------------------ measured overlap attribution
+
+@needs_mesh
+def test_dispatch_spans_interleave_on_mesh():
+    mesh = dfft.make_mesh(8)
+    plan = dfft.plan_dft_c2c_3d((16, 8, 8), mesh, dtype=CDT)
+    spans = dispatch_spans([plan, plan])
+    names = [n for n, _, _ in spans]
+    assert any(n.startswith("cc0:") for n in names)
+    assert any(n.startswith("cc1:") for n in names)
+    cc = overlap_from_events(spans)["concurrent"]
+    assert cc["groups"] == 2
+    # schedule_concurrent interleaves the two stage DAGs: the realized
+    # dispatch overlap is strictly positive (and < 1 by construction).
+    assert 0.0 < cc["hide_ratio"] < 1.0
+    with pytest.raises(ValueError):
+        dispatch_spans([dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT)])
+
+
+@needs_mesh
+def test_explain_measured_overlap_concurrent(metrics_on):
+    mesh = dfft.make_mesh(8)
+    plan = dfft.plan_dft_c2c_3d((16, 8, 8), mesh, dtype=CDT)
+    rec = dfft.explain(plan, measure=False, concurrent=2)
+    ov = rec["overlap"]
+    assert ov is not None and ov["kind"] == "concurrent"
+    assert ov["cohort"] == 2 and ov["groups"] == 2
+    assert 0.0 < ov["measured_hide_ratio"] < 1.0
+    assert len(ov["measured_samples"]) >= 1
+    assert isinstance(ov["model_hide_ratio"], float)
+    assert "model_speedup" in ov and "divergence" in ov
+
+
+@needs_mesh
+def test_explain_measured_overlap_leg_pipeline():
+    mesh = dfft.make_mesh(8)
+    p2 = dfft.plan_dft_c2c_3d((16, 8, 8), mesh, dtype=CDT,
+                              overlap_chunks=2)
+    rec = dfft.explain(p2, measure=False)
+    ov = rec["overlap"]
+    assert ov is not None and ov["kind"] == "overlap_k"
+    assert ov["cohort"] == 1 and ov["groups"] == 2
+    # The per-chunk [k] spans joined; the dispatch-level ratio is
+    # honest (0.0 for back-to-back chunk issue), never negative.
+    assert 0.0 <= ov["measured_hide_ratio"] <= 1.0
+    # Model side: min(1, sum leg hides / raw t2) — clamped nonnegative.
+    assert 0.0 <= ov["model_hide_ratio"] <= 1.0
+
+
+@needs_mesh
+def test_explain_overlap_disarmed_pin_and_validation():
+    mesh = dfft.make_mesh(8)
+    plan = dfft.plan_dft_c2c_3d((16, 8, 8), mesh, dtype=CDT)
+    # No concurrent cohort, K=1: no overlap block at all (the record
+    # shape every pre-PR-16 consumer saw).
+    assert dfft.explain(plan, measure=False)["overlap"] is None
+    with pytest.raises(ValueError):
+        dfft.explain(plan, measure=False, concurrent=True)
+    with pytest.raises(ValueError):
+        dfft.explain(plan, measure=False, concurrent=1)

@@ -120,6 +120,72 @@ def test_plan_affecting_knobs_are_plan_cache_keyed():
         f"{sorted(unknown)}")
 
 
+# --------------------------------------------- metrics registry <-> docs
+#
+# PR 16's monitor exports every registered series to Prometheus; an
+# undocumented series is an unnamed dashboard line, and a documented
+# series nothing emits is a phantom row operators will grep for in vain.
+# Same mechanical closure as the knob lint: the series table in
+# OBSERVABILITY.md ("Registered series") must match the literal series
+# names the package emits, in both directions.
+
+_SERIES_EMIT = re.compile(r'\b(?:inc|set_gauge|observe)\(\s*"([a-z0-9_]+)"')
+_SERIES_NAME = re.compile(r"`([a-z][a-z0-9_]+)`")
+_SERIES_TYPES = {"counter", "gauge", "histogram"}
+
+
+def _emitted_series() -> set[str]:
+    """Literal series names at every ``inc``/``set_gauge``/``observe``
+    call site in the package (the registry's emit API — call sites pass
+    pure string literals by convention, enforced here by omission: a
+    computed name would dodge the docs lint and the Prometheus naming
+    audit with it)."""
+    series: set[str] = set()
+    for root, dirs, files in os.walk(PKG):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for name in files:
+            if name.endswith(".py"):
+                with open(os.path.join(root, name)) as f:
+                    series |= set(_SERIES_EMIT.findall(f.read()))
+    return series
+
+
+def _documented_series() -> set[str]:
+    """Series named in OBSERVABILITY.md's metrics table: backticked
+    names from the first cell of every row whose type cell is
+    counter/gauge/histogram (slash-joined families like
+    ``plan_cache_hits`` / ``plan_cache_misses`` contribute each name)."""
+    series: set[str] = set()
+    with open(DOC_FILES[0]) as f:
+        for line in f:
+            if not line.lstrip().startswith("|"):
+                continue
+            cells = [c.strip() for c in line.strip().strip("|").split("|")]
+            if len(cells) < 2:
+                continue
+            if cells[1].split(" ")[0] not in _SERIES_TYPES:
+                continue
+            series |= set(_SERIES_NAME.findall(cells[0]))
+    return series
+
+
+def test_every_emitted_series_is_documented():
+    missing = _emitted_series() - _documented_series()
+    assert not missing, (
+        f"metric series the package emits but OBSERVABILITY.md's "
+        f"'Registered series' table does not document: {sorted(missing)}"
+        f" — add a row (name, type, labels, meaning) where the series "
+        f"was added")
+
+
+def test_every_documented_series_is_emitted():
+    phantom = _documented_series() - _emitted_series()
+    assert not phantom, (
+        f"OBSERVABILITY.md documents metric series nothing in the "
+        f"package emits: {sorted(phantom)} — stale rows mislead anyone "
+        f"building dashboards on the Prometheus export")
+
+
 def test_plan_affecting_list_matches_docs_claim():
     """TUNING.md's env tables claim their knobs are plan-cache-keyed;
     hold the claim to the tuple (cache-lifecycle knobs that never change

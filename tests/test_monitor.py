@@ -1,0 +1,712 @@
+"""Live serving monitor (PR 16, docs/OBSERVABILITY.md "Live monitoring
+& health").
+
+Contracts pinned here:
+
+1. **Lifecycle** — ``DFFT_MONITOR=interval[,path]`` parsing (unset/0 =
+   disarmed, malformed = ValueError), start/stop idempotence, the
+   daemon sampler streaming parseable JSONL and going quiet after
+   ``stop()``, env-armed queues tearing their sampler down on
+   ``close()`` (idempotent, queue usable after).
+2. **Zero-overhead disarmed pin** — without ``DFFT_MONITOR`` a queue
+   carries no monitor and produces the exact PR 15 observable surface:
+   byte-identical results, empty metrics, empty pending state.
+3. **Health engine** — windowed SLO burn rate over lifetime ledger
+   counters (fast alert / slow warn, per-tenant, single-sample series
+   read as lifetime totals), quota-pressure and degraded warns, the
+   queue-stall watchdog (fires once per group per episode, re-arms on
+   flush progress, emits ``serving_stalls`` + a retroactive
+   ``serve_stall`` span).
+4. **Prometheus rendering** — ``dfft_``-prefixed families with
+   ``_total``/``_count``/``_sum``/quantile rows, label values
+   containing commas ("(64, 64, 64)" shapes) kept intact, queue and
+   per-tenant SLO blocks.
+5. **Satellites** — the trace ring (``DFFT_TRACE_MAX_EVENTS`` eviction
+   counted in ``trace_dropped_events`` + the ``dropped_events`` banner
+   ``report merge`` surfaces), wait-histogram sampling reservoirs
+   (p50/p99 with an exactness flag), ``capture_events`` tee nesting,
+   and the ``report health``/``report live`` CLI including the
+   ``--gate`` exit contract and the regress-layer health gating.
+
+Mesh-level acceptance (monitored queue under concurrent multi-tenant
+load, measured overlap in explain records) lives in
+``tests/test_a2o_monitor.py`` — this file stays single-device.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu import monitor, report
+from distributedfft_tpu.monitor import (
+    Monitor,
+    health_from_samples,
+    load_series,
+    overlap_from_events,
+    prometheus_from_sample,
+    realized_overlap,
+    update_overlap_correction,
+)
+from distributedfft_tpu.qos import QosPolicy, Tenant
+from distributedfft_tpu.utils import metrics as m
+from distributedfft_tpu.utils import trace as tr
+
+SHAPE = (8, 8, 8)
+CDT = jnp.complex128
+
+
+def _world(seed=0, shape=SHAPE):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal(shape) + 1j * rng.standard_normal(shape)
+
+
+@pytest.fixture
+def metrics_on():
+    dfft.enable_metrics()
+    m.metrics_reset()
+    yield
+    m.metrics_reset()
+    dfft.enable_metrics(False)
+
+
+def _queue(policy=None, **kw):
+    kw.setdefault("dtype", CDT)
+    kw.setdefault("max_batch", 64)
+    return dfft.CoalescingQueue(None, policy=policy, **kw)
+
+
+def _wait_for(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+# ------------------------------------------------------------ lifecycle
+
+def test_from_env_parsing(monkeypatch):
+    monkeypatch.delenv("DFFT_MONITOR", raising=False)
+    assert Monitor.from_env() is None
+    monkeypatch.setenv("DFFT_MONITOR", "0")
+    assert Monitor.from_env() is None
+    monkeypatch.setenv("DFFT_MONITOR", "-2")
+    assert Monitor.from_env() is None  # non-positive interval = disarmed
+    monkeypatch.setenv("DFFT_MONITOR", "0.5")
+    mon = Monitor.from_env()
+    assert mon.interval_s == 0.5 and mon.path is None
+    monkeypatch.setenv("DFFT_MONITOR", "0.25, /tmp/series.jsonl ")
+    mon = Monitor.from_env()
+    assert mon.interval_s == 0.25 and mon.path == "/tmp/series.jsonl"
+    monkeypatch.setenv("DFFT_MONITOR", "fast,/tmp/x")
+    with pytest.raises(ValueError, match="DFFT_MONITOR"):
+        Monitor.from_env()
+
+
+@pytest.mark.parametrize("bad", [0, -1.0, True, "1"])
+def test_interval_validation(bad):
+    with pytest.raises(ValueError, match="interval_s"):
+        Monitor(interval_s=bad)
+
+
+def test_start_stop_idempotent():
+    mon = Monitor(interval_s=60.0)
+    try:
+        assert mon.start() is mon
+        t1 = mon._thread
+        assert t1 is not None and t1.is_alive() and t1.daemon
+        mon.start()  # second start: same thread, no respawn
+        assert mon._thread is t1
+    finally:
+        mon.stop()
+    assert not t1.is_alive() and mon._thread is None
+    mon.stop()  # idempotent
+    # Restartable after stop.
+    mon.start()
+    t2 = mon._thread
+    assert t2 is not None and t2 is not t1 and t2.is_alive()
+    mon.stop()
+    assert not t2.is_alive()
+    # Manual monitor (no interval): start is a no-op, sampling works.
+    manual = Monitor()
+    assert manual.start() is manual and manual._thread is None
+    assert manual.sample()["schema"] == monitor.MONITOR_SCHEMA
+    manual.stop()
+
+
+def test_daemon_sampler_streams_jsonl(tmp_path):
+    path = str(tmp_path / "series.jsonl")
+    with Monitor(interval_s=0.02, path=path) as mon:
+        assert _wait_for(lambda: len(load_series(path)) >= 3)
+    # stop() joins the thread: the series must go quiet.
+    n = len(load_series(path))
+    time.sleep(0.1)
+    docs = load_series(path)
+    assert len(docs) == n
+    assert all(d["schema"] == monitor.MONITOR_SCHEMA for d in docs)
+    seqs = [d["seq"] for d in docs]
+    assert seqs == sorted(seqs)
+    assert mon.samples  # in-memory ring mirrors the file
+
+
+def test_sample_document_shape(metrics_on):
+    pol = QosPolicy([Tenant("acme", "interactive", slo_wait_s=1.0)])
+    q = _queue(policy=pol)
+    q.submit(jnp.asarray(_world(1)), tenant="acme")
+    mon = Monitor(q)
+    doc = mon.sample()
+    assert set(doc) == {"schema", "ts", "pid", "seq", "metrics",
+                        "queue", "qos"}
+    qb = doc["queue"]
+    assert qb["kind"] == "c2c" and qb["depth"] == 1 and qb["groups"] == 1
+    assert qb["oldest_pending_age_s"] >= 0.0 and qb["stalls_total"] == 0
+    assert "acme" in doc["qos"]["tenants"]
+    # Queue-less monitor: both blocks are None, sampling still works.
+    bare = Monitor().sample()
+    assert bare["queue"] is None and bare["qos"] is None
+    q.flush()
+
+
+def test_disarmed_queue_is_byte_identical(monkeypatch):
+    """Acceptance pin: without DFFT_MONITOR the queue carries no
+    monitor and reproduces the exact PR 15 observable surface."""
+    monkeypatch.delenv("DFFT_MONITOR", raising=False)
+    assert not tr.tracing_enabled()
+    m.enable_metrics(False)
+    m.metrics_reset()
+    q = _queue()
+    assert q._monitor is None
+    xs = [_world(s) for s in (1, 2)]
+    hs = [q.submit(jnp.asarray(v)) for v in xs]
+    assert q.flush() == 2
+    ref = dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT)
+    for v, h in zip(xs, hs):
+        assert np.array_equal(np.asarray(h.result()),
+                              np.asarray(ref(jnp.asarray(v))))
+    assert dfft.metrics_snapshot()["counters"] == {}
+    assert q._pending == {} and q._formed == {}
+
+
+def test_env_armed_queue_and_close(tmp_path, monkeypatch):
+    path = str(tmp_path / "armed.jsonl")
+    monkeypatch.setenv("DFFT_MONITOR", f"0.02,{path}")
+    q = _queue()
+    mon = q._monitor
+    assert mon is not None and mon.queue is q
+    assert mon._thread is not None and mon._thread.is_alive()
+    h = q.submit(jnp.asarray(_world(3)))
+    q.flush()
+    ref = dfft.plan_dft_c2c_3d(SHAPE, None, dtype=CDT)
+    assert np.array_equal(np.asarray(h.result()),
+                          np.asarray(ref(jnp.asarray(_world(3)))))
+    assert _wait_for(lambda: len(load_series(path)) >= 2)
+    t = mon._thread
+    q.close()
+    assert not t.is_alive()
+    q.close()  # idempotent
+    # close is a quiesce point, not a poison pill.
+    h2 = q.submit(jnp.asarray(_world(4)))
+    q.flush()
+    h2.result()
+
+
+def test_concurrent_writers_one_series(tmp_path):
+    """N threads streaming into ONE series file: every line parses
+    (append_line is line-atomic; the multi-process variant is
+    tests/test_atomic_stores.py)."""
+    path = str(tmp_path / "shared.jsonl")
+    nthreads, nsamples = 4, 25
+
+    def worker():
+        mon = Monitor(path=path)
+        for _ in range(nsamples):
+            mon.sample()
+
+    threads = [threading.Thread(target=worker) for _ in range(nthreads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    with open(path) as f:
+        lines = f.read().splitlines()
+    assert len(lines) == nthreads * nsamples
+    for ln in lines:
+        json.loads(ln)  # a torn line would fail to parse
+    assert len(load_series(path)) == nthreads * nsamples
+
+
+# --------------------------------------------------------- health engine
+
+def _hsample(ts, *, submits=0.0, misses=0.0, shed=0.0, declared=True,
+             slo_ok=None, stalls=0.0, degraded=0.0, tenant="acme"):
+    """One synthetic monitor sample with lifetime ledger totals."""
+    t = {"class": "interactive", "submits": submits, "transforms": submits,
+         "deadline_misses": misses, "quota_shed": shed}
+    if declared:
+        t["slo_wait_s"] = 1.0
+    if slo_ok is not None:
+        t["slo_ok"] = slo_ok
+    counters = {}
+    if degraded:
+        counters["serving_degraded"] = {"kind=c2c": degraded}
+    return {
+        "schema": 1, "ts": ts, "pid": 1, "seq": int(ts),
+        "metrics": {"counters": counters},
+        "queue": {"kind": "c2c", "depth": 0, "groups": 0,
+                  "oldest_pending_age_s": 0.0, "flush_seq": 0,
+                  "stalls_total": stalls},
+        "qos": {"schema": 1, "tenants": {tenant: t}},
+    }
+
+
+def test_health_empty_series_is_unknown():
+    v = health_from_samples([])
+    assert v["status"] == "unknown" and v["alerts"] == []
+
+
+def test_health_ok_below_burn_threshold():
+    v = health_from_samples([_hsample(0, submits=100),
+                             _hsample(50, submits=120, misses=1)])
+    assert v["status"] == "ok" and v["alerts"] == []
+    assert v["totals"]["deadline_misses"] == 1
+
+
+def test_health_fast_burn_alerts():
+    # 10 misses over 20 windowed submits = 50% burn >> 10% threshold.
+    v = health_from_samples([_hsample(0, submits=100),
+                             _hsample(50, submits=120, misses=10)])
+    assert v["status"] == "alert"
+    (a,) = [x for x in v["alerts"] if x["name"] == "slo_burn"]
+    assert a["severity"] == "alert" and a["tenant"] == "acme"
+    assert a["burn_fast"] == pytest.approx(0.5)
+
+
+def test_health_counters_are_diffed_not_read_as_rates():
+    # Big lifetime totals, zero increase in-window: no burn. This is
+    # the counters-are-lifetime contract — a long-lived process's old
+    # misses must never read as a live incident.
+    v = health_from_samples([_hsample(0, submits=1000, misses=400),
+                             _hsample(30, submits=1000, misses=400)])
+    assert v["status"] == "ok"
+
+
+def test_health_slow_burn_warns_when_fast_window_forgives():
+    # All the badness is older than the fast window but inside the
+    # slow one: slo_burn_slow (warn), never the fast alert.
+    v = health_from_samples([_hsample(0, submits=100),
+                             _hsample(300, submits=200, misses=40),
+                             _hsample(500, submits=201, misses=40),
+                             _hsample(520, submits=202, misses=40)])
+    assert v["status"] == "warn"
+    (a,) = v["alerts"]
+    assert a["name"] == "slo_burn_slow" and a["severity"] == "warn"
+    assert a["burn_fast"] == 0.0 and a["burn_slow"] > 0.1
+
+
+def test_health_lifetime_slo_violation_alerts():
+    # Single-sample series (the bench health_snapshot path): the
+    # ledger's own lifetime slo_ok=False verdict fires the alert even
+    # with no windowed burn.
+    v = health_from_samples([_hsample(0, submits=10, slo_ok=False)])
+    assert v["status"] == "alert"
+    assert [a["name"] for a in v["alerts"]] == ["slo_burn"]
+
+
+def test_health_quota_pressure_and_degraded_warn():
+    # Undeclared-SLO tenant: sheds warn (quota_pressure) but can never
+    # fire the SLO gate; degraded executions warn from the fault
+    # counters.
+    v = health_from_samples([
+        _hsample(0, submits=10, declared=False),
+        _hsample(30, submits=20, shed=3, declared=False, degraded=2.0)])
+    assert v["status"] == "warn"
+    names = sorted(a["name"] for a in v["alerts"])
+    assert names == ["degraded", "quota_pressure"]
+    assert all(a["severity"] == "warn" for a in v["alerts"])
+
+
+def test_health_stall_alert_from_watchdog_counter():
+    v = health_from_samples([_hsample(0), _hsample(30, stalls=1.0)])
+    assert v["status"] == "alert"
+    assert [a["name"] for a in v["alerts"]] == ["stall"]
+    assert v["totals"]["stalls"] == 1.0
+
+
+def test_health_snapshot_single_shot(metrics_on):
+    v = monitor.health_snapshot()
+    assert v["schema"] == monitor.HEALTH_SCHEMA
+    assert v["status"] == "ok" and v["samples"] == 1
+
+
+# -------------------------------------------------------- stall watchdog
+
+def test_stall_watchdog_fires_once_and_rearms(tmp_path, metrics_on):
+    tr.init_tracing(str(tmp_path / "stall"), format="chrome")
+    try:
+        # No max_wait_s: the grace interval plays the deadline, so the
+        # watchdog (not a flush timer) owns the verdict.
+        q = _queue()
+        mon = Monitor(q, stall_factor=1.0, stall_grace_s=0.05)
+        h = q.submit(jnp.asarray(_world(7)))
+        s1 = mon.sample()
+        assert s1["queue"]["stalls_total"] == 0  # first sample: no
+        time.sleep(0.12)                         # progress baseline yet
+        s2 = mon.sample()
+        assert s2["queue"]["stalls_total"] == 1
+        assert s2["queue"]["stalled"][0]["age_s"] > 0.05
+        assert s2["queue"]["stalled"][0]["tenant"] is None
+        s3 = mon.sample()  # same episode: counted once, not again
+        assert s3["queue"]["stalls_total"] == 1 and "stalled" not in s3
+        assert m.counter_total("serving_stalls") == 1
+        q.flush()
+        h.result()
+        s4 = mon.sample()  # progress re-arms; nothing pending now
+        assert s4["queue"]["depth"] == 0
+        assert s4["queue"]["flush_seq"] > s2["queue"]["flush_seq"]
+        # A fresh group + a fresh quiet period is a NEW episode.
+        h2 = q.submit(jnp.asarray(_world(8)))
+        time.sleep(0.12)
+        s5 = mon.sample()
+        assert s5["queue"]["stalls_total"] == 2
+        q.flush()
+        h2.result()
+    finally:
+        path = tr.finalize_tracing()
+    names = [e["name"] for e in report.load_events(path)]
+    # The retroactive span covers each un-flushed wait.
+    assert names.count("serve_stall[c2c]") == 2
+
+
+# --------------------------------------------------- Prometheus rendering
+
+def test_prometheus_rendering_families_and_comma_labels():
+    sample = {
+        "ts": 1234.5,
+        "metrics": {
+            "counters": {"executes": {"kind=c2c,shape=(64, 64, 64)": 3}},
+            "gauges": {"serving_queue_depth": {"kind=c2c": 2}},
+            "histograms": {"serving_wait_seconds": {"kind=c2c": {
+                "count": 2, "total": 0.3, "mean": 0.15, "min": 0.1,
+                "max": 0.2, "p50": 0.15, "p99": 0.2, "exact": True}}},
+        },
+        "queue": {"kind": "c2c", "depth": 5, "groups": 2,
+                  "oldest_pending_age_s": 0.25, "flush_seq": 7,
+                  "stalls_total": 1},
+        "qos": {"tenants": {"acme": {
+            "submits": 10, "transforms": 9, "quota_shed": 2,
+            "deadline_misses": 1, "wait_p50_s": 0.01, "wait_p99_s": 0.2,
+            "slo_wait_s": 0.05, "slo_ok": False}}},
+    }
+    text = prometheus_from_sample(sample)
+    lines = text.splitlines()
+    # Comma inside a label VALUE must not split the label set.
+    assert ('dfft_executes_total{kind="c2c",shape="(64, 64, 64)"} 3'
+            in lines)
+    assert "# TYPE dfft_executes_total counter" in lines
+    assert 'dfft_serving_queue_depth{kind="c2c"} 2' in lines
+    assert 'dfft_serving_wait_seconds_count{kind="c2c"} 2' in lines
+    assert 'dfft_serving_wait_seconds_sum{kind="c2c"} 0.3' in lines
+    assert ('dfft_serving_wait_seconds{kind="c2c",quantile="0.5"} 0.15'
+            in lines)
+    assert 'dfft_queue_depth{kind="c2c"} 5' in lines
+    assert 'dfft_queue_oldest_pending_age_seconds{kind="c2c"} 0.25' in lines
+    assert 'dfft_queue_stalls_total{kind="c2c"} 1' in lines
+    assert 'dfft_tenant_submits_total{tenant="acme"} 10' in lines
+    assert 'dfft_tenant_slo_misses_total{tenant="acme"} 1' in lines
+    assert 'dfft_tenant_quota_shed_total{tenant="acme"} 2' in lines
+    assert ('dfft_tenant_wait_seconds{tenant="acme",quantile="0.99"} 0.2'
+            in lines)
+    assert 'dfft_tenant_slo_ok{tenant="acme"} 0' in lines
+    assert any(ln.startswith("dfft_monitor_sample_timestamp_seconds ")
+               for ln in lines)
+    assert text.endswith("\n")
+
+
+def test_prometheus_text_from_live_monitor(metrics_on):
+    q = _queue()
+    q.submit(jnp.asarray(_world(9)))  # records serving_submits itself
+    text = Monitor(q).prometheus_text()
+    assert 'dfft_serving_submits_total{kind="c2c"} 1' in text
+    assert 'dfft_queue_depth{kind="c2c"} 1' in text
+    q.flush()
+
+
+# ------------------------------------------------- measured overlap joins
+
+def test_realized_overlap_groups_and_clamp():
+    # Two cc groups interleaved over half their extents.
+    ev = [("cc0:t0_fft", 0.0, 1.0), ("cc1:t0_fft", 0.5, 1.5)]
+    out = overlap_from_events(ev)
+    assert out["legs"] is None
+    cc = out["concurrent"]
+    assert cc["groups"] == 2
+    assert cc["hide_ratio"] == pytest.approx(0.25)
+    # Back-to-back dispatch: exactly 0, never negative.
+    seq = overlap_from_events([("cc0:a", 0.0, 1.0), ("cc1:b", 1.5, 2.5)])
+    assert seq["concurrent"]["hide_ratio"] == 0.0
+    # Single group: no join.
+    assert overlap_from_events([("cc0:a", 0.0, 1.0)])["concurrent"] is None
+    assert realized_overlap([], lambda n: None) is None
+
+
+def test_overlap_chunk_suffix_joins_strip_cc_prefix():
+    ev = [
+        ("cc0:t2_exchange_slab[0]", 0.0, 1.0),
+        ("cc0:t2_exchange_slab[1]", 0.5, 1.5),
+        ("t3_fft_x", 2.0, 3.0),  # unsuffixed spans are ignored
+    ]
+    legs = overlap_from_events(ev)["legs"]
+    assert legs["groups"] == 2
+    assert legs["hide_ratio"] == pytest.approx(0.25)
+
+
+def test_update_overlap_correction_requires_measured_and_model():
+    assert update_overlap_correction(None) is None
+    assert update_overlap_correction({"kind": "concurrent"}) is None
+    assert update_overlap_correction({
+        "kind": "concurrent", "measured_hide_ratio": 0.3,
+        "model_hide_ratio": 0.0}) is None  # model must be positive
+    assert update_overlap_correction({
+        "kind": "warp", "measured_hide_ratio": 0.3,
+        "model_hide_ratio": 0.5}) is None  # unknown kind
+
+
+# ------------------------------------------------------- trace ring (sat)
+
+def test_trace_ring_evicts_counts_and_banners(tmp_path, monkeypatch,
+                                              metrics_on, capsys):
+    monkeypatch.setenv("DFFT_TRACE_NATIVE", "0")
+    monkeypatch.setenv("DFFT_TRACE_MAX_EVENTS", "32")
+    tr.init_tracing(str(tmp_path / "ring"))
+    try:
+        for i in range(100):
+            tr.record_span(f"ev{i}", float(i), float(i) + 0.5)
+        dropped = tr.dropped_events()
+        assert dropped > 0
+        assert m.counter_total("trace_dropped_events") == dropped
+    finally:
+        path = tr.finalize_tracing()
+    with open(path) as f:
+        text = f.read()
+    assert f"dropped_events {dropped}\n" in text
+    assert report.ring_dropped(path) == dropped
+    # The banner is metadata, not a malformed row; the newest events
+    # survive (the ring keeps the spans nearest the incident).
+    events = report.load_events(path)
+    assert events and events[-1]["name"] == "ev99"
+    assert len(events) == 100 - dropped
+    assert report.main(["merge", path]) == 0
+    out = capsys.readouterr().out
+    assert f"{dropped} event(s) evicted by the in-memory ring" in out
+
+
+def test_trace_ring_chrome_metadata(tmp_path, monkeypatch):
+    monkeypatch.setenv("DFFT_TRACE_MAX_EVENTS", "16")
+    tr.init_tracing(str(tmp_path / "ringc"), format="chrome")
+    try:
+        for i in range(50):
+            tr.record_span(f"ev{i}", float(i), float(i) + 0.5)
+        dropped = tr.dropped_events()
+        assert dropped > 0
+    finally:
+        path = tr.finalize_tracing()
+    assert path.endswith(".json")
+    assert report.ring_dropped(path) == dropped
+    assert json.load(open(path))["metadata"]["dropped_events"] == dropped
+
+
+def test_trace_ring_unbounded_at_zero(tmp_path, monkeypatch):
+    monkeypatch.setenv("DFFT_TRACE_NATIVE", "0")
+    monkeypatch.setenv("DFFT_TRACE_MAX_EVENTS", "0")
+    tr.init_tracing(str(tmp_path / "unb"))
+    try:
+        for i in range(100):
+            tr.record_span(f"ev{i}", float(i), float(i) + 0.5)
+        assert tr.dropped_events() == 0
+    finally:
+        path = tr.finalize_tracing()
+    assert report.ring_dropped(path) == 0
+    assert len(report.load_events(path)) == 100
+
+
+# ------------------------------------------- reservoir quantiles (sat)
+
+def test_wait_histogram_reservoir_quantiles(metrics_on):
+    for i in range(10):
+        m.observe("serving_wait_seconds", 0.001 * (i + 1), kind="c2c")
+    snap = dfft.metrics_snapshot()
+    h = snap["histograms"]["serving_wait_seconds"]["kind=c2c"]
+    assert h["exact"] is True and h["count"] == 10
+    assert h["p50"] == pytest.approx(0.0055)
+    assert h["p99"] == pytest.approx(0.00991, rel=1e-3)
+    # Non-reservoir histograms stay pure aggregates: no quantiles.
+    m.observe("serving_batch_size", 4, kind="c2c")
+    b = dfft.metrics_snapshot()["histograms"]["serving_batch_size"]
+    assert "p50" not in b["kind=c2c"]
+
+
+def test_reservoir_flips_to_estimate_past_capacity(metrics_on):
+    n = m.RESERVOIR_SIZE + 100
+    for i in range(n):
+        m.observe("serving_tenant_wait_seconds", float(i), kind="c2c",
+                  tenant="t")
+    snap = dfft.metrics_snapshot()
+    (h,) = snap["histograms"]["serving_tenant_wait_seconds"].values()
+    assert h["count"] == n and h["exact"] is False
+    # Algorithm R keeps a uniform sample: the median estimate stays in
+    # the bulk of the distribution.
+    assert 0.2 * n < h["p50"] < 0.8 * n
+
+
+def test_capture_events_tee_nests_and_restores():
+    assert not tr.tracing_enabled()
+    with tr.capture_events() as outer:
+        with tr.add_trace("one"):
+            pass
+        with tr.capture_events() as inner:
+            with tr.add_trace("two"):
+                pass
+        with tr.add_trace("three"):
+            pass
+    assert [n for n, _, _ in outer] == ["one", "three"]
+    assert [n for n, _, _ in inner] == ["two"]
+    assert not tr.tracing_enabled()
+    # Outside any capture, a disabled session records nothing.
+    with tr.add_trace("four"):
+        pass
+    assert [n for n, _, _ in outer] == ["one", "three"]
+
+
+# ------------------------------------------------------------ CLI surface
+
+def _write_series(path, samples):
+    with open(path, "w") as f:
+        for s in samples:
+            f.write(json.dumps(s) + "\n")
+
+
+def test_report_health_cli_series_json_gate(tmp_path, capsys):
+    healthy = str(tmp_path / "healthy.jsonl")
+    _write_series(healthy, [_hsample(0, submits=100),
+                            _hsample(50, submits=120, misses=1)])
+    burning = str(tmp_path / "burning.jsonl")
+    _write_series(burning, [_hsample(0, submits=100),
+                            _hsample(50, submits=120, misses=10)])
+    assert report.main(["health", "--series", healthy]) == 0
+    assert "status: ok" in capsys.readouterr().out
+    assert report.main(["health", "--series", healthy, "--gate"]) == 0
+    capsys.readouterr()
+    # Without --gate a firing alert still exits 0 (report-only).
+    assert report.main(["health", "--series", burning]) == 0
+    err = capsys.readouterr().err
+    assert "slo_burn" in err
+    assert report.main(["health", "--series", burning, "--gate"]) == 1
+    capsys.readouterr()
+    # --json round-trips the verdict document.
+    assert report.main(["health", "--series", burning, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["status"] == "alert"
+    assert any(a["name"] == "slo_burn" for a in doc["alerts"])
+    # Threshold override de-fangs the same series.
+    assert report.main(["health", "--series", burning, "--gate",
+                        "--burn-threshold", "0.9"]) == 0
+    capsys.readouterr()
+    # No samples -> exit 2.
+    assert report.main(["health", "--series",
+                        str(tmp_path / "missing.jsonl")]) == 2
+    capsys.readouterr()
+
+
+def test_report_health_cli_reads_history_record(tmp_path, capsys):
+    from distributedfft_tpu import regress
+
+    verdict = health_from_samples([_hsample(0, submits=10, slo_ok=False)])
+    rec = regress.make_run_record(metric="monitor_smoke", value=1.0,
+                                  backend="cpu", health=verdict)
+    hist = str(tmp_path / "history.jsonl")
+    regress.append_records([rec], hist)
+    assert report.main(["health", "--history", hist]) == 0
+    assert "slo_burn" in capsys.readouterr().out
+    assert report.main(["health", "--history", hist, "--gate"]) == 1
+    capsys.readouterr()
+    # No health block anywhere -> exit 2.
+    hist2 = str(tmp_path / "bare.jsonl")
+    regress.append_records([regress.make_run_record(
+        metric="x", value=1.0, backend="cpu")], hist2)
+    assert report.main(["health", "--history", hist2]) == 2
+    capsys.readouterr()
+
+
+def test_report_live_cli(tmp_path, capsys):
+    series = str(tmp_path / "live.jsonl")
+    _write_series(series, [
+        _hsample(0, submits=5),
+        _hsample(10, submits=9, misses=1, slo_ok=False)])
+    assert report.main(["live", "--series", series]) == 0
+    out = capsys.readouterr().out
+    assert "2 sample(s)" in out and "queue[c2c]" in out
+    assert "tenant acme" in out and "MISS" in out
+    assert report.main(["live", "--series", series, "--prom"]) == 0
+    prom = capsys.readouterr().out
+    assert 'dfft_queue_depth{kind="c2c"} 0' in prom
+    assert 'dfft_tenant_slo_misses_total{tenant="acme"} 1' in prom
+    assert report.main(["live", "--series", series, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["seq"] == 10  # newest by timestamp
+    assert report.main(["live", "--series",
+                        str(tmp_path / "nope.jsonl")]) == 2
+    capsys.readouterr()
+
+
+def test_load_series_is_lenient_and_sorts(tmp_path):
+    path = str(tmp_path / "messy.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps(_hsample(20)) + "\n")
+        f.write("{torn line\n")
+        f.write("[1, 2]\n")  # foreign but valid JSON: not a sample
+        f.write(json.dumps(_hsample(5)) + "\n")
+    docs = load_series(path)
+    assert [d["ts"] for d in docs] == [5, 20]
+    assert load_series(str(tmp_path / "absent.jsonl")) == []
+
+
+# -------------------------------------------------- regress health gating
+
+def test_regress_gates_on_health_alerts(tmp_path):
+    from distributedfft_tpu import regress
+
+    verdict = health_from_samples([
+        _hsample(0, submits=100),
+        _hsample(50, submits=120, misses=10, stalls=1.0)])
+    assert verdict["status"] == "alert"
+    rec = regress.make_run_record(metric="fft_gflops", value=100.0,
+                                  backend="cpu", health=verdict)
+    assert rec["health"]["status"] == "alert"
+    # normalize_bench_line lifts the bench.py health block.
+    rec2 = regress.normalize_bench_line(
+        {"metric": "fft_gflops", "value": 100.0, "backend": "cpu",
+         "health": verdict}, source="t")
+    assert rec2["health"]["status"] == "alert"
+    # compare_record copies the firing verdict through baseline-free...
+    res = regress.compare_record(rec, [])
+    assert res["health"]["status"] == "alert"
+    names = {a["name"] for a in res["health"]["alerts"]}
+    assert names == {"stall", "slo_burn"}
+    # ...and regressed_metrics turns it into gate entries.
+    bad = regress.regressed_metrics(res)
+    assert "health:stall" in bad and "health:slo_burn[acme]" in bad
+    # A healthy verdict adds nothing and never gates.
+    ok = regress.make_run_record(
+        metric="fft_gflops", value=100.0, backend="cpu",
+        health=health_from_samples([_hsample(0, submits=10)]))
+    res_ok = regress.compare_record(ok, [])
+    assert "health" not in res_ok
+    assert regress.regressed_metrics(res_ok) == []
