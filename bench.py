@@ -425,6 +425,22 @@ def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
         out["health"] = health_snapshot()
     except Exception:  # noqa: BLE001 — telemetry, not contract
         pass
+    # Process identity (docs/OBSERVABILITY.md "Fleet view"): which
+    # host/process produced this line — the key that lets the fleet
+    # aggregator and the run-record store attribute a regression to a
+    # member. Multi-process runs also stamp the process shape; the
+    # run-record store keys "procs" into the baseline group so single-
+    # and multi-process runs never share a compare baseline.
+    import socket as _socket
+
+    out["host"] = _socket.gethostname()
+    out["pid"] = os.getpid()
+    try:
+        if jax.process_count() > 1:
+            out["procs"] = jax.process_count()
+            out["process_index"] = jax.process_index()
+    except Exception:  # noqa: BLE001 — telemetry, not contract
+        pass
     print(json.dumps(out), flush=True)
     return out
 

@@ -67,6 +67,8 @@ from __future__ import annotations
 import json
 import os
 import re
+import socket
+import sys
 import threading
 import time
 from collections import deque
@@ -90,9 +92,36 @@ __all__ = [
 ]
 
 #: Sample-document format version (stamped into every JSONL sample).
-MONITOR_SCHEMA = 1
+#: v2 added the fleet identity fields (``host``/``process_index``), the
+#: monotonic clock stamp (``mono`` — the fleet aggregator's clock-offset
+#: anchor), and the per-tenant wait-reservoir tail inside the qos block
+#: (:meth:`..qos.QosPolicy.slo_report` ``include_waits``). v1 samples
+#: still load and merge (the added fields are simply absent).
+MONITOR_SCHEMA = 2
 #: Health-verdict format version (stamped into every health block).
 HEALTH_SCHEMA = 1
+
+#: This process's hostname, stamped into every sample — half of the
+#: fleet stream identity (``host``/``pid``); the other half of the
+#: shared-directory naming convention (``fleet.series_path``).
+_HOST = socket.gethostname()
+
+#: Sampling interval when only ``DFFT_MONITOR_DIR`` is set (no
+#: ``DFFT_MONITOR`` interval to say otherwise).
+DEFAULT_DIR_INTERVAL_S = 1.0
+
+
+def _process_index() -> int | None:
+    """``jax.process_index()`` when jax is already imported and
+    initialized; None otherwise. Never imports jax — a metrics-only
+    monitor in a jax-free process must stay jax-free."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        return int(jax.process_index())
+    except Exception:  # noqa: BLE001 — uninitialized backend
+        return None
 
 #: A pending group is judged stalled past ``stall_factor x max_wait_s``
 #: (or ``x stall_grace_s`` on queues without a deadline) with no flush
@@ -170,21 +199,36 @@ class Monitor:
 
     @classmethod
     def from_env(cls, queue=None) -> "Monitor | None":
-        """A monitor armed from ``DFFT_MONITOR=interval[,path]``; None
-        when the knob is unset/0 (the zero-overhead default)."""
+        """A monitor armed from ``DFFT_MONITOR=interval[,path]`` and/or
+        the fleet directory convention ``DFFT_MONITOR_DIR=dir`` (one
+        JSONL series per process: ``monitor-<host>-<pid>.jsonl``). None
+        when both are unset (the zero-overhead default). An explicit
+        ``DFFT_MONITOR=0`` disarms even with the directory set; an
+        explicit path in ``DFFT_MONITOR`` wins over the derived one;
+        the directory alone samples at ``DEFAULT_DIR_INTERVAL_S``."""
         spec = os.environ.get("DFFT_MONITOR", "").strip()
-        if spec in ("", "0"):
+        mdir = os.environ.get("DFFT_MONITOR_DIR", "").strip()
+        if spec in ("", "0") and not mdir:
             return None
-        head, _, tail = spec.partition(",")
-        try:
-            interval = float(head)
-        except ValueError:
-            raise ValueError(
-                f"DFFT_MONITOR must be 'interval[,path]' (seconds), "
-                f"got {spec!r}") from None
-        if interval <= 0:
+        if spec == "0":
             return None
-        return cls(queue, interval_s=interval, path=tail.strip() or None)
+        interval, tail = DEFAULT_DIR_INTERVAL_S, ""
+        if spec:
+            head, _, tail = spec.partition(",")
+            try:
+                interval = float(head)
+            except ValueError:
+                raise ValueError(
+                    f"DFFT_MONITOR must be 'interval[,path]' (seconds), "
+                    f"got {spec!r}") from None
+            if interval <= 0:
+                return None
+        path = tail.strip() or None
+        if path is None and mdir:
+            from .fleet import series_path
+
+            path = series_path(mdir)
+        return cls(queue, interval_s=interval, path=path)
 
     def start(self) -> "Monitor":
         """Arm the daemon sampler thread (no-op without ``interval_s``,
@@ -300,7 +344,14 @@ class Monitor:
         doc = {
             "schema": MONITOR_SCHEMA,
             "ts": time.time(),
+            # The monotonic stamp next to the wall stamp is the fleet
+            # aggregator's clock-offset anchor: within one host every
+            # process shares the monotonic epoch, so ts - mono deltas
+            # across streams ARE wall-clock skew (fleet.estimate_offsets).
+            "mono": time.monotonic(),
+            "host": _HOST,
             "pid": os.getpid(),
+            "process_index": _process_index(),
             "seq": self._seq,
             "metrics": _metrics.metrics_snapshot(),
             "queue": self._watch_queue(now),
@@ -308,7 +359,10 @@ class Monitor:
         self._seq += 1
         q = self.queue
         pol = getattr(q, "policy", None) if q is not None else None
-        doc["qos"] = pol.slo_report() if pol is not None else None
+        # include_waits: the reservoir tail rides in the sample so the
+        # fleet aggregator can quantile-merge waits across processes.
+        doc["qos"] = (pol.slo_report(include_waits=True)
+                      if pol is not None else None)
         self._samples.append(doc)
         if self.path:
             append_line(self.path, json.dumps(doc, sort_keys=True))
@@ -575,55 +629,55 @@ def _plabels(label_str: str, extra: dict | None = None) -> str:
     return "{" + ",".join(f'{k}="{_esc(v)}"' for k, v in pairs) + "}"
 
 
-def prometheus_from_sample(sample: dict) -> str:
-    """One monitor sample in Prometheus text exposition format. Series
-    are prefixed ``dfft_``; counters get ``_total``, histograms emit
-    ``_count``/``_sum`` plus ``quantile`` rows where the registry keeps
-    a reservoir; the queue/QoS blocks surface depth, pending age, stall
-    count, and per-tenant SLO standing for scraping."""
-    lines: list[str] = []
+def _prom_rows(sample: dict, extra: dict | None = None) -> list[tuple]:
+    """One monitor sample as ``(family, type, line)`` Prometheus rows.
+    ``extra`` labels (the fleet renderer's ``proc``/``host``) are
+    appended to every row's label set. :func:`_render_prom` joins rows
+    into the text exposition format, emitting each family's ``# TYPE``
+    exactly once — the property that lets the fleet view concatenate N
+    processes' rows into one valid scrape document."""
+    rows: list[tuple] = []
+    extra = extra or {}
 
-    def typed(name: str, kind: str) -> None:
-        lines.append(f"# TYPE {name} {kind}")
+    def lab(label_str: str, more: dict | None = None) -> str:
+        merged = dict(more or {})
+        merged.update(extra)
+        return _plabels(label_str, merged)
 
     snap = sample.get("metrics") or {}
-    for name, rows in sorted((snap.get("counters") or {}).items()):
-        typed(f"dfft_{name}_total", "counter")
-        for lbl, v in sorted(rows.items()):
-            lines.append(f"dfft_{name}_total{_plabels(lbl)} {v:g}")
-    for name, rows in sorted((snap.get("gauges") or {}).items()):
-        typed(f"dfft_{name}", "gauge")
-        for lbl, v in sorted(rows.items()):
-            lines.append(f"dfft_{name}{_plabels(lbl)} {v:g}")
-    for name, rows in sorted((snap.get("histograms") or {}).items()):
-        typed(f"dfft_{name}", "summary")
-        for lbl, h in sorted(rows.items()):
-            lines.append(f"dfft_{name}_count{_plabels(lbl)} "
-                         f"{h.get('count', 0):g}")
-            lines.append(f"dfft_{name}_sum{_plabels(lbl)} "
-                         f"{h.get('total', 0.0):g}")
+    for name, fam in sorted((snap.get("counters") or {}).items()):
+        pname = f"dfft_{name}_total"
+        for lbl, v in sorted(fam.items()):
+            rows.append((pname, "counter", f"{pname}{lab(lbl)} {v:g}"))
+    for name, fam in sorted((snap.get("gauges") or {}).items()):
+        pname = f"dfft_{name}"
+        for lbl, v in sorted(fam.items()):
+            rows.append((pname, "gauge", f"{pname}{lab(lbl)} {v:g}"))
+    for name, fam in sorted((snap.get("histograms") or {}).items()):
+        pname = f"dfft_{name}"
+        for lbl, h in sorted(fam.items()):
+            rows.append((pname, "summary",
+                         f"{pname}_count{lab(lbl)} {h.get('count', 0):g}"))
+            rows.append((pname, "summary",
+                         f"{pname}_sum{lab(lbl)} {h.get('total', 0.0):g}"))
             for q, fld in (("0.5", "p50"), ("0.99", "p99")):
                 if fld in h:
-                    lines.append(
-                        f"dfft_{name}"
-                        f"{_plabels(lbl, {'quantile': q})} {h[fld]:g}")
+                    rows.append((pname, "summary",
+                                 f"{pname}{lab(lbl, {'quantile': q})} "
+                                 f"{h[fld]:g}"))
 
     qb = sample.get("queue") or None
     if qb:
         kind = {"kind": qb.get("kind", "")}
-        typed("dfft_queue_depth", "gauge")
-        lines.append(f"dfft_queue_depth{_plabels('', kind)} "
-                     f"{qb.get('depth', 0):g}")
-        typed("dfft_queue_pending_groups", "gauge")
-        lines.append(f"dfft_queue_pending_groups{_plabels('', kind)} "
-                     f"{qb.get('groups', 0):g}")
-        typed("dfft_queue_oldest_pending_age_seconds", "gauge")
-        lines.append(
-            f"dfft_queue_oldest_pending_age_seconds{_plabels('', kind)} "
-            f"{qb.get('oldest_pending_age_s', 0.0):g}")
-        typed("dfft_queue_stalls_total", "counter")
-        lines.append(f"dfft_queue_stalls_total{_plabels('', kind)} "
-                     f"{qb.get('stalls_total', 0):g}")
+        for pname, ptype, fld, dflt in (
+                ("dfft_queue_depth", "gauge", "depth", 0),
+                ("dfft_queue_pending_groups", "gauge", "groups", 0),
+                ("dfft_queue_oldest_pending_age_seconds", "gauge",
+                 "oldest_pending_age_s", 0.0),
+                ("dfft_queue_stalls_total", "counter",
+                 "stalls_total", 0)):
+            rows.append((pname, ptype,
+                         f"{pname}{lab('', kind)} {qb.get(fld, dflt):g}"))
 
     tenants = ((sample.get("qos") or {}).get("tenants") or {})
     if tenants:
@@ -632,33 +686,65 @@ def prometheus_from_sample(sample: dict) -> str:
                 ("quota_shed", "dfft_tenant_quota_shed_total", "counter"),
                 ("deadline_misses", "dfft_tenant_slo_misses_total",
                  "counter"))
-        for fld, pname, kind in fams:
-            typed(pname, kind)
+        for fld, pname, ptype in fams:
             for tname, t in sorted(tenants.items()):
                 v = t.get(fld)
                 if isinstance(v, (int, float)):
-                    lines.append(
-                        f"{pname}{_plabels('', {'tenant': tname})} {v:g}")
-        typed("dfft_tenant_wait_seconds", "summary")
+                    rows.append((pname, ptype,
+                                 f"{pname}{lab('', {'tenant': tname})} "
+                                 f"{v:g}"))
         for tname, t in sorted(tenants.items()):
             for q, fld in (("0.5", "wait_p50_s"), ("0.99", "wait_p99_s")):
                 v = t.get(fld)
                 if isinstance(v, (int, float)):
-                    lines.append(
+                    rows.append((
+                        "dfft_tenant_wait_seconds", "summary",
                         f"dfft_tenant_wait_seconds"
-                        f"{_plabels('', {'tenant': tname, 'quantile': q})}"
-                        f" {v:g}")
-        typed("dfft_tenant_slo_ok", "gauge")
+                        f"{lab('', {'tenant': tname, 'quantile': q})}"
+                        f" {v:g}"))
         for tname, t in sorted(tenants.items()):
             if "slo_ok" in t:
-                lines.append(
-                    f"dfft_tenant_slo_ok{_plabels('', {'tenant': tname})}"
-                    f" {1 if t['slo_ok'] else 0}")
+                rows.append((
+                    "dfft_tenant_slo_ok", "gauge",
+                    f"dfft_tenant_slo_ok{lab('', {'tenant': tname})} "
+                    f"{1 if t['slo_ok'] else 0}"))
 
-    typed("dfft_monitor_sample_timestamp_seconds", "gauge")
-    lines.append(f"dfft_monitor_sample_timestamp_seconds "
-                 f"{sample.get('ts', 0.0):.6f}")
+    ts_line = f"dfft_monitor_sample_timestamp_seconds{lab('')}" \
+        if extra else "dfft_monitor_sample_timestamp_seconds"
+    rows.append(("dfft_monitor_sample_timestamp_seconds", "gauge",
+                 f"{ts_line} {sample.get('ts', 0.0):.6f}"))
+    return rows
+
+
+def _render_prom(rows: list[tuple]) -> str:
+    """Join ``(family, type, line)`` rows into the Prometheus text
+    exposition format. Each family's ``# TYPE`` header is emitted once,
+    at the family's first appearance; later rows of the same family
+    (another process's, in the fleet view) group under it."""
+    by_family: dict[str, tuple[str, list[str]]] = {}
+    order: list[str] = []
+    for family, ptype, line in rows:
+        if family not in by_family:
+            by_family[family] = (ptype, [])
+            order.append(family)
+        by_family[family][1].append(line)
+    lines: list[str] = []
+    for family in order:
+        ptype, fam_lines = by_family[family]
+        lines.append(f"# TYPE {family} {ptype}")
+        lines.extend(fam_lines)
     return "\n".join(lines) + "\n"
+
+
+def prometheus_from_sample(sample: dict) -> str:
+    """One monitor sample in Prometheus text exposition format. Series
+    are prefixed ``dfft_``; counters get ``_total``, histograms emit
+    ``_count``/``_sum`` plus ``quantile`` rows where the registry keeps
+    a reservoir; the queue/QoS blocks surface depth, pending age, stall
+    count, and per-tenant SLO standing for scraping. The fleet view
+    (:func:`..fleet.prometheus_from_fleet`) renders the same rows once
+    per process with ``proc``/``host`` labels."""
+    return _render_prom(_prom_rows(sample))
 
 
 # ------------------------------------------- measured overlap attribution

@@ -105,6 +105,12 @@ DEFAULT_STARVE_WAIT_S = 1.0
 #: samples drop first; p50/p99 are computed over the tail).
 _WAIT_RESERVOIR = 8192
 
+#: Bound of the reservoir *export* (``slo_report(include_waits=True)``)
+#: — the newest tail that rides inside monitor sample documents so the
+#: fleet aggregator can quantile-merge waits across processes without
+#: shipping the full 8192-sample ring on every sample.
+_WAIT_EXPORT = 256
+
 
 def class_rank(klass: str) -> int:
     """0 = realtime (drains first) .. 2 = batch (drains last)."""
@@ -471,12 +477,22 @@ class QosPolicy:
         with self._lock:
             self._entry(t.name)["deadline_misses"] += n
 
-    def slo_report(self) -> dict:
+    def slo_report(self, *, include_waits: bool | int = False) -> dict:
         """The SLO ledger as one JSON document: per tenant, the class/
         weight/quota declaration, the intake/drain/shed/miss counters,
         the p50/p99 queue wait over the reservoir, and — when the
         tenant declared ``slo_wait_s`` — whether p99 currently meets it
-        (``slo_ok``; misses count against it too)."""
+        (``slo_ok``; misses count against it too).
+
+        ``include_waits`` additionally exports the newest tail of each
+        tenant's wait reservoir as a ``waits`` list (True = the
+        ``_WAIT_EXPORT`` default cap, an int = that cap) — the raw
+        samples the fleet aggregator quantile-merges across processes;
+        the per-process p50/p99 rows alone cannot be merged."""
+        cap = 0
+        if include_waits:
+            cap = (_WAIT_EXPORT if include_waits is True
+                   else max(1, int(include_waits)))
         with self._lock:
             out = {}
             names = set(self._ledger) | set(self._tenants)
@@ -501,6 +517,10 @@ class QosPolicy:
                     row["slo_ok"] = (row["deadline_misses"] == 0
                                      and (p99 is None
                                           or p99 <= t.slo_wait_s))
+                if cap:
+                    raw = e.get("waits", ())
+                    row["waits"] = [round(float(w), 6)
+                                    for w in list(raw)[-cap:]]
                 out[name] = row
         return {"schema": 1, "tenants": out}
 

@@ -283,14 +283,20 @@ def normalize_bench_line(
     # different latency/throughput regimes by construction — so
     # realtime and batch runs never share a compare baseline;
     # policy-free rows keep the old schema and groups.
+    # "procs"/"topology" are the multi-process shape (jax
+    # process_count / the mesh's cross-host layout): a 4-process run
+    # pays DCN hops a single-process run never sees, so single- and
+    # multi-process runs must never share a compare baseline;
+    # single-process rows keep the old schema and groups.
     for k in ("dtype", "devices", "decomposition", "overlap", "tuned",
               "batch", "profile", "wire_dtype", "transport", "op",
-              "degraded", "precision", "concurrent", "tenant_class"):
+              "degraded", "precision", "concurrent", "tenant_class",
+              "procs", "topology"):
         if obj.get(k) is not None:
             config[k] = obj[k]
     ex: dict = {}
     for k in ("executor", "donated", "vs_baseline", "max_roundtrip_err",
-              "all"):
+              "all", "host", "pid", "process_index"):
         if obj.get(k) is not None:
             ex[k] = obj[k]
     if extra:

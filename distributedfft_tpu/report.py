@@ -78,6 +78,7 @@ import glob as _glob
 import json
 import re
 import sys
+import time
 
 from . import regress
 
@@ -271,16 +272,40 @@ def ring_dropped(path: str) -> int:
     return 0
 
 
-def merge_files(paths: list[str]) -> list[dict]:
+def merge_files(paths: list[str], *, align: str = "none",
+                offsets_s: dict[int, float] | None = None) -> list[dict]:
     """One timeline from many per-process files, sorted by start time.
     Malformed events across all files are skipped with one total count
-    on stderr (partial logs from killed workers are a normal input)."""
+    on stderr (partial logs from killed workers are a normal input).
+
+    ``align`` handles files whose clocks do not share an origin:
+    ``"none"`` (default) keeps every timestamp as written;
+    ``"start"`` shifts each FILE so its earliest event starts at t=0 —
+    the right mode for per-process text logs, whose stamps are relative
+    to each process's own trace start. ``offsets_s`` applies measured
+    wall-clock skew on top: per trace-lane pid (the jax process index
+    the recorder stamps), that many seconds are SUBTRACTED from the
+    lane's events — pair it with the fleet aggregator's
+    :func:`..fleet.estimate_offsets` (``report merge --monitor-dir``)
+    for one clock-aligned Perfetto timeline across processes."""
+    if align not in ("none", "start"):
+        raise ValueError(f"align must be 'none' or 'start', "
+                         f"got {align!r}")
     events: list[dict] = []
     dropped = 0
     for path in paths:
         evs, d = _load_events(path)
+        if align == "start" and evs:
+            t0 = min(e["ts"] for e in evs)
+            for e in evs:
+                e["ts"] -= t0
         events.extend(evs)
         dropped += d
+    if offsets_s:
+        for e in events:
+            off = offsets_s.get(e["pid"])
+            if off:
+                e["ts"] -= off * 1e6
     if dropped:
         print(f"report: skipped {dropped} malformed event(s) across "
               f"{len(paths)} file(s)", file=sys.stderr)
@@ -366,14 +391,41 @@ def _main_merge(argv: list[str]) -> int:
     p.add_argument("--sort", default="total",
                    choices=("total", "count", "mean", "min", "max", "name"),
                    help="aggregate table sort key (default: total)")
+    p.add_argument("--align", default="none", choices=("none", "start"),
+                   help="'start' re-origins each FILE's clock at its "
+                        "first event — per-process text logs stamp "
+                        "relative times, so merging without alignment "
+                        "interleaves incomparable clocks")
+    p.add_argument("--monitor-dir", default=None, metavar="DIR",
+                   help="fleet monitor-series directory "
+                        "(DFFT_MONITOR_DIR): estimate each process's "
+                        "wall-clock skew from its monitor stream and "
+                        "subtract it from its trace lane (matched on "
+                        "jax process index)")
     args = p.parse_args(argv)
 
     paths: list[str] = []
     for pat in args.paths:
         hits = sorted(_glob.glob(pat))
         paths.extend(hits if hits else [pat])
+    offsets_s = None
+    if args.monitor_dir:
+        from .fleet import estimate_offsets, load_fleet
+
+        streams = load_fleet(args.monitor_dir)
+        stream_offsets = estimate_offsets(streams)
+        offsets_s = {}
+        for sid, samples in streams.items():
+            pi = samples[-1].get("process_index")
+            off = stream_offsets.get(sid, 0.0)
+            if isinstance(pi, int) and off:
+                offsets_s[pi] = off
+        if not streams:
+            print(f"report: {args.monitor_dir}: no monitor series — "
+                  f"merging without skew correction", file=sys.stderr)
     try:
-        events = merge_files(paths)
+        events = merge_files(paths, align=args.align,
+                             offsets_s=offsets_s)
     except OSError as e:
         print(f"report: {e}", file=sys.stderr)
         return 2
@@ -1250,38 +1302,145 @@ def _main_live(argv: list[str]) -> int:
                         "sample")
     p.add_argument("--json", action="store_true",
                    help="print the newest sample document as JSON")
+    p.add_argument("--watch", type=float, default=None, metavar="N",
+                   help="follow mode: re-read and re-render the newest "
+                        "sample every N seconds until interrupted")
+    p.add_argument("--watch-max", type=int, default=None,
+                   help=argparse.SUPPRESS)  # bound iterations (tests)
     args = p.parse_args(argv)
 
     from .monitor import load_series, prometheus_from_sample
 
-    samples = load_series(args.series)
-    if not samples:
-        print(f"report live: {args.series}: no monitor samples",
+    def render() -> tuple[str, int]:
+        samples = load_series(args.series)
+        if not samples:
+            return (f"report live: {args.series}: no monitor samples", 2)
+        newest = samples[-1]
+        if args.prom:
+            return (prometheus_from_sample(newest).rstrip("\n"), 0)
+        if args.json:
+            return (json.dumps(newest, indent=2, sort_keys=True), 0)
+        lines = [f"{len(samples)} sample(s); "
+                 f"newest seq={newest.get('seq')} "
+                 f"pid={newest.get('pid')}"
+                 + (f" host={newest['host']}"
+                    if newest.get("host") else "")]
+        qb = newest.get("queue") or {}
+        if qb:
+            lines.append(
+                f"queue[{qb.get('kind')}]: depth={qb.get('depth')} "
+                f"groups={qb.get('groups')} "
+                f"oldest_age={qb.get('oldest_pending_age_s', 0.0):.3f}s "
+                f"stalls={qb.get('stalls_total', 0)}")
+        tenants = ((newest.get("qos") or {}).get("tenants") or {})
+        for name, t in sorted(tenants.items()):
+            slo = ("-" if t.get("slo_ok") is None
+                   else "ok" if t["slo_ok"] else "MISS")
+            lines.append(
+                f"tenant {name}: submits={t.get('submits', 0)} "
+                f"misses={t.get('deadline_misses', 0)} "
+                f"shed={t.get('quota_shed', 0)} slo={slo}")
+        return ("\n".join(lines), 0)
+
+    if args.watch is None:
+        text, rc = render()
+        print(text, file=sys.stderr if rc else sys.stdout)
+        return rc
+    if args.watch <= 0:
+        print("report live: --watch must be a positive interval",
               file=sys.stderr)
         return 2
-    newest = samples[-1]
+    # Follow mode: terminal refresh on a tty (clear + home), plain
+    # re-render blocks otherwise (pipes, tests, CI logs). A series that
+    # has not appeared yet is watched patiently, not a hard error.
+    n = 0
+    try:
+        while True:
+            text, _rc = render()
+            if sys.stdout.isatty():
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print(text)
+            sys.stdout.flush()
+            n += 1
+            if args.watch_max is not None and n >= args.watch_max:
+                return 0
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        return 0
+    except BrokenPipeError:  # |head closed the pipe — a clean exit
+        try:
+            sys.stdout.close()
+        except OSError:
+            pass
+        return 0
+
+
+def _main_fleet(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m distributedfft_tpu.report fleet",
+        description="Fleet view over a shared monitor-series directory "
+                    "(DFFT_MONITOR_DIR; docs/OBSERVABILITY.md 'Fleet "
+                    "view & load generation'): per-process series are "
+                    "clock-aligned and merged into fleet samples, "
+                    "judged by the fleet health engine — per-member "
+                    "verdicts plus cross-stream straggler/imbalance/"
+                    "fleet-stall alerts.")
+    p.add_argument("--dir", default=None, metavar="DIR",
+                   help="monitor-series directory (default: "
+                        "DFFT_MONITOR_DIR)")
+    p.add_argument("--prom", action="store_true",
+                   help="Prometheus text exposition: every member's "
+                        "newest sample with proc/host labels plus the "
+                        "dfft_fleet_* aggregates")
+    p.add_argument("--json", action="store_true",
+                   help="print the fleet verdict document as JSON")
+    p.add_argument("--gate", action="store_true",
+                   help="exit 1 when the fleet verdict is 'alert' "
+                        "(member stall/burn, fleet_stall, "
+                        "straggler_skew)")
+    p.add_argument("--fast-window", type=float, default=None,
+                   metavar="S", help="fast burn window, seconds")
+    p.add_argument("--slow-window", type=float, default=None,
+                   metavar="S", help="slow burn window, seconds")
+    p.add_argument("--burn-threshold", type=float, default=None,
+                   metavar="FRAC",
+                   help="windowed bad-submit fraction that fires "
+                        "slo_burn")
+    p.add_argument("--bucket", type=float, default=None, metavar="S",
+                   help="merge bucket width, seconds (default: the "
+                        "fleet's median sampling interval)")
+    args = p.parse_args(argv)
+
+    from . import fleet as _fleet
+
+    dir_ = args.dir or _fleet.monitor_dir_from_env()
+    if not dir_:
+        print("report fleet: no --dir given and DFFT_MONITOR_DIR is "
+              "unset", file=sys.stderr)
+        return 2
+    streams = _fleet.load_fleet(dir_)
+    if not streams:
+        print(f"report fleet: {dir_}: no monitor series",
+              file=sys.stderr)
+        return 2
     if args.prom:
-        print(prometheus_from_sample(newest), end="")
+        print(_fleet.prometheus_from_fleet(streams), end="")
         return 0
+    kw = {}
+    if args.fast_window is not None:
+        kw["fast_window_s"] = args.fast_window
+    if args.slow_window is not None:
+        kw["slow_window_s"] = args.slow_window
+    if args.burn_threshold is not None:
+        kw["burn_threshold"] = args.burn_threshold
+    if args.bucket is not None:
+        kw["bucket_s"] = args.bucket
+    doc = _fleet.fleet_health(streams, **kw)
     if args.json:
-        print(json.dumps(newest, indent=2, sort_keys=True))
-        return 0
-    qb = newest.get("queue") or {}
-    print(f"{len(samples)} sample(s); newest seq={newest.get('seq')} "
-          f"pid={newest.get('pid')}")
-    if qb:
-        print(f"queue[{qb.get('kind')}]: depth={qb.get('depth')} "
-              f"groups={qb.get('groups')} "
-              f"oldest_age={qb.get('oldest_pending_age_s', 0.0):.3f}s "
-              f"stalls={qb.get('stalls_total', 0)}")
-    tenants = ((newest.get("qos") or {}).get("tenants") or {})
-    for name, t in sorted(tenants.items()):
-        slo = ("-" if t.get("slo_ok") is None
-               else "ok" if t["slo_ok"] else "MISS")
-        print(f"tenant {name}: submits={t.get('submits', 0)} "
-              f"misses={t.get('deadline_misses', 0)} "
-              f"shed={t.get('quota_shed', 0)} slo={slo}")
-    return 0
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        print(_fleet.format_fleet(doc))
+    return 1 if (args.gate and doc.get("status") == "alert") else 0
 
 
 _SUBCOMMANDS = {
@@ -1295,6 +1454,7 @@ _SUBCOMMANDS = {
     "qos": _main_qos,
     "health": _main_health,
     "live": _main_live,
+    "fleet": _main_fleet,
 }
 
 

@@ -509,10 +509,14 @@ class CoalescingQueue:
         # a plain int bump keeps the disarmed hot path byte-identical.
         self._flush_seq = 0
         # DFFT_MONITOR=interval[,path] arms a live sampler per queue
-        # (docs/OBSERVABILITY.md "Live monitoring & health"); unset, the
-        # queue carries no monitor and takes no hook anywhere.
+        # (docs/OBSERVABILITY.md "Live monitoring & health");
+        # DFFT_MONITOR_DIR=dir arms one too, streaming into the shared
+        # fleet directory as monitor-<host>-<pid>.jsonl (docs/
+        # OBSERVABILITY.md "Fleet view & load generation"). With both
+        # unset the queue carries no monitor and takes no hook anywhere.
         self._monitor = None
-        if os.environ.get("DFFT_MONITOR", "").strip() not in ("", "0"):
+        if (os.environ.get("DFFT_MONITOR", "").strip() not in ("", "0")
+                or os.environ.get("DFFT_MONITOR_DIR", "").strip()):
             from .monitor import Monitor
 
             self._monitor = Monitor.from_env(self)

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import os
+import socket
 import time
 import math
 from contextlib import contextmanager
@@ -154,7 +155,12 @@ def _write_chrome(path: str, events, proc: int, nprocs: int) -> None:
     # inner pairs inside their enclosing span.
     trace_events.sort(key=lambda ev: (ev["ts"], ev["ph"] != "B"))
     with open(path, "w") as f:
-        meta = {"process": proc, "process_count": nprocs}
+        # Writer identity: the trace lane's pid is the jax process
+        # index (the MPI-rank role), so the OS-level identity rides in
+        # metadata — the fleet tooling (report merge --monitor-dir)
+        # matches trace lanes to monitor streams through it.
+        meta = {"process": proc, "process_count": nprocs,
+                "host": socket.gethostname(), "os_pid": os.getpid()}
         if _dropped:
             meta["dropped_events"] = _dropped
         json.dump(

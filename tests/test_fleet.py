@@ -1,0 +1,413 @@
+"""Fleet observability plane (docs/OBSERVABILITY.md "Fleet view & load
+generation"): clock-offset estimation over the skewed fixtures, the
+lenient multi-series loader, the bucketed merge with its cross-process
+quantile merge, the cross-stream health verdicts, Prometheus labeling,
+and the ``report fleet`` CLI.
+
+The ``tests/data/fleet_skew`` fixtures are three same-host series —
+stream 102's wall clock runs +5 s ahead of its peers (same monotonic
+epoch, the NTP-step shape), stream 103 ends in a torn line (killed
+writer), ``monitor-fixhost-999.jsonl`` is empty (a worker dead before
+its first sample), and ``README.txt`` is a foreign file the loader
+must ignore.
+"""
+
+import json
+import os
+
+import pytest
+
+from distributedfft_tpu import fleet, monitor, report
+from distributedfft_tpu.fleet import (
+    estimate_offsets,
+    fleet_health,
+    format_fleet,
+    load_fleet,
+    merge_streams,
+    monitor_dir_from_env,
+    prometheus_from_fleet,
+    series_path,
+)
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "data", "fleet_skew")
+
+
+# --------------------------------------------------- synthetic streams
+
+def _sample(pid, i, *, skew=0.0, host="h1", waits=(0.01,), submits=None,
+            shed=0, misses=0, stalls=0, depth=0, flush=None, slo=1.0,
+            tenant="acme", pi=None):
+    waits = list(waits)
+    return {
+        "schema": 2, "ts": 1000.0 + i + skew, "mono": 50.0 + i,
+        "host": host, "pid": pid,
+        "process_index": pi, "seq": i,
+        "metrics": {"counters": {
+            "serving_submits": {"op=fft": float(5 * (i + 1))}}},
+        "queue": {"kind": "c2c", "depth": depth,
+                  "groups": 1 if depth else 0,
+                  "oldest_pending_age_s": 0.5 * depth,
+                  "flush_seq": flush if flush is not None else i,
+                  "stalls_total": stalls},
+        "qos": {"schema": 1, "tenants": {tenant: {
+            "class": "interactive", "weight": 1.0, "rate": None,
+            "submits": submits if submits is not None else 5 * (i + 1),
+            "transforms": 5 * i, "quota_shed": shed,
+            "deadline_misses": misses, "slo_wait_s": slo,
+            "wait_p50_s": sorted(waits)[len(waits) // 2],
+            "wait_p99_s": max(waits), "slo_ok": True,
+            "waits": waits}}},
+    }
+
+
+def _stream(pid, n=6, **kw):
+    return [_sample(pid, i, **kw) for i in range(n)]
+
+
+# ------------------------------------------------- directory convention
+
+def test_series_path_and_env(monkeypatch, tmp_path):
+    p = series_path(str(tmp_path))
+    assert p == str(tmp_path / f"monitor-{monitor._HOST}-"
+                               f"{os.getpid()}.jsonl")
+    assert series_path("d", host="h", pid=7) == os.path.join(
+        "d", "monitor-h-7.jsonl")
+    monkeypatch.delenv("DFFT_MONITOR_DIR", raising=False)
+    assert monitor_dir_from_env() is None
+    monkeypatch.setenv("DFFT_MONITOR_DIR", "  ")
+    assert monitor_dir_from_env() is None
+    monkeypatch.setenv("DFFT_MONITOR_DIR", str(tmp_path))
+    assert monitor_dir_from_env() == str(tmp_path)
+
+
+def test_load_fleet_tolerates_torn_empty_and_foreign():
+    streams = load_fleet(FIXDIR)
+    # 999 (empty) and README.txt must not appear; 103's torn tail is
+    # dropped but its 7 whole lines survive.
+    assert sorted(streams) == ["fixhost:101#0", "fixhost:102#1",
+                               "fixhost:103#2"]
+    assert len(streams["fixhost:101#0"]) == 8
+    assert len(streams["fixhost:103#2"]) == 7
+    assert load_fleet(os.path.join(FIXDIR, "no-such-dir")) == {}
+
+
+# --------------------------------------------------------- clock offsets
+
+def test_estimate_offsets_recovers_fixture_skew():
+    streams = load_fleet(FIXDIR)
+    off = estimate_offsets(streams)
+    # Host-group median anchor: the two honest streams define the
+    # reference; 102's +5s wall step is recovered exactly (shared
+    # monotonic epoch).
+    assert off["fixhost:101#0"] == pytest.approx(0.0, abs=1e-9)
+    assert off["fixhost:103#2"] == pytest.approx(0.0, abs=1e-9)
+    assert off["fixhost:102#1"] == pytest.approx(5.0, abs=1e-9)
+
+
+def test_offsets_not_corrected_across_hosts_or_without_mono():
+    # Different hosts: monotonic epochs are unrelated boot times, so no
+    # cross-host correction is attempted even with wild anchor gaps.
+    a = _stream(1, host="hostA")
+    b = [dict(s, mono=s["mono"] + 1e6) for s in _stream(2, host="hostB")]
+    off = estimate_offsets({"hostA:1": a, "hostB:2": b})
+    assert off == {"hostA:1": 0.0, "hostB:2": 0.0}
+    # v1 samples without mono: offset 0 (no anchor to estimate).
+    legacy = [{k: v for k, v in s.items() if k != "mono"}
+              for s in _stream(3)]
+    assert estimate_offsets({"h1:3": legacy})["h1:3"] == 0.0
+
+
+# ---------------------------------------------------------------- merge
+
+def test_merge_sums_counters_and_shapes_like_monitor_samples():
+    streams = {"h1:1": _stream(1, depth=2), "h1:2": _stream(2, depth=1)}
+    merged = merge_streams(streams)
+    assert merged and all(m["schema"] == 2 and m["fleet"]
+                          for m in merged)
+    newest = merged[-1]
+    assert newest["procs"] == 2
+    # Queue gauges sum across members; flush progress too.
+    assert newest["queue"]["depth"] == 3
+    assert newest["queue"]["flush_seq"] == 10  # 5 + 5
+    # Metrics counters sum per (name, label row).
+    rows = newest["metrics"]["counters"]["serving_submits"]
+    assert rows["op=fft"] == 60.0  # 30 + 30
+    # Tenant ledgers sum; the merged sample is monitor-shaped, so the
+    # single-process health engine consumes it unchanged.
+    assert newest["qos"]["tenants"]["acme"]["submits"] == 60
+    verdict = monitor.health_from_samples(merged)
+    assert verdict["status"] == "ok"
+    # per_proc carries each member's share for the imbalance checks.
+    assert set(newest["per_proc"]) == {"h1:1", "h1:2"}
+    assert newest["per_proc"]["h1:1"]["submits"] == 30
+
+
+def test_merge_carries_slow_sampler_forward():
+    fast = _stream(1, n=8)
+    slow = _stream(2, n=2)  # died (or samples slowly) after t=1001
+    merged = merge_streams({"h1:1": fast, "h1:2": slow})
+    newest = merged[-1]
+    # The dead member's last lifetime counters persist in the fleet sum
+    # (counters are monotone), it never vanishes from the merge.
+    assert newest["procs"] == 2
+    assert newest["qos"]["tenants"]["acme"]["submits"] == 40 + 10
+
+
+def test_merge_empty_and_offset_application():
+    assert merge_streams({}) == []
+    # A +5s-skewed stream with offsets applied lands in the same
+    # buckets as its honest twin (corrected time), so the merge pairs
+    # samples that were taken at the same true instant.
+    honest = _stream(1)
+    skewed = _stream(2, skew=5.0)
+    streams = {"h1:1": honest, "h1:2": skewed}
+    merged = merge_streams(streams,
+                           offsets=estimate_offsets(streams))
+    assert all(m["procs"] == 2 for m in merged)
+
+
+# ------------------------------------------------------- quantile merge
+
+def test_reservoir_quantile_merge_matches_exact_pool():
+    """The merged tenant p50/p99 must equal the exact quantiles of the
+    pooled per-process waits (concatenate-then-rank), never an average
+    of per-process quantiles — quantiles do not average."""
+    w1 = [0.010 + 0.0001 * k for k in range(40)]   # low cluster
+    w2 = [0.100 + 0.0005 * k for k in range(40)]   # high cluster
+    streams = {"h1:1": _stream(1, waits=w1), "h1:2": _stream(2, waits=w2)}
+    newest = merge_streams(streams)[-1]
+    t = newest["qos"]["tenants"]["acme"]
+
+    pool = sorted(w1 + w2)
+    exact_p50 = pool[int(0.50 * len(pool))]
+    exact_p99 = pool[min(len(pool) - 1, int(0.99 * len(pool)))]
+    assert t["wait_p50_s"] == pytest.approx(exact_p50, rel=1e-9)
+    assert t["wait_p99_s"] == pytest.approx(exact_p99, rel=1e-9)
+    # The sanity bound that catches quantile-averaging bugs: the pooled
+    # p99 lives in the HIGH cluster; averaging per-process p99s would
+    # land between the clusters.
+    assert t["wait_p99_s"] >= max(w2) * 0.99
+    # And the merged p50/p99 bracket every member's own quantiles.
+    assert min(w1) <= t["wait_p50_s"] <= max(w2)
+
+
+def test_quantile_merge_tolerates_missing_reservoirs():
+    # v1-ish samples without exported waits: counters still merge, the
+    # fleet quantiles fall back to None rather than inventing numbers.
+    s1 = _stream(1)
+    for s in s1:
+        del s["qos"]["tenants"]["acme"]["waits"]
+    s2 = _stream(2)
+    for s in s2:
+        del s["qos"]["tenants"]["acme"]["waits"]
+    newest = merge_streams({"h1:1": s1, "h1:2": s2})[-1]
+    t = newest["qos"]["tenants"]["acme"]
+    assert t["submits"] == 60 and t["wait_p99_s"] is None
+
+
+# --------------------------------------------------------- fleet health
+
+def test_fleet_health_ok_and_empty():
+    assert fleet_health({})["status"] == "unknown"
+    streams = {"h1:1": _stream(1), "h1:2": _stream(2)}
+    doc = fleet_health(streams)
+    assert doc["status"] == "ok" and doc["alerts"] == []
+    assert set(doc["procs"]) == {"h1:1", "h1:2"}
+    assert doc["procs"]["h1:1"]["status"] == "ok"
+    assert "fleet status: ok" in format_fleet(doc)
+
+
+def test_fleet_stall_member_stalls_while_peers_progress():
+    healthy = _stream(1, n=8)
+    sick = [_sample(2, i, stalls=(1 if i >= 5 else 0),
+                    depth=3, flush=2) for i in range(8)]
+    doc = fleet_health({"h1:1": healthy, "h1:2": sick})
+    names = {(a["name"], a.get("proc")) for a in doc["alerts"]}
+    assert ("fleet_stall", "h1:2") in names
+    assert doc["status"] == "alert"
+    # The member's own watchdog verdict also rides along (scope fleet:
+    # the merged series sees the stall counter climb too).
+    assert any(a["name"] == "stall" and a["scope"] == "fleet"
+               for a in doc["alerts"])
+
+
+def test_fleet_stall_quiet_member_with_undrained_work():
+    # A member that goes dark mid-run WITH work still queued is a
+    # fleet_stall; one that finished cleanly (drained to depth 0,
+    # series simply ends earlier) is not.
+    long = _stream(1, n=12)
+    dead = _stream(2, n=3, depth=4)     # vanishes at t≈1002, depth 4
+    done = _stream(3, n=3, depth=0)     # finished cleanly at t≈1002
+    doc = fleet_health({"h1:1": long, "h1:2": dead, "h1:3": done})
+    flagged = {a.get("proc") for a in doc["alerts"]
+               if a["name"] == "fleet_stall"}
+    assert flagged == {"h1:2"}
+
+
+def test_straggler_skew_wait_divergence():
+    fast1 = _stream(1, waits=[0.01] * 8)
+    fast2 = _stream(2, waits=[0.012] * 8)
+    slow = _stream(3, waits=[0.5] * 8)  # 40x the fleet median
+    doc = fleet_health({"h1:1": fast1, "h1:2": fast2, "h1:3": slow})
+    skews = [a for a in doc["alerts"] if a["name"] == "straggler_skew"]
+    assert skews and skews[0]["proc"] == "h1:3"
+    assert doc["status"] == "alert"
+
+
+def test_straggler_skew_burn_divergence():
+    ok1 = _stream(1)
+    ok2 = _stream(2)
+    burner = [_sample(3, i, submits=5 * (i + 1), misses=2 * i)
+              for i in range(6)]
+    doc = fleet_health({"h1:1": ok1, "h1:2": ok2, "h1:3": burner})
+    assert any(a["name"] == "straggler_skew" and a["proc"] == "h1:3"
+               for a in doc["alerts"])
+
+
+def test_quota_imbalance_warns_not_gates():
+    # One process carries ~all of the shared tenant's submits.
+    hog = _stream(1, submits=None)  # 5*(i+1): 30 by the end
+    idle = [_sample(2, i, submits=1) for i in range(6)]  # flat 1
+    doc = fleet_health({"h1:1": hog, "h1:2": idle})
+    imb = [a for a in doc["alerts"] if a["name"] == "quota_imbalance"]
+    assert imb and imb[0]["severity"] == "warn"
+    assert imb[0]["proc"] == "h1:1" and imb[0]["tenant"] == "acme"
+    # warn alone never gates.
+    assert doc["status"] == "warn"
+
+
+def test_fleet_health_on_fixtures_is_clean():
+    # The skewed-but-healthy fixture fleet: clock skew alone is not an
+    # incident.
+    doc = fleet_health(load_fleet(FIXDIR))
+    assert doc["status"] in ("ok", "warn")
+    assert not [a for a in doc["alerts"] if a["severity"] == "alert"]
+    assert doc["offsets"]["fixhost:102#1"] == pytest.approx(5.0)
+
+
+# ----------------------------------------------------------- Prometheus
+
+def test_prometheus_from_fleet_labels_and_aggregates():
+    streams = load_fleet(FIXDIR)
+    text = prometheus_from_fleet(streams)
+    lines = text.splitlines()
+    # Per-member rows carry proc/host labels.
+    assert any('proc="fixhost:102#1"' in ln and 'host="fixhost"' in ln
+               for ln in lines)
+    # Fleet aggregates.
+    assert "dfft_fleet_procs 3" in lines
+    assert any(ln.startswith("dfft_fleet_queue_depth ")
+               for ln in lines)
+    assert any(ln.startswith("dfft_fleet_tenant_submits_total")
+               and 'tenant="acme"' in ln for ln in lines)
+    off = [ln for ln in lines
+           if ln.startswith("dfft_fleet_clock_offset_seconds")]
+    assert any('proc="fixhost:102#1"' in ln and "5.0" in ln
+               for ln in off)
+    # One # TYPE per family across the whole document.
+    types = [ln.split()[2] for ln in lines if ln.startswith("# TYPE")]
+    assert len(types) == len(set(types))
+
+
+# ------------------------------------------------------------------ CLI
+
+def test_report_fleet_cli_text_json_gate(capsys):
+    rc = report.main(["fleet", "--dir", FIXDIR])
+    out = capsys.readouterr().out
+    assert rc == 0 and "fleet status:" in out
+    rc = report.main(["fleet", "--dir", FIXDIR, "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["schema"] == fleet.FLEET_SCHEMA
+    assert set(doc["procs"]) == {"fixhost:101#0", "fixhost:102#1",
+                                 "fixhost:103#2"}
+    rc = report.main(["fleet", "--dir", FIXDIR, "--prom"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "dfft_fleet_procs 3" in out
+    # Healthy fixtures gate 0.
+    assert report.main(["fleet", "--dir", FIXDIR, "--gate"]) == 0
+
+
+def test_report_fleet_cli_gates_on_stall(tmp_path, capsys):
+    healthy = _stream(1, n=8)
+    sick = [_sample(2, i, stalls=(1 if i >= 5 else 0), depth=3,
+                    flush=2) for i in range(8)]
+    for name, ss in (("monitor-h1-1.jsonl", healthy),
+                     ("monitor-h1-2.jsonl", sick)):
+        with open(tmp_path / name, "w") as f:
+            for s in ss:
+                f.write(json.dumps(s) + "\n")
+    rc = report.main(["fleet", "--dir", str(tmp_path), "--gate"])
+    out = capsys.readouterr().out
+    assert rc == 1 and "fleet_stall" in out
+
+
+def test_report_fleet_cli_errors(tmp_path, capsys, monkeypatch):
+    monkeypatch.delenv("DFFT_MONITOR_DIR", raising=False)
+    assert report.main(["fleet"]) == 2
+    assert "DFFT_MONITOR_DIR" in capsys.readouterr().err
+    assert report.main(["fleet", "--dir", str(tmp_path)]) == 2
+    assert "no monitor series" in capsys.readouterr().err
+    # The env default works.
+    monkeypatch.setenv("DFFT_MONITOR_DIR", FIXDIR)
+    assert report.main(["fleet"]) == 0
+
+
+# ----------------------------------------------- clock-aligned merge CLI
+
+def test_merge_files_align_start_and_offsets(tmp_path):
+    # Two per-process text logs with process-relative stamps: without
+    # alignment lane 1 appears to start 100s after lane 0.
+    a = tmp_path / "trace_0.log"
+    a.write_text("process 0\n0.000100 0.000050 t0_fft\n"
+                 "0.000200 0.000050 t2_exchange\n")
+    b = tmp_path / "trace_1.log"
+    b.write_text("process 1\n100.000100 0.000050 t0_fft\n"
+                 "100.000200 0.000050 t2_exchange\n")
+    raw = report.merge_files([str(a), str(b)])
+    spread = max(e["ts"] for e in raw) - min(e["ts"] for e in raw)
+    assert spread > 99e6  # microseconds: the unaligned gap
+    aligned = report.merge_files([str(a), str(b)], align="start")
+    assert max(e["ts"] for e in aligned) < 1e3  # sub-ms after re-origin
+    # Both lanes start at 0.
+    assert min(e["ts"] for e in aligned if e["pid"] == 0) == 0.0
+    assert min(e["ts"] for e in aligned if e["pid"] == 1) == 0.0
+    # Measured skew subtracts per lane (seconds -> µs).
+    corr = report.merge_files([str(a), str(b)], align="start",
+                              offsets_s={1: 5.0})
+    lane1 = [e["ts"] for e in corr if e["pid"] == 1]
+    assert min(lane1) == pytest.approx(-5e6)
+    with pytest.raises(ValueError, match="align"):
+        report.merge_files([str(a)], align="wall")
+
+
+def test_report_merge_cli_align_flags(tmp_path, capsys):
+    a = tmp_path / "trace_0.log"
+    a.write_text("process 0\n0.1 0.05 t0_fft\n")
+    b = tmp_path / "trace_1.log"
+    b.write_text("process 1\n900.1 0.05 t0_fft\n")
+    out_json = tmp_path / "merged.json"
+    rc = report.main(["merge", str(a), str(b), "--align", "start",
+                      "-o", str(out_json)])
+    assert rc == 0
+    capsys.readouterr()
+    doc = json.loads(out_json.read_text())
+    ts = [e["ts"] for e in doc["traceEvents"]]
+    assert max(ts) - min(ts) < 1e3  # aligned, not 900s apart
+
+
+def test_report_merge_cli_monitor_dir_offsets(tmp_path, capsys):
+    # Trace lanes are jax process indexes; the fixture streams carry
+    # process_index 0..2, stream 102 (index 1) +5s skewed — its lane
+    # must shift by -5s.
+    a = tmp_path / "trace_0.log"
+    a.write_text("process 0\n10.0 0.05 t0_fft\n")
+    b = tmp_path / "trace_1.log"
+    b.write_text("process 1\n10.0 0.05 t0_fft\n")
+    out_json = tmp_path / "merged.json"
+    rc = report.main(["merge", str(a), str(b), "--monitor-dir", FIXDIR,
+                      "-o", str(out_json)])
+    assert rc == 0
+    capsys.readouterr()
+    doc = json.loads(out_json.read_text())
+    lanes = {e["pid"]: e["ts"] for e in doc["traceEvents"]}
+    assert lanes[0] - lanes[1] == pytest.approx(5e6)  # µs

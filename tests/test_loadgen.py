@@ -1,0 +1,132 @@
+"""Mixed-traffic load generator (``python -m distributedfft_tpu
+.loadgen``): schedule determinism, spec parsing, the in-process worker
+driving a monitor-armed queue, and (slow-marked) the 2-process
+end-to-end run the CI fleet smoke mirrors.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from distributedfft_tpu import loadgen
+from distributedfft_tpu.fleet import load_fleet
+from distributedfft_tpu.loadgen import (
+    build_schedule,
+    parse_mix,
+    parse_shapes,
+)
+
+
+# ------------------------------------------------------------- schedule
+
+def _sched(**kw):
+    base = dict(seed=7, rank=0, duration_s=2.0, rate_hz=50.0,
+                mix=parse_mix("rt:3,bulk:1"),
+                shapes=parse_shapes("8x8x8,16x8x4"),
+                dtypes=["complex64"], ops=["fft", "ifft"])
+    base.update(kw)
+    return build_schedule(**base)
+
+
+def test_schedule_is_deterministic_per_seed_and_rank():
+    a = [e.astuple() for e in _sched()]
+    b = [e.astuple() for e in _sched()]
+    assert a == b and len(a) > 0
+    assert a != [e.astuple() for e in _sched(seed=8)]
+    assert a != [e.astuple() for e in _sched(rank=1)]
+
+
+def test_schedule_open_loop_poisson_shape():
+    evs = _sched(duration_s=4.0, rate_hz=100.0)
+    ts = [e.t for e in evs]
+    assert ts == sorted(ts) and 0.0 < ts[0] and ts[-1] < 4.0
+    # Poisson arrivals at 100/s over 4s: ~400 events, generous bounds.
+    assert 250 < len(evs) < 600
+    tenants = {e.tenant for e in evs}
+    assert tenants == {"rt", "bulk"}
+    # The 3:1 mix shows in the draw (loose bound).
+    n_rt = sum(1 for e in evs if e.tenant == "rt")
+    assert n_rt > len(evs) / 2
+    assert {e.shape for e in evs} == {(8, 8, 8), (16, 8, 4)}
+    assert {e.op for e in evs} <= {"fft", "ifft"}
+
+
+def test_schedule_degenerate_knobs():
+    assert _sched(rate_hz=0.0) == []
+    assert _sched(duration_s=0.0) == []
+
+
+def test_parse_mix_and_shapes():
+    assert parse_mix("rt:3,bulk:1") == [("rt", 3.0), ("bulk", 1.0)]
+    assert parse_mix("solo") == [("solo", 1.0)]
+    assert parse_mix("-") == [(None, 1.0)]  # anonymous lane
+    assert parse_mix("") == [(None, 1.0)]
+    with pytest.raises(ValueError, match="weight"):
+        parse_mix("rt:0")
+    assert parse_shapes("8x8x8, 16x8x4") == [(8, 8, 8), (16, 8, 4)]
+    with pytest.raises(ValueError):
+        parse_shapes("8x0x8")
+    with pytest.raises(ValueError):
+        parse_shapes("")
+
+
+# ------------------------------------------------------------- worker
+
+def test_worker_in_process_streams_series(tmp_path, monkeypatch):
+    """One worker run inline: drives a real queue on CPU, streams its
+    monitor series into the fleet dir, reports stats on stdout."""
+    monkeypatch.setenv("DFFT_MONITOR_DIR", str(tmp_path))
+    monkeypatch.setenv("DFFT_MONITOR", "0.05")
+    monkeypatch.setenv("DFFT_METRICS", "1")
+    monkeypatch.setenv(
+        "DFFT_QOS", "rt:class=realtime,weight=3,slo=5;bulk:class=batch")
+    monkeypatch.delenv("DFFT_FAULT_INJECT", raising=False)
+    rc = loadgen.main(["--worker", "--rank", "0", "--seed", "3",
+                       "--duration", "0.6", "--rate", "40"])
+    assert rc == 0
+    streams = load_fleet(str(tmp_path))
+    assert len(streams) == 1
+    samples = next(iter(streams.values()))
+    newest = samples[-1]
+    assert newest["pid"] == os.getpid()
+    tenants = newest["qos"]["tenants"]
+    assert set(tenants) == {"rt", "bulk"}
+    assert sum(t["submits"] for t in tenants.values()) > 0
+    # Healthy run: drained, no stalls.
+    assert newest["queue"]["stalls_total"] == 0
+    assert newest["queue"]["depth"] == 0
+
+
+@pytest.mark.slow
+def test_two_process_loadgen_and_fault_drill(tmp_path):
+    """The CI fleet smoke, as a test: healthy 2-process run gates 0; a
+    DFFT_FAULT_INJECT run wedges one worker and must gate 1."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DFFT_FAULT_INJECT", None)
+    ok_dir = tmp_path / "ok"
+    r = subprocess.run(
+        [sys.executable, "-m", "distributedfft_tpu.loadgen",
+         "--procs", "2", "--duration", "2", "--rate", "30",
+         "--dir", str(ok_dir), "--gate", "--json"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["status"] in ("ok", "warn") and len(doc["procs"]) == 2
+
+    bad_dir = tmp_path / "bad"
+    env_bad = dict(env,
+                   DFFT_FAULT_INJECT="execute:every=1,kind=deterministic")
+    r = subprocess.run(
+        [sys.executable, "-m", "distributedfft_tpu.loadgen",
+         "--procs", "2", "--duration", "2", "--rate", "30",
+         "--dir", str(bad_dir), "--gate", "--json"],
+        env=env_bad, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 1, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["status"] == "alert"
+    assert any(w.get("wedged") for w in doc["workers"])
+    assert any(a["name"] in ("stall", "fleet_stall")
+               for a in doc["alerts"])

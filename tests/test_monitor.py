@@ -34,6 +34,7 @@ load, measured overlap in explain records) lives in
 """
 
 import json
+import os
 import threading
 import time
 
@@ -95,6 +96,7 @@ def _wait_for(pred, timeout=10.0):
 
 def test_from_env_parsing(monkeypatch):
     monkeypatch.delenv("DFFT_MONITOR", raising=False)
+    monkeypatch.delenv("DFFT_MONITOR_DIR", raising=False)
     assert Monitor.from_env() is None
     monkeypatch.setenv("DFFT_MONITOR", "0")
     assert Monitor.from_env() is None
@@ -109,6 +111,33 @@ def test_from_env_parsing(monkeypatch):
     monkeypatch.setenv("DFFT_MONITOR", "fast,/tmp/x")
     with pytest.raises(ValueError, match="DFFT_MONITOR"):
         Monitor.from_env()
+
+
+def test_from_env_monitor_dir(monkeypatch, tmp_path):
+    """DFFT_MONITOR_DIR alone arms the fleet convention: per-process
+    series path under the shared dir, default sampling interval; an
+    explicit DFFT_MONITOR interval (or path / '0') composes with it."""
+    from distributedfft_tpu.fleet import series_path
+
+    monkeypatch.delenv("DFFT_MONITOR", raising=False)
+    monkeypatch.setenv("DFFT_MONITOR_DIR", str(tmp_path))
+    mon = Monitor.from_env()
+    assert mon is not None
+    assert mon.interval_s == monitor.DEFAULT_DIR_INTERVAL_S
+    assert mon.path == series_path(str(tmp_path))
+    assert os.path.basename(mon.path) == (
+        f"monitor-{monitor._HOST}-{os.getpid()}.jsonl")
+    # Interval from DFFT_MONITOR, path from the dir convention.
+    monkeypatch.setenv("DFFT_MONITOR", "0.05")
+    mon = Monitor.from_env()
+    assert mon.interval_s == 0.05
+    assert mon.path == series_path(str(tmp_path))
+    # An explicit path wins over the derived one.
+    monkeypatch.setenv("DFFT_MONITOR", "0.05,/tmp/explicit.jsonl")
+    assert Monitor.from_env().path == "/tmp/explicit.jsonl"
+    # Explicit off beats the dir.
+    monkeypatch.setenv("DFFT_MONITOR", "0")
+    assert Monitor.from_env() is None
 
 
 @pytest.mark.parametrize("bad", [0, -1.0, True, "1"])
@@ -163,12 +192,22 @@ def test_sample_document_shape(metrics_on):
     q.submit(jnp.asarray(_world(1)), tenant="acme")
     mon = Monitor(q)
     doc = mon.sample()
-    assert set(doc) == {"schema", "ts", "pid", "seq", "metrics",
-                        "queue", "qos"}
+    assert set(doc) == {"schema", "ts", "mono", "host", "pid",
+                        "process_index", "seq", "metrics", "queue",
+                        "qos"}
+    # Identity stamps (the fleet aggregator's join keys): host is this
+    # machine, pid this process, mono the monotonic twin of ts that
+    # clock-offset estimation anchors on.
+    assert doc["host"] == monitor._HOST and doc["pid"] == os.getpid()
+    assert isinstance(doc["mono"], float)
+    assert doc["process_index"] == jax.process_index()
     qb = doc["queue"]
     assert qb["kind"] == "c2c" and qb["depth"] == 1 and qb["groups"] == 1
     assert qb["oldest_pending_age_s"] >= 0.0 and qb["stalls_total"] == 0
     assert "acme" in doc["qos"]["tenants"]
+    # The sample's SLO ledger exports the wait-reservoir tail so fleet
+    # merges can compute true cross-process quantiles.
+    assert isinstance(doc["qos"]["tenants"]["acme"].get("waits"), list)
     # Queue-less monitor: both blocks are None, sampling still works.
     bare = Monitor().sample()
     assert bare["queue"] is None and bare["qos"] is None
@@ -176,9 +215,11 @@ def test_sample_document_shape(metrics_on):
 
 
 def test_disarmed_queue_is_byte_identical(monkeypatch):
-    """Acceptance pin: without DFFT_MONITOR the queue carries no
-    monitor and reproduces the exact PR 15 observable surface."""
+    """Acceptance pin: without DFFT_MONITOR (and without the fleet's
+    DFFT_MONITOR_DIR) the queue carries no monitor and reproduces the
+    exact PR 15 observable surface."""
     monkeypatch.delenv("DFFT_MONITOR", raising=False)
+    monkeypatch.delenv("DFFT_MONITOR_DIR", raising=False)
     assert not tr.tracing_enabled()
     m.enable_metrics(False)
     m.metrics_reset()
