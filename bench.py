@@ -281,7 +281,8 @@ def _plan_wire_kw(plan) -> dict:
 def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
           all_times, donated=False, stages=None, overlap=None, tuned=None,
           cost=None, batch=None, wire_dtype=None, transport=None,
-          precision=None, op=None, degraded=False, concurrent=None):
+          precision=None, op=None, degraded=False, concurrent=None,
+          scheduler=None, waves_per_s=None, occupancy=None):
     import jax
 
     from distributedfft_tpu.utils.metrics import metrics_snapshot
@@ -339,6 +340,20 @@ def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
         # sequential rows keep the old schema.
         out["concurrent"] = cc
         out["concurrent_transforms_per_s"] = round(total / seconds, 3)
+    if scheduler is not None:
+        # Wave-scheduler serving run (DFFT_BENCH_SERVE / bench.py
+        # --serve-streaming): requests driven through a CoalescingQueue
+        # in streaming (persistent drain loop) or discrete flush mode.
+        # The run-record store keys "scheduler" into the baseline config
+        # group — a streaming run must never share baselines with flush
+        # runs — and lifts waves_per_s into rates; the occupancy block
+        # (docs/OBSERVABILITY.md "Wave scheduler occupancy") makes the
+        # line self-describing about device idle between waves.
+        out["scheduler"] = scheduler
+        if waves_per_s is not None:
+            out["waves_per_s"] = round(waves_per_s, 3)
+        if occupancy is not None:
+            out["occupancy"] = occupancy
     if b > 1:
         # Batched multi-request run (DFFT_BENCH_BATCH): part of the
         # baseline group — a B=8 coalesced run must never be judged
@@ -672,6 +687,91 @@ def _worker_concurrent(shape_n, shape, mesh, dtype, n_dev, cc: int,
           **_plan_wire_kw(plan))
 
 
+def _worker_serving(shape_n, shape, mesh, dtype, n_dev, b: int | None,
+                    mode: str) -> None:
+    """The wave-scheduler serving measurement (``DFFT_BENCH_SERVE=
+    stream|flush``, or ``bench.py --serve-streaming``): N submits driven
+    through a :class:`..serving.CoalescingQueue` — ``stream`` through
+    the persistent drain loop (``serve()``/``stop()``), ``flush``
+    through the discrete path — with waves/s and the scheduler-occupancy
+    snapshot as the numbers under test. The result line stamps
+    ``scheduler`` so the run-record store keys streaming and flush runs
+    into different baselines, and ``waves_per_s`` lifts into the gated
+    rates."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    import distributedfft_tpu as dfft
+    from distributedfft_tpu import serving as _serving
+    from distributedfft_tpu.utils.timing import max_rel_err, sync
+
+    b = b or 4
+    raw_sub = os.environ.get("DFFT_BENCH_SERVE_SUBMITS", "").strip()
+    n_sub = int(raw_sub) if raw_sub else 4 * b
+    executor = os.environ.get("DFFT_BENCH_EXECUTORS", "xla").split(",")[0]
+    with _precision_env(executor.strip()) as base:
+        plan = dfft.plan_dft_c2c_3d(shape, mesh, direction=dfft.FORWARD,
+                                    dtype=dtype, executor=base)
+        iplan = dfft.plan_dft_c2c_3d(shape, mesh, direction=dfft.BACKWARD,
+                                     dtype=dtype, executor=base)
+
+        mk_kw = {}
+        if plan.in_sharding is not None:
+            mk_kw["out_shardings"] = plan.in_sharding
+
+        @functools.partial(jax.jit, **mk_kw, static_argnums=0)
+        def make_input(seed: int):
+            k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+            re = jax.random.normal(k1, shape, jnp.float32)
+            im = jax.random.normal(k2, shape, jnp.float32)
+            return (re + 1j * im).astype(dtype)
+
+        xs = [make_input(4242 + i) for i in range(n_sub)]
+        sync(xs)
+
+        def run_once() -> tuple[float, dict, list]:
+            q = _serving.CoalescingQueue(
+                mesh, kind="c2c", max_batch=b, executor=base,
+                concurrent_groups=2, streaming=(mode == "stream"))
+            if q._wave_stats is None:
+                # Flush mode without a live monitor: arm the occupancy
+                # recorder explicitly — the snapshot IS the measurement.
+                q._wave_stats = _serving._WaveStats(q.kind)
+            t0 = time.perf_counter()
+            handles = [q.submit(x) for x in xs]
+            if mode == "stream":
+                q.stop(drain=True)
+            else:
+                q.flush()
+            outs = [h.result() for h in handles]
+            sync(outs)
+            seconds = time.perf_counter() - t0
+            snap = q._wave_stats.snapshot()
+            q.close()
+            return seconds, snap, outs
+
+        run_once()  # warm: compiles land in the cache, stats discarded
+        total_s, snap, outs = run_once()
+        max_err = float(max_rel_err(iplan(outs[0]), xs[0]))
+        if not max_err < ERR_GATE:
+            raise AssertionError(
+                f"roundtrip error {max_err} exceeds {ERR_GATE}")
+    occupancy = {k: snap.get(k) for k in (
+        "width_mean", "idle_fraction", "idle_s", "busy_s",
+        "wave_duration_p50_s", "preemptions", "bumped_transforms")}
+    waves = snap.get("waves") or 0
+    _emit(shape_n, total_s / max(1, n_sub), max_err, base, n_dev,
+          plan.decomposition,
+          {f"{base}+serve-{mode}": round(total_s, 6)},
+          overlap=getattr(plan.options, "overlap_chunks", None),
+          cost=_plan_cost_block(plan),
+          scheduler="streaming" if mode == "stream" else "flush",
+          waves_per_s=(waves / total_s if total_s > 0 else 0.0),
+          occupancy=occupancy, **_plan_wire_kw(plan))
+
+
 def _worker(shape_n: int) -> None:
     """Measure and print result JSON lines (runs in a subprocess). A line
     is printed after EVERY improvement — the first candidate's number is
@@ -720,6 +820,16 @@ def _worker(shape_n: int) -> None:
     if op_env:
         return _worker_op(shape_n, shape, mesh, dtype, n_dev, op_env,
                           batch_b)
+
+    # Serving-scheduler mode: requests through a CoalescingQueue in
+    # streaming or discrete-flush mode (waves_per_s + occupancy are the
+    # numbers under test; composes with DFFT_BENCH_BATCH for the
+    # coalescing width).
+    serve_env = os.environ.get("DFFT_BENCH_SERVE", "").strip().lower()
+    if serve_env in ("stream", "streaming", "flush"):
+        return _worker_serving(
+            shape_n, shape, mesh, dtype, n_dev, batch_b,
+            "stream" if serve_env.startswith("stream") else "flush")
 
     # Concurrent-schedule mode: N independent transforms as ONE
     # interleaved program (concurrent_transforms_per_s is the number
@@ -1167,6 +1277,15 @@ def _orchestrate() -> dict | None:
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         _worker(int(sys.argv[2]))
+    elif len(sys.argv) > 1 and sys.argv[1] in ("--serve-streaming",
+                                               "--serve-flush"):
+        # Direct serving-scheduler measurement (no orchestrator): drive
+        # a CoalescingQueue in streaming or discrete-flush mode at the
+        # given extent (default 128 — the wave scheduler, not the FFT,
+        # is under test) and print the one result line.
+        os.environ["DFFT_BENCH_SERVE"] = (
+            "stream" if sys.argv[1] == "--serve-streaming" else "flush")
+        _worker(int(sys.argv[2]) if len(sys.argv) > 2 else 128)
     else:
         main()  # catches internally; the contract is JSON + rc 0
         sys.exit(0)

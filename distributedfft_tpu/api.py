@@ -878,7 +878,8 @@ def _build_brick_edges(m, in_boxes, out_boxes, in_world, out_world,
         y = from_canon(y)
         return out_reorder(y) if out_reorder is not None else y
 
-    return edge_in, edge_out, (in_bspec, out_bspec)
+    return (edge_in, edge_out, (in_bspec, out_bspec),
+            (in_reorder, to_canon, from_canon, out_reorder))
 
 
 def _check_brick_algorithm(algorithm: str) -> None:
@@ -938,16 +939,15 @@ def _wrap_brick_io_single(
     mesh): same ``[1, *pad]`` stack I/O convention as the distributed
     tier, so callers are decomposition-agnostic."""
     from .parallel.bricks import stack_pad_for
+    from .stagegraph import BrickEdgeGraph, compile_brick_io
 
     edge_in, edge_out = _single_brick_edges(
         in_boxes, out_boxes, inner.in_shape, inner.out_shape)
-    inner_fn = inner.fn
-
-    jit_kw: dict = {"donate_argnums": 0} if inner.options.donate else {}
-
-    @functools.partial(jax.jit, **jit_kw)
-    def fn(stack):
-        return edge_out(inner_fn(edge_in(stack)))
+    fn = compile_brick_io(
+        BrickEdgeGraph(edge_in=(None, edge_in), edge_out=(edge_out, None),
+                       donate=inner.options.donate,
+                       meta={"decomposition": inner.decomposition}),
+        inner.fn)
 
     return Plan3D(
         shape=inner.shape, direction=inner.direction, dtype=inner.dtype,
@@ -967,23 +967,28 @@ def _wrap_brick_io(
     inner: Plan3D, in_boxes: Sequence[Box3], out_boxes: Sequence[Box3]
 ) -> Plan3D:
     """Bracket a canonical-chain plan with the overlap-map ring reshapes
-    (shared by the c2c and r2c brick planners)."""
+    (shared by the c2c and r2c brick planners). The wrapper program is
+    declared as a :class:`..stagegraph.BrickEdgeGraph` and compiled by
+    :func:`..stagegraph.compile_brick_io` — the PR 18 migration of the
+    named IR remainder (byte-identical HLO, pinned)."""
     from .parallel.bricks import stack_pad_for
+    from .stagegraph import BrickEdgeGraph, compile_brick_io
 
     if inner.mesh is None or inner.in_sharding is None:
         return _wrap_brick_io_single(inner, in_boxes, out_boxes)
     m = inner.mesh
-    edge_in, edge_out, edges = _build_brick_edges(
+    _, _, edges, pieces = _build_brick_edges(
         m, in_boxes, out_boxes, inner.in_shape, inner.out_shape,
         inner.in_sharding.spec, inner.out_sharding.spec,
         inner.options.algorithm)
-    inner_fn = inner.fn
-
-    jit_kw: dict = {"donate_argnums": 0} if inner.options.donate else {}
-
-    @functools.partial(jax.jit, **jit_kw)
-    def fn(stack):
-        return edge_out(inner_fn(edge_in(stack)))
+    in_reorder, to_canon, from_canon, out_reorder = pieces
+    fn = compile_brick_io(
+        BrickEdgeGraph(edge_in=(in_reorder, to_canon),
+                       edge_out=(from_canon, out_reorder),
+                       donate=inner.options.donate, specs=edges,
+                       meta={"decomposition": inner.decomposition,
+                             "algorithm": inner.options.algorithm}),
+        inner.fn)
 
     p = len(in_boxes)
     names = tuple(m.axis_names)
@@ -1468,7 +1473,7 @@ def _dd_brick_wrap(inner: DDPlan3D, in_world, out_world, in_boxes,
             fn=fn1, in_sharding=None, out_sharding=None,
         )
     m = inner.mesh
-    edge_in, edge_out, _ = _build_brick_edges(
+    edge_in, edge_out, _, _ = _build_brick_edges(
         m, in_boxes, out_boxes, in_world, out_world,
         inner.in_sharding.spec, inner.out_sharding.spec, algorithm)
     inner_fn = inner.fn

@@ -278,6 +278,41 @@ def _merge_tenants(docs: list[dict | None]) -> dict | None:
     return {"schema": 1, "tenants": tenants}
 
 
+def _merge_waves(docs: list[dict]) -> dict:
+    """Sum per-process wave-scheduler occupancy blocks (the queue
+    block's ``waves`` snapshot, schema 3) into one fleet block. The
+    counters and busy/idle second pools sum; ``idle_fraction`` is
+    re-derived from the POOLED seconds (fractions do not average — a
+    process that ran one wave must not weigh as much as one that ran a
+    thousand), and ``width_mean`` is re-weighted by each member's wave
+    count for the same reason."""
+    out: dict = {"waves": 0, "preemptions": 0, "bumped_groups": 0,
+                 "bumped_transforms": 0, "idle_s": 0.0, "busy_s": 0.0}
+    wsum = 0.0
+    dur_max = None
+    for d in docs:
+        for fld in ("waves", "preemptions", "bumped_groups",
+                    "bumped_transforms"):
+            v = d.get(fld)
+            if isinstance(v, (int, float)):
+                out[fld] += v
+        for fld in ("idle_s", "busy_s"):
+            v = d.get(fld)
+            if isinstance(v, (int, float)):
+                out[fld] += float(v)
+        wm, n = d.get("width_mean"), d.get("waves")
+        if isinstance(wm, (int, float)) and isinstance(n, (int, float)):
+            wsum += wm * n
+        dm = d.get("wave_duration_max_s")
+        if isinstance(dm, (int, float)):
+            dur_max = dm if dur_max is None else max(dur_max, dm)
+    total = out["idle_s"] + out["busy_s"]
+    out["idle_fraction"] = (out["idle_s"] / total) if total > 0 else None
+    out["width_mean"] = (wsum / out["waves"]) if out["waves"] else None
+    out["wave_duration_max_s"] = dur_max
+    return out
+
+
 def _proc_share(sample: dict) -> dict:
     """One process's contribution row for a fleet sample's ``per_proc``
     block."""
@@ -374,6 +409,12 @@ def merge_streams(
                 "stalls_total": sum(q.get("stalls_total", 0)
                                     for q in queues),
             }
+            wave_docs = [q["waves"] for q in queues
+                         if isinstance(q.get("waves"), dict)]
+            if wave_docs:
+                fleet_queue["waves"] = _merge_waves(wave_docs)
+                fleet_queue["streaming"] = any(
+                    q.get("streaming") for q in queues)
         doc = {
             "schema": 2,
             "fleet": True,
@@ -510,6 +551,9 @@ def fleet_health(
             "stalls": _stream_stall_delta(samples, fast_window_s),
             "burn_fast": _stream_burn(samples, fast_window_s),
             "wait_p99_s": _stream_wait_p99(samples),
+            "wave_idle_fraction": (
+                ((samples[-1].get("queue") or {}).get("waves") or {})
+                .get("idle_fraction")),
             "progressed": _stream_progressed(samples, fast_window_s),
             "alerts": v.get("alerts") or [],
         }
@@ -631,6 +675,19 @@ def prometheus_from_fleet(
         rows.append(("dfft_fleet_queue_stalls_total", "counter",
                      f"dfft_fleet_queue_stalls_total "
                      f"{qb.get('stalls_total', 0):g}"))
+        wv = qb.get("waves") or {}
+        if wv:
+            rows.append(("dfft_fleet_waves_total", "counter",
+                         f"dfft_fleet_waves_total "
+                         f"{wv.get('waves', 0):g}"))
+            rows.append(("dfft_fleet_wave_preemptions_total", "counter",
+                         f"dfft_fleet_wave_preemptions_total "
+                         f"{wv.get('preemptions', 0):g}"))
+            frac = wv.get("idle_fraction")
+            if isinstance(frac, (int, float)):
+                rows.append(("dfft_fleet_wave_idle_fraction", "gauge",
+                             f"dfft_fleet_wave_idle_fraction "
+                             f"{frac:.6f}"))
         for tname, t in sorted(
                 ((newest.get("qos") or {}).get("tenants") or {}).items()):
             for fld, pname in (
@@ -664,9 +721,10 @@ def format_fleet(doc: dict) -> str:
         wid = max(len("proc"), max(len(s) for s in procs))
         lines.append(f"{'proc':<{wid}}  {'status':<7} {'samples':>7}  "
                      f"{'offset_s':>9}  {'burn':>6}  {'p99_s':>9}  "
-                     f"{'stalls':>6}  progressed")
+                     f"{'stalls':>6}  {'idle':>5}  progressed")
         for sid, p in sorted(procs.items()):
             p99 = p.get("wait_p99_s")
+            idle = p.get("wave_idle_fraction")
             lines.append(
                 f"{sid:<{wid}}  {str(p.get('status')):<7} "
                 f"{p.get('samples', 0):>7d}  "
@@ -674,6 +732,7 @@ def format_fleet(doc: dict) -> str:
                 f"{p.get('burn_fast', 0.0):>6.0%}  "
                 f"{('-' if p99 is None else f'{p99:.6f}'):>9}  "
                 f"{p.get('stalls', 0):>6g}  "
+                f"{('-' if idle is None else f'{idle:.0%}'):>5}  "
                 f"{'yes' if p.get('progressed') else 'no'}")
     alerts = doc.get("alerts") or []
     if not alerts:

@@ -23,6 +23,11 @@ gate (docs/OBSERVABILITY.md "Fleet view & load generation"):
    ``flush()`` cadence (``--flush-every``) — arrival rate is the
    independent variable, so backpressure shows up in the monitor series
    (depth, waits, sheds) instead of silently slowing the generator.
+   ``--streaming`` swaps the cadence for the persistent wave drain loop
+   (``serve()``/``stop()``; docs/SERVING_QOS.md "Streaming scheduler"):
+   the loop owns dispatch, each worker's stats line carries its wave
+   count/preemptions/idle fraction, and the monitor series record the
+   schema-3 ``waves`` occupancy block the fleet gate aggregates.
 
 3. **Fault drill** — ``DFFT_FAULT_INJECT`` in the parent environment is
    forwarded to exactly one worker (``--fault-rank``, default 0) and
@@ -193,7 +198,8 @@ def _run_worker(ns: argparse.Namespace) -> int:
     queue = CoalescingQueue(
         max_batch=ns.max_batch,
         max_wait_s=ns.max_wait if ns.max_wait and ns.max_wait > 0
-        else None)
+        else None,
+        streaming=bool(ns.streaming))
     has_policy = queue.policy is not None
 
     # One buffer per (shape, dtype) — the generator measures the
@@ -214,7 +220,8 @@ def _run_worker(ns: argparse.Namespace) -> int:
         return bufs[key]
 
     stats = {"rank": ns.rank, "pid": os.getpid(), "submitted": 0,
-             "shed": 0, "flushed": 0, "wedged": False}
+             "shed": 0, "flushed": 0, "wedged": False,
+             "mode": "streaming" if ns.streaming else "flush"}
     wedged = False
     start = time.monotonic()
     next_flush = ns.flush_every
@@ -223,7 +230,11 @@ def _run_worker(ns: argparse.Namespace) -> int:
         if ev.t > now:
             time.sleep(ev.t - now)
             now = ev.t
-        while not wedged and now >= next_flush:
+        # Streaming mode: the persistent drain loop owns dispatch —
+        # the explicit flush cadence stays off (an injected fault
+        # fails that wave's handles but never wedges the loop, so the
+        # wedge drill below is a flush-mode shape by construction).
+        while not ns.streaming and not wedged and now >= next_flush:
             next_flush += ns.flush_every
             try:
                 stats["flushed"] += queue.flush(reason="manual")
@@ -251,6 +262,18 @@ def _run_worker(ns: argparse.Namespace) -> int:
         m = queue._monitor
         if m is not None:
             m.stop()  # final sample; close() would flush (and raise)
+    elif ns.streaming:
+        # Drain the in-flight waves through the loop, then snapshot the
+        # scheduler occupancy into the worker stats line before close()
+        # tears the recorder down.
+        queue.stop(drain=True)
+        ws = queue._wave_stats
+        if ws is not None:
+            snap = ws.snapshot()
+            stats["waves"] = snap.get("waves", 0)
+            stats["preemptions"] = snap.get("preemptions", 0)
+            stats["idle_fraction"] = snap.get("idle_fraction")
+        queue.close()
     else:
         try:
             stats["flushed"] += queue.flush(reason="manual")
@@ -289,6 +312,8 @@ def _spawn(ns: argparse.Namespace, rank: int, dir_: str):
             ("--flush-every", ns.flush_every),
             ("--linger", ns.linger)):
         argv.extend([flag, str(val)])
+    if ns.streaming:
+        argv.append("--streaming")
     return subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
                             stderr=subprocess.DEVNULL, text=True)
 
@@ -320,6 +345,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="dtype mix (default complex64)")
     ap.add_argument("--ops", default="fft,ifft",
                     help="op mix: fft|ifft (default both)")
+    ap.add_argument("--streaming", action="store_true",
+                    help="drive the workers through the persistent "
+                         "streaming drain loop (serve()/stop(); "
+                         "docs/SERVING_QOS.md 'Streaming scheduler') "
+                         "instead of the explicit flush cadence")
     ap.add_argument("--max-batch", type=int, default=8,
                     help="queue max_batch (default 8)")
     ap.add_argument("--max-wait", type=float, default=0.0,
@@ -384,6 +414,9 @@ def main(argv: list[str] | None = None) -> int:
                   f"{w.get('submitted', 0)} submitted, "
                   f"{w.get('shed', 0)} shed, "
                   f"{w.get('flushed', 0)} flushed"
+                  + (f", {w['waves']} waves"
+                     f" ({w.get('preemptions', 0)} preempted)"
+                     if w.get("waves") is not None else "")
                   + (" [WEDGED]" if w.get("wedged") else ""))
         print(format_fleet(doc))
     if ns.gate:

@@ -95,9 +95,14 @@ __all__ = [
 #: v2 added the fleet identity fields (``host``/``process_index``), the
 #: monotonic clock stamp (``mono`` — the fleet aggregator's clock-offset
 #: anchor), and the per-tenant wait-reservoir tail inside the qos block
-#: (:meth:`..qos.QosPolicy.slo_report` ``include_waits``). v1 samples
-#: still load and merge (the added fields are simply absent).
-MONITOR_SCHEMA = 2
+#: (:meth:`..qos.QosPolicy.slo_report` ``include_waits``). v3 (PR 18)
+#: added the ``waves`` block inside the queue reading — the streaming
+#: scheduler's occupancy document (wave count/width, admit-to-dispatch
+#: latency per class, inter-wave device-idle fraction, preemption
+#: counts; ``CoalescingQueue._wave_stats.snapshot()``), present on
+#: streaming or monitor-armed queues. Older samples still load and
+#: merge (the added fields are simply absent).
+MONITOR_SCHEMA = 3
 #: Health-verdict format version (stamped into every health block).
 HEALTH_SCHEMA = 1
 
@@ -334,6 +339,14 @@ class Monitor:
         }
         if stalled:
             out["stalled"] = stalled
+        ws = getattr(q, "_wave_stats", None)
+        if ws is not None:
+            # Scheduler occupancy (schema v3): the wave-level document
+            # `report live`/`report fleet` render and the streaming
+            # acceptance gate (idle fraction, realtime admit latency)
+            # judges.
+            out["waves"] = ws.snapshot()
+        out["streaming"] = bool(getattr(q, "_streaming", False))
         return out
 
     def sample(self) -> dict:
@@ -678,6 +691,34 @@ def _prom_rows(sample: dict, extra: dict | None = None) -> list[tuple]:
                  "stalls_total", 0)):
             rows.append((pname, ptype,
                          f"{pname}{lab('', kind)} {qb.get(fld, dflt):g}"))
+
+    waves = (qb or {}).get("waves")
+    if waves:
+        kind = {"kind": (qb or {}).get("kind", "")}
+        for pname, ptype, fld in (
+                ("dfft_waves_total", "counter", "waves"),
+                ("dfft_wave_preemptions_total", "counter", "preemptions"),
+                ("dfft_wave_bumped_transforms_total", "counter",
+                 "bumped_transforms"),
+                ("dfft_wave_idle_seconds_total", "counter", "idle_s"),
+                ("dfft_wave_busy_seconds_total", "counter", "busy_s"),
+                ("dfft_wave_idle_fraction", "gauge", "idle_fraction"),
+                ("dfft_wave_width_mean", "gauge", "width_mean"),
+                ("dfft_wave_duration_seconds_max", "gauge",
+                 "wave_duration_max_s")):
+            v = waves.get(fld)
+            if isinstance(v, (int, float)):
+                rows.append((pname, ptype,
+                             f"{pname}{lab('', kind)} {v:g}"))
+        for klass, a in sorted((waves.get("admit_wait") or {}).items()):
+            for q, fld in (("0.5", "p50_s"), ("0.99", "p99_s")):
+                v = a.get(fld)
+                if isinstance(v, (int, float)):
+                    rows.append((
+                        "dfft_wave_admit_seconds", "summary",
+                        f"dfft_wave_admit_seconds"
+                        f"{lab('', {'class': klass, 'quantile': q})}"
+                        f" {v:g}"))
 
     tenants = ((sample.get("qos") or {}).get("tenants") or {})
     if tenants:

@@ -462,6 +462,64 @@ class QosPolicy:
             chunks.append(cur)
         return chunks
 
+    def preempt_wave(self, infos, width: int):
+        """Wave admission with realtime preemption — the streaming
+        scheduler's admission point (docs/SERVING_QOS.md, "Streaming
+        scheduler & wave preemption"). ``infos`` is the full pending
+        sequence in drain order (:meth:`order_groups` output, dicts with
+        at least ``tenant``/``n``), ``width`` the next wave's capacity.
+        Returns ``(admit, bumped, charges)``:
+
+        - ``admit`` — the groups the next wave dispatches (at most
+          ``width``, relative order preserved), with EVERY realtime
+          group guaranteed a slot ahead of lower classes: a realtime
+          arrival never waits out a saturated wave.
+        - ``bumped`` — the would-have-dispatched lower-class groups a
+          realtime group displaced. They are re-queued, never dropped:
+          the caller leaves them pending with formation stamps intact,
+          so they sit at the front of the next drain order and their
+          starvation clocks keep running.
+        - ``charges`` — ``{tenant: transforms}`` already deducted (via
+          :meth:`charge`) from the preempting realtime tenants: each
+          bumped transform is recovery-shaped work paid by whoever
+          demanded the slot, the same even-recovery-work-charges
+          discipline retries follow.
+
+        Without a realtime group past the cutoff this is plain
+        truncation: ``(infos[:width], [], {})``.
+        """
+        infos = list(infos)
+        width = max(1, int(width))
+        with self._lock:
+            ranks = {id(i): self._tenants.get(
+                i["tenant"], Tenant(i["tenant"] or "default")).rank
+                for i in infos}
+        window = infos[:width]
+        window_ids = {id(i) for i in window}
+        rt = [i for i in infos if ranks[id(i)] == 0]
+        jumpers = [i for i in rt if id(i) not in window_ids]
+        if not jumpers:
+            return window, [], {}
+        others = [i for i in infos if ranks[id(i)] != 0]
+        admit = (rt + others)[:width]
+        # Preserve drain order within the admitted set: realtime first
+        # is a guarantee of ADMISSION, not of schedule position —
+        # concurrent_chunks/order already put higher classes first.
+        admit_ids = {id(i) for i in admit}
+        admit = [i for i in infos if id(i) in admit_ids]
+        bumped = [i for i in window if id(i) not in admit_ids]
+        charges: dict[str, int] = {}
+        for k, b in enumerate(bumped):
+            t = jumpers[k % len(jumpers)]["tenant"]
+            charges[t] = charges.get(t, 0) + int(b.get("n", 1))
+        for t, n in charges.items():
+            self.charge(t, n)
+        with self._lock:
+            for t, n in charges.items():
+                e = self._entry(t or "default")
+                e["preemptions"] = e.get("preemptions", 0) + n
+        return admit, bumped, charges
+
     # ------------------------------------------------------ SLO ledger
 
     def note_wait(self, name: str | None, seconds: float) -> None:
@@ -508,6 +566,7 @@ class QosPolicy:
                     "transforms": e.get("transforms", 0),
                     "quota_shed": e.get("quota_shed", 0),
                     "deadline_misses": e.get("deadline_misses", 0),
+                    "preemptions": e.get("preemptions", 0),
                     "wait_p50_s": _quantile(waits, 0.50),
                     "wait_p99_s": _quantile(waits, 0.99),
                     "slo_wait_s": t.slo_wait_s,

@@ -89,7 +89,7 @@ AUX_COST_METRICS = ("peak_hbm_bytes", "compile_seconds")
 #: keyed into the record config (``op``), so operator runs never share
 #: baselines with bare transforms.
 AUX_RATE_METRICS = ("transforms_per_s", "solves_per_s",
-                    "concurrent_transforms_per_s")
+                    "concurrent_transforms_per_s", "waves_per_s")
 
 _MAD_SCALE = 1.4826       # MAD -> sigma under a normal noise model
 
@@ -288,10 +288,17 @@ def normalize_bench_line(
     # pays DCN hops a single-process run never sees, so single- and
     # multi-process runs must never share a compare baseline;
     # single-process rows keep the old schema and groups.
+    # "scheduler" is the serving dispatch mode (DFFT_BENCH_SERVE /
+    # bench.py --serve-streaming): a streaming run keeps a rolling wave
+    # program in flight (admission overlaps the previous wave's drain)
+    # while a flush run pays a full barrier per dispatch — different
+    # latency/occupancy regimes by construction — so streaming and
+    # flush rows form their own baseline groups and waves_per_s never
+    # compares across modes; non-serving rows keep the old schema.
     for k in ("dtype", "devices", "decomposition", "overlap", "tuned",
               "batch", "profile", "wire_dtype", "transport", "op",
               "degraded", "precision", "concurrent", "tenant_class",
-              "procs", "topology"):
+              "procs", "topology", "scheduler"):
         if obj.get(k) is not None:
             config[k] = obj[k]
     ex: dict = {}

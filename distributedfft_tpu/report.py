@@ -1332,6 +1332,25 @@ def _main_live(argv: list[str]) -> int:
                 f"groups={qb.get('groups')} "
                 f"oldest_age={qb.get('oldest_pending_age_s', 0.0):.3f}s "
                 f"stalls={qb.get('stalls_total', 0)}")
+        wv = qb.get("waves") or {}
+        if wv:
+            # Scheduler-occupancy line (schema-3 samples; docs/
+            # OBSERVABILITY.md "Wave scheduler occupancy").
+            mode = "streaming" if qb.get("streaming") else "flush"
+            idle = wv.get("idle_fraction")
+            wm = wv.get("width_mean")
+            lines.append(
+                f"waves[{mode}]: n={wv.get('waves', 0)} "
+                f"width_mean={'-' if wm is None else f'{wm:.2f}'} "
+                f"idle={'-' if idle is None else f'{idle:.0%}'} "
+                f"preempt={wv.get('preemptions', 0)} "
+                f"bumped={wv.get('bumped_transforms', 0)}")
+            for klass, aw in sorted((wv.get("admit_wait") or {}).items()):
+                p99 = aw.get("p99_s")
+                lines.append(
+                    f"  admit[{klass}]: n={aw.get('n', 0)} "
+                    f"p50={aw.get('p50_s', 0.0):.6f}s "
+                    f"p99={'-' if p99 is None else f'{p99:.6f}'}s")
         tenants = ((newest.get("qos") or {}).get("tenants") or {})
         for name, t in sorted(tenants.items()):
             slo = ("-" if t.get("slo_ok") is None

@@ -95,6 +95,7 @@ from __future__ import annotations
 
 import itertools
 import os
+import queue as _queuelib
 import sys
 import threading
 import time
@@ -283,6 +284,177 @@ class _Req:
         self.tenant = tenant
 
 
+def _quantile(sorted_vals: list, q: float) -> float | None:
+    """Nearest-rank quantile over an already-sorted sample list."""
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, max(0, int(q * len(sorted_vals))))
+    return float(sorted_vals[i])
+
+
+class _WaveStats:
+    """Wave-level scheduler occupancy accounting (docs/OBSERVABILITY.md
+    "Scheduler occupancy"): dispatched waves and their widths, per-class
+    admit-to-dispatch latency, inter-wave device idle vs busy time, and
+    preemption counts. One *wave* is one dispatch cohort — a streaming
+    loop iteration's admitted set, or (baseline mode) one ``flush()``'s
+    drained set, so the streaming-vs-flush idle comparison the PR 18
+    acceptance gate makes is like-for-like.
+
+    Armed by the streaming drain loop (:meth:`CoalescingQueue.serve`)
+    and by monitor-armed queues in flush mode (the baseline); a queue
+    with neither carries ``None`` and no hot path takes a hook.
+
+    Completion stamps come from a dedicated daemon *stamper* thread
+    that ``block_until_ready``'s each wave's output arrays in dispatch
+    order — the dispatch path never blocks on the device. Inter-wave
+    idle is the gap between one wave's drain and the next wave's
+    dispatch while nothing else was in flight: exactly the device gap
+    the streaming scheduler exists to close. With waves in flight
+    back-to-back (dispatch k+1 before drain k) no idle accrues."""
+
+    _RESERVOIR = 2048
+
+    def __init__(self, kind: str = "c2c"):
+        self.kind = kind
+        self._lock = threading.Lock()
+        self.waves = 0
+        self.preemptions = 0       # preemption events (waves that bumped)
+        self.bumped_groups = 0
+        self.bumped_transforms = 0
+        self.idle_s = 0.0
+        self.busy_s = 0.0
+        self._widths: list[float] = []
+        self._durations: list[float] = []    # dispatch -> drain, seconds
+        self._periods: list[float] = []      # dispatch -> next dispatch
+        self._admit: dict[str, list[float]] = {}  # class -> waits
+        self._last_dispatch: float | None = None
+        self._q: _queuelib.Queue = _queuelib.Queue()
+        self._thread: threading.Thread | None = None
+
+    def _push(self, vals: list, v: float) -> None:
+        # Caller holds the lock. Bounded reservoir: drop the oldest half
+        # once full (recent waves are what occupancy questions are
+        # about).
+        if len(vals) >= self._RESERVOIR:
+            del vals[:self._RESERVOIR // 2]
+        vals.append(float(v))
+
+    def note_wave(self, *, width: int, t_dispatch: float, outputs,
+                  waits=()) -> None:
+        """Record one dispatched wave. ``outputs`` are the wave's async
+        output arrays (handed to the stamper thread for the drain
+        stamp); ``waits`` is ``[(class, admit_to_dispatch_s), ...]``,
+        one entry per request the wave admitted."""
+        with self._lock:
+            self.waves += 1
+            self._push(self._widths, float(width))
+            if self._last_dispatch is not None:
+                self._push(self._periods,
+                           max(0.0, t_dispatch - self._last_dispatch))
+            self._last_dispatch = t_dispatch
+            for klass, w in waits:
+                self._push(self._admit.setdefault(klass or "none", []), w)
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._stamper, name="dfft-wave-stamper",
+                    daemon=True)
+                self._thread.start()
+        if _metrics._enabled:
+            _metrics.inc("serving_waves", kind=self.kind)
+            _metrics.observe("serving_wave_width", float(width),
+                             kind=self.kind)
+            for klass, w in waits:
+                _metrics.observe("serving_wave_admit_seconds", w,
+                                 kind=self.kind,
+                                 tenant_class=klass or "none")
+        self._q.put((t_dispatch, outputs))
+
+    def note_preemption(self, groups: int, transforms: int) -> None:
+        """Record one wave-admission preemption event: ``groups`` bumped
+        groups totalling ``transforms`` transforms."""
+        with self._lock:
+            self.preemptions += 1
+            self.bumped_groups += int(groups)
+            self.bumped_transforms += int(transforms)
+        if _metrics._enabled:
+            _metrics.inc("serving_wave_preemptions", kind=self.kind)
+            _metrics.inc("serving_wave_bumped", float(transforms),
+                         kind=self.kind)
+
+    def _stamper(self) -> None:
+        last_drain: float | None = None
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            t_dispatch, outputs = item
+            try:
+                jax.block_until_ready(outputs)
+            except Exception:  # noqa: BLE001 — a failed wave still
+                pass           # closes its accounting interval
+            t_drain = time.perf_counter()
+            idle = busy = 0.0
+            if last_drain is None or t_dispatch > last_drain:
+                if last_drain is not None:
+                    idle = t_dispatch - last_drain
+                busy = max(0.0, t_drain - t_dispatch)
+            else:
+                busy = max(0.0, t_drain - last_drain)
+            last_drain = max(t_drain, last_drain or t_drain)
+            with self._lock:
+                self.idle_s += idle
+                self.busy_s += busy
+                self._push(self._durations, max(0.0, t_drain - t_dispatch))
+            if _metrics._enabled:
+                if idle > 0:
+                    _metrics.inc("serving_wave_idle_seconds", idle,
+                                 kind=self.kind)
+                if busy > 0:
+                    _metrics.inc("serving_wave_busy_seconds", busy,
+                                 kind=self.kind)
+
+    def stop(self) -> None:
+        """Let the stamper thread exit once the queue drains (daemon —
+        safe to skip; a later :meth:`note_wave` restarts it)."""
+        self._q.put(None)
+
+    def snapshot(self) -> dict:
+        """One JSON-ready occupancy document (the monitor's ``waves``
+        sample block, schema v3)."""
+        with self._lock:
+            widths = sorted(self._widths)
+            durs = sorted(self._durations)
+            periods = sorted(self._periods)
+            total = self.idle_s + self.busy_s
+            admit = {}
+            for klass, vals in self._admit.items():
+                s = sorted(vals)
+                admit[klass] = {
+                    "n": len(s),
+                    "p50_s": _quantile(s, 0.50),
+                    "p99_s": _quantile(s, 0.99),
+                    "max_s": s[-1] if s else None,
+                }
+            return {
+                "waves": self.waves,
+                "preemptions": self.preemptions,
+                "bumped_groups": self.bumped_groups,
+                "bumped_transforms": self.bumped_transforms,
+                "width_mean": (sum(widths) / len(widths)
+                               if widths else None),
+                "width_max": widths[-1] if widths else None,
+                "wave_duration_p50_s": _quantile(durs, 0.50),
+                "wave_duration_max_s": durs[-1] if durs else None,
+                "wave_period_p50_s": _quantile(periods, 0.50),
+                "idle_s": self.idle_s,
+                "busy_s": self.busy_s,
+                "idle_fraction": (self.idle_s / total
+                                  if total > 0 else None),
+                "admit_wait": admit,
+            }
+
+
 def _env_int(name: str) -> int | None:
     raw = os.environ.get(name, "").strip()
     if not raw:
@@ -411,10 +583,14 @@ class CoalescingQueue:
         fallback_executor: str | None = None,
         concurrent_groups: int | str | None = None,
         policy: "QosPolicy | str | None" = None,
+        streaming: bool | None = None,
         **plan_kw,
     ):
         if kind not in ("c2c", "r2c"):
             raise ValueError(f"kind must be c2c|r2c, got {kind!r}")
+        if streaming is None:
+            streaming = os.environ.get(
+                "DFFT_SERVE_STREAMING", "").strip() not in ("", "0")
         if concurrent_groups is None:
             raw = os.environ.get("DFFT_CONCURRENT_GROUPS", "").strip()
             concurrent_groups = ("auto" if raw == "auto"
@@ -515,6 +691,19 @@ class CoalescingQueue:
         # OBSERVABILITY.md "Fleet view & load generation"). With both
         # unset the queue carries no monitor and takes no hook anywhere.
         self._monitor = None
+        # Streaming drain-loop state (docs/SERVING_QOS.md "Streaming
+        # scheduler & wave preemption"): serve()/stop() manage the
+        # persistent loop; _arrival wakes it (set by submit only while
+        # streaming is armed — the disarmed submit path is one flag
+        # check away from byte-identical); _wave_stats carries the
+        # occupancy accounting (also armed, flush-mode, on monitored
+        # queues so the idle-fraction baseline exists).
+        self._streaming = False
+        self._serve_thread: threading.Thread | None = None
+        self._serve_stop = threading.Event()
+        self._drain_on_stop = True
+        self._arrival = threading.Event()
+        self._wave_stats: _WaveStats | None = None
         if (os.environ.get("DFFT_MONITOR", "").strip() not in ("", "0")
                 or os.environ.get("DFFT_MONITOR_DIR", "").strip()):
             from .monitor import Monitor
@@ -522,6 +711,10 @@ class CoalescingQueue:
             self._monitor = Monitor.from_env(self)
             if self._monitor is not None:
                 self._monitor.start()
+        if self._monitor is not None:
+            self._wave_stats = _WaveStats(self.kind)
+        if streaming:
+            self.serve()
 
     # ------------------------------------------------------------ intake
 
@@ -668,6 +861,12 @@ class CoalescingQueue:
                     self._formed[key] = (next(self._order),
                                          time.perf_counter())
                 req = _Req(x, handle, scale, tenant=tname)
+                if self._streaming and handle._enqueued is None:
+                    # The wave scheduler's admit-to-dispatch latency
+                    # (and its realtime-SLO acceptance gate) needs the
+                    # enqueue stamp even with the recorder off — the
+                    # deadline-timer precedent.
+                    handle._enqueued = time.perf_counter()
                 if pol is not None and handle._enqueued is None:
                     # The QoS ledger's wait/starvation clocks need the
                     # enqueue stamp even with the recorder off (the
@@ -686,6 +885,13 @@ class CoalescingQueue:
                     t.start()
                 group.append(req)
                 full = len(group) >= self.max_batch
+                if self._streaming:
+                    # The drain loop owns ALL dispatch while streaming:
+                    # wake it instead of auto-flushing from the submit
+                    # thread (a full group is simply ripe for the next
+                    # wave; _next_wave splits it at max_batch).
+                    full = False
+                    self._arrival.set()
                 if self.max_wait_s is not None:
                     # The deadline clock runs even with the recorder
                     # off: the timer callback judges the group's oldest
@@ -881,7 +1087,8 @@ class CoalescingQueue:
         done = 0
         recording = tracing_enabled() or _metrics._enabled
         flushed_at = (time.perf_counter()
-                      if recording or self.policy is not None else 0.0)
+                      if recording or self.policy is not None
+                      or self._wave_stats is not None else 0.0)
         with self._lock:
             keys = ([key] if key is not None
                     else self._drain_order(flushed_at))
@@ -922,6 +1129,17 @@ class CoalescingQueue:
                 for k, group in groups:
                     done += self._execute_group(k, group, reason=reason,
                                                 flushed_at=flushed_at)
+            ws = self._wave_stats
+            if ws is not None and groups:
+                # Baseline occupancy: one flush cohort = one wave, so
+                # the monitor's idle-fraction comparison against the
+                # streaming loop is like-for-like.
+                outs = [r.handle._value for _, g in groups for r in g
+                        if r.handle._event.is_set()
+                        and r.handle._error is None]
+                ws.note_wave(width=len(groups), t_dispatch=flushed_at,
+                             outputs=outs,
+                             waits=self._admit_waits(groups, flushed_at))
             if recording and _metrics._enabled and groups:
                 _metrics.set_gauge(
                     "serving_queue_depth",
@@ -958,6 +1176,22 @@ class CoalescingQueue:
             hit = self._auto_widths.get(memo_key)
             if hit is not None:
                 return hit
+            from .tuner import tune_concurrent_width
+
+            # Measured width tournament (DFFT_WIDTH_TOURNAMENT,
+            # docs/SERVING_QOS.md): time the live plan tuple's prefixes as
+            # real interleaved programs and rank widths by measured
+            # throughput — wisdom-keyed (kind="concurrent"), so a
+            # stored winner replays with zero timing executions and a
+            # fixed wisdom file makes the width deterministic. Returns
+            # None when disarmed; the analytic model below then prices
+            # the widths as before.
+            measured = tune_concurrent_width(plans, counts)
+            if measured is not None:
+                if len(self._auto_widths) >= 64:
+                    self._auto_widths.pop(next(iter(self._auto_widths)))
+                self._auto_widths[memo_key] = measured
+                return measured
             from .calibrate import model_correction
             from .explain import _model_shape_itemsize, device_profile
             from .plan_logic import model_concurrent_seconds
@@ -1026,6 +1260,27 @@ class CoalescingQueue:
                                      kind=self.kind, tenant=r.tenant)
             if pol is not None and r.tenant is not None:
                 pol.note_wait(r.tenant, wait)
+
+    def _admit_waits(self, groups: list, now: float) -> list:
+        """Per-request admit-to-dispatch intervals of one wave as
+        ``[(tenant class, seconds), ...]`` — the wave-stats sample that
+        backs the realtime-latency SLO gate. Requests without an
+        enqueue stamp (recorder, policy, deadline, and streaming all
+        disarmed) contribute nothing."""
+        pol = self.policy
+        waits = []
+        for k, g in groups:
+            klass = None
+            if pol is not None:
+                try:
+                    klass = pol.resolve(self._tenant_of(k)).klass
+                except Exception:  # noqa: BLE001 — unregistered tenant
+                    klass = None
+            for r in g:
+                if r.handle._enqueued is not None:
+                    waits.append((klass,
+                                  max(0.0, now - r.handle._enqueued)))
+        return waits
 
     def _execute_concurrent(self, chunk: list, *, reason: str,
                             flushed_at: float) -> int:
@@ -1338,6 +1593,215 @@ class CoalescingQueue:
         except Exception:  # noqa: BLE001 — annotation is telemetry
             pass
 
+    # ------------------------------------------------- streaming waves
+
+    def serve(self, *, poll_s: float = 0.05) -> "CoalescingQueue":
+        """Start the persistent streaming drain loop (docs/
+        SERVING_QOS.md "Streaming scheduler & wave preemption") — the
+        PR 18 lift from discrete ``flush()`` cohorts to a continuous
+        scheduler. A daemon thread keeps a rolling interleaved program
+        in flight: each iteration assembles the next *wave* (up to the
+        concurrent width's groups, in QoS drain order, with realtime
+        wave-preemption), dispatches it asynchronously, and only then
+        blocks on the *previous* wave — so newly formed groups are
+        admitted into the next wave of an already-running schedule
+        instead of waiting for the current dispatch, and under heavy
+        traffic the device never waits for the queue.
+
+        While streaming, submit's ``max_batch`` auto-flush is routed to
+        the loop (a wakeup instead of a dispatch from the submit
+        thread); explicit ``flush()``/``result()`` still work and stay
+        byte-identical on non-streaming queues (pinned). Idempotent;
+        also armed at construction by ``streaming=True`` or
+        ``DFFT_SERVE_STREAMING=1``. ``poll_s`` bounds the idle wakeup
+        (arrivals wake the loop immediately via an event)."""
+        with self._lock:
+            if self._serve_thread is not None \
+                    and self._serve_thread.is_alive():
+                return self
+            if self._wave_stats is None:
+                self._wave_stats = _WaveStats(self.kind)
+            self._serve_stop = threading.Event()
+            self._drain_on_stop = True
+            self._streaming = True
+            t = threading.Thread(target=self._serve_loop,
+                                 args=(float(poll_s),),
+                                 name="dfft-serve", daemon=True)
+            self._serve_thread = t
+            t.start()
+        return self
+
+    def stop(self, *, drain: bool = True,
+             timeout: float | None = 30.0) -> None:
+        """Stop the streaming drain loop. ``drain=True`` (default) lets
+        the loop dispatch every pending group and retire its in-flight
+        waves first — a clean shutdown loses no admitted work;
+        ``drain=False`` exits after the wave in flight (pending groups
+        stay queued and the queue remains fully usable in flush mode).
+        Idempotent; ``serve()`` may re-arm afterwards."""
+        with self._lock:
+            t = self._serve_thread
+            self._streaming = False  # new submits stop waking the loop
+            if t is None:
+                return
+            self._drain_on_stop = bool(drain)
+            self._serve_stop.set()
+        self._arrival.set()  # wake a loop parked on an empty queue
+        if t.is_alive():
+            t.join(timeout)
+        with self._lock:
+            if self._serve_thread is t:
+                self._serve_thread = None
+
+    def _serve_loop(self, poll_s: float) -> None:
+        """The persistent drain loop body. ``prev`` holds the previous
+        wave's async outputs: dispatching wave k+1 BEFORE blocking on
+        wave k is what keeps the device busy across the admission
+        point — at most two waves are in flight, and the barrier wait
+        (where newly arrived work coalesces into the next wave) happens
+        under the younger wave's device time."""
+        stop = self._serve_stop
+        prev: list = []
+        while True:
+            stopping = stop.is_set()
+            if stopping and not self._drain_on_stop:
+                break
+            wave = self._next_wave()
+            if wave is None:
+                if stopping:
+                    break  # drained: nothing pending, nothing admitted
+                self._arrival.clear()
+                # Re-check under the cleared event so an arrival racing
+                # the clear is never lost (it set the event after the
+                # probe; wait() then returns immediately).
+                if self.pending() == 0:
+                    self._arrival.wait(poll_s)
+                continue
+            groups, waits = wave
+            t_dispatch = time.perf_counter()
+            outs = self._execute_wave(groups, flushed_at=t_dispatch)
+            ws = self._wave_stats
+            if ws is not None:
+                ws.note_wave(width=len(groups), t_dispatch=t_dispatch,
+                             outputs=outs, waits=waits)
+            # Admission point: retire the PREVIOUS wave. The current
+            # one keeps executing while we block here, and every
+            # arrival during this wait lands in the next wave.
+            if prev:
+                try:
+                    jax.block_until_ready(prev)
+                except Exception:  # noqa: BLE001 — failed handles
+                    pass           # already carry their errors
+            prev = outs
+        if prev:
+            try:
+                jax.block_until_ready(prev)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _next_wave(self):
+        """Assemble the next admission wave under the lock (streaming
+        loop only): up to the concurrent width's groups, popped in QoS
+        drain order, with **wave-level preemption** — a realtime-class
+        group is guaranteed a slot in THIS wave, bumping later-class
+        members when the width is saturated; bumped groups stay queued
+        with their formation stamps (and starvation clocks) intact, so
+        they sit at the front of the next drain order, and the
+        preempting tenant's quota is charged (:meth:`..qos.QosPolicy
+        .preempt_wave`). Groups larger than ``max_batch`` split at the
+        boundary exactly like ``flush(limit=)``. Returns ``(groups,
+        waits)`` or ``None`` when nothing is pending."""
+        now = time.perf_counter()
+        with self._lock:
+            keys = self._drain_order(now)
+            if not keys:
+                return None
+            probe = [(k, self._pending[k]) for k in keys
+                     if self._pending.get(k)]
+            if not probe:
+                return None
+            width = max(1, self._concurrent_width(probe[:4]))
+            take = [k for k, _ in probe[:width]]
+            if self.policy is not None and len(probe) > width:
+                infos = [{"key": k, "tenant": self._tenant_of(k),
+                          "n": len(g)} for k, g in probe]
+                admit, bumped, _charges = self.policy.preempt_wave(
+                    infos, width)
+                take = [i["key"] for i in admit]
+                if bumped:
+                    ws = self._wave_stats
+                    if ws is not None:
+                        ws.note_preemption(
+                            len(bumped), sum(i["n"] for i in bumped))
+            groups = []
+            for k in take:
+                g = self._pending.get(k)
+                if not g:
+                    continue
+                if len(g) > self.max_batch:
+                    # Split at the batch quantum: the remainder keeps
+                    # the group's formation stamp (and its deadline
+                    # timers), exactly the flush(limit=) discipline.
+                    self._pending[k] = g[self.max_batch:]
+                    g = g[:self.max_batch]
+                else:
+                    self._pending.pop(k)
+                    self._formed.pop(k, None)
+                groups.append((k, g))
+            if not groups:
+                return None
+            self._flush_seq += 1  # stall-watchdog progress marker
+            self._space.notify_all()  # admission waiters: depth fell
+            waits = self._admit_waits(groups, now)
+            if _metrics._enabled:
+                _metrics.set_gauge(
+                    "serving_queue_depth",
+                    float(sum(len(g) for g in self._pending.values())),
+                    kind=self.kind)
+        return groups, waits
+
+    def _execute_wave(self, groups: list, *, flushed_at: float) -> list:
+        """Dispatch one assembled wave OUTSIDE the queue lock (submits
+        must never wait on a dispatch): the flush dispatch body at wave
+        granularity — multi-group waves interleave through
+        :meth:`_execute_concurrent` (which owns the sequential
+        fallback), singletons take :meth:`_execute_group` and its
+        retry/degraded/bisect chain. Returns the wave's resolved async
+        output arrays (the loop's admission barrier blocks on them).
+
+        A fault mid-wave never wedges the loop: the legacy
+        (``retry_max=None``) dispatch re-raises after failing its
+        group's handles, but a streaming wave has no caller to re-raise
+        to — the error is absorbed, any handle the abort left
+        unresolved is failed with it, and the wave's remaining chunks
+        (and the loop) keep going."""
+        if len(groups) > 1:
+            chunks = self._concurrent_chunks(groups, len(groups))
+        else:
+            chunks = [groups]
+        for chunk in chunks:
+            try:
+                if len(chunk) > 1:
+                    self._execute_concurrent(chunk, reason="stream",
+                                             flushed_at=flushed_at)
+                else:
+                    k, g = chunk[0]
+                    self._execute_group(k, g, reason="stream",
+                                        flushed_at=flushed_at)
+            except Exception as e:  # noqa: BLE001 — see docstring
+                for k, g in chunk:
+                    for r in g:
+                        if not r.handle._event.is_set():
+                            r.handle._fail(e)
+        outs = []
+        for _, g in groups:
+            for r in g:
+                h = r.handle
+                if h._event.is_set() and h._error is None \
+                        and h._value is not None:
+                    outs.append(h._value)
+        return outs
+
     # -------------------------------------------------------------- warm
 
     def warm(self, shapes, *, batches=(None,),
@@ -1354,14 +1818,19 @@ class CoalescingQueue:
         return n
 
     def close(self) -> None:
-        """Drain the queue (a final manual flush) and tear down the
+        """Drain the queue (stopping the streaming loop with a full
+        drain when armed, plus a final manual flush) and tear down the
         attached live monitor's sampler thread, if any. Idempotent;
         the queue stays usable afterwards (close is a quiesce point,
         not a poison pill)."""
+        self.stop(drain=True)
         self.flush(reason="manual")
         m = self._monitor
         if m is not None:
             m.stop()
+        ws = self._wave_stats
+        if ws is not None:
+            ws.stop()
 
 
 def warm_pool(mesh=None, top_n: int = 4, *, path: str | None = None,

@@ -36,7 +36,10 @@ skipped packs, midpoint ``axis_index`` offsets emitted at their
 original trace position via node *factories*).
 
 Not yet migrated (the named remainder): ``parallel/ddslab.py`` (the
-double-double tier) and ``parallel/bricks.py`` (brick-I/O edges).
+double-double tier). The ``parallel/bricks.py`` brick-I/O edges migrated
+in PR 18: their wrapper program is now a declarative
+:class:`BrickEdgeGraph` compiled by :func:`compile_brick_io` (pinned
+byte-identical to the pre-refactor hand-threaded jit in api.py).
 
 See ``docs/ARCHITECTURE.md`` ("Stage-graph chain IR") for the node
 schema, the compiler contract, and the concurrent-scheduler policy.
@@ -45,6 +48,8 @@ schema, the compiler contract, and the concurrent-scheduler policy.
 from __future__ import annotations
 
 import functools
+import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Sequence
 
@@ -67,15 +72,19 @@ __all__ = [
     "StageGraph",
     "StagedStage",
     "StagedGraph",
+    "BrickEdgeGraph",
     "local_node",
     "exchange_node",
     "compile_fused",
     "compile_staged",
+    "compile_brick_io",
     "apply_multiplier",
     "apply_midpoint",
     "graph_of",
     "ConcurrentPlan",
+    "WaveSchedule",
     "schedule_concurrent",
+    "schedule_waves",
 ]
 
 #: The stage-kind registry — every node kind a chain graph may carry.
@@ -534,6 +543,68 @@ def compile_staged(graph: StagedGraph):
         [(s.name, build_stage(s)) for s in graph.stages])
 
 
+# ------------------------------------------------- brick-I/O edge tier
+
+@dataclass(frozen=True)
+class BrickEdgeGraph:
+    """Declarative description of a brick-I/O wrapper program — the
+    named IR remainder of ``parallel/bricks.py``, migrated here in
+    PR 18 so ONE compiler owns every jitted chain program.
+
+    The wrapper brackets a canonical-chain program with the overlap-map
+    edges: ``edge_in`` is the ``(reorder | None, reshape)`` pair applied
+    to the caller's brick stack on entry (storage-order canonicalization
+    then the bricks->spec reshape), ``edge_out`` the ``(reshape,
+    reorder | None)`` pair on exit (spec->bricks then the inverse order
+    edge). The callables are the shard_map'd plan-time programs built by
+    :mod:`..parallel.bricks` (or the crop/transpose pair of the
+    single-device tier); this graph only declares how they compose and
+    :func:`compile_brick_io` is the one place the jit is built.
+    ``specs`` carries the ``(in, out)`` :class:`..parallel.bricks
+    .BrickSpec` accounting pair (None on the single-device tier);
+    ``meta`` planner metadata — neither is read by the compiler."""
+
+    edge_in: tuple
+    edge_out: tuple
+    donate: bool = False
+    specs: tuple | None = None
+    meta: dict = field(default_factory=dict, compare=False)
+
+    def __post_init__(self):
+        for label, pair in (("edge_in", self.edge_in),
+                            ("edge_out", self.edge_out)):
+            if len(pair) != 2:
+                raise ValueError(
+                    f"{label} must be a (reorder|None, reshape) pair "
+                    f"(edge_out: (reshape, reorder|None)), got {pair!r}")
+
+
+def compile_brick_io(graph: BrickEdgeGraph, inner_fn):
+    """Compile a :class:`BrickEdgeGraph` around a canonical-chain
+    program into the brick plan's end-to-end jitted ``fn`` — exactly
+    the wrapper the brick planners used to hand-thread (byte-identical
+    StableHLO, pinned in ``tests/_hlo_pin_cases.py``'s ``brick_*``
+    cases): optional order edge in, bricks->spec reshape, the inner
+    chain, spec->bricks reshape, optional order edge out, one jit with
+    the chain's donation policy.
+
+    The compiled callable carries the graph as ``fn.brick_edges`` (the
+    feature-detection twin of ``fn.stage_graph``)."""
+    in_reorder, in_reshape = graph.edge_in
+    out_reshape, out_reorder = graph.edge_out
+
+    jit_kw: dict = {"donate_argnums": 0} if graph.donate else {}
+
+    @functools.partial(jax.jit, **jit_kw)
+    def fn(stack):
+        x = stack if in_reorder is None else in_reorder(stack)
+        y = out_reshape(inner_fn(in_reshape(x)))
+        return y if out_reorder is None else out_reorder(y)
+
+    fn.brick_edges = graph
+    return fn
+
+
 # ----------------------------------------------- concurrent scheduling
 
 @dataclass
@@ -728,3 +799,154 @@ def _build_concurrent(plans: tuple) -> ConcurrentPlan:
 
     return ConcurrentPlan(fn=fn, plans=plans, mesh=mesh,
                           in_shardings=in_shs, out_shardings=out_shs)
+
+
+# ----------------------------------------------------- wave scheduling
+
+def _mesh_compatible(a, b) -> bool:
+    """Same physical mesh under :func:`schedule_concurrent`'s rule:
+    identity, or equal shape + device order + axis names."""
+    return a is b or (
+        a.shape == b.shape
+        and list(a.devices.flat) == list(b.devices.flat)
+        and a.axis_names == b.axis_names)
+
+
+def schedule_waves(plans: Sequence, width: int = 4) -> list[tuple]:
+    """Partition N plans into dispatch *waves* — the unit the streaming
+    scheduler (``CoalescingQueue.serve()``) keeps rolling. A wave is a
+    consecutive run of at most ``width`` mutually schedulable plans:
+    all built through the stage-graph IR on one shared mesh, so the run
+    interleaves into a single program via :func:`schedule_concurrent`.
+    A plan below the IR tier — no ``plan.graph`` — or on a different
+    mesh breaks the run and rides a singleton wave (it still dispatches,
+    it just cannot interleave). Order-preserving: the caller's drain
+    order (QoS order in serving) is the admission order.
+    """
+    if not isinstance(width, int) or width < 1:
+        raise ValueError(f"wave width must be a positive int, got {width!r}")
+    waves: list[tuple] = []
+    cur: list = []
+    cur_mesh = None
+    for p in plans:
+        g = getattr(p, "graph", None)
+        if g is None:
+            if cur:
+                waves.append(tuple(cur))
+                cur, cur_mesh = [], None
+            waves.append((p,))
+            continue
+        if cur and (len(cur) >= width
+                    or not _mesh_compatible(g.mesh, cur_mesh)):
+            waves.append(tuple(cur))
+            cur = []
+        if not cur:
+            cur_mesh = g.mesh
+        cur.append(p)
+    if cur:
+        waves.append(tuple(cur))
+    return waves
+
+
+class WaveSchedule:
+    """Rolling wave-at-a-time orchestration over
+    :func:`schedule_concurrent` — the abstraction the streaming serving
+    loop dispatches through (docs/SERVING_QOS.md, "Streaming scheduler
+    & wave preemption").
+
+    A *wave* is the set of transforms whose stage DAGs are interleaved
+    into one device program. :meth:`dispatch` issues a wave
+    asynchronously (JAX dispatch returns while the outputs are still in
+    flight) and enqueues it as the newest in-flight wave;
+    :meth:`barrier` blocks until the *oldest* in-flight wave has fully
+    drained and retires it. The barrier is the **admission point**:
+    with ``depth=2`` (the default), wave ``k+1`` is assembled and
+    dispatched while wave ``k`` still executes, so newly formed work
+    joins the next wave instead of waiting for the running dispatch —
+    host-side assembly hides under device time, and the device never
+    waits for the queue as long as one wave's worth of work is pending.
+
+    Bit-exactness is :func:`schedule_concurrent`'s: each transform's
+    per-step math is its fused chain's, only issue order changes.
+    """
+
+    def __init__(self, *, max_width: int = 4, depth: int = 2):
+        if not isinstance(max_width, int) or max_width < 1:
+            raise ValueError(
+                f"max_width must be a positive int, got {max_width!r}")
+        if not isinstance(depth, int) or depth < 1:
+            raise ValueError(f"depth must be a positive int, got {depth!r}")
+        self.max_width = max_width
+        self.depth = depth
+        self.waves = 0  # waves dispatched over the schedule's lifetime
+        self.records: list[dict] = []  # retired waves, barrier order
+        self._inflight: deque = deque()  # (record, outputs)
+
+    @property
+    def inflight(self) -> int:
+        """Waves dispatched but not yet retired by a barrier."""
+        return len(self._inflight)
+
+    def dispatch(self, plans: Sequence, inputs: Sequence) -> tuple:
+        """Issue one wave and return its (asynchronous) outputs.
+
+        ``plans``/``inputs`` pair one input array per plan. Two or more
+        IR-tier plans on a shared mesh interleave through
+        :func:`schedule_concurrent`; anything else — a singleton wave,
+        or members below the IR tier — dispatches per-plan in order
+        (still asynchronous, just not interleaved). If the schedule is
+        already ``depth`` waves deep, blocks on :meth:`barrier` first
+        so at most ``depth`` waves are ever in flight."""
+        plans = tuple(plans)
+        inputs = tuple(inputs)
+        if len(plans) != len(inputs):
+            raise ValueError(
+                f"wave of {len(plans)} plans takes {len(plans)} inputs, "
+                f"got {len(inputs)}")
+        if not plans:
+            raise ValueError("cannot dispatch an empty wave")
+        if len(plans) > self.max_width:
+            raise ValueError(
+                f"wave of {len(plans)} plans exceeds max_width="
+                f"{self.max_width}; partition with schedule_waves first")
+        while len(self._inflight) >= self.depth:
+            self.barrier()
+        interleaved = len(plans) >= 2 and all(
+            getattr(p, "graph", None) is not None for p in plans) and all(
+            _mesh_compatible(p.graph.mesh, plans[0].graph.mesh)
+            for p in plans[1:])
+        if interleaved:
+            outs = schedule_concurrent(plans)(*inputs)
+        else:
+            outs = tuple(p.fn(x) for p, x in zip(plans, inputs))
+        rec = {"index": self.waves, "width": len(plans),
+               "interleaved": interleaved,
+               "dispatched_at": time.perf_counter()}
+        self.waves += 1
+        self._inflight.append((rec, outs))
+        return outs
+
+    def barrier(self) -> dict | None:
+        """Retire the oldest in-flight wave: block until its outputs are
+        ready, stamp drain time/duration, append to :attr:`records`, and
+        return the record (``None`` when nothing is in flight). This is
+        the admission point — callers assemble the next wave from work
+        that arrived while the retired wave ran."""
+        if not self._inflight:
+            return None
+        rec, outs = self._inflight.popleft()
+        try:
+            jax.block_until_ready(outs)
+        finally:
+            rec["drained_at"] = time.perf_counter()
+            rec["duration_s"] = rec["drained_at"] - rec["dispatched_at"]
+            self.records.append(rec)
+        return rec
+
+    def drain(self) -> list[dict]:
+        """Barrier until nothing is in flight; returns the retired
+        records in barrier order."""
+        recs = []
+        while self._inflight:
+            recs.append(self.barrier())
+        return recs

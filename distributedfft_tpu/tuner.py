@@ -84,6 +84,9 @@ __all__ = [
     "stale_wisdom_entries",
     "tuned_plan",
     "tuned_label",
+    "width_budget",
+    "concurrent_width_key",
+    "tune_concurrent_width",
 ]
 
 WISDOM_SCHEMA = 1
@@ -1112,3 +1115,154 @@ def tuned_plan(kind: str, shape, mesh, options: PlanOptions,
         return _build_candidate(kind, shape, mesh, base, plan_kw,
                                 by_label[winner], donate=True)
     return built[winner]
+
+
+# -------------------------------------------- concurrent-width tournament
+
+def width_budget() -> tuple[int, int] | None:
+    """(iters, repeats) of the concurrent-width tournament, from
+    ``DFFT_WIDTH_TOURNAMENT`` as ``"ITERS"`` or ``"ITERSxREPEATS"``
+    (repeats default 2). Unset / ``""`` / ``"0"`` / ``"off"`` -> None:
+    the tournament is disarmed and ``concurrent_groups="auto"`` stays
+    on the analytic overlap model (:func:`..monitor.model_concurrent_seconds`)
+    — measuring widths executes real programs, so it is opt-in the same
+    way ``DFFT_TUNE_ITERS`` gates the plan tournaments."""
+    raw = os.environ.get("DFFT_WIDTH_TOURNAMENT", "").strip()
+    if raw.lower() in ("", "0", "off"):
+        return None
+    parts = raw.lower().split("x")
+    try:
+        if len(parts) == 1:
+            iters, repeats = int(parts[0]), 2
+        elif len(parts) == 2:
+            iters, repeats = int(parts[0]), int(parts[1])
+        else:
+            raise ValueError
+        if iters < 1 or repeats < 1:
+            raise ValueError
+    except ValueError:
+        raise ValueError(
+            "DFFT_WIDTH_TOURNAMENT must be 'ITERS' or 'ITERSxREPEATS' "
+            f"(positive ints), or ''/'0'/'off' to disarm; got {raw!r}"
+        ) from None
+    return iters, repeats
+
+
+def concurrent_width_key(plans: Sequence, counts: Sequence[int]) -> dict:
+    """The wisdom identity of one width tournament: the lead plan's
+    problem tuple under ``kind="concurrent"``, extended with a
+    ``"tuple"`` field naming EVERY member plan (shape × dtype ×
+    direction × batch, in drain order) and the live per-group transform
+    ``"counts"`` — a width measured on one plan tuple must never replay
+    into another, exactly the scoping discipline :func:`wisdom_key`
+    applies to batch/err_budget. Extra fields are schema-safe: lookups
+    match the full JSON identity, and the staleness check is a
+    subset test on the standard fields."""
+    p0 = plans[0]
+    mesh = getattr(p0, "mesh", None)
+    ndev = int(math.prod(mesh.devices.shape)) if mesh is not None else 1
+    key = wisdom_key(
+        kind="concurrent",
+        shape=p0.shape,
+        dtype=getattr(p0, "in_dtype", None) or p0.dtype,
+        direction=p0.direction,
+        ndev=ndev,
+        mesh_dims=tuple(mesh.devices.shape) if mesh is not None else None,
+        batch=getattr(p0, "batch", None),
+    )
+    key["tuple"] = [
+        "x".join(str(s) for s in p.shape)
+        + f":{np.dtype(getattr(p, 'in_dtype', None) or p.dtype)}"
+        + f":d{p.direction}:b{getattr(p, 'batch', None) or 1}"
+        for p in plans
+    ]
+    key["counts"] = [int(c) for c in counts]
+    return key
+
+
+def tune_concurrent_width(
+    plans: Sequence,
+    counts: Sequence[int],
+    *,
+    path: str | None = None,
+) -> int | None:
+    """Measured tournament over concurrent flush/wave widths — the PR 18
+    replacement for the model-only ``concurrent_groups="auto"``: rank
+    width ``w`` by the measured throughput of the live plan tuple's
+    first ``w`` groups scheduled as ONE interleaved program
+    (:func:`..stagegraph.schedule_concurrent`), i.e. waves/s scaled by
+    the wave's transform count (``counts[:w]`` transforms retire per
+    wave, so seconds-per-transform is the scale-free rank).
+
+    Returns the winning width, or ``None`` when the tournament is
+    disarmed (:func:`width_budget` is None) — the caller then falls
+    back to the analytic model. Wisdom-keyed like the plan tournaments
+    (``kind="concurrent"``): a hit replays the stored width with ZERO
+    timing executions, so a fixed wisdom file makes the choice
+    deterministic; a measured winner is appended with its per-width
+    times, waves/s, and budget so ``report wisdom`` shows the margin.
+    Multi-host safe: widths build/time/decide through
+    :func:`measured_select`'s lockstep protocol."""
+    budget = width_budget()
+    if budget is None:
+        return None
+    plans = list(plans)
+    counts = [int(c) for c in counts]
+    if len(plans) < 2:
+        return max(1, len(plans))
+    if path is None:
+        path = default_wisdom_path()
+    key = concurrent_width_key(plans, counts)
+    if path is not None:
+        entry = lookup_wisdom(key, path)
+        if entry is not None:
+            w = entry.get("winner", {}).get("width")
+            if isinstance(w, int) and 1 <= w <= len(plans):
+                _metrics.inc("tune_wisdom_hits", kind="concurrent")
+                return w
+    _metrics.inc("tune_wisdom_misses", kind="concurrent")
+
+    from . import api
+    from .stagegraph import schedule_concurrent
+    from .utils.timing import time_fn_amortized
+
+    iters, repeats = budget
+    names = [f"w{w}" for w in range(1, len(plans) + 1)]
+
+    def build(nm):
+        w = int(nm[1:])
+        if w == 1:
+            fn = plans[0].fn
+        else:
+            fn = schedule_concurrent(plans[:w]).fn
+        xs = tuple(api.alloc_local(p) for p in plans[:w])
+        return w, fn, xs
+
+    def measure(built_obj):
+        w, fn, xs = built_obj
+        t, _ = time_fn_amortized(fn, *xs, iters=iters, repeats=repeats)
+        return t / sum(counts[:w])  # seconds per transform
+
+    winner, built, times = measured_select(
+        names, build, measure, what="concurrent width")
+    w = built[winner][0]
+    if path is not None:
+        per_transform = times[winner]
+        secs = per_transform * sum(counts[:w])
+        entry = {
+            "schema": WISDOM_SCHEMA,
+            "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "key": key,
+            "winner": {"width": int(w)},
+            "seconds": float(secs),
+            "waves_per_s": (1.0 / secs) if secs > 0 else None,
+            "transforms_per_s":
+                (1.0 / per_transform) if per_transform > 0 else None,
+            "times": {nm: (float(t) if math.isfinite(t) else None)
+                      for nm, t in times.items()},
+            "budget": [iters, repeats],
+        }
+        from .utils.atomicio import append_line
+
+        append_line(path, json.dumps(entry, sort_keys=True))
+    return int(w)

@@ -113,6 +113,46 @@ def _staged(build, in_shape, in_dtype):
     return run
 
 
+def _plan_case(build):
+    """One plan-level case: the plan's end-to-end jitted ``fn`` lowered
+    on its own declared I/O contract. Used for the brick-I/O edge
+    wrappers (whose jit lives above the chain builders) and the serving
+    flush dispatch programs (the batched plans ``CoalescingQueue.flush``
+    builds) — both must stay byte-identical through the PR 18
+    streaming-scheduler / brick-migration refactor."""
+
+    def run():
+        plan = build()
+        return [("fn", _lower(plan.fn, plan.in_shape, plan.in_dtype))]
+
+    return run
+
+
+def _brick_boxes():
+    """Deterministic uneven box lists over the EVEN world: a non-grid
+    unequal-bisection tree in (the general brick case no PartitionSpec
+    expresses) and y-slabs out."""
+    from distributedfft_tpu.geometry import Box3, make_slabs, world_box
+
+    w = world_box(EVEN)
+
+    def bisect(box, depth):
+        if depth == 0:
+            return [box]
+        ax = max(range(3), key=lambda d: box.shape[d])
+        lo, hi = box.low[ax], box.high[ax]
+        cut = lo + max(1, (hi - lo) * 2 // 5)  # deliberately unequal
+        la = list(box.low), list(box.high)
+        la[1][ax] = cut
+        lb = list(box.low), list(box.high)
+        lb[0][ax] = cut
+        a = Box3(tuple(la[0]), tuple(la[1]))
+        b = Box3(tuple(lb[0]), tuple(lb[1]))
+        return bisect(a, depth - 1) + bisect(b, depth - 1)
+
+    return bisect(w, 3), make_slabs(w, 8, axis=1)
+
+
 def build_cases() -> dict:
     """name -> zero-arg callable returning ``[(subname, text), ...]``."""
     from distributedfft_tpu.parallel.pencil import (
@@ -238,7 +278,55 @@ def build_cases() -> dict:
             lambda: build_slab_op_stages(m8, EVEN, _poisson_mult(EVEN)),
             EVEN, CDT),
     }
+    cases.update(_brick_and_serve_cases(m8))
     return cases
+
+
+def _brick_and_serve_cases(m8) -> dict:
+    """The PR 18 pin additions: the brick-I/O edge wrappers (captured
+    before their migration onto the stagegraph builders) and the serving
+    flush dispatch programs (captured before the streaming-scheduler
+    refactor — the non-streaming ``flush()`` path must stay
+    byte-identical)."""
+    import distributedfft_tpu as dfft
+    from distributedfft_tpu.geometry import make_slabs, world_box
+
+    ins, outs = _brick_boxes()
+    n2h = EVEN[2] // 2 + 1
+    r2c_outs = make_slabs(world_box(EVEN[:2] + (n2h,)), 8, axis=0)
+    ins_ord = [b.with_order((1, 2, 0)) if i % 3 == 0 else b
+               for i, b in enumerate(ins)]
+    outs_ord = [b.with_order((2, 0, 1)) if i == 1 else b
+                for i, b in enumerate(outs)]
+    solo_in = [world_box(EVEN).with_order((2, 0, 1))]
+    solo_out = [world_box(EVEN)]
+    return {
+        # ---- brick-I/O edges (migrated onto stagegraph builders) ------
+        "brick_c2c_ring": _plan_case(
+            lambda: dfft.plan_brick_dft_c2c_3d(EVEN, m8, ins, outs,
+                                               dtype=CDT)),
+        "brick_c2c_a2av": _plan_case(
+            lambda: dfft.plan_brick_dft_c2c_3d(EVEN, m8, ins, outs,
+                                               dtype=CDT,
+                                               algorithm="alltoallv")),
+        "brick_c2c_order": _plan_case(
+            lambda: dfft.plan_brick_dft_c2c_3d(EVEN, m8, ins_ord,
+                                               outs_ord, dtype=CDT)),
+        "brick_c2c_donate": _plan_case(
+            lambda: dfft.plan_brick_dft_c2c_3d(EVEN, m8, ins, outs,
+                                               dtype=CDT, donate=True)),
+        "brick_r2c_fwd": _plan_case(
+            lambda: dfft.plan_brick_dft_r2c_3d(EVEN, m8, ins, r2c_outs,
+                                               dtype=CDT)),
+        "brick_c2c_single": _plan_case(
+            lambda: dfft.plan_brick_dft_c2c_3d(EVEN, None, solo_in,
+                                               solo_out, dtype=CDT)),
+        # ---- serving flush dispatch programs --------------------------
+        "serve_flush_b1": _plan_case(
+            lambda: dfft.plan_dft_c2c_3d(EVEN, m8, dtype=CDT)),
+        "serve_flush_b3": _plan_case(
+            lambda: dfft.plan_dft_c2c_3d(EVEN, m8, dtype=CDT, batch=3)),
+    }
 
 
 def env_fingerprint() -> dict:
@@ -274,6 +362,37 @@ def write_captures() -> None:
     print(f"wrote {MANIFEST}")
 
 
+def write_new_captures() -> None:
+    """Capture ONLY cases absent from the existing manifest and merge
+    them in — the targeted pre-refactor capture for pin additions
+    (``write`` would re-capture everything, silently re-baselining any
+    regression in the already-pinned cases)."""
+    man = read_manifest()
+    if man is None:
+        write_captures()
+        return
+    if man.get("env") != env_fingerprint():
+        raise SystemExit(
+            f"environment moved since the original capture: "
+            f"{man.get('env')} != {env_fingerprint()}; a merged manifest "
+            f"would mix incomparable pins")
+    fresh = 0
+    for name, run in sorted(build_cases().items()):
+        if name in man["cases"]:
+            continue
+        subs = {}
+        for sub, text in run():
+            with open(_case_path(name, sub), "w") as f:
+                f.write(text)
+            subs[sub] = hashlib.sha256(text.encode()).hexdigest()
+            print(f"captured {name}__{sub}: {len(text)} bytes")
+        man["cases"][name] = subs
+        fresh += 1
+    with open(MANIFEST, "w") as f:
+        json.dump(man, f, indent=1, sort_keys=True)
+    print(f"merged {fresh} new case(s) into {MANIFEST}")
+
+
 def read_manifest() -> dict | None:
     try:
         with open(MANIFEST) as f:
@@ -290,5 +409,7 @@ def load_capture(name: str, sub: str) -> str:
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "write":
         write_captures()
+    elif len(sys.argv) > 1 and sys.argv[1] == "write-new":
+        write_new_captures()
     else:
         print(__doc__)

@@ -1,0 +1,384 @@
+"""Streaming wave scheduler (PR 18, docs/SERVING_QOS.md "Streaming
+scheduler & wave preemption").
+
+Contracts pinned here:
+
+1. **Wave preemption** (``qos.QosPolicy.preempt_wave``) — a
+   realtime-class group past a saturated wave's cutoff is admitted into
+   THIS wave, displacing the youngest lower-class window members; the
+   bumped transforms are charged to the preempting tenant (the ledger's
+   ``preemptions`` row) and the bumped groups are returned for
+   re-queueing, never dropped. Without a realtime group past the
+   cutoff, plain truncation: no bumps, no charges.
+2. **Streaming drain loop** (``serve()``/``stop()``) — bit-parity with
+   the direct plan, clean shutdown with in-flight waves (every handle
+   resolved, loop thread dead, nothing pending), idempotent
+   re-arm/re-stop, and the ``DFFT_SERVE_STREAMING`` constructor knob.
+3. **Width tournament** (``tuner.tune_concurrent_width``) — budget
+   grammar (``DFFT_WIDTH_TOURNAMENT``), and determinism under fixed
+   wisdom: the first call measures and persists a winner, every later
+   call replays it without re-measuring.
+4. **Fault isolation** — an injected execute fault mid-wave fails that
+   wave's handles but never wedges the loop: later waves still drain
+   and the queue stays usable.
+5. **(slow) Occupancy win** — on one fixed arrival trace, the
+   streaming scheduler's measured inter-wave device-idle fraction is
+   strictly lower than the discrete flush cadence's, and the realtime
+   class's p99 admit-to-dispatch latency stays within a wave duration.
+
+NOTE on the filename: must collect BEFORE ``test_alltoallv.py``
+(alphabetical clean-backend tier; see ``tests/conftest.py``).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu import serving, tuner
+from distributedfft_tpu.qos import QosPolicy, Tenant
+from distributedfft_tpu.serving import CoalescingQueue
+
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the virtual 8-device mesh"
+)
+
+SHAPE = (8, 8, 8)
+CDT = jnp.complex64
+
+
+def _x(seed=0, shape=SHAPE):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(
+        (rng.standard_normal(shape)
+         + 1j * rng.standard_normal(shape)).astype(np.complex64))
+
+
+def _rt_policy():
+    pol = QosPolicy()
+    pol.register(Tenant("rt", klass="realtime"))
+    pol.register(Tenant("bulk", klass="batch"))
+    return pol
+
+
+# ------------------------------------------------- 1. wave preemption
+
+
+def test_preempt_wave_admits_realtime_and_charges():
+    pol = _rt_policy()
+    infos = [
+        {"key": "b1", "tenant": "bulk", "n": 3},
+        {"key": "b2", "tenant": "bulk", "n": 2},
+        {"key": "r1", "tenant": "rt", "n": 1},
+    ]
+    admit, bumped, charges = pol.preempt_wave(infos, 2)
+    assert [i["key"] for i in admit] == ["b1", "r1"]
+    assert [i["key"] for i in bumped] == ["b2"]
+    # The bumped transforms are charged to the preempting realtime
+    # tenant and land in its ledger's preemption row.
+    assert charges == {"rt": 2}
+    row = pol.slo_report()["tenants"]["rt"]
+    assert row["preemptions"] == 2
+
+
+def test_preempt_wave_all_realtime_guaranteed():
+    pol = _rt_policy()
+    infos = [{"key": f"b{i}", "tenant": "bulk", "n": 1} for i in range(3)]
+    infos += [{"key": f"r{i}", "tenant": "rt", "n": 1} for i in range(2)]
+    admit, bumped, _ = pol.preempt_wave(infos, 2)
+    # Width 2, two realtime groups past the cutoff: BOTH get slots —
+    # a realtime arrival never waits out a saturated wave.
+    assert [i["key"] for i in admit] == ["r0", "r1"]
+    assert [i["key"] for i in bumped] == ["b0", "b1"]
+
+
+def test_preempt_wave_without_realtime_truncates():
+    pol = _rt_policy()
+    infos = [{"key": f"b{i}", "tenant": "bulk", "n": 1} for i in range(4)]
+    admit, bumped, charges = pol.preempt_wave(infos, 2)
+    assert [i["key"] for i in admit] == ["b0", "b1"]
+    assert bumped == [] and charges == {}
+    assert pol.slo_report()["tenants"]["rt"]["preemptions"] == 0
+
+
+def test_preempt_wave_order_preserved_under_width():
+    pol = _rt_policy()
+    infos = [
+        {"key": "b1", "tenant": "bulk", "n": 1},
+        {"key": "r1", "tenant": "rt", "n": 1},
+        {"key": "b2", "tenant": "bulk", "n": 1},
+    ]
+    admit, bumped, charges = pol.preempt_wave(infos, 3)
+    # Unsaturated width: everything dispatches, relative order intact.
+    assert [i["key"] for i in admit] == ["b1", "r1", "b2"]
+    assert bumped == [] and charges == {}
+
+
+# ------------------------------------- 2. streaming drain loop
+
+
+@needs_mesh
+def test_streaming_parity_and_clean_shutdown():
+    mesh = dfft.make_mesh(8)
+    plan = dfft.plan_dft_c2c_3d(SHAPE, mesh, direction=dfft.FORWARD,
+                                dtype=CDT)
+    xs = [_x(i) for i in range(10)]
+    want = [plan(x) for x in xs]
+    q = CoalescingQueue(mesh, max_batch=4, dtype=CDT, streaming=True)
+    try:
+        assert q._streaming and q._serve_thread is not None
+        handles = [q.submit(x) for x in xs]
+        q.stop(drain=True)
+        # Clean shutdown with in-flight waves: every admitted request
+        # resolved, nothing pending, the loop thread exited.
+        for h, w in zip(handles, want):
+            np.testing.assert_array_equal(np.asarray(h.result(timeout=60)),
+                                          np.asarray(w))
+        assert q.pending() == 0
+        assert q._serve_thread is None
+        # Idempotent: stop again, re-arm, stop again.
+        q.stop()
+        q.serve()
+        assert q._serve_thread is not None
+        h = q.submit(xs[0])
+        q.stop(drain=True)
+        np.testing.assert_array_equal(np.asarray(h.result(timeout=60)),
+                                      np.asarray(want[0]))
+    finally:
+        q.close()
+
+
+@needs_mesh
+def test_streaming_records_wave_occupancy():
+    mesh = dfft.make_mesh(8)
+    q = CoalescingQueue(mesh, max_batch=4, dtype=CDT, streaming=True)
+    try:
+        hs = [q.submit(_x(i)) for i in range(8)]
+        q.stop(drain=True)
+        for h in hs:
+            h.result(timeout=60)
+        snap = q._wave_stats.snapshot()
+        assert snap["waves"] >= 1
+        assert snap["busy_s"] > 0.0
+        assert snap["width_max"] >= 1
+        # Admit-to-dispatch reservoirs exist for the anonymous class.
+        assert sum(v["n"] for v in snap["admit_wait"].values()) > 0
+    finally:
+        q.close()
+
+
+def test_env_knob_arms_streaming(monkeypatch):
+    monkeypatch.setenv("DFFT_SERVE_STREAMING", "1")
+    q = CoalescingQueue(max_batch=2, dtype=CDT)
+    try:
+        assert q._streaming and q._serve_thread.is_alive()
+        h = q.submit(_x(3))
+        q.stop(drain=True)
+        h.result(timeout=60)
+    finally:
+        q.close()
+    monkeypatch.setenv("DFFT_SERVE_STREAMING", "0")
+    q2 = CoalescingQueue(max_batch=2, dtype=CDT)
+    try:
+        assert not q2._streaming and q2._serve_thread is None
+    finally:
+        q2.close()
+
+
+@needs_mesh
+def test_streaming_realtime_admitted_under_saturation():
+    mesh = dfft.make_mesh(8)
+    pol = _rt_policy()
+    q = CoalescingQueue(mesh, max_batch=2, dtype=CDT, policy=pol,
+                        streaming=True)
+    try:
+        hs = [q.submit(_x(i), tenant="bulk") for i in range(8)]
+        hs += [q.submit(_x(100 + i), tenant="rt") for i in range(3)]
+        q.stop(drain=True)
+        for h in hs:
+            h.result(timeout=120)  # nobody starved, nothing dropped
+        led = pol.slo_report()["tenants"]
+        assert led["rt"]["transforms"] == 3
+        assert led["bulk"]["transforms"] == 8
+    finally:
+        q.close()
+
+
+# ---------------------------------------- 3. width tournament
+
+
+def test_width_budget_grammar(monkeypatch):
+    monkeypatch.delenv("DFFT_WIDTH_TOURNAMENT", raising=False)
+    assert tuner.width_budget() is None
+    for off in ("0", "off", ""):
+        monkeypatch.setenv("DFFT_WIDTH_TOURNAMENT", off)
+        assert tuner.width_budget() is None
+    monkeypatch.setenv("DFFT_WIDTH_TOURNAMENT", "3")
+    assert tuner.width_budget() == (3, 2)
+    monkeypatch.setenv("DFFT_WIDTH_TOURNAMENT", "4x5")
+    assert tuner.width_budget() == (4, 5)
+    monkeypatch.setenv("DFFT_WIDTH_TOURNAMENT", "junk")
+    with pytest.raises(ValueError):
+        tuner.width_budget()
+
+
+@needs_mesh
+def test_width_tournament_deterministic_under_wisdom(
+        monkeypatch, tmp_path):
+    mesh = dfft.make_mesh(8)
+    plan = dfft.plan_dft_c2c_3d(SHAPE, mesh, direction=dfft.FORWARD,
+                                dtype=CDT)
+    plans, counts = [plan, plan, plan], [1, 1, 1]
+    path = str(tmp_path / "wisdom.jsonl")
+
+    monkeypatch.delenv("DFFT_WIDTH_TOURNAMENT", raising=False)
+    assert tuner.tune_concurrent_width(plans, counts, path=path) is None
+
+    monkeypatch.setenv("DFFT_WIDTH_TOURNAMENT", "2x1")
+    w1 = tuner.tune_concurrent_width(plans, counts, path=path)
+    assert isinstance(w1, int) and 1 <= w1 <= 3
+    lines = [json.loads(ln) for ln in open(path)]
+    assert len(lines) == 1
+    entry = lines[0]
+    assert entry["winner"]["width"] == w1
+    assert entry["key"]["kind"] == "concurrent"
+    assert entry["waves_per_s"] > 0
+    # Fixed wisdom => deterministic replay: same width, no re-measure
+    # (the store would have grown a second line).
+    for _ in range(3):
+        assert tuner.tune_concurrent_width(plans, counts, path=path) == w1
+    assert sum(1 for _ in open(path)) == 1
+
+
+@needs_mesh
+def test_queue_auto_width_uses_tournament(monkeypatch, tmp_path):
+    path = str(tmp_path / "wisdom.jsonl")
+    monkeypatch.setenv("DFFT_WISDOM", path)
+    monkeypatch.setenv("DFFT_WIDTH_TOURNAMENT", "1x1")
+    mesh = dfft.make_mesh(8)
+    # max_batch above the per-group submit count so neither group
+    # auto-flushes "full" before flush() sees BOTH pending (the
+    # concurrent path needs >= 2 groups in one drain).
+    q = CoalescingQueue(mesh, max_batch=4, dtype=CDT,
+                        concurrent_groups="auto")
+    try:
+        hs = [q.submit(_x(i, (8, 8, 8))) for i in range(2)]
+        hs += [q.submit(_x(9 + i, (16, 8, 4))) for i in range(2)]
+        q.flush()
+        for h in hs:
+            h.result(timeout=120)
+        # The measured tournament persisted its winner for the live
+        # plan tuple (model-only auto never writes wisdom).
+        entries = [json.loads(ln) for ln in open(path)]
+        assert any(e.get("key", {}).get("kind") == "concurrent"
+                   for e in entries)
+    finally:
+        q.close()
+
+
+# ------------------------------------------- 4. fault isolation
+
+
+@needs_mesh
+def test_fault_mid_wave_does_not_wedge_loop(chaos):
+    mesh = dfft.make_mesh(8)
+    q = CoalescingQueue(mesh, max_batch=2, dtype=CDT, streaming=True)
+    try:
+        chaos("execute:every=2,kind=deterministic")
+        hs = [q.submit(_x(i)) for i in range(8)]
+        q.stop(drain=True)
+        # Every handle resolved — success or a carried error, never a
+        # hang — and the loop exited cleanly.
+        outcomes = []
+        for h in hs:
+            try:
+                h.result(timeout=60)
+                outcomes.append("ok")
+            except Exception:  # noqa: BLE001 — injected
+                outcomes.append("err")
+        assert q._serve_thread is None and q.pending() == 0
+        # Disarmed, the queue keeps serving (the loop never wedged).
+        os.environ.pop("DFFT_FAULT_INJECT", None)
+        from distributedfft_tpu import faults
+        faults.reset()
+        q.serve()
+        h = q.submit(_x(42))
+        q.stop(drain=True)
+        assert np.asarray(h.result(timeout=60)).shape == SHAPE
+    finally:
+        q.close()
+
+
+# ------------------------------------ 5. (slow) occupancy win
+
+
+@pytest.mark.slow
+@needs_mesh
+def test_streaming_idle_fraction_beats_flush_cadence():
+    """On one fixed arrival trace, the streaming loop's inter-wave
+    device-idle fraction must undercut the discrete flush cadence's
+    (which parks arrivals until the next tick), and the realtime
+    class's p99 admit-to-dispatch wait must stay within a wave
+    duration (plus CPU scheduling noise)."""
+    mesh = dfft.make_mesh(8)
+    shape = (16, 16, 8)
+    pol_kw = dict(max_batch=4, dtype=CDT)
+    # Seeded SATURATED trace: arrival gaps (mean ~0.5 ms) below the
+    # per-wave service time even with every compile cache warm, so work
+    # is pending across waves in both modes. That is the scenario the
+    # scheduler exists for — the flush cadence parks the backlog until
+    # the next tick (device idle between ticks), the streaming loop
+    # dispatches wave k+1 the moment wave k's admission point opens.
+    # (An arrival-LIMITED trace proves nothing: with gaps above the
+    # service time both schedulers just wait for traffic.)
+    import random
+    rng = random.Random(7)
+    trace = [(rng.uniform(0.0, 0.001), "rt" if i % 5 == 0 else "bulk")
+             for i in range(150)]
+    cadence = 0.02
+
+    def drive(streaming: bool) -> dict:
+        q = CoalescingQueue(mesh, policy=_rt_policy(),
+                            streaming=streaming, **pol_kw)
+        if q._wave_stats is None:
+            q._wave_stats = serving._WaveStats(q.kind)
+        try:
+            hs = []
+            next_flush = time.perf_counter() + cadence
+            for i, (gap, tenant) in enumerate(trace):
+                time.sleep(gap)
+                if not streaming and time.perf_counter() >= next_flush:
+                    q.flush(reason="manual")
+                    next_flush = time.perf_counter() + cadence
+                hs.append(q.submit(_x(i, shape), tenant=tenant))
+            if streaming:
+                q.stop(drain=True)
+            else:
+                q.flush(reason="manual")
+            for h in hs:
+                h.result(timeout=120)
+            return q._wave_stats.snapshot()
+        finally:
+            q.close()
+
+    drive(True)  # warm: compiles land in the plan/compile caches
+    stream_snap = drive(True)
+    flush_snap = drive(False)
+    s_idle = stream_snap["idle_fraction"]
+    f_idle = flush_snap["idle_fraction"]
+    assert s_idle is not None and f_idle is not None
+    assert s_idle < f_idle, (
+        f"streaming idle {s_idle:.3f} not below flush-cadence idle "
+        f"{f_idle:.3f}")
+    rt = stream_snap["admit_wait"].get("realtime")
+    assert rt and rt["n"] > 0
+    dur_max = stream_snap["wave_duration_max_s"] or 0.0
+    assert rt["p99_s"] <= dur_max + 0.05, (
+        f"realtime p99 admit wait {rt['p99_s']:.4f}s exceeds one wave "
+        f"duration ({dur_max:.4f}s) beyond scheduling noise")
