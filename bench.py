@@ -271,18 +271,24 @@ def _plan_wire_kw(plan) -> dict:
     label's ``:bf16``/``:f32`` suffix) — _emit drops the defaults so
     exact/alltoall/full-precision rows keep the old schema."""
     opts = getattr(plan, "options", None)
+    ex = getattr(plan, "executor", None) or ""
     return {
         "wire_dtype": getattr(opts, "wire_dtype", None),
         "transport": getattr(opts, "algorithm", None),
         "precision": getattr(opts, "mm_precision", None),
+        # Pallas fusion tier (executor label ":fuse" — stage-pair
+        # mega-kernels): stamped so fused runs form their own baseline
+        # group; unfused rows keep the old schema (None is dropped).
+        "fusion": True if ":fuse" in ex else None,
     }
 
 
 def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
           all_times, donated=False, stages=None, overlap=None, tuned=None,
           cost=None, batch=None, wire_dtype=None, transport=None,
-          precision=None, op=None, degraded=False, concurrent=None,
-          scheduler=None, waves_per_s=None, occupancy=None):
+          precision=None, fusion=None, op=None, degraded=False,
+          concurrent=None, scheduler=None, waves_per_s=None,
+          occupancy=None):
     import jax
 
     from distributedfft_tpu.utils.metrics import metrics_snapshot
@@ -387,6 +393,13 @@ def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
         # one-pass bf16 run must never be judged against f32-exact
         # baselines or vice versa. Untier'd rows keep the old schema.
         out["precision"] = precision
+    if fusion:
+        # Pallas fusion tier run (executor ``pallas:fuse`` — adjacent
+        # stage pairs collapsed into shape-specialized mega-kernels):
+        # keyed into the baseline config group so a fused run's wall
+        # time is never judged against unfused baselines or vice versa.
+        # Unfused rows keep the old schema.
+        out["fusion"] = True
     if degraded:
         # Degraded-mode fallback run (docs/ROBUSTNESS.md): the matmul-
         # DFT executor stood in for a faulted default. The run-record

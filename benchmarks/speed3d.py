@@ -69,6 +69,20 @@ def parse_args(argv):
                         "'<alg>+mmbf16' (mirroring '+wbf16') so "
                         "reduced-precision sweep rows never mix with "
                         "exact baselines. Matmul-family executors only")
+    p.add_argument("-fuse", action="store_true",
+                   help="request the Pallas fusion tier: composes onto "
+                        "-executor as the fused label ('pallas' -> "
+                        "'pallas:fuse'), collapsing adjacent stage "
+                        "pairs (stage FFT + wire encode, decode + "
+                        "stage FFT) into ONE shape-specialized Pallas "
+                        "mega-kernel each — the inter-stage HBM "
+                        "round-trip elided. Needs -wire (the fusion "
+                        "pass gates on a wire codec) and K=1; "
+                        "ineligible sites fall back counted, never "
+                        "fail. Stamped into the CSV algorithm column "
+                        "'<alg>+pfuse' so fused sweep rows never mix "
+                        "with unfused baselines. Pallas-family "
+                        "executors only")
     p.add_argument("-concurrent", type=int, default=None, metavar="N",
                    help="co-scheduled transform count: N independent "
                         "transforms merged into ONE interleaved device "
@@ -124,19 +138,22 @@ def parse_args(argv):
                         "suffix), so tuned sweeps never mix with untuned "
                         "baselines")
     p.add_argument("-wire", default=None,
-                   choices=("bf16", "int8", "none"),
+                   choices=("bf16", "int8", "split", "none"),
                    metavar="DTYPE",
                    help="on-wire exchange compression codec: 'bf16' "
                         "casts the t2 payload to (real, imag) bfloat16 "
                         "pairs around each collective (half the wire "
                         "bytes for c64), 'int8' block-scales the "
                         "component planes to int8 with an f32 scale "
-                        "sidecar (~quarter the c64 wire bytes), 'none' "
-                        "pins the exact wire (overriding "
+                        "sidecar (~quarter the c64 wire bytes), "
+                        "'split' ships int16 mantissas with a shared "
+                        "power-of-two exponent sidecar (half the wire "
+                        "bytes at ~100x better accuracy than bf16), "
+                        "'none' pins the exact wire (overriding "
                         "DFFT_WIRE_DTYPE). Stamped into the CSV "
-                        "algorithm column '<alg>+wbf16'/'+wint8' so "
-                        "compressed sweep rows never mix with exact "
-                        "baselines")
+                        "algorithm column '<alg>+wbf16'/'+wint8'/"
+                        "'+wsplit' so compressed sweep rows never mix "
+                        "with exact baselines")
     p.add_argument("-r2c_axis", type=int, default=2, choices=(0, 1, 2),
                    help="halved axis for r2c/c2r (heFFTe r2c_direction)")
     p.add_argument("-ndev", type=int, default=None, help="device count (default: all)")
@@ -259,6 +276,11 @@ def main(argv=None) -> None:
             raise SystemExit("-tune owns the wire axis (compressed "
                              "candidates enter only under a plan error "
                              "budget); do not pin one with -wire")
+        if args.fuse:
+            raise SystemExit("-tune owns the fusion axis (fused "
+                             "candidates enter the tournament beside "
+                             "their wire codecs); do not pin it with "
+                             "-fuse")
     if args.explain:
         if args.bricks or args.precision == "dd":
             raise SystemExit("-explain applies to the c2c/r2c chain "
@@ -266,6 +288,9 @@ def main(argv=None) -> None:
         args.metrics = True  # the attribution join reads the registry
     if args.wire is not None and (args.bricks or args.precision == "dd"):
         raise SystemExit("-wire applies to the c2c/r2c chain planners; "
+                         "brick and dd plans do not take it")
+    if args.fuse and (args.bricks or args.precision == "dd"):
+        raise SystemExit("-fuse applies to the c2c/r2c chain planners; "
                          "brick and dd plans do not take it")
     if args.batch is not None:
         if args.batch < 1:
@@ -373,6 +398,14 @@ def main(argv=None) -> None:
         from distributedfft_tpu.ops.executors import tiered_name
 
         args.executor = tiered_name(args.executor, args.mm)
+    if args.fuse:
+        # Compose the fusion flag onto the executor label the same way
+        # -mm composes the tier: one composition point, resolved by
+        # every downstream consumer through the executor-label grammar.
+        # Raises for non-Pallas executors (fusion is meaningless there).
+        from distributedfft_tpu.ops.executors import fused_name
+
+        args.executor = fused_name(args.executor, True)
     plan_fn = dfft.plan_dft_r2c_3d if args.kind == "r2c" else dfft.plan_dft_c2c_3d
     kw = dict(decomposition=decomposition, executor=args.executor,
               dtype=dtype, algorithm=algorithm)
@@ -677,7 +710,8 @@ def main(argv=None) -> None:
         alg_label = _algorithm_label(
             algorithm, overlap, batch=bsz,
             wire=getattr(fwd.options, "wire_dtype", None), op=args.op,
-            mm=getattr(fwd.options, "mm_precision", None))
+            mm=getattr(fwd.options, "mm_precision", None),
+            fuse=":fuse" in (fwd.executor or ""))
         if ccn is not None:
             # Concurrent rows compile a merged N-transform program —
             # never comparable to sequential rows (same separation rule
@@ -732,17 +766,20 @@ def _algorithm_label(algorithm: str, overlap: int | None,
                      batch: int | None = None,
                      wire: str | None = None,
                      op: str | None = None,
-                     mm: str | None = None) -> str:
+                     mm: str | None = None,
+                     fuse: bool = False) -> str:
     """Algorithm column label with the overlap chunk count
     (``alltoall+ov4``), coalesced batch size (``alltoall+b8``), on-wire
     compression (``alltoall+wbf16``), fused spectral operator
-    (``alltoall+oppoisson``), and/or plan-scoped matmul precision tier
-    (``alltoall+mmbf16``) appended — overlapped / batched / compressed /
-    operator / reduced-precision sweep rows must never be
-    indistinguishable from monolithic exact single-transform baselines
-    (the regress store keys the label into the baseline config group).
-    Default (K=1, unbatched, exact-wire, bare-transform, env-default
-    precision) rows keep the bare name (schema unchanged)."""
+    (``alltoall+oppoisson``), plan-scoped matmul precision tier
+    (``alltoall+mmbf16``), and/or Pallas stage-pair fusion
+    (``alltoall+wbf16+pfuse``) appended — overlapped / batched /
+    compressed / operator / reduced-precision / fused sweep rows must
+    never be indistinguishable from monolithic exact single-transform
+    baselines (the regress store keys the label into the baseline
+    config group). Default (K=1, unbatched, exact-wire, bare-transform,
+    env-default precision, unfused) rows keep the bare name (schema
+    unchanged)."""
     label = (f"{algorithm}+ov{overlap}"
              if overlap and overlap != 1 else algorithm)
     if batch and batch > 1:
@@ -753,6 +790,8 @@ def _algorithm_label(algorithm: str, overlap: int | None,
         label += f"+op{op}"
     if mm:
         label += f"+mm{mm}"
+    if fuse:
+        label += "+pfuse"
     return label
 
 

@@ -54,6 +54,7 @@ from .plan_logic import (
     PlanOptions,
     io_boxes,
     logic_plan3d,
+    resolve_fuse,
     resolve_overlap_chunks,
     resolve_tune_mode,
     spec_entries as _spec_entries_impl,
@@ -219,18 +220,20 @@ def _resolve_options(
     max_roundtrip_err: float | None = None,
     mm_precision: str | None = None,
     mm_complex: str | None = None,
+    fuse: bool | str | None = None,
 ) -> PlanOptions:
     if options is not None:
         if (decomposition is not None or executor != "xla" or donate
                 or algorithm != "alltoall" or overlap_chunks is not None
                 or tune is not None or wire_dtype is not None
                 or max_roundtrip_err is not None
-                or mm_precision is not None or mm_complex is not None):
+                or mm_precision is not None or mm_complex is not None
+                or fuse is not None):
             raise ValueError(
                 "pass either options= or individual plan keywords, not both"
             )
-        return _apply_mm_tiers(options)
-    return _apply_mm_tiers(PlanOptions(
+        return _apply_fuse(_apply_mm_tiers(options))
+    return _apply_fuse(_apply_mm_tiers(PlanOptions(
         decomposition=decomposition or "auto",
         algorithm=algorithm,
         executor=_default_executor(executor),
@@ -241,7 +244,8 @@ def _resolve_options(
         max_roundtrip_err=max_roundtrip_err,
         mm_precision=mm_precision,
         mm_complex=mm_complex,
-    ))
+        fuse=fuse,
+    )))
 
 
 def _apply_mm_tiers(opts: PlanOptions) -> PlanOptions:
@@ -256,7 +260,8 @@ def _apply_mm_tiers(opts: PlanOptions) -> PlanOptions:
     import dataclasses
 
     from .ops.executors import (
-        MM_EXECUTOR_BASES, split_executor, tiered_name,
+        MM_EXECUTOR_BASES, fused_name, split_executor, split_fuse,
+        tiered_name,
     )
 
     ex = opts.executor
@@ -264,11 +269,14 @@ def _apply_mm_tiers(opts: PlanOptions) -> PlanOptions:
         if ":" not in ex:
             return opts
         base, tier, cmode = split_executor(ex)  # validates the label
+        _, want_fuse = split_fuse(ex)  # the orthogonal fusion flag
         return dataclasses.replace(
             opts, mm_precision=tier, mm_complex=cmode,
-            # Canonical spelling ("matmul:high" -> "matmul:f32"): one
-            # label per tier across cache keys, wisdom, and stamps.
-            executor=tiered_name(base, tier, cmode))
+            # Canonical spelling ("matmul:high" -> "matmul:f32", the
+            # ":fuse" flag last): one label per tier across cache keys,
+            # wisdom, and stamps.
+            executor=fused_name(tiered_name(base, tier, cmode),
+                                want_fuse or None))
     if not ex.split(":", 1)[0].startswith(MM_EXECUTOR_BASES):
         if resolve_tune_mode(opts.tune) != "off":
             # Tuned planning: the tier choice pins the TUNER's precision
@@ -286,6 +294,45 @@ def _apply_mm_tiers(opts: PlanOptions) -> PlanOptions:
                          else (name, None, None))
     return dataclasses.replace(opts, executor=name, mm_precision=tier,
                                mm_complex=cmode)
+
+
+def _apply_fuse(opts: PlanOptions) -> PlanOptions:
+    """Normalize the Pallas fusion flag into the canonical executor
+    label — the ``_apply_mm_tiers`` convention: after this,
+    ``opts.executor``'s ``:fuse`` flag and ``opts.fuse`` are two views
+    of one choice (the label is what the plan cache, wisdom store, and
+    benchmark stamps key; whether fusion actually *activates* is then
+    the stage-graph gate, :func:`..stagegraph.plan_fusion`).
+
+    An explicit ``fuse=True`` on an executor family without a fusion
+    tier is a loud error (the ``mm_precision`` discipline); the
+    ``DFFT_FUSE`` env default is a preference and is ignored there —
+    a global ``DFFT_FUSE=1`` must not break ``xla`` plans."""
+    import dataclasses
+
+    from .ops.executors import FUSE_BASES, fused_name, split_fuse
+
+    ex = opts.executor
+    if not isinstance(ex, str):
+        return opts
+    pinned = split_fuse(ex)[1] if ":" in ex else False
+    if opts.fuse is False and pinned:
+        raise ValueError(
+            f"executor {ex!r} already pins the fuse flag; fuse=False "
+            f"conflicts (drop one of the two spellings)")
+    want = resolve_fuse(opts.fuse)
+    if want and not pinned:
+        if ex.split(":", 1)[0] in FUSE_BASES:
+            ex = fused_name(ex, True)
+            pinned = True
+        elif opts.fuse is not None:
+            raise ValueError(
+                f"fuse=True scopes the Pallas-family executors "
+                f"{FUSE_BASES}; executor={ex!r} has no fusion tier "
+                f"(the DFFT_FUSE env default is ignored there)")
+    if ex == opts.executor and bool(opts.fuse) == pinned:
+        return opts
+    return dataclasses.replace(opts, executor=ex, fuse=pinned)
 
 
 def _thunk_guard_executor(opts: PlanOptions, lp: LogicPlan,
@@ -505,6 +552,7 @@ def plan_dft_c2c_3d(
     max_roundtrip_err: float | None = None,
     mm_precision: str | None = None,
     mm_complex: str | None = None,
+    fuse: bool | str | None = None,
     options: PlanOptions | None = None,
     in_spec: P | None = None,
     out_spec: P | None = None,
@@ -576,6 +624,15 @@ def plan_dft_c2c_3d(
     ``DFFT_MM_PRECISION`` env default at trace time, byte-identical to
     today. ``mm_complex="gauss"`` likewise scopes the 3-real-matmul
     complex product (env default ``DFFT_MM_COMPLEX``).
+
+    ``fuse=True`` requests the Pallas fusion tier (executor label
+    ``pallas:fuse`` — the same choice spelled as a kwarg): adjacent
+    stage/codec pairs around each compressed exchange collapse into one
+    shape-specialized mega-kernel when the stage-graph gate passes
+    (``wire_dtype`` set, ``overlap_chunks=1``); ineligible graphs and
+    shapes fall back to the unfused chain, counted and explain-visible,
+    never an error. ``None`` defers to ``DFFT_FUSE`` (unset = off,
+    byte-identical HLO). See docs/TUNING.md, "Pallas fusion tier".
     """
     shape, forward = _check_direction(shape, direction)
     batch = _norm_batch(batch)
@@ -584,7 +641,8 @@ def plan_dft_c2c_3d(
                          "in_spec/out_spec require batch=None (or 1)")
     opts = _resolve_options(decomposition, executor, donate, algorithm,
                             options, overlap_chunks, tune, wire_dtype,
-                            max_roundtrip_err, mm_precision, mm_complex)
+                            max_roundtrip_err, mm_precision, mm_complex,
+                            fuse)
     if resolve_tune_mode(opts.tune) != "off":
         from . import tuner
 
@@ -1023,6 +1081,7 @@ def plan_dft_r2c_3d(
     max_roundtrip_err: float | None = None,
     mm_precision: str | None = None,
     mm_complex: str | None = None,
+    fuse: bool | str | None = None,
     options: PlanOptions | None = None,
     in_spec: P | None = None,
     out_spec: P | None = None,
@@ -1062,7 +1121,7 @@ def plan_dft_r2c_3d(
             donate=donate, algorithm=algorithm,
             overlap_chunks=overlap_chunks, tune=tune,
             wire_dtype=wire_dtype, max_roundtrip_err=max_roundtrip_err,
-            mm_precision=mm_precision, mm_complex=mm_complex,
+            mm_precision=mm_precision, mm_complex=mm_complex, fuse=fuse,
             options=options, in_spec=in_spec, out_spec=out_spec,
         )
     if batch is not None and (in_spec is not None or out_spec is not None):
@@ -1071,7 +1130,8 @@ def plan_dft_r2c_3d(
     shape, forward = _check_direction(shape, direction)
     opts = _resolve_options(decomposition, executor, donate, algorithm,
                             options, overlap_chunks, tune, wire_dtype,
-                            max_roundtrip_err, mm_precision, mm_complex)
+                            max_roundtrip_err, mm_precision, mm_complex,
+                            fuse)
     if resolve_tune_mode(opts.tune) != "off":
         from . import tuner
 
@@ -1210,7 +1270,8 @@ def _r2c_axis_wrapped(shape, mesh, axis: int, *, direction, decomposition,
                       executor, dtype, donate, algorithm, options, in_spec,
                       out_spec, overlap_chunks=None, tune=None,
                       wire_dtype=None, max_roundtrip_err=None,
-                      mm_precision=None, mm_complex=None) -> Plan3D:
+                      mm_precision=None, mm_complex=None,
+                      fuse=None) -> Plan3D:
     """r2c/c2r with the halved axis != 2 (heFFTe ``r2c_direction`` 0/1):
     the canonical chain (real axis = 2) runs on a transposed view.
     Caller-facing metadata — shapes, shardings, boxes — is permuted back
@@ -1230,7 +1291,7 @@ def _r2c_axis_wrapped(shape, mesh, axis: int, *, direction, decomposition,
             executor=executor, dtype=dtype, donate=donate,
             algorithm=algorithm, overlap_chunks=overlap_chunks, tune=tune,
             wire_dtype=wire_dtype, max_roundtrip_err=max_roundtrip_err,
-            mm_precision=mm_precision, mm_complex=mm_complex,
+            mm_precision=mm_precision, mm_complex=mm_complex, fuse=fuse,
             options=options,
             in_spec=_permute_spec3(in_spec, perm),
             out_spec=_permute_spec3(out_spec, perm),
@@ -1680,6 +1741,10 @@ _PLAN_ENV_KNOBS = (
     # resolves from the env at plan time, so two calls under different
     # wire modes compile different collective programs.
     "DFFT_WIRE_DTYPE",
+    # Pallas fusion tier: the default of PlanOptions.fuse resolves from
+    # the env at plan time (fused chains compile a different program —
+    # codec moved out of the transport into the stage kernels).
+    "DFFT_FUSE",
 )
 
 

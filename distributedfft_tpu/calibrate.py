@@ -339,6 +339,49 @@ def _measure_leg_gbps(iters: int) -> tuple[float | None, float | None]:
     return ici, dcn
 
 
+def _measure_fuse_speedup(iters: int) -> float | None:
+    """Fused-vs-unfused stage-pair throughput: the measured speedup of
+    ONE ``pallas:fuse`` mega-kernel (stage FFT + wire encode in a single
+    launch, intermediate kept in VMEM) over the unfused chain (Pallas
+    FFT to HBM, then the codec's encode pass re-reading it) on a
+    representative stage block. ``> 1`` means the fusion tier's HBM
+    round-trip saving is real on this chip — the number the pruning
+    model's ``(1 + wire_factor)/2`` stage discount claims. TPU only:
+    off-TPU the Pallas kernels run interpreted and the ratio would
+    measure the Python interpreter, so the field stays null (consumers
+    treat null as "model discount unverified")."""
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        return None
+    from .ops import pallas_fft, pallas_fuse
+    from .parallel.exchange import wire_codec
+    from .utils.timing import time_fn_amortized
+
+    rows, n, tiles = 256, 512, 8
+    if pallas_fuse.kernel_ineligible(
+            (rows, n), 1, 1, tiles, jnp.complex64, "split") is not None:
+        return None
+    x = jnp.ones((rows, n), jnp.complex64)
+    codec = wire_codec("split")
+
+    @jax.jit
+    def unfused(v):
+        y = pallas_fft.fft_along_axis(v, axis=1, forward=True)
+        return codec.encode(y, tile_axis=1, tiles=tiles)
+
+    @jax.jit
+    def fused(v):
+        return pallas_fuse.fused_fft_encode(
+            v, fft_axis=1, forward=True, tile_axis=1, tiles=tiles,
+            wire_dtype="split")
+
+    tu, _ = time_fn_amortized(unfused, x, iters=iters, repeats=2)
+    tf, _ = time_fn_amortized(fused, x, iters=iters, repeats=2)
+    return tu / tf if tu > 0 and tf > 0 else None
+
+
 def _measure_launch_seconds(iters: int) -> float | None:
     """Fixed per-dispatch cost: a trivial jitted op, synced per call —
     the launch + host round-trip floor the exchange model charges per
@@ -385,6 +428,9 @@ def calibrate(iters: int = 10, *, wire: bool = True) -> dict:
         ("wire_gbps", (lambda: _measure_wire_gbps(iters)) if wire
          else (lambda: None)),
         ("launch_seconds", lambda: _measure_launch_seconds(iters)),
+        # Fused stage-pair tier: measured mega-kernel vs unfused-chain
+        # speedup (null off-TPU — the tier only compiles natively there).
+        ("fuse_speedup", lambda: _measure_fuse_speedup(iters)),
     ):
         try:
             prof[field] = fn()
@@ -441,6 +487,9 @@ def format_profile(prof: dict) -> str:
         f"matmul bf16:    {num(prof.get('mm_bf16_tflops'), 'TFlop/s')}",
         f"matmul f32:     {num(prof.get('mm_f32_tflops'), 'TFlop/s')}",
         f"launch floor:   {num(prof.get('launch_seconds'), 's')}",
+        f"fuse speedup:   {num(prof.get('fuse_speedup'), 'x')}"
+        + ("" if prof.get("fuse_speedup") is not None
+           else "  (TPU only: fused stage-pair tier unmeasured)"),
         f"ici leg:        {num(prof.get('ici_gbps'), 'GB/s')}",
         f"dcn leg:        {num(prof.get('dcn_gbps'), 'GB/s')}"
         + ("" if prof.get("dcn_gbps") is not None

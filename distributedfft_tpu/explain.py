@@ -193,7 +193,7 @@ def model_stage_estimates(plan, hw: dict | None = None) -> dict:
     divergence gate then judges the model *after* its own persisted
     feedback."""
     from .calibrate import model_correction
-    from .plan_logic import model_stage_seconds
+    from .plan_logic import fused_model_stages, model_stage_seconds
     from .tuner import mm_tier_tflops
 
     hw = hw or device_profile()
@@ -219,6 +219,11 @@ def model_stage_estimates(plan, hw: dict | None = None) -> dict:
         # mm_tier_tflops); None for every other executor keeps the pure
         # HBM roofline byte-identical.
         mm_tflops=mm_tier_tflops(plan.executor),
+        # Fused stage pairs keep the intermediate in VMEM: the stages
+        # the fusion pass actually collapses for this plan shape are
+        # priced without their inter-stage c64 HBM stream (empty tuple
+        # for every unfused plan keeps the roofline byte-identical).
+        fused=fused_model_stages(lp, shape, itemsize),
     )
 
 
@@ -1086,6 +1091,24 @@ def explain(
                  for k in STAGE_KEYS})
         except Exception:  # noqa: BLE001 — a single-controller runtime
             record["across_hosts"] = None  # without allgather support
+    # Fusion-tier view: what the stage-graph fusion pass decided for
+    # this plan (``graph.meta["fusion"]``, stamped at compile time) —
+    # the requested/active verdict, the gate reasons when it stayed
+    # off, and the per-exchange site routing (sender/receiver kernel
+    # vs counted fallback). Captured last: the site records fill in
+    # when the plan body traces, which the measurements above force.
+    try:
+        meta = getattr(plan.graph, "meta", None)
+        fu = meta.get("fusion") if isinstance(meta, dict) else None
+    except Exception:  # noqa: BLE001 — plans below the graph tier
+        fu = None
+    record["fusion"] = None if not isinstance(fu, dict) else {
+        "requested": bool(fu.get("requested")),
+        "active": bool(fu.get("active")),
+        "reasons": [str(r) for r in (fu.get("reasons") or ())],
+        "sites": {str(k): dict(v)
+                  for k, v in (fu.get("sites") or {}).items()},
+    }
     return record
 
 
@@ -1130,6 +1153,21 @@ def format_explain(record: dict) -> str:
             f"wire: {wire['wire_dtype']} compression"
             + (f" (x{wf:.2f} wire bytes" if wf else " (")
             + (f", round-trip err {err:.2e})" if err is not None else ")"))
+    fu = record.get("fusion")
+    if isinstance(fu, dict) and fu.get("requested"):
+        if fu.get("active"):
+            sites = fu.get("sites") or {}
+            routes = sorted(
+                f"{v.get('sender', '?')}+{v.get('receiver', '?')}"
+                for v in sites.values()) if sites else []
+            lines.append(
+                "fusion: active (stage-pair mega-kernels"
+                + (f"; sites {', '.join(routes)}" if routes else "")
+                + ")")
+        else:
+            lines.append(
+                "fusion: requested but gated off "
+                f"({', '.join(fu.get('reasons') or ['unknown'])})")
     timing = record.get("timing") or {}
     if timing.get("source") == "device":
         lines.append("timing: device timeline (jax.profiler capture)")

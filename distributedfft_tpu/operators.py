@@ -370,6 +370,7 @@ def plan_spectral_op(
     tune: str | None = None,
     wire_dtype: str | None = None,
     max_roundtrip_err: float | None = None,
+    fuse: bool | str | None = None,
     options=None,
     batch: int | None = None,
 ) -> OpPlan3D:
@@ -408,7 +409,7 @@ def plan_spectral_op(
     batch = _api._norm_batch(batch)
     opts = _api._resolve_options(
         decomposition, executor, donate, algorithm, options,
-        overlap_chunks, tune, wire_dtype, max_roundtrip_err)
+        overlap_chunks, tune, wire_dtype, max_roundtrip_err, fuse=fuse)
     if resolve_tune_mode(opts.tune) != "off":
         return _tuned_op_plan(shape, mesh, op, opts,
                               dict(dtype=dtype, batch=batch))

@@ -71,6 +71,67 @@ MM_EXECUTOR_BASES = ("matmul", "pallas")
 MM_COMPLEX_MODES = ("native", "gauss")
 
 
+#: The stage-fusion flag token: ``pallas:fuse`` asks the stage-graph
+#: compiler's fusion pass (``stagegraph.plan_fusion``) to fuse the wire
+#: codec's encode/decode into the adjacent stage computes — Pallas
+#: mega-kernels where the shapes are eligible, the pure-JAX codec mirror
+#: otherwise. Orthogonal to the precision tiers: the flag never changes
+#: the local executor callable (``get_executor("pallas:fuse")`` is the
+#: plain pallas executor), it is plan-level state the compiler consumes.
+FUSE_SUFFIX = "fuse"
+
+#: Bases the fuse flag composes with (the fused kernels are Pallas
+#: specializations; other bases have no fused engine to dispatch to).
+FUSE_BASES = ("pallas",)
+
+
+def split_fuse(name: str) -> tuple[str, bool]:
+    """Strip the ``:fuse`` flag off an executor label: ``"pallas:fuse"
+    -> ("pallas", True)``, ``"pallas:bf16:fuse" -> ("pallas:bf16",
+    True)``; unfused labels return ``(name, False)``. Validates the flag
+    only rides a :data:`FUSE_BASES` base and appears at most once. Pure
+    label algebra — the fusion pass and the planner normalization share
+    this one parse."""
+    if ":" not in name:
+        return name, False
+    base, *mods = name.split(":")
+    hits = mods.count(FUSE_SUFFIX)
+    if hits == 0:
+        return name, False
+    if hits > 1:
+        raise ValueError(f"executor {name!r} repeats the fuse flag")
+    if base not in FUSE_BASES:
+        raise ValueError(
+            f"the :fuse flag applies to {FUSE_BASES} executors, "
+            f"got {name!r}")
+    rest = [m for m in mods if m != FUSE_SUFFIX]
+    return ":".join([base] + rest), True
+
+
+def fused_name(name: str, fuse: bool | None = None) -> str:
+    """Compose/normalize the fuse flag onto a label (the fuse analog of
+    :func:`tiered_name`). ``fuse=None`` keeps the label's own flag;
+    ``True`` adds it (idempotent; validates the base); ``False`` with a
+    label that already pins ``:fuse`` raises — a plan asking for
+    ``executor="pallas:fuse", fuse=False`` is a bug, not a precedence
+    question. The canonical composed form carries ``:fuse`` last:
+    ``pallas:bf16:fuse``."""
+    bare, have = split_fuse(name)
+    if fuse is None:
+        fuse = have
+    elif have and not fuse:
+        raise ValueError(
+            f"executor {name!r} already pins the fuse flag; "
+            f"conflicting request fuse=False")
+    if not fuse:
+        return bare
+    if bare.split(":", 1)[0] not in FUSE_BASES:
+        raise ValueError(
+            f"the fuse tier applies to {FUSE_BASES} executors, "
+            f"got {name!r}")
+    return bare + f":{FUSE_SUFFIX}"
+
+
 def split_executor(name: str) -> tuple[str, str | None, str | None]:
     """Parse a (possibly tiered) executor label into
     ``(base, precision_tier, complex_mode)`` — e.g. ``"matmul:bf16:gauss"
@@ -80,6 +141,7 @@ def split_executor(name: str) -> tuple[str, str | None, str | None]:
     grammar). Validates suffix vocabulary and that the base consults the
     precision knobs at all; does NOT require the base to be registered
     (pure label algebra, shared with the tuner's candidate space)."""
+    name, _ = split_fuse(name)  # the fuse flag is orthogonal label state
     if ":" not in name:
         return name, None, None
     base, *mods = name.split(":")
@@ -117,6 +179,7 @@ def tiered_name(base: str, precision: str | None = None,
     for ``executor="matmul:bf16", mm_precision="highest"`` is a bug, not
     a precedence question). ``None`` tiers leave the bare name (the env
     defaults keep governing that plan's trace)."""
+    base, have_fuse = split_fuse(base)
     b, have_tier, have_cmode = (split_executor(base) if ":" in base
                                 else (base, None, None))
     if precision is not None:
@@ -139,10 +202,10 @@ def tiered_name(base: str, precision: str | None = None,
     if cmode == "native":
         cmode = None  # the bare default — not a distinct label
     if tier is None and cmode is None:
-        return b
+        return fused_name(b, have_fuse) if have_fuse else b
     name = b + (f":{tier}" if tier else "") + (f":{cmode}" if cmode else "")
     split_executor(name)  # one validation path for every composed label
-    return name
+    return fused_name(name, have_fuse) if have_fuse else name
 
 
 #: Executor bases that lower through XLA's FFT ops — the family the
@@ -467,10 +530,12 @@ def _pallas_executor(x: Array, axes: Sequence[int], forward: bool = True) -> Arr
     if (len(axes) >= 2 and jnp.dtype(x.dtype) == jnp.complex64
             and x.size > 0
             and {axes[-2] % x.ndim, axes[-1] % x.ndim}
-            == {x.ndim - 2, x.ndim - 1}
-            and pallas_fft.eligible2d(x.shape[-2], x.shape[-1])):
-        x = pallas_fft.fft2_last(x, forward=forward)
-        axes = axes[:-2]
+            == {x.ndim - 2, x.ndim - 1}):
+        if pallas_fft.eligible2d(x.shape[-2], x.shape[-1]):
+            x = pallas_fft.fft2_last(x, forward=forward)
+            axes = axes[:-2]
+        else:
+            pallas_fft.record_fallback(axes[-1], "plane2d")
     for ax in axes:
         x = pallas_fft.fft_along_axis(x, ax, forward=forward)
     return x

@@ -743,18 +743,32 @@ def _four_step_ref(x2: jnp.ndarray, n: int, forward: bool) -> jnp.ndarray:
     return z.transpose(0, 2, 1).reshape(x2.shape)
 
 
+def record_fallback(axis, reason: str) -> None:
+    """Count one Pallas-eligibility fallback into the ``pallas_fallback``
+    metrics series (axis + reason labels). Trace-time: the eligibility
+    decision is static per compiled plan, so the counter ticks once per
+    trace, not per execute — the observable is *which shapes route away
+    from the kernel and why* (docs/OBSERVABILITY.md)."""
+    from ..utils import metrics as _metrics
+
+    _metrics.inc("pallas_fallback", axis=int(axis), reason=reason)
+
+
 def fft_along_axis(x: jnp.ndarray, axis: int, forward: bool = True) -> jnp.ndarray:
     """C2C FFT along one axis via the fused Pallas kernel; falls back to the
-    recursive MXU-matmul path for ineligible lengths/dtypes. Forward is
-    unnormalized, inverse scaled by 1/n (numpy convention)."""
+    recursive MXU-matmul path for ineligible lengths/dtypes (counted in the
+    ``pallas_fallback`` metrics series). Forward is unnormalized, inverse
+    scaled by 1/n (numpy convention)."""
     from . import dft_matmul
 
     n = x.shape[axis]
     two_level = False
     if jnp.dtype(x.dtype) != jnp.complex64 or x.size == 0:
+        record_fallback(axis, "dtype" if x.size else "empty")
         return dft_matmul.fft_along_axis(x, axis, forward=forward)
     if not eligible(n):
         if outer_split(n) is None:
+            record_fallback(axis, "length")
             return dft_matmul.fft_along_axis(x, axis, forward=forward)
         two_level = True
 

@@ -215,6 +215,17 @@ def _bf16_decode(parts, dtype, *, tile_axis: int = 0,
     return lax.complex(r, i).astype(dtype)
 
 
+def exact_pow2(k: jnp.ndarray) -> jnp.ndarray:
+    """Exact float32 ``2**k`` for integer-valued ``k``, built from the
+    exponent bits. XLA's ``exp2`` can land 1 ulp off an exact power of
+    two (observed on XLA:CPU at ``exp2(-13.0)``), which would silently
+    void the exact-decode/idempotence contract the pow2 steps exist
+    for. Clamps to the normal range (denormal steps would lose the
+    exact ``q * step`` product anyway)."""
+    kk = jnp.clip(k, -126.0, 127.0).astype(jnp.int32)
+    return lax.bitcast_convert_type((kk + 127) << 23, jnp.float32)
+
+
 def _pow2_step(amax: jnp.ndarray) -> jnp.ndarray:
     """Power-of-two quantization step covering ``amax`` in 127 signed
     levels. Power-of-two steps make every decode product ``q * step``
@@ -222,8 +233,9 @@ def _pow2_step(amax: jnp.ndarray) -> jnp.ndarray:
     the property the staged per-leg wire boundaries (decode at one
     stage's exit, re-encode at the next stage's entry) rely on for
     bit-parity with the fused single-cast chain."""
+    safe = jnp.where(amax > 0.0, amax, jnp.float32(127.0))
     return jnp.where(
-        amax > 0.0, jnp.exp2(jnp.ceil(jnp.log2(amax / 127.0))),
+        amax > 0.0, exact_pow2(jnp.ceil(jnp.log2(safe / 127.0))),
         jnp.float32(1.0)).astype(jnp.float32)
 
 
@@ -284,6 +296,66 @@ def _int8_decode(parts, dtype, *, tile_axis: int = 0,
     return lax.complex(r, i).astype(dtype)
 
 
+def _pow2_step16(amax: jnp.ndarray) -> jnp.ndarray:
+    """Power-of-two step covering ``amax`` in 32767 signed levels — the
+    16-bit analog of :func:`_pow2_step`, with the same exactly-idempotent
+    decode property (``q * step`` exact in float32)."""
+    safe = jnp.where(amax > 0.0, amax, jnp.float32(32767.0))
+    return jnp.where(
+        amax > 0.0, exact_pow2(jnp.ceil(jnp.log2(safe / 32767.0))),
+        jnp.float32(1.0)).astype(jnp.float32)
+
+
+def _split_encode(x: jnp.ndarray, *, tile_axis: int = 0,
+                  tiles: int = 1) -> tuple:
+    """Split-exponent (shared-exponent block-float) wire form: one
+    power-of-two exponent per (peer tile, component plane) rides a tiny
+    f32 sidecar while every element ships a full int16 mantissa. Same
+    wire bytes as ``bf16`` (4 per complex pair) but the 15-bit mantissa
+    against a block-shared exponent lands ~2^-15 relative error where
+    bf16's 8-bit mantissa gives ~2^-9 — a distinct accuracy point at
+    the same byte cost. Block/sidecar geometry is identical to
+    :func:`_int8_encode` (one scale slot per peer tile, transported
+    with the payload's (split, concat) semantics)."""
+    _check_complex(x)
+    planes = jnp.stack([x.real, x.imag], axis=-1).astype(jnp.float32)
+    t = tile_axis
+    p = max(1, int(tiles))
+    S = planes.shape[t]
+    c = -(-S // p)
+    padded = _pad_axis(planes, t, p * c)
+    shp = padded.shape
+    view = padded.reshape(shp[:t] + (p, c) + shp[t + 1:])
+    red = tuple(a for a in range(view.ndim)
+                if a != t and a != view.ndim - 1)
+    amax = jnp.max(jnp.abs(view), axis=red, keepdims=True)
+    bshape = [1] * planes.ndim
+    bshape[t] = p
+    bshape[-1] = 2
+    scales = _pow2_step16(amax).reshape(bshape)
+    per_row = lax.slice_in_dim(jnp.repeat(scales, c, axis=t), 0, S, axis=t)
+    q = jnp.clip(jnp.round(planes / per_row), -32767.0, 32767.0).astype(
+        jnp.int16)
+    return (q, scales)
+
+
+def _split_decode(parts, dtype, *, tile_axis: int = 0,
+                  tiles: int = 1) -> jnp.ndarray:
+    """Inverse of :func:`_split_encode` (see :func:`_int8_decode` for
+    the tile-axis alignment contract)."""
+    q, scales = parts
+    t = tile_axis
+    p = max(1, int(tiles))
+    S = q.shape[t]
+    c = -(-S // p)
+    per_row = lax.slice_in_dim(jnp.repeat(scales, c, axis=t), 0, S, axis=t)
+    vals = q.astype(jnp.float32) * per_row  # exact: pow2 step
+    rdt = _component_dtype(dtype)
+    r = vals[..., 0].astype(rdt)
+    i = vals[..., 1].astype(rdt)
+    return lax.complex(r, i).astype(dtype)
+
+
 @dataclass(frozen=True)
 class WireCodec:
     """One pluggable on-wire compression codec of the t2 exchange.
@@ -326,6 +398,9 @@ register_wire_codec(WireCodec(
     name="bf16", pair_bytes=4, encode=_bf16_encode, decode=_bf16_decode))
 register_wire_codec(WireCodec(
     name="int8", pair_bytes=2, encode=_int8_encode, decode=_int8_decode,
+    sidecar=True))
+register_wire_codec(WireCodec(
+    name="split", pair_bytes=4, encode=_split_encode, decode=_split_decode,
     sidecar=True))
 
 

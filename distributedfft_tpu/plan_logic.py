@@ -121,6 +121,18 @@ class PlanOptions:
     ``mm_complex``: plan-scoped complex-product mode of the same family
     (``"gauss"`` = the 3-real-matmul split; ``None``/``"native"`` defers
     to ``DFFT_MM_COMPLEX``).
+    ``fuse``: the Pallas stage-fusion tier — ``True`` composes the
+    ``:fuse`` flag into the executor label (``pallas:fuse``, a DISTINCT
+    plan-cache-keyed executor; :func:`..ops.executors.fused_name`),
+    asking the stage-graph compiler's fusion pass to fold the wire
+    codec's encode/decode into the adjacent stage computes (Pallas
+    mega-kernels where eligible; see ``docs/TUNING.md`` "Pallas fusion
+    tier"). ``False`` pins fusion off; ``None`` (the default) defers to
+    the ``DFFT_FUSE`` env var at plan time (unset -> off,
+    byte-identical HLO to today's plans). Only meaningful with a
+    ``pallas``-family executor and a compressed ``wire_dtype``;
+    ineligible graphs fall back to the unfused chain with a counted,
+    explain-visible reason — never an error.
     """
 
     decomposition: str = "auto"
@@ -134,6 +146,7 @@ class PlanOptions:
     max_roundtrip_err: float | None = None
     mm_precision: str | None = None
     mm_complex: str | None = None
+    fuse: bool | None = None
 
     def __post_init__(self) -> None:
         if self.algorithm not in ALGORITHMS:
@@ -205,6 +218,23 @@ class PlanOptions:
             raise ValueError(
                 f"mm_complex must be one of {MM_COMPLEX_MODES} or None, "
                 f"got {self.mm_complex!r}")
+        fu = self.fuse
+        if isinstance(fu, str):
+            # Env-style spellings normalize to the tri-state bool.
+            fu = fu.strip().lower()
+            if fu in ("", "none"):
+                fu = None
+            elif fu in ("1", "true", "on", "fuse"):
+                fu = True
+            elif fu in ("0", "false", "off"):
+                fu = False
+            else:
+                raise ValueError(
+                    f"fuse must be a bool or None, got {self.fuse!r}")
+            object.__setattr__(self, "fuse", fu)
+        elif fu is not None and not isinstance(fu, bool):
+            raise ValueError(
+                f"fuse must be a bool or None, got {self.fuse!r}")
 
 
 DEFAULT_OPTIONS = PlanOptions()
@@ -292,6 +322,67 @@ def resolve_wire_dtype(value: str | None) -> str | None:
     raise ValueError(
         f"wire_dtype must be one of {tuple(w for w in WIRE_DTYPES if w)} "
         f"or 'none', got {value!r} (check DFFT_WIRE_DTYPE)")
+
+
+def resolve_fuse(value: bool | None) -> bool:
+    """Resolve a ``PlanOptions.fuse`` value to a concrete bool.
+
+    ``None`` reads the ``DFFT_FUSE`` env var at plan time (unset ->
+    ``False``, today's unfused chains — byte-identical HLO); explicit
+    bools pass through. One resolution point so the planners, the
+    tuner's candidate space, and the benchmark drivers agree on whether
+    a given environment fuses."""
+    if value is None:
+        raw = os.environ.get("DFFT_FUSE", "").strip().lower()
+        if raw in ("", "0", "false", "off", "none"):
+            return False
+        if raw in ("1", "true", "on", "fuse"):
+            return True
+        raise ValueError(
+            f"DFFT_FUSE must be 0/1/on/off, got {raw!r}")
+    return bool(value)
+
+
+def fused_model_stages(lp, shape=None, itemsize: int = 8) -> tuple:
+    """Stage keys the Pallas fusion tier fuses with the wire codec for
+    the chain ``lp`` describes — the ``fused=`` argument of
+    :func:`model_stage_seconds` (the explain layer and the tuner's
+    pruning model both derive it here, so they price fused plans
+    identically).
+
+    Empty when the plan does not activate fusion (the
+    :func:`..stagegraph.plan_fusion` gate: executor carries the
+    ``:fuse`` flag, a wire codec is set, overlap K == 1), and for
+    chains with no kernel-fused stage: the single tier has no exchange,
+    and the slab chains' multi-axis t0 sender and trailing op-chain
+    inverse pass run the pure-JAX codec path, whose HBM streams match
+    the unfused chain's — only their t3 receiver (c2c) fuses. Pencil
+    chains fuse sender and every receiver (``t0``/``t1``/``t3``)."""
+    from .ops.executors import split_fuse
+
+    ex = lp.options.executor
+    if not isinstance(ex, str):
+        return ()
+    try:
+        if not split_fuse(ex)[1]:
+            return ()
+    except ValueError:
+        return ()
+    if resolve_wire_dtype(lp.options.wire_dtype) is None:
+        return ()
+    k = lp.options.overlap_chunks
+    if not isinstance(k, int):
+        ndev = 1 if lp.mesh is None else math.prod(lp.mesh.devices.shape)
+        k = resolve_overlap_chunks(k, shape, ndev, itemsize)
+    if k != 1:
+        return ()
+    if lp.mesh is None or lp.decomposition == "single":
+        return ()
+    if lp.decomposition == "pencil":
+        return ("t0", "t1", "t3")
+    if getattr(lp, "op", None):
+        return ()
+    return ("t3",)
 
 
 def resolve_tune_mode(value: str | None) -> str:
@@ -940,6 +1031,7 @@ def model_stage_seconds(
     mm_tflops: float | None = None,
     concurrent_hide_seconds: float = 0.0,
     hide_correction: float = 1.0,
+    fused: Sequence[str] = (),
 ) -> dict:
     """Per-stage analytical prediction of one execution, keyed exactly
     ``t0..t3`` — the model side of the explain/attribution join. A fused
@@ -1000,7 +1092,16 @@ def model_stage_seconds(
     interleave achieves less hide than the ideal model assumes is
     priced — and auto-width/auto-K ranked — at its observed overlap.
     1.0 (the default) is the uncorrected model, numerically
-    unchanged."""
+    unchanged.
+
+    ``fused`` names stages the Pallas fusion tier fuses with the wire
+    codec (:func:`fused_model_stages`): the codec pack/unpack happens
+    in-register inside the stage kernel, so the intermediate c64 block
+    the unfused chain streams between stage and codec is replaced by
+    the WIRE form — each read+write pass pair (2·block) becomes
+    (1 + wire_factor)·block. Flops are unchanged (fusion moves bytes,
+    not math); the mm_tflops compute floor still applies. ``()`` (the
+    default) is the unfused model, numerically unchanged."""
     shape = tuple(int(s) for s in shape)
     ndev = 1 if lp.mesh is None else math.prod(lp.mesh.devices.shape)
     bsz = getattr(lp, "batch", None) or 1
@@ -1065,6 +1166,26 @@ def model_stage_seconds(
         out = {"t0": fft_stage(fft_stages[0]),
                "t1": fft_stage(fft_stages[1]),
                "t2": dict(zero), "t3": fft_stage(fft_stages[2])}
+
+    if fused:
+        from .parallel.exchange import wire_itemsize
+
+        wf = wire_itemsize(itemsize, lp.options.wire_dtype) / float(itemsize)
+        for st in fused:
+            # A fused stage's exchange-facing stream is the WIRE form:
+            # each of the stage's read+write pass pairs (2·block) keeps
+            # one c64 stream and trades the other — the intermediate
+            # block the unfused chain hands the codec — for wire bytes,
+            # so 2·block -> (1 + wire_factor)·block per pass.
+            e = out.get(st)
+            if not e or e["hbm_bytes"] <= 0.0 or wf >= 1.0:
+                continue
+            e["hbm_bytes"] *= (1.0 + wf) / 2.0
+            e["seconds"] = e["hbm_bytes"] / (hbm_gbps * 1e9)
+            if mm_tflops and e.get("mm_flops"):
+                e["seconds"] = max(e["seconds"],
+                                   e["mm_flops"] / (mm_tflops * 1e12))
+            e["fused"] = True
 
     from .parallel.exchange import (
         WIRE_BYTE_KEYS, exchange_model_seconds,
@@ -1194,14 +1315,17 @@ def model_concurrent_seconds(
     def exposed_s(m: dict) -> float:
         return m["t2"]["seconds"]
 
-    solo = [model_stage_seconds(lp, shape, itemsize, **kw)
+    solo = [model_stage_seconds(
+                lp, shape, itemsize,
+                fused=fused_model_stages(lp, shape, itemsize), **kw)
             for lp, shape, itemsize in transforms]
     comp = [compute_s(m) for m in solo]
     total_comp = sum(comp)
     priced = [
         model_stage_seconds(
             lp, shape, itemsize,
-            concurrent_hide_seconds=total_comp - comp[i], **kw)
+            concurrent_hide_seconds=total_comp - comp[i],
+            fused=fused_model_stages(lp, shape, itemsize), **kw)
         for i, (lp, shape, itemsize) in enumerate(transforms)
     ]
     sequential = sum(comp[i] + exposed_s(solo[i])

@@ -288,6 +288,13 @@ def normalize_bench_line(
     # pays DCN hops a single-process run never sees, so single- and
     # multi-process runs must never share a compare baseline;
     # single-process rows keep the old schema and groups.
+    # "fusion" is the Pallas fusion tier (executor label ":fuse" —
+    # adjacent stage pairs collapsed into shape-specialized
+    # mega-kernels, the inter-stage HBM round-trip elided): a fused run
+    # compiles a different program class than the unfused chain, so
+    # fused rows form their own baseline group and never poison (nor
+    # are judged against) unfused baselines; unfused rows keep the old
+    # schema and groups.
     # "scheduler" is the serving dispatch mode (DFFT_BENCH_SERVE /
     # bench.py --serve-streaming): a streaming run keeps a rolling wave
     # program in flight (admission overlaps the previous wave's drain)
@@ -297,8 +304,8 @@ def normalize_bench_line(
     # compares across modes; non-serving rows keep the old schema.
     for k in ("dtype", "devices", "decomposition", "overlap", "tuned",
               "batch", "profile", "wire_dtype", "transport", "op",
-              "degraded", "precision", "concurrent", "tenant_class",
-              "procs", "topology", "scheduler"):
+              "degraded", "precision", "fusion", "concurrent",
+              "tenant_class", "procs", "topology", "scheduler"):
         if obj.get(k) is not None:
             config[k] = obj[k]
     ex: dict = {}

@@ -76,6 +76,7 @@ __all__ = [
     "local_node",
     "exchange_node",
     "compile_fused",
+    "plan_fusion",
     "compile_staged",
     "compile_brick_io",
     "apply_multiplier",
@@ -313,6 +314,196 @@ def apply_midpoint(u, multiplier: Callable, grids: tuple):
         return apply_multiplier(u, multiplier(*grids))
 
 
+# -------------------------------------------------------- fusion pass
+
+def plan_fusion(graph: StageGraph) -> dict:
+    """The Pallas fusion tier's graph-level gate (docs/TUNING.md,
+    "Pallas fusion tier"; docs/ARCHITECTURE.md, "Fusion pass").
+
+    Fusion is requested by the ``:fuse`` executor flag
+    (:func:`..ops.executors.split_fuse`) and activates only when the
+    whole-graph preconditions hold; the returned dict is stored as
+    ``graph.meta["fusion"]`` for the explain layer:
+
+    - ``requested``: the executor carries the flag;
+    - ``active``: requested and every gate passed — the compiler routes
+      each exchange through :func:`_run_fused_site`;
+    - ``reasons``: the failed gates when requested but inactive
+      (``no_wire_codec`` — there is no codec stream to fuse into the
+      stage kernels; ``overlap_k`` — chunked exchanges pipeline through
+      :func:`..parallel.exchange.exchange_overlapped`, whose per-chunk
+      compute the mega-kernels cannot subdivide; ``no_exchange``), each
+      counted into the ``fusion_fallback`` series with site ``graph``;
+    - ``sites``: per-exchange trace-time records (sender/receiver route
+      and kernel-fallback reason), filled in as the program traces.
+
+    An inactive gate NEVER errors: the graph compiles exactly as the
+    unfused executor would (byte-identical program — the flag itself
+    changes nothing until every gate passes)."""
+    from .ops.executors import split_fuse
+
+    info: dict = {"requested": False, "active": False, "reasons": (),
+                  "sites": {}}
+    ex = graph.executor
+    if not isinstance(ex, str):
+        return info
+    try:
+        _, fused = split_fuse(ex)
+    except ValueError:
+        return info
+    if not fused:
+        return info
+    info["requested"] = True
+    reasons = []
+    if graph.wire_dtype is None:
+        reasons.append("no_wire_codec")
+    if graph.overlap_chunks != 1:
+        reasons.append("overlap_k")
+    if not any(isinstance(n, ExchangeNode) for n in graph.nodes):
+        reasons.append("no_exchange")
+    info["reasons"] = tuple(reasons)
+    info["active"] = not reasons
+    if reasons:
+        from .ops.pallas_fuse import record_fusion_fallback
+
+        for r in reasons:
+            record_fusion_fallback("graph", r)
+    return info
+
+
+def _fused_senders(nodes: tuple) -> tuple[dict, set]:
+    """Map each exchange index to the maximal run of non-fused local
+    nodes immediately before it (its *sender* — the stage whose output
+    feeds the wire), plus the set of consumed indices the main walk
+    skips. A fused node or another exchange breaks the run, so pair-(b)
+    receivers (t_mid) re-encode with an EMPTY sender."""
+    sender_of: dict = {}
+    consumed: set = set()
+    for i, n in enumerate(nodes):
+        if not isinstance(n, ExchangeNode):
+            continue
+        js: list = []
+        j = i - 1
+        while (j >= 0 and isinstance(nodes[j], LocalNode)
+               and not nodes[j].fuse and j not in consumed):
+            js.append(j)
+            j -= 1
+        js.reverse()
+        sender_of[i] = tuple(js)
+        consumed |= set(js)
+    return sender_of, consumed
+
+
+def _run_fused_site(y, graph: StageGraph, interp: "_Interp",
+                    n: ExchangeNode, nxt: LocalNode,
+                    senders: tuple, site: dict):
+    """Trace one fused exchange site: sender stage + wire encode (ONE
+    Pallas mega-kernel when the stage is a single kernel-eligible FFT
+    along the split axis), the collective shipping the *wire parts*
+    through :func:`..parallel.exchange.exchange_uneven` with the codec
+    already applied, then wire decode + receiver stage (the receiver
+    mega-kernel, or the pure decode + interpreter/factory compute).
+
+    Bit-parity with the unfused transport: the codec calls, part
+    shipping, and pad/crop geometry are exactly what the in-transport
+    wire path performs (dense transports ship ceil-padded splits whose
+    quantized tail zeros decode to zero — the same bytes the transport
+    itself would have produced); the mega-kernels' mirrors route
+    through the unfused executor + codec, so any kernel fallback is
+    value-identical by construction. Trace attribution moves the codec
+    out of the exchange span into the stage spans it fused with —
+    that is the observable win, documented in docs/OBSERVABILITY.md."""
+    from .ops import pallas_fuse
+    from .parallel.exchange import wire_codec
+
+    codec = wire_codec(graph.wire_dtype)
+    sender_ops = tuple(op for nd in senders for op in nd.ops)
+    packs = [op for op in sender_ops if op[0] == "pack"]
+    core = [op for op in sender_ops if op[0] != "pack"]
+    run_pack = graph.algorithm != "alltoallv"
+    packs_noop = all(
+        (not run_pack) or y.shape[op[1]] == op[2] for op in packs)
+
+    kernel_reason = None
+    if not senders:
+        site["sender"] = "encode_only"
+    elif (len(core) == 1 and core[0][0] == "fft"
+          and len(core[0][1]) == 1 and packs_noop):
+        site["sender"] = "kernel"
+    else:
+        if len(core) == 1 and core[0][0] == "fft" and len(core[0][1]) > 1:
+            kernel_reason = "multi_axis"
+        elif not packs_noop:
+            kernel_reason = "uneven_pack"
+        else:
+            kernel_reason = "ops"
+        site["sender"] = kernel_reason
+
+    if site["sender"] == "kernel":
+        fft_node = next(nd for nd in senders
+                        if any(op[0] == "fft" for op in nd.ops))
+        with add_trace(fft_node.name):
+            parts = pallas_fuse.fused_fft_encode(
+                y, fft_axis=core[0][1][0], forward=core[0][2],
+                tile_axis=n.split, tiles=n.parts,
+                wire_dtype=graph.wire_dtype,
+                site=f"{n.name}:sender")
+        payload_dtype = y.dtype
+    else:
+        if kernel_reason is not None:
+            pallas_fuse.record_fusion_fallback(
+                f"{n.name}:sender", kernel_reason)
+        for nd in senders:
+            with add_trace(nd.name):
+                y = interp.run(nd.ops, y)
+        payload_dtype = y.dtype
+        parts = codec.encode(y, tile_axis=n.split, tiles=n.parts)
+
+    from .parallel.exchange import exchange_uneven
+
+    with add_trace(n.name):
+        shipped = tuple(
+            exchange_uneven(
+                p, n.mesh_axis, split_axis=n.split, concat_axis=n.concat,
+                axis_size=n.parts, algorithm=graph.algorithm,
+                platform=graph.platform, axis_sizes=n.axis_sizes,
+                wire_dtype=None)
+            for p in parts)
+
+    rshape = shipped[0].shape[:-1]
+    rops = nxt.ops
+    recv_kernel = (
+        nxt.factory is None and not nxt.takes_bounds
+        and 1 <= len(rops) <= 2 and rops[-1][0] == "fft"
+        and len(rops[-1][1]) == 1
+        and (len(rops) == 1
+             or (rops[0][0] == "crop"
+                 and rshape[rops[0][1]] == rops[0][2])))
+    if recv_kernel:
+        site["receiver"] = "kernel"
+        with add_trace(nxt.name):
+            y = pallas_fuse.fused_decode_fft(
+                shipped, payload_dtype, fft_axis=rops[-1][1][0],
+                forward=rops[-1][2], tile_axis=n.concat, tiles=n.parts,
+                wire_dtype=graph.wire_dtype,
+                site=f"{nxt.name}:receiver")
+        return y
+    site["receiver"] = ("factory" if nxt.factory is not None else "ops")
+    if nxt.factory is None:
+        pallas_fuse.record_fusion_fallback(f"{nxt.name}:receiver", "ops")
+    with add_trace(nxt.name):
+        w = codec.decode(shipped, payload_dtype, tile_axis=n.concat,
+                         tiles=n.parts)
+        if nxt.factory is not None:
+            compute = nxt.factory()
+            extent = jax.tree_util.tree_leaves(w)[0].shape[n.chunk_axis]
+            return compute(w, 0, extent) if nxt.takes_bounds else compute(w)
+        if nxt.takes_bounds:
+            extent = jax.tree_util.tree_leaves(w)[0].shape[n.chunk_axis]
+            return interp.run(nxt.ops, w, bounds=(0, extent))
+        return interp.run(nxt.ops, w)
+
+
 # ------------------------------------------------------ fused compiler
 
 def compile_fused(graph: StageGraph):
@@ -333,14 +524,31 @@ def compile_fused(graph: StageGraph):
     graph.validate()
     interp = _Interp(graph.executor, graph.algorithm)
     nodes = graph.nodes
+    fusion = plan_fusion(graph)
+    graph.meta["fusion"] = fusion
+    if fusion["active"]:
+        sender_of, consumed = _fused_senders(nodes)
+    else:
+        sender_of, consumed = {}, set()
 
     def local_fn(x):
         y = x
         i = 0
         while i < len(nodes):
+            if i in consumed:  # sender nodes run inside their fused site
+                i += 1
+                continue
             n = nodes[i]
             if isinstance(n, ExchangeNode):
                 nxt = nodes[i + 1]
+                if fusion["active"]:
+                    site = fusion["sites"].setdefault(
+                        i, {"exchange": n.name})
+                    y = _run_fused_site(
+                        y, graph, interp, n, nxt,
+                        tuple(nodes[j] for j in sender_of[i]), site)
+                    i += 2
+                    continue
                 if nxt.factory is not None:
                     compute = nxt.factory()
                 elif nxt.takes_bounds:

@@ -286,7 +286,21 @@ def enumerate_candidates(
     only (``None`` = the bare label; ``"bf16"`` -> ``matmul:bf16``, a
     distinct executor whose accuracy the same budget admits — the
     tuned planner widens it to ``(None, "bf16", "f32")`` under a budget,
-    or pins it to an explicit ``PlanOptions.mm_precision``)."""
+    or pins it to an explicit ``PlanOptions.mm_precision``).
+
+    Fusion axis: every Pallas-family executor in the menu additionally
+    enters as its fused label (``pallas`` -> ``pallas:fuse``, the
+    stage-pair mega-kernel tier) — but only crossed with a real wire
+    codec at ``K=1``, because those are exactly the plans whose fusion
+    pass activates (:func:`..stagegraph.plan_fusion` gates on a wire
+    codec and monolithic exchanges); anywhere else the fused plan is
+    byte-identical to the unfused one and would waste a tournament
+    slot. Since compressed wire enters the space only under a
+    ``max_roundtrip_err`` budget, fused candidates are budget-gated for
+    free, and their accuracy cost is the codec's alone
+    (``executor_roundtrip_error("pallas:fuse") == 0``: the kernel
+    reproduces the unfused arithmetic)."""
+    from .ops.executors import FUSE_BASES, fused_name, split_fuse
     from .parallel.exchange import FLAT_ALGORITHMS
 
     shape = tuple(int(s) for s in shape)
@@ -308,6 +322,14 @@ def enumerate_candidates(
     execs = _cross_tiers(
         list(executors) if executors is not None else _default_executors(),
         mm_tiers)
+    fused_execs = []
+    for ex in execs:
+        try:
+            bare, has_fuse = split_fuse(ex)
+        except ValueError:
+            continue
+        if not has_fuse and bare.split(":", 1)[0] in FUSE_BASES:
+            fused_execs.append(fused_name(ex, True))
     ks = _overlap_values(shape, ndev, itemsize * (batch or 1))
     out = []
     for d, alg in pairs:
@@ -315,6 +337,11 @@ def enumerate_candidates(
             for k in ks:
                 for ex in execs:
                     out.append(Candidate(d, alg, ex, k, wd))
+                if wd is not None and k == 1:
+                    # Fused labels only where the fusion pass can
+                    # activate: real wire codec, monolithic exchange.
+                    for ex in fused_execs:
+                        out.append(Candidate(d, alg, ex, k, wd))
     return out
 
 
@@ -376,6 +403,28 @@ def model_cost(
         t_mm = (mm_dft_flops(shape) * (batch or 1) / ndev) / (
             mm_rate * 1e12)
         t_fft = max(t_fft, t_mm)
+    if cand.wire_dtype is not None and cand.overlap_chunks == 1:
+        # Fused-tier HBM discount, mirroring fused_model_stages /
+        # model_stage_seconds: each fused stage keeps one c64 stream
+        # and trades the other for wire bytes, so its 2B read+write
+        # pair shrinks to B(1 + wire_factor) — a (1+wf)/2 scale on
+        # that stage's share of the 3-stage roofline. Pencil fuses all
+        # three compute stages (t0/t1 as sender kernels, t3 as the
+        # receiver kernel); slab only the receiver side of its single
+        # exchange (the 2-axis t0 sender stays unfused).
+        from .ops.executors import split_fuse as _split_fuse
+
+        try:
+            _, _has_fuse = _split_fuse(cand.executor)
+        except ValueError:
+            _has_fuse = False
+        if _has_fuse:
+            from .parallel.exchange import wire_itemsize
+
+            wf = wire_itemsize(itemsize, cand.wire_dtype) / float(itemsize)
+            if wf < 1.0:
+                nf = 3 if cand.decomposition == "pencil" else 1
+                t_fft *= 1.0 - nf * (1.0 - wf) / 6.0
     payloads = exchange_payloads(lp, shape, itemsize)
     # Downstream FFT time each exchange can hide under: one chain stage.
     t_stage = t_fft / (len(payloads) + 1)
@@ -980,7 +1029,7 @@ def tuned_plan(kind: str, shape, mesh, options: PlanOptions,
     # non-matmul candidate's label).
     base = replace(options, tune="off", donate=False,
                    executor=options.executor.split(":", 1)[0],
-                   mm_precision=None, mm_complex=None)
+                   mm_precision=None, mm_complex=None, fuse=None)
     ndev, mesh_dims = _mesh_context(mesh)
     heuristic = replace(options, tune="off")
     if ndev <= 1:
@@ -1008,6 +1057,7 @@ def tuned_plan(kind: str, shape, mesh, options: PlanOptions,
     if entry is not None:
         from .ops.executors import (
             REDUCED_TIERS, executor_roundtrip_error, split_executor,
+            split_fuse,
         )
 
         _metrics.inc("tune_wisdom_hits", kind=kind)
@@ -1039,6 +1089,9 @@ def tuned_plan(kind: str, shape, mesh, options: PlanOptions,
                 wd = None
                 if reduced_tier:
                     ex = split_executor(ex)[0]  # the exact bare label
+                # Exact wire means the fusion pass could only gate out
+                # (no_wire_codec) — replay the bare unfused label.
+                ex = split_fuse(ex)[0]
         cand = Candidate(
             decomposition=str(entry["winner"]["decomposition"]),
             algorithm=str(entry["winner"]["algorithm"]),

@@ -288,7 +288,10 @@ def test_int8_accuracy_through_plans(alg, mesh_shape):
 def test_enumerate_budget_widens_to_registry():
     cands = tuner.enumerate_candidates(
         SHAPE, 8, executors=("xla",), wire_dtypes=WIRE_DTYPES)
-    assert {c.wire_dtype for c in cands} == {None, "bf16", "int8"}
+    # The enumerated wire axis IS the registry menu (plus exact) — it
+    # widens automatically as codecs register (PR 19 added "split").
+    assert {c.wire_dtype for c in cands} == set(WIRE_DTYPES)
+    assert {None, "bf16", "int8", "split"} <= set(WIRE_DTYPES)
     comp = next(c for c in cands if c.wire_dtype == "int8")
     assert comp.label.endswith("+wint8")
 

@@ -48,6 +48,7 @@ PLAN_AFFECTING = {
     "DFFT_XLA_REAL", "DFFT_FORCE_REAL_LOWERING", "DFFT_OVERLAP",
     "DFFT_TUNE", "DFFT_WISDOM", "DFFT_TUNE_ITERS", "DFFT_TUNE_MAX",
     "DFFT_HW_PROFILE", "DFFT_TUNE_CORRECTION", "DFFT_WIRE_DTYPE",
+    "DFFT_FUSE",
 }
 
 _KNOB = re.compile(r"DFFT_[A-Z0-9_]*[A-Z0-9]")
