@@ -453,6 +453,20 @@ def _emit(shape_n, seconds, max_err, executor, n_dev, decomposition,
         out["health"] = health_snapshot()
     except Exception:  # noqa: BLE001 — telemetry, not contract
         pass
+    try:
+        # Numerics plane (docs/OBSERVABILITY.md "Numerics plane"):
+        # shadow-audit drift verdicts + non-finite sentinel counters.
+        # Stamped only when the plane saw something (DFFT_SHADOW_RATE
+        # armed or a sentinel fired) — regressed_metrics folds drifting
+        # buckets into the gate, so a codec that got fast by getting
+        # wrong cannot pass compare --gate.
+        from distributedfft_tpu.numerics import numerics_snapshot
+
+        nsnap = numerics_snapshot()
+        if nsnap is not None:
+            out["numerics"] = nsnap
+    except Exception:  # noqa: BLE001 — telemetry, not contract
+        pass
     # Process identity (docs/OBSERVABILITY.md "Fleet view"): which
     # host/process produced this line — the key that lets the fleet
     # aggregator and the run-record store attribute a regression to a

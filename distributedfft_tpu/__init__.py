@@ -85,6 +85,12 @@ from .qos import QosPolicy, QuotaExceeded, Tenant  # noqa: F401
 # the fault error type is lifted for except clauses.
 from . import faults  # noqa: F401
 from .faults import InjectedFault  # noqa: F401
+# Numerics observability plane (docs/OBSERVABILITY.md "Numerics
+# plane"): the module is the API surface (dfft.numerics
+# .numerics_snapshot / .realized_error); the quarantine error a
+# poisoned request's handle carries is lifted for except clauses.
+from . import numerics  # noqa: F401
+from .numerics import NonFiniteResult  # noqa: F401
 from .geometry import Box3, world_box  # noqa: F401
 from .local import (  # noqa: F401
     LocalPlan,

@@ -336,6 +336,59 @@ def _proc_share(sample: dict) -> dict:
     }
 
 
+def _merge_numerics(blocks: list[dict | None]) -> dict | None:
+    """Pool per-process numerics ledgers (monitor schema v4;
+    docs/OBSERVABILITY.md "Numerics plane") into one fleet block — the
+    wait-reservoir discipline applied to accuracy: counters sum, the
+    exported realized-error tails concatenate per (plan, tenant)
+    bucket, and the fleet p50/p99/drift verdict is re-ranked over the
+    union (never averaged percentiles — quantiles do not average).
+    Mixed-schema fleets (a rolling restart with pre-v4 members still
+    streaming schema 2/3) treat absent blocks as empty: None when no
+    member carries one."""
+    from .numerics import DEFAULT_SLACK, judge_bucket
+
+    blocks = [b for b in blocks if isinstance(b, dict)]
+    if not blocks:
+        return None
+    slack = max((b["slack"] for b in blocks
+                 if isinstance(b.get("slack"), (int, float))),
+                default=DEFAULT_SLACK)
+    out: dict = {"schema": 1, "sampled": 0, "audited": 0,
+                 "audit_failures": 0, "slack": slack,
+                 "nonfinite": {}, "plans": {}}
+    pooled: dict[str, dict] = {}
+    for b in blocks:
+        for fld in ("sampled", "audited", "audit_failures"):
+            v = b.get(fld)
+            if isinstance(v, (int, float)):
+                out[fld] += int(v)
+        for k, v in (b.get("nonfinite") or {}).items():
+            if isinstance(v, (int, float)):
+                out["nonfinite"][k] = out["nonfinite"].get(k, 0) + int(v)
+        for key, bucket in (b.get("plans") or {}).items():
+            dst = pooled.setdefault(key, {
+                "plan": bucket.get("plan"), "tenant": bucket.get("tenant"),
+                "n": 0, "admitted_err": 0.0, "floor": 0.0, "errors": []})
+            if isinstance(bucket.get("n"), (int, float)):
+                dst["n"] += int(bucket["n"])
+            for fld in ("admitted_err", "floor"):
+                if isinstance(bucket.get(fld), (int, float)):
+                    dst[fld] = max(dst[fld], float(bucket[fld]))
+            errs = bucket.get("errors")
+            if isinstance(errs, list):
+                dst["errors"].extend(float(e) for e in errs
+                                     if isinstance(e, (int, float)))
+    for key, dst in sorted(pooled.items()):
+        doc = judge_bucket(dst["errors"], dst["n"], dst["admitted_err"],
+                           dst["floor"], slack)
+        doc["plan"] = dst["plan"]
+        doc["tenant"] = dst["tenant"]
+        doc["errors"] = sorted(dst["errors"])[-64:]
+        out["plans"][key] = doc
+    return out
+
+
 def merge_streams(
     streams: dict[str, list[dict]],
     *,
@@ -429,6 +482,14 @@ def merge_streams(
             "per_proc": {sid: _proc_share(m)
                          for sid, m in sorted(members.items())},
         }
+        # Schema tolerance (rolling restarts): members may mix monitor
+        # schemas 2/3/4 in one directory — blocks a member does not
+        # carry (waves, numerics) are treated as empty, and the merged
+        # numerics block appears only when at least one member has one.
+        nmerged = _merge_numerics([m.get("numerics")
+                                   for m in members.values()])
+        if nmerged is not None:
+            doc["numerics"] = nmerged
         out.append(doc)
     return out
 

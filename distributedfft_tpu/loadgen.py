@@ -195,7 +195,17 @@ def _run_worker(ns: argparse.Namespace) -> int:
         shapes=parse_shapes(ns.shapes),
         dtypes=[d.strip() for d in ns.dtypes.split(",") if d.strip()],
         ops=[o.strip() for o in ns.ops.split(",") if o.strip()])
+    mesh = None
+    if ns.mesh > 0:
+        # Distributed plans: the wire codec (DFFT_WIRE_DTYPE) only
+        # engages on a multi-device mesh, so numerics drift drills
+        # need this armed (single-device plans are exact by
+        # construction).
+        from .parallel.mesh import make_mesh
+
+        mesh = make_mesh(ns.mesh)
     queue = CoalescingQueue(
+        mesh,
         max_batch=ns.max_batch,
         max_wait_s=ns.max_wait if ns.max_wait and ns.max_wait > 0
         else None,
@@ -222,6 +232,25 @@ def _run_worker(ns: argparse.Namespace) -> int:
     stats = {"rank": ns.rank, "pid": os.getpid(), "submitted": 0,
              "shed": 0, "flushed": 0, "wedged": False,
              "mode": "streaming" if ns.streaming else "flush"}
+    # --hot-tail P: seeded heavy-tailed amplitude mixing — a fraction P
+    # of submits scale one random octant block of their input by ~1e4
+    # (docs/OBSERVABILITY.md "Numerics plane"). Pure data shaping: the
+    # schedule, tenancy, and arrival times stay byte-identical to the
+    # P=0 run; what changes is the dynamic range the block-scaled wire
+    # codecs see — a hot member batched into a cohort poisons the
+    # shared per-tile scales, and the shadow audit must catch it.
+    hot_rng = random.Random(f"{ns.seed}:{ns.rank}:hot")
+
+    def maybe_hot(x):
+        if ns.hot_tail <= 0 or hot_rng.random() >= ns.hot_tail:
+            return x
+        y = np.array(x, copy=True)
+        sl = tuple(
+            slice(0, max(1, n // 2)) if hot_rng.random() < 0.5
+            else slice(n - max(1, n // 2), n) for n in y.shape)
+        y[sl] *= 1e4
+        return y
+
     wedged = False
     start = time.monotonic()
     next_flush = ns.flush_every
@@ -245,7 +274,7 @@ def _run_worker(ns: argparse.Namespace) -> int:
                 wedged = True
                 stats["wedged"] = True
         try:
-            queue.submit(buf(ev.shape, ev.dtype),
+            queue.submit(maybe_hot(buf(ev.shape, ev.dtype)),
                          direction=FORWARD if ev.op != "ifft"
                          else BACKWARD,
                          tenant=ev.tenant if has_policy else None)
@@ -280,6 +309,18 @@ def _run_worker(ns: argparse.Namespace) -> int:
         except Exception:  # noqa: BLE001
             stats["wedged"] = True
         queue.close()
+    # Numerics-plane summary (when DFFT_SHADOW_RATE armed the plane):
+    # how many requests were shadow-audited and the worst bucket's
+    # drift ratio — the worker-stats view of what the fleet gate
+    # judges.
+    from .numerics import numerics_snapshot
+
+    nsnap = numerics_snapshot()
+    if nsnap is not None:
+        stats["shadow_sampled"] = nsnap.get("sampled", 0)
+        stats["drift_ratio"] = max(
+            (b.get("drift_ratio", 0.0)
+             for b in (nsnap.get("plans") or {}).values()), default=0.0)
     print(json.dumps(stats))
     return 0
 
@@ -310,6 +351,8 @@ def _spawn(ns: argparse.Namespace, rank: int, dir_: str):
             ("--ops", ns.ops), ("--max-batch", ns.max_batch),
             ("--max-wait", ns.max_wait),
             ("--flush-every", ns.flush_every),
+            ("--hot-tail", ns.hot_tail),
+            ("--mesh", ns.mesh),
             ("--linger", ns.linger)):
         argv.extend([flag, str(val)])
     if ns.streaming:
@@ -360,6 +403,18 @@ def main(argv: list[str] | None = None) -> int:
                          "(default 0.25)")
     ap.add_argument("--flush-every", type=float, default=0.05,
                     help="worker flush cadence seconds (default 0.05)")
+    ap.add_argument("--mesh", type=int, default=0, metavar="N",
+                    help="worker mesh size: 0 (default) = meshless "
+                         "single-device plans (exact, no wire); N >= 1 "
+                         "= make_mesh(N) distributed plans so the wire "
+                         "codec engages (numerics drills)")
+    ap.add_argument("--hot-tail", type=float, default=0.0, metavar="P",
+                    help="fraction of submits that scale a random "
+                         "block of the input by ~1e4 (seeded "
+                         "heavy-tailed amplitude mixing; stresses "
+                         "shared-exponent wire codecs for numerics "
+                         "drift drills — docs/OBSERVABILITY.md "
+                         "'Numerics plane')")
     ap.add_argument("--linger", type=float, default=4.5,
                     help="wedged-worker linger after the schedule ends "
                          "so its leftover pending groups age past the "

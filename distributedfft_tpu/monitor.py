@@ -101,8 +101,14 @@ __all__ = [
 #: latency per class, inter-wave device-idle fraction, preemption
 #: counts; ``CoalescingQueue._wave_stats.snapshot()``), present on
 #: streaming or monitor-armed queues. Older samples still load and
-#: merge (the added fields are simply absent).
-MONITOR_SCHEMA = 3
+#: merge (the added fields are simply absent). v4 (PR 20) added the
+#: ``numerics`` block — the numerical-health ledger of the shadow-
+#: sampled accuracy audit (:mod:`..numerics`; docs/OBSERVABILITY.md
+#: "Numerics plane"): sampled/audited counts, per-(plan-tuple, tenant)
+#: realized-error reservoir tails against the admitted budget with the
+#: drift verdict, and the non-finite sentinel counters. Present only
+#: once the plane is armed (``DFFT_SHADOW_RATE``) or a sentinel fired.
+MONITOR_SCHEMA = 4
 #: Health-verdict format version (stamped into every health block).
 HEALTH_SCHEMA = 1
 
@@ -376,6 +382,14 @@ class Monitor:
         # fleet aggregator can quantile-merge waits across processes.
         doc["qos"] = (pol.slo_report(include_waits=True)
                       if pol is not None else None)
+        # Numerics plane (schema v4): the process-global shadow-audit /
+        # non-finite ledger. None (block absent) while the plane is
+        # dark — older consumers and disarmed processes are unaffected.
+        from .numerics import numerics_snapshot
+
+        nsnap = numerics_snapshot()
+        if nsnap is not None:
+            doc["numerics"] = nsnap
         self._samples.append(doc)
         if self.path:
             append_line(self.path, json.dumps(doc, sort_keys=True))
@@ -497,6 +511,12 @@ def health_from_samples(
     - ``quota_pressure`` (warn) — quota sheds within the fast window.
     - ``degraded`` (warn) — degraded executions or isolated failures
       within the fast window (the PR 10 fault counters).
+    - ``accuracy_drift`` (alert) — a shadow-audited plan bucket's
+      realized p99 error exceeds its admitted budget x slack
+      (docs/OBSERVABILITY.md "Numerics plane").
+    - ``nonfinite`` (alert) — non-finite outputs from finite inputs
+      within the fast window (quarantined serving damage);
+      ``nonfinite_input`` (warn) is the caller-side counterpart.
     """
     if not samples:
         return {"schema": HEALTH_SCHEMA, "status": "unknown",
@@ -578,6 +598,48 @@ def health_from_samples(
             "detail": f"{fault_d:g} degraded execution(s)/isolated "
                       f"failure(s) in the fast window"})
 
+    # Numerics plane (schema v4; docs/OBSERVABILITY.md "Numerics
+    # plane"): accuracy drift judges the newest ledger (the reservoirs
+    # are cumulative — a drifting plan stays drifting until its p99
+    # recovers); the non-finite sentinels are windowed counter deltas
+    # like every other counter verdict. Output-site non-finites are
+    # serving damage (alert); input-site ones are the caller's (warn).
+    numerics = newest.get("numerics") or {}
+    drifting = [b for b in (numerics.get("plans") or {}).values()
+                if b.get("drifting")]
+    if drifting:
+        worst = max(drifting, key=lambda b: b.get("drift_ratio", 0.0))
+        alerts.append({
+            "name": "accuracy_drift", "severity": "alert",
+            "plan": worst.get("plan"), "tenant": worst.get("tenant"),
+            "drift_ratio": worst.get("drift_ratio"),
+            "detail": (f"{len(drifting)} plan bucket(s) drifting; "
+                       f"worst {worst.get('plan')}: realized p99 "
+                       f"{worst.get('realized_p99', 0.0):.3g} is "
+                       f"{worst.get('drift_ratio', 0.0):.3g}x the "
+                       f"admitted budget "
+                       f"{worst.get('admitted_err', 0.0):.3g}")})
+
+    def nonfinite_of(site):
+        def get(s):
+            nf = (s.get("numerics") or {}).get("nonfinite") or {}
+            return float(sum(v for k, v in nf.items()
+                             if k.startswith(site + ":")))
+        return get
+
+    nf_out_d = _delta(samples, fast_window_s, nonfinite_of("output"))
+    if nf_out_d > 0:
+        alerts.append({
+            "name": "nonfinite", "severity": "alert",
+            "detail": f"{nf_out_d:g} non-finite output(s) from finite "
+                      f"input(s) in the fast window (quarantined)"})
+    nf_in_d = _delta(samples, fast_window_s, nonfinite_of("input"))
+    if nf_in_d > 0:
+        alerts.append({
+            "name": "nonfinite_input", "severity": "warn",
+            "detail": f"{nf_in_d:g} non-finite caller input(s) in the "
+                      f"fast window (delivered as-is, never retried)"})
+
     firing = [a for a in alerts if a["severity"] == "alert"]
     fast_n = len(samples) - len(
         samples[:samples.index(_baseline(samples, fast_window_s))]
@@ -604,6 +666,10 @@ def health_from_samples(
                 newest.get("metrics"), "serving_isolated_failures"),
             "expired": _counter_sum(newest.get("metrics"),
                                     "serving_expired"),
+            "shadow_sampled": float(numerics.get("sampled", 0)),
+            "shadow_audited": float(numerics.get("audited", 0)),
+            "nonfinite": float(sum(
+                (numerics.get("nonfinite") or {}).values())),
         },
     }
 
@@ -749,6 +815,42 @@ def _prom_rows(sample: dict, extra: dict | None = None) -> list[tuple]:
                     "dfft_tenant_slo_ok", "gauge",
                     f"dfft_tenant_slo_ok{lab('', {'tenant': tname})} "
                     f"{1 if t['slo_ok'] else 0}"))
+
+    numerics = sample.get("numerics") or None
+    if numerics:
+        for pname, fld in (
+                ("dfft_numerics_shadow_sampled_total", "sampled"),
+                ("dfft_numerics_shadow_audited_total", "audited"),
+                ("dfft_numerics_audit_failures_total",
+                 "audit_failures")):
+            v = numerics.get(fld)
+            if isinstance(v, (int, float)):
+                rows.append((pname, "counter",
+                             f"{pname}{lab('')} {v:g}"))
+        for sk, v in sorted((numerics.get("nonfinite") or {}).items()):
+            site, _, nfkind = sk.partition(":")
+            rows.append((
+                "dfft_numerics_nonfinite_total", "counter",
+                f"dfft_numerics_nonfinite_total"
+                f"{lab('', {'site': site, 'kind': nfkind})} {v:g}"))
+        for _, b in sorted((numerics.get("plans") or {}).items()):
+            pl = {"plan": b.get("plan", ""),
+                  "tenant": b.get("tenant") or ""}
+            for pname, fld in (
+                    ("dfft_numerics_admitted_err", "admitted_err"),
+                    ("dfft_numerics_drift_ratio", "drift_ratio")):
+                v = b.get(fld)
+                if isinstance(v, (int, float)):
+                    rows.append((pname, "gauge",
+                                 f"{pname}{lab('', pl)} {v:g}"))
+            for q, fld in (("0.5", "realized_p50"),
+                           ("0.99", "realized_p99")):
+                v = b.get(fld)
+                if isinstance(v, (int, float)):
+                    rows.append((
+                        "dfft_numerics_realized_err", "summary",
+                        f"dfft_numerics_realized_err"
+                        f"{lab('', dict(pl, quantile=q))} {v:g}"))
 
     ts_line = f"dfft_monitor_sample_timestamp_seconds{lab('')}" \
         if extra else "dfft_monitor_sample_timestamp_seconds"

@@ -1,0 +1,409 @@
+"""Runtime numerical-health plane — shadow audits + non-finite sentinels.
+
+The platform trades accuracy for speed in three independent places —
+wire codecs (bf16/int8/split), matmul precision tiers, and the Pallas
+fusion tier — and every error figure the tuner's ONE-budget admission
+rule consumes (:func:`..parallel.exchange.wire_roundtrip_error`,
+:func:`..ops.executors.executor_roundtrip_error`) is a *plan-time
+estimate on a seeded Gaussian input*. Nothing in the PR 16/17 monitor →
+fleet → health pipeline observes the error actually *realized* on live
+traffic, where block-scaled quantization degrades sharply on
+heavy-tailed dynamic ranges (a single hot request poisons the shared
+per-tile pow2 scales of every cohort member batched with it — see
+``tests/test_a2r_numerics.py``'s adversarial-range parity test) and a
+non-finite value silently propagates through a coalesced batch. This
+module is the numerical axis of that pipeline (docs/OBSERVABILITY.md
+"Numerics plane"):
+
+1. **Shadow-sampled accuracy audit.** ``DFFT_SHADOW_RATE=p[,seed]``
+   arms a deterministic seeded sampler on every
+   :class:`..serving.CoalescingQueue`; a fraction ``p`` of requests
+   are, after their primary (possibly batched/compressed/fused)
+   execution resolves, re-executed through a memoized *exact reference
+   plan* (same geometry, exact wire, exact executor tier, fusion off).
+   The realized relative error lands in a per-(plan-tuple, tenant)
+   Algorithm-R reservoir in this module's process-global ledger,
+   alongside the plan's *admitted* budget (the seeded wire + executor
+   roundtrip figures), producing a live drift verdict: realized p99 vs
+   admitted budget x a slack factor. Unset ⇒ the plane is dark and the
+   serving path is byte-identical (pinned).
+
+2. **Non-finite sentinels.** Cheap ``isfinite`` reductions at the
+   serving output boundary — with the *input* checked first, so a
+   caller's NaN is distinguished from codec/executor damage — stamp
+   ``numerics_nonfinite{site,kind}`` counters. A non-finite output for
+   a finite input raises :class:`NonFiniteResult` (classified
+   deterministic by ``faults.classify``), routing the group into the
+   existing retry → exact-rebuild → bisect chain so the poisoned
+   request fails alone while its cohort completes bit-correct. A
+   non-finite input is the caller's: reported, delivered, never
+   retried.
+
+3. **Surfacing.** :func:`numerics_snapshot` is the schema-4 monitor
+   block (:meth:`..monitor.Monitor.sample`), pooled cross-process by
+   :func:`..fleet.merge_streams` (rank over concatenated tails, never
+   averaged percentiles), judged by ``health_from_samples``
+   (``accuracy_drift`` / ``nonfinite`` alerts) and ``report numerics
+   [--gate]``.
+
+Import stays jax-free (the monitor/report/fleet consumers are
+stdlib-pure); jax is pulled in lazily by the array helpers only.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+
+from .utils import metrics as _metrics
+
+__all__ = [
+    "NonFiniteResult",
+    "NumericsPlane",
+    "Reservoir",
+    "DEFAULT_SLACK",
+    "MIN_DRIFT_SAMPLES",
+    "parse_shadow_rate",
+    "realized_error",
+    "nonfinite_kind",
+    "record_audit",
+    "record_audit_failure",
+    "record_nonfinite",
+    "drift_floor",
+    "judge_bucket",
+    "numerics_snapshot",
+    "reset_numerics",
+    "NUMERICS_SCHEMA",
+]
+
+#: Version stamp of the ``numerics`` block inside monitor samples.
+NUMERICS_SCHEMA = 1
+
+#: Drift slack: realized p99 may exceed the admitted budget by this
+#: factor before ``accuracy_drift`` fires. Headroom for the honest gap
+#: between the admitted figure (max-relative on a seeded Gaussian) and
+#: the realized metric (L2-relative on live data) — ~2-4x apart for a
+#: well-behaved codec, orders of magnitude apart under block-scale
+#: contamination (the failure mode the audit exists to catch).
+DEFAULT_SLACK = 8.0
+
+#: A bucket needs this many audits before its drift verdict can fire —
+#: one unlucky draw is not drift.
+MIN_DRIFT_SAMPLES = 5
+
+#: Reservoir capacity per (plan-tuple, tenant) bucket, and the exported
+#: tail length (the monitor-sample / fleet-merge payload cap — same
+#: discipline as the QoS wait reservoirs).
+_RESERVOIR_CAP = 256
+_TAIL_EXPORT = 64
+
+
+class NonFiniteResult(ArithmeticError):
+    """A serving execution produced NaN/Inf from a finite input.
+
+    Raised by the armed numerics plane at the output boundary *before
+    any handle resolves*, so the fault chain (retry → exact-rebuild →
+    bisect; docs/ROBUSTNESS.md) owns the failure: a poisoned request
+    fails alone with this error on its handle while finite cohort
+    members complete bit-correct. ``faults.classify`` sees it as
+    deterministic (retrying the same math reproduces the same Inf).
+    """
+
+    def __init__(self, message: str, *, site: str = "output",
+                 kind: str = "inf"):
+        super().__init__(message)
+        self.site = site
+        self.kind = kind
+
+
+def parse_shadow_rate(raw: str | None) -> tuple[float, int] | None:
+    """``DFFT_SHADOW_RATE=p[,seed]`` -> ``(p, seed)``; unset/empty ->
+    None (plane dark). ``p`` clamps to [0, 1]; rate 0 still arms the
+    non-finite sentinels (audits just never sample). A malformed value
+    raises — a typo silently disabling the audit is not acceptable."""
+    if raw is None:
+        return None
+    raw = raw.strip()
+    if not raw:
+        return None
+    head, _, tail = raw.partition(",")
+    try:
+        p = float(head)
+        seed = int(tail) if tail.strip() else 0
+    except ValueError:
+        raise ValueError(
+            f"DFFT_SHADOW_RATE must be 'p[,seed]' (e.g. '0.1' or "
+            f"'0.25,7'), got {raw!r}") from None
+    return (min(max(p, 0.0), 1.0), seed)
+
+
+class NumericsPlane:
+    """Per-queue arm of the plane: the deterministic shadow sampler.
+
+    One seeded PRNG consumed once per request in dispatch order — same
+    seed, same traffic, same picks (the loadgen reproducibility
+    contract). The ledger itself is process-global (module state), so
+    every armed queue in a process feeds one monitor block.
+    """
+
+    def __init__(self, rate: float, seed: int = 0):
+        self.rate = float(rate)
+        self.seed = int(seed)
+        self._rng = random.Random(f"shadow:{seed}")
+        self._lock = threading.Lock()
+        global _ARMED
+        _ARMED = True
+
+    @classmethod
+    def from_env(cls) -> "NumericsPlane | None":
+        parsed = parse_shadow_rate(os.environ.get("DFFT_SHADOW_RATE"))
+        if parsed is None:
+            return None
+        return cls(*parsed)
+
+    def pick(self) -> bool:
+        """Deterministically decide whether the next request is
+        shadow-audited."""
+        if self.rate <= 0.0:
+            return False
+        with self._lock:
+            return self._rng.random() < self.rate
+
+
+# ------------------------------------------------------------- metrics
+
+
+def realized_error(y, yref) -> float:
+    """Realized relative error of ``y`` against the exact reference:
+    ``||y - yref||_2 / ||yref||_2`` (L2-relative — one scalar that
+    weights every element, so a cohort member whose wire tiles were
+    zeroed by a co-batched outlier reads O(1), not the misleadingly
+    tiny figure a max-normalized metric would give). Zero reference →
+    absolute L2 of ``y``."""
+    import numpy as np
+
+    a = np.asarray(y, dtype=np.complex128).ravel()
+    b = np.asarray(yref, dtype=np.complex128).ravel()
+    denom = float(np.linalg.norm(b))
+    num = float(np.linalg.norm(a - b))
+    if not np.isfinite(num):
+        return float("inf")
+    return num / denom if denom > 0.0 else num
+
+
+def nonfinite_kind(x) -> str | None:
+    """``"nan"`` / ``"inf"`` when ``x`` contains a non-finite value,
+    None when clean (or non-inexact). Two scalar device reductions —
+    the arrays stay put."""
+    import jax.numpy as jnp
+
+    dt = getattr(x, "dtype", None)
+    if dt is None:
+        return None
+    if not (jnp.issubdtype(dt, jnp.floating)
+            or jnp.issubdtype(dt, jnp.complexfloating)):
+        return None
+    if bool(jnp.all(jnp.isfinite(x))):
+        return None
+    return "nan" if bool(jnp.any(jnp.isnan(x))) else "inf"
+
+
+def drift_floor(dtype) -> float:
+    """Noise floor under the drift judgment: 100 machine epsilons of
+    the dtype's real component. Exact plans admit a budget of 0.0; an
+    fp rounding wiggle above zero must not read as infinite drift."""
+    import numpy as np
+
+    try:
+        real = np.finfo(np.dtype(dtype)).eps
+    except ValueError:
+        return 1e-12
+    return 100.0 * float(real)
+
+
+# ------------------------------------------------------------ reservoir
+
+
+class Reservoir:
+    """Algorithm-R reservoir of realized errors (seeded, bounded).
+
+    The PR 16 wait-reservoir discipline applied to accuracy: keep a
+    uniform sample of up to ``cap`` observations, export a bounded tail
+    for cross-process pooling (fleet ranks concatenated tails, never
+    averages percentiles)."""
+
+    __slots__ = ("cap", "n", "values", "_rng")
+
+    def __init__(self, cap: int = _RESERVOIR_CAP, seed: int = 0):
+        self.cap = cap
+        self.n = 0
+        self.values: list[float] = []
+        self._rng = random.Random(f"reservoir:{seed}")
+
+    def add(self, x: float) -> None:
+        self.n += 1
+        if len(self.values) < self.cap:
+            self.values.append(float(x))
+            return
+        j = self._rng.randrange(self.n)
+        if j < self.cap:
+            self.values[j] = float(x)
+
+    def quantile(self, q: float) -> float:
+        return _quantile(sorted(self.values), q)
+
+    def tail(self, k: int = _TAIL_EXPORT) -> list[float]:
+        """The ``k`` largest held values (the informative end of an
+        error distribution) — the exported pooling payload."""
+        return sorted(self.values)[-k:]
+
+
+def _quantile(ordered: list[float], q: float) -> float:
+    """Nearest-rank quantile of an ascending list (the fleet/qos
+    convention); 0.0 on empty."""
+    if not ordered:
+        return 0.0
+    i = min(len(ordered) - 1, max(0, int(q * len(ordered))))
+    return float(ordered[i])
+
+
+def judge_bucket(errors: list[float], n: int, admitted: float,
+                 floor: float, slack: float = DEFAULT_SLACK) -> dict:
+    """The drift verdict shared by the live ledger, the fleet merge,
+    and the report renderer: realized p99 (nearest-rank over
+    ``errors``) against ``max(admitted, floor) * slack``; fires only
+    with ``n >= MIN_DRIFT_SAMPLES``."""
+    ordered = sorted(float(e) for e in errors)
+    budget = max(float(admitted), float(floor))
+    p99 = _quantile(ordered, 0.99)
+    ratio = (p99 / budget) if budget > 0.0 else 0.0
+    return {
+        "n": int(n),
+        "admitted_err": float(admitted),
+        "floor": float(floor),
+        "realized_p50": _quantile(ordered, 0.50),
+        "realized_p99": p99,
+        "drift_ratio": ratio,
+        "drifting": bool(n >= MIN_DRIFT_SAMPLES and ratio > slack),
+    }
+
+
+# --------------------------------------------------------------- ledger
+
+
+class _Ledger:
+    """Process-global accuracy/non-finite ledger (the monitor block's
+    backing store — like the metrics registry, one per process)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with getattr(self, "_lock", threading.Lock()):
+            self.sampled = 0
+            self.audited = 0
+            self.audit_failures = 0
+            self.nonfinite: dict[str, int] = {}
+            # bucket key "<plan>@<tenant|->" -> dict with reservoir
+            self.plans: dict[str, dict] = {}
+
+    def record_sampled(self) -> None:
+        with self._lock:
+            self.sampled += 1
+        _metrics.inc("numerics_shadow_sampled")
+
+    def record_audit(self, plan_label: str, tenant: str | None,
+                     realized: float, admitted: float,
+                     floor: float) -> None:
+        key = f"{plan_label}@{tenant or '-'}"
+        with self._lock:
+            self.audited += 1
+            b = self.plans.get(key)
+            if b is None:
+                b = {"plan": plan_label, "tenant": tenant,
+                     "admitted_err": float(admitted),
+                     "floor": float(floor),
+                     "reservoir": Reservoir(seed=len(self.plans))}
+                self.plans[key] = b
+            b["admitted_err"] = float(admitted)
+            b["floor"] = float(floor)
+            b["reservoir"].add(realized)
+        _metrics.inc("numerics_shadow_audits")
+
+    def record_audit_failure(self) -> None:
+        with self._lock:
+            self.audit_failures += 1
+
+    def record_nonfinite(self, site: str, kind: str) -> None:
+        key = f"{site}:{kind}"
+        with self._lock:
+            self.nonfinite[key] = self.nonfinite.get(key, 0) + 1
+        _metrics.inc("numerics_nonfinite", site=site, kind=kind)
+
+    def snapshot(self, slack: float = DEFAULT_SLACK) -> dict | None:
+        """The monitor-sample ``numerics`` block; None while the plane
+        has never been armed AND nothing was recorded (disarmed
+        processes keep emitting schema-4 samples without the block)."""
+        with self._lock:
+            active = (_ARMED or self.sampled or self.audited
+                      or self.audit_failures or self.nonfinite
+                      or self.plans)
+            if not active:
+                return None
+            out = {
+                "schema": NUMERICS_SCHEMA,
+                "sampled": self.sampled,
+                "audited": self.audited,
+                "audit_failures": self.audit_failures,
+                "slack": slack,
+                "nonfinite": dict(self.nonfinite),
+                "plans": {},
+            }
+            for key, b in sorted(self.plans.items()):
+                res: Reservoir = b["reservoir"]
+                doc = judge_bucket(res.values, res.n, b["admitted_err"],
+                                   b["floor"], slack)
+                doc["plan"] = b["plan"]
+                doc["tenant"] = b["tenant"]
+                # The pooled-merge payload: the reservoir's upper tail.
+                doc["errors"] = res.tail()
+                out["plans"][key] = doc
+            return out
+
+
+_LEDGER = _Ledger()
+#: Flips True the first time any NumericsPlane is constructed in this
+#: process — from then on samples carry the block even when it is all
+#: zeros (a healthy armed run must be distinguishable from a dark one).
+_ARMED = False
+
+
+def record_audit(plan_label: str, tenant: str | None, realized: float,
+                 admitted: float, floor: float) -> None:
+    _LEDGER.record_audit(plan_label, tenant, realized, admitted, floor)
+
+
+def record_audit_failure() -> None:
+    _LEDGER.record_audit_failure()
+
+
+def record_nonfinite(site: str, kind: str) -> None:
+    _LEDGER.record_nonfinite(site, kind)
+
+
+def record_sampled() -> None:
+    _LEDGER.record_sampled()
+
+
+def numerics_snapshot(slack: float = DEFAULT_SLACK) -> dict | None:
+    """The process-global ``numerics`` block (monitor schema 4), or
+    None when the plane has never been armed and nothing recorded."""
+    return _LEDGER.snapshot(slack)
+
+
+def reset_numerics() -> None:
+    """Clear the ledger (tests; the armed flag stays — arming is a
+    process-lifetime property)."""
+    _LEDGER.reset()

@@ -59,6 +59,7 @@ the c64 wire bytes).
 
 from __future__ import annotations
 
+import hashlib
 from dataclasses import dataclass
 from typing import Any
 
@@ -429,24 +430,39 @@ def wire_decode(y: jnp.ndarray, dtype,
 
 
 def wire_roundtrip_error(dtype, wire_dtype: str | None = "bf16",
-                         n: int = 4096) -> float:
+                         n: int = 4096, *, sample=None) -> float:
     """Measured relative round-trip error of one wire cast
     (``max |decode(encode(x)) - x| / max |x|`` over a seeded
     standard-normal complex block, tiled the way an 8-way exchange
     would tile it) — the number the tuner's error-budget filter and
     ``explain``'s ``wire.compression_err`` field report. Every
     registered codec is measured the same seeded/cached way, so
-    per-candidate pruning never re-measures. 0.0 for the exact wire."""
+    per-candidate pruning never re-measures. 0.0 for the exact wire.
+
+    ``sample`` measures on caller-supplied data instead of the seeded
+    Gaussian (cached by content digest — the convolve-kernel digest
+    discipline). The seeded figure is OPTIMISTIC for non-Gaussian
+    dynamic ranges: the block-scaled codecs (int8/split) share one
+    pow2 exponent per tile, so a heavy-tailed sample degrades far
+    beyond the seeded estimate (docs/TUNING.md codec table; the
+    numerics plane's shadow audit exists to observe exactly this)."""
     if wire_dtype is None:
         return 0.0
     codec = wire_codec(wire_dtype)
-    key = (str(np.dtype(dtype)), wire_dtype, int(n))
+    if sample is not None:
+        x = np.asarray(sample, dtype=np.dtype(dtype)).ravel()
+        digest = hashlib.sha256(x.tobytes()).hexdigest()[:16]
+        key = (str(np.dtype(dtype)), wire_dtype, x.size, digest)
+    else:
+        x = None
+        key = (str(np.dtype(dtype)), wire_dtype, int(n))
     hit = _WIRE_ERR_CACHE.get(key)
     if hit is not None:
         return hit
-    rng = np.random.default_rng(0)
-    x = (rng.standard_normal(n) + 1j * rng.standard_normal(n)).astype(
-        np.dtype(dtype))
+    if x is None:
+        rng = np.random.default_rng(0)
+        x = (rng.standard_normal(n)
+             + 1j * rng.standard_normal(n)).astype(np.dtype(dtype))
     tiles = 8
     parts = codec.encode(jnp.asarray(x), tile_axis=0, tiles=tiles)
     y = np.asarray(codec.decode(parts, dtype, tile_axis=0, tiles=tiles))

@@ -148,6 +148,7 @@ def make_run_record(
     explain: dict | None = None,
     qos: dict | None = None,
     health: dict | None = None,
+    numerics: dict | None = None,
     source: str = "",
     commit: str | None = None,
     recorded_at: str | None = None,
@@ -203,6 +204,12 @@ def make_run_record(
         # regressed_metrics alongside cost/rates and surfaced offline
         # by ``report health``.
         rec["health"] = health
+    if numerics:
+        # The numerics plane's drift/sentinel ledger (numerics
+        # .numerics_snapshot()); gated by regressed_metrics (drifting
+        # buckets, non-finite outputs) and surfaced by ``report
+        # numerics``.
+        rec["numerics"] = numerics
     if extra:
         rec["extra"] = extra
     return rec
@@ -334,6 +341,9 @@ def normalize_bench_line(
     health = obj.get("health")
     if not isinstance(health, dict):
         health = None
+    numerics = obj.get("numerics")
+    if not isinstance(numerics, dict):
+        numerics = None
     rates = {k: obj[k] for k in AUX_RATE_METRICS
              if isinstance(obj.get(k), (int, float))}
     return make_run_record(
@@ -353,6 +363,7 @@ def normalize_bench_line(
         explain=explain,
         qos=qos,
         health=health,
+        numerics=numerics,
         source=source,
         commit=commit,
         recorded_at=recorded_at,
@@ -600,6 +611,19 @@ def compare_record(
                 for a in health.get("alerts") or []
                 if isinstance(a, dict)],
         }
+    numerics = record.get("numerics")
+    if isinstance(numerics, dict):
+        # Like health, the numerics verdict needs no baseline: a
+        # drifting plan bucket or a non-finite-output sentinel is
+        # absolute badness. Copied through (drop the raw error tails)
+        # so regressed_metrics gates on it.
+        out["numerics"] = {
+            "nonfinite": dict(numerics.get("nonfinite") or {}),
+            "plans": {
+                key: {k: v for k, v in b.items() if k != "errors"}
+                for key, b in (numerics.get("plans") or {}).items()
+                if isinstance(b, dict)},
+        }
     if len(base) < min_samples:
         return out
     med, mad = robust_stats([float(r["value"]) for r in base])
@@ -689,6 +713,19 @@ def regressed_metrics(result: dict) -> list[str]:
             if alert.get("tenant"):
                 name = f"{name}[{alert['tenant']}]"
             out.append(f"health:{name}")
+    # Numerics-plane drift (docs/OBSERVABILITY.md "Numerics plane"): a
+    # run whose shadow audit judged a plan bucket drifting — or whose
+    # sentinels caught non-finite outputs — regressed numerically even
+    # when every timing metric improved. Fast-but-newly-wrong must not
+    # pass the perf gate.
+    numerics = result.get("numerics") or {}
+    for key, b in sorted((numerics.get("plans") or {}).items()):
+        if b.get("drifting"):
+            out.append(f"numerics:drift:{key}")
+    nf_out = sum(v for k, v in (numerics.get("nonfinite") or {}).items()
+                 if k.startswith("output:"))
+    if nf_out > 0:
+        out.append("numerics:nonfinite")
     return out
 
 

@@ -1287,6 +1287,117 @@ def _main_health(argv: list[str]) -> int:
     return 1 if (args.gate and firing) else 0
 
 
+def _format_numerics(block: dict) -> str:
+    lines = [
+        f"numerics: {block.get('sampled', 0)} sampled, "
+        f"{block.get('audited', 0)} audited"
+        + (f" ({block.get('audit_failures', 0)} failed)"
+           if block.get("audit_failures") else "")
+        + f", slack {block.get('slack', 0.0):g}x"]
+    nf = block.get("nonfinite") or {}
+    lines.append("non-finite: " + (", ".join(
+        f"{k} {v}" for k, v in sorted(nf.items())) if nf else "none"))
+    plans = block.get("plans") or {}
+    if plans:
+        lines.append(f"{'plan bucket':44} {'n':>5} {'admitted':>9} "
+                     f"{'p50':>9} {'p99':>9} {'drift':>8}")
+        for key, b in sorted(plans.items()):
+            lines.append(
+                f"{key:44} {b.get('n', 0):>5} "
+                f"{b.get('admitted_err', 0.0):>9.3g} "
+                f"{b.get('realized_p50', 0.0):>9.3g} "
+                f"{b.get('realized_p99', 0.0):>9.3g} "
+                f"{b.get('drift_ratio', 0.0):>7.3g}x"
+                + ("  DRIFTING" if b.get("drifting") else ""))
+    else:
+        lines.append("no audited plan buckets")
+    return "\n".join(lines)
+
+
+def _numerics_firing(block: dict) -> list[str]:
+    """What would trip ``report numerics --gate``: drifting plan
+    buckets and output-site non-finite sentinels (input-site ones are
+    the caller's — surfaced, never gating)."""
+    firing = [f"accuracy_drift:{key}"
+              for key, b in sorted((block.get("plans") or {}).items())
+              if b.get("drifting")]
+    nf_out = sum(v for k, v in (block.get("nonfinite") or {}).items()
+                 if k.startswith("output:"))
+    if nf_out > 0:
+        firing.append(f"nonfinite:output:{nf_out:g}")
+    return firing
+
+
+def _main_numerics(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m distributedfft_tpu.report numerics",
+        description="Numerics-plane ledger (docs/OBSERVABILITY.md "
+                    "'Numerics plane'): shadow-sampled realized error "
+                    "per plan bucket against the admitted budget "
+                    "(drift verdicts), plus the non-finite sentinel "
+                    "counters. Reads a monitor JSONL series "
+                    "(--series), a fleet directory (--dir; ledgers "
+                    "pool cross-process — concatenated reservoir "
+                    "tails, re-ranked quantiles), or this process's "
+                    "live ledger.")
+    p.add_argument("--series", default=None, metavar="FILE",
+                   help="monitor JSONL series (DFFT_MONITOR=interval,"
+                        "path)")
+    p.add_argument("--dir", dest="dir_", default=None, metavar="DIR",
+                   help="fleet series directory (DFFT_MONITOR_DIR)")
+    p.add_argument("--json", action="store_true",
+                   help="print the pooled numerics block as JSON")
+    p.add_argument("--gate", action="store_true",
+                   help="exit 1 on accuracy drift or non-finite "
+                        "outputs (the CI verdict)")
+    args = p.parse_args(argv)
+    if args.series and args.dir_:
+        print("report numerics: pass --series or --dir, not both",
+              file=sys.stderr)
+        return 2
+
+    block = None
+    if args.dir_:
+        from . import fleet as _fleet
+
+        streams = _fleet.load_fleet(args.dir_)
+        if not streams:
+            print(f"report numerics: {args.dir_}: no monitor series",
+                  file=sys.stderr)
+            return 2
+        merged = _fleet.merge_streams(streams)
+        block = next((m["numerics"] for m in reversed(merged)
+                      if isinstance(m.get("numerics"), dict)), None)
+    elif args.series:
+        from .monitor import load_series
+
+        samples = load_series(args.series)
+        if not samples:
+            print(f"report numerics: {args.series}: no monitor "
+                  f"samples", file=sys.stderr)
+            return 2
+        block = next((s["numerics"] for s in reversed(samples)
+                      if isinstance(s.get("numerics"), dict)), None)
+    else:
+        from .numerics import numerics_snapshot
+
+        block = numerics_snapshot()
+    if block is None:
+        print("report numerics: no numerics block — the plane is dark "
+              "(arm it with DFFT_SHADOW_RATE=p[,seed])",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(block, indent=2, sort_keys=True))
+    else:
+        print(_format_numerics(block))
+    firing = _numerics_firing(block)
+    if firing and not args.json:
+        print(f"{len(firing)} numerics verdict(s) firing: {firing}",
+              file=sys.stderr)
+    return 1 if (args.gate and firing) else 0
+
+
 def _main_live(argv: list[str]) -> int:
     p = argparse.ArgumentParser(
         prog="python -m distributedfft_tpu.report live",
@@ -1474,6 +1585,7 @@ _SUBCOMMANDS = {
     "health": _main_health,
     "live": _main_live,
     "fleet": _main_fleet,
+    "numerics": _main_numerics,
 }
 
 

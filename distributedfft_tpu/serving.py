@@ -106,6 +106,7 @@ import jax
 import jax.numpy as jnp
 
 from . import faults as _faults
+from . import numerics as _numerics
 from .local import FORWARD
 from .ops.executors import Scale
 from .qos import QosPolicy, QuotaExceeded
@@ -691,6 +692,16 @@ class CoalescingQueue:
         # OBSERVABILITY.md "Fleet view & load generation"). With both
         # unset the queue carries no monitor and takes no hook anywhere.
         self._monitor = None
+        # DFFT_SHADOW_RATE=p[,seed] arms the numerics plane (docs/
+        # OBSERVABILITY.md "Numerics plane"): shadow-sampled accuracy
+        # audits against a memoized exact reference plan plus
+        # non-finite sentinels with quarantine. Unset ⇒ None, and the
+        # serving path takes zero numerics branches — byte-identical
+        # behavior and HLO (pinned by tests/test_a2r_numerics.py).
+        self._numerics = _numerics.NumericsPlane.from_env()
+        # plan-tuple key[:3] -> exact reference plan (or None when the
+        # reference cannot build — audits for that tuple are skipped).
+        self._shadow_plans: dict[tuple, Any] = {}
         # Streaming drain-loop state (docs/SERVING_QOS.md "Streaming
         # scheduler & wave preemption"): serve()/stop() manage the
         # persistent loop; _arrival wakes it (set by submit only while
@@ -1341,12 +1352,28 @@ class CoalescingQueue:
         #                          retry/degraded/bisect chain.
         from .ops.executors import apply_scale
 
-        for plan, y, (k, g) in zip(plans, ys, live_groups):
-            gt = self._tenant_of(k)
+        g_outs = []
+        for plan, y, (_, g) in zip(plans, ys, live_groups):
+            outs = []
             for i, r in enumerate(g):
                 out = y if len(g) == 1 else y[i]
                 if r.scale != Scale.NONE:
                     out = apply_scale(out, r.scale, plan.world_size)
+                outs.append(out)
+            g_outs.append(outs)
+        if self._numerics is not None:
+            # Sentinel sweep before ANY handle resolves: a non-finite
+            # output must not leak through the concurrent fast path.
+            # The per-group fallback owns the retry -> exact-rebuild ->
+            # bisect quarantine chain, so route the whole chunk there.
+            try:
+                for (_, g), outs in zip(live_groups, g_outs):
+                    self._guard_nonfinite(g, outs, tag, tracing)
+            except _numerics.NonFiniteResult:
+                return sequential()
+        for plan, (k, g), outs in zip(plans, live_groups, g_outs):
+            gt = self._tenant_of(k)
+            for r, out in zip(g, outs):
                 r.handle._set(out)
             if _metrics._enabled:
                 _metrics.inc("serving_flushes", kind=self.kind)
@@ -1368,6 +1395,9 @@ class CoalescingQueue:
                          kind=self.kind)
             _metrics.observe("serving_concurrent_groups",
                              float(len(live_groups)), kind=self.kind)
+        if self._numerics is not None:
+            for plan, (k, g), outs in zip(plans, live_groups, g_outs):
+                self._shadow_audit(k, plan, g, outs, tag, tracing)
         return b_total
 
     def _execute_group(self, key: tuple, group: list, *,
@@ -1426,9 +1456,14 @@ class CoalescingQueue:
                 plan = self._plan(key, None, False, executor=executor)
             with _span(f"serve_execute[{tag}]", tracing):
                 out = execute(plan, r.x, scale=r.scale)
+                if self._numerics is not None:
+                    self._guard_nonfinite(group, [out], tag, tracing)
                 if executor is not None:
                     r.handle.degraded = True
                 r.handle._set(out)
+            if self._numerics is not None and executor is None:
+                self._shadow_audit(key, plan, group, [out], tag,
+                                   tracing)
             return plan
         with _span(f"serve_plan[{tag}]", tracing):
             plan = self._plan(key, len(group), self.donate,
@@ -1445,16 +1480,170 @@ class CoalescingQueue:
             stacked = jax.device_put(stacked, plan.in_sharding)
         with _span(f"serve_execute[{tag}]", tracing):
             y = plan(stacked)
+            outs = []
             for i, r in enumerate(group):
                 out = y[i]
                 if r.scale != Scale.NONE:
                     from .ops.executors import apply_scale
 
                     out = apply_scale(out, r.scale, plan.world_size)
+                outs.append(out)
+            if self._numerics is not None:
+                self._guard_nonfinite(group, outs, tag, tracing)
+            for r, out in zip(group, outs):
                 if executor is not None:
                     r.handle.degraded = True
                 r.handle._set(out)
+        if self._numerics is not None and executor is None:
+            self._shadow_audit(key, plan, group, outs, tag, tracing)
         return plan
+
+    # --------------------------------------------------- numerics plane
+
+    def _guard_nonfinite(self, group: list, outs: list, tag: str,
+                         tracing: bool) -> None:
+        """Armed-only non-finite sentinel at the output boundary
+        (docs/OBSERVABILITY.md "Numerics plane"). The *input* is
+        checked first so a caller's NaN/Inf is distinguished from
+        codec/executor damage: a non-finite input is counted
+        (``numerics_nonfinite{site=input}``) and its output delivered
+        as-is — the caller's problem, never retried. A non-finite
+        output from a finite input raises :class:`~.numerics
+        .NonFiniteResult` BEFORE any handle resolves, so the fault
+        chain (retry → exact-rebuild → bisect) quarantines the
+        poisoned request while finite cohort members complete
+        bit-correct."""
+        for r, out in zip(group, outs):
+            ikind = _numerics.nonfinite_kind(r.x)
+            if ikind is not None:
+                with _span("numerics_nonfinite[input]", tracing):
+                    _numerics.record_nonfinite("input", ikind)
+                continue
+            okind = _numerics.nonfinite_kind(out)
+            if okind is not None:
+                with _span("numerics_nonfinite[output]", tracing):
+                    _numerics.record_nonfinite("output", okind)
+                raise _numerics.NonFiniteResult(
+                    f"non-finite ({okind}) output from a finite input "
+                    f"[{tag}]", site="output", kind=okind)
+
+    def _shadow_plan(self, key: tuple):
+        """The memoized exact reference plan for ``key``'s plan tuple:
+        same geometry and direction, exact wire (``wire_dtype="none"``
+        pins the uncompressed exchange regardless of DFFT_WIRE_DTYPE),
+        exact executor tier, fusion and tuner off — the yardstick every
+        shadow audit compares against. Unbuildable references memoize
+        None (that tuple's audits are skipped, counted as failures)."""
+        pk = key[:3]
+        if pk in self._shadow_plans:
+            return self._shadow_plans[pk]
+        shape, dtype, direction = pk
+        kw = dict(self.plan_kw, direction=direction, batch=None,
+                  donate=False, wire_dtype="none", fuse=False,
+                  tune="off")
+        for tiered in ("mm_precision", "mm_complex",
+                       "max_roundtrip_err"):
+            kw.pop(tiered, None)
+        if dtype is not None:
+            kw["dtype"] = dtype
+        ex = kw.pop("executor", None)
+        if ex:
+            from .ops.executors import (MM_EXECUTOR_BASES,
+                                        split_executor, split_fuse,
+                                        tiered_name)
+
+            base, _tier, _cmode = split_executor(split_fuse(ex)[0])
+            kw["executor"] = (tiered_name(base, "highest")
+                              if base in MM_EXECUTOR_BASES else base)
+        try:
+            plan = self._planner()(shape, self.mesh, **kw)
+        except Exception:  # noqa: BLE001 — no reference, no audit
+            plan = None
+        self._shadow_plans[pk] = plan
+        return plan
+
+    def _plan_label(self, key: tuple, plan) -> str:
+        """The ledger bucket label of a plan tuple — readable, stable
+        across processes (the fleet pools on it)."""
+        import numpy as np
+
+        from .plan_logic import resolve_wire_dtype
+
+        sh = "x".join(str(n) for n in key[0])
+        try:
+            # Meshless (single-device) plans never exchange — no wire
+            # codec runs, whatever DFFT_WIRE_DTYPE says.
+            if getattr(plan, "mesh", None) is None:
+                wd = "exact"
+            else:
+                wd = resolve_wire_dtype(plan.options.wire_dtype) or "exact"
+        except Exception:  # noqa: BLE001
+            wd = "exact"
+        d = "fwd" if getattr(plan, "forward", True) else "inv"
+        return (f"{self.kind}:{sh}:{np.dtype(plan.dtype).name}:{d}:"
+                f"{plan.executor}:{wd}")
+
+    def _admitted_err(self, plan) -> float:
+        """The plan's admitted error budget — the seeded plan-time
+        figures the tuner's ONE-budget admission rule consumed
+        (docs/TUNING.md): wire-compression + executor-tier roundtrip.
+        The drift verdict compares realized error against this."""
+        from .ops.executors import executor_roundtrip_error
+        from .parallel.exchange import wire_roundtrip_error
+        from .plan_logic import resolve_wire_dtype
+
+        err = 0.0
+        try:
+            wd = (None if getattr(plan, "mesh", None) is None
+                  else resolve_wire_dtype(plan.options.wire_dtype))
+            if wd:
+                err += wire_roundtrip_error(plan.dtype, wd)
+        except Exception:  # noqa: BLE001 — unknown codec: no budget
+            pass
+        try:
+            err += executor_roundtrip_error(plan.executor, plan.dtype)
+        except Exception:  # noqa: BLE001 — bare label: no tier budget
+            pass
+        return err
+
+    def _shadow_audit(self, key: tuple, plan, group: list, outs: list,
+                      tag: str, tracing: bool) -> None:
+        """Shadow-sampled accuracy audit: picked requests re-execute
+        through the memoized exact reference plan after their primary
+        execution resolved; the realized L2-relative error lands in the
+        process-global ledger against the plan's admitted budget.
+        Shadow work is charged traffic (the owning tenant's bucket pays
+        for the re-execution, like recovery work — docs/SERVING_QOS
+        .md); audit failures are counted, never raised — telemetry
+        must not fail serving."""
+        ns = self._numerics
+        picked = [(r, out) for r, out in zip(group, outs)
+                  if ns.pick()]
+        if not picked:
+            return
+        from .api import execute
+
+        label = self._plan_label(key, plan)
+        tenant = self._tenant_of(key)
+        for r, out in picked:
+            _numerics.record_sampled()
+            try:
+                ref = self._shadow_plan(key)
+                if ref is None:
+                    _numerics.record_audit_failure()
+                    continue
+                with _span(f"shadow_audit[{tag}]", tracing):
+                    yref = execute(ref, r.x, scale=r.scale)
+                    realized = _numerics.realized_error(out, yref)
+                _numerics.record_audit(
+                    label, tenant, realized, self._admitted_err(plan),
+                    _numerics.drift_floor(
+                        getattr(yref, "dtype", plan.dtype)))
+            except Exception:  # noqa: BLE001 — telemetry never fails
+                _numerics.record_audit_failure()
+                continue
+            if self.policy is not None and r.tenant:
+                self.policy.charge(r.tenant, 1)
 
     # ------------------------------------------------- fault tolerance
 

@@ -1,0 +1,672 @@
+"""Numerics observability plane acceptance (docs/OBSERVABILITY.md
+"Numerics plane") on the virtual 8-device mesh.
+
+Contracts pinned here:
+
+1. **Disarmed pin** — ``DFFT_SHADOW_RATE`` unset leaves the queue's
+   plane ``None`` and the served outputs bit-identical to an armed
+   run's primary path (the audit observes, never perturbs).
+2. **Shadow-sampled accuracy audit** — an armed queue re-executes
+   sampled requests through the memoized exact reference plan;
+   realized error lands in per-(plan, tenant) reservoirs against the
+   admitted budget. An exact plan audits to realized 0; an int8-wire
+   plan fed one hot co-batched request drifts past the slack (the
+   shared per-tile pow2 scales zero the cohort's wire data).
+3. **Non-finite sentinels with quarantine** — a finite input whose
+   transform overflows raises :class:`dfft.NonFiniteResult` on ITS
+   handle only, through the retry -> exact-rebuild -> bisect chain;
+   cohort members complete bit-correct. A non-finite *input* is
+   reported, delivered, never retried.
+4. **Adversarial dynamic-range parity** — the block-scaled codecs'
+   seeded roundtrip figures are optimistic on heavy-tailed batches:
+   int8 realized L2 error lands >10x its seeded figure, and split —
+   despite its 15-bit mantissa levels — degrades even further
+   *relative to its tiny seeded figure* (shared-exponent physics: the
+   absolute contamination error is level-count invariant, so the
+   finer codec's headroom is an illusion under contamination). Only
+   the elementwise bf16 cast stays within ~2x.
+5. **Surfacing** — monitor samples stamp the schema-4 ``numerics``
+   block; ``health_from_samples`` fires ``accuracy_drift``/
+   ``nonfinite``; fleet merge pools reservoir tails by rank (never
+   averaged); mixed schema 2/3/4 fleets merge; ``report numerics
+   --gate`` and the regress fold gate on drift.
+
+NOTE on the filename: must collect BEFORE ``test_alltoallv.py``
+(alphabetical clean-backend tier; see ``tests/conftest.py``).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu import numerics
+
+DATA = os.path.join(os.path.dirname(__file__), "data", "fleet_skew")
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger(monkeypatch):
+    """Every test starts dark: no env arming, empty ledger, and the
+    process-lifetime armed flag restored afterwards so this file
+    leaves no trace in later-collected suites."""
+    monkeypatch.delenv("DFFT_SHADOW_RATE", raising=False)
+    monkeypatch.delenv("DFFT_WIRE_DTYPE", raising=False)
+    numerics.reset_numerics()
+    armed = numerics._ARMED
+    yield
+    numerics.reset_numerics()
+    numerics._ARMED = armed
+
+
+def _mk(rng, shape=(8, 8, 8)):
+    return (rng.standard_normal(shape)
+            + 1j * rng.standard_normal(shape)).astype(np.complex64)
+
+
+# ------------------------------------------------------------- parsing
+
+
+def test_parse_shadow_rate_forms():
+    assert numerics.parse_shadow_rate(None) is None
+    assert numerics.parse_shadow_rate("") is None
+    assert numerics.parse_shadow_rate("  ") is None
+    assert numerics.parse_shadow_rate("0.25") == (0.25, 0)
+    assert numerics.parse_shadow_rate("0.1,7") == (0.1, 7)
+    assert numerics.parse_shadow_rate("1") == (1.0, 0)
+    # Clamped, not rejected: a fat-fingered 1.5 audits everything.
+    assert numerics.parse_shadow_rate("1.5") == (1.0, 0)
+    assert numerics.parse_shadow_rate("-0.5,3") == (0.0, 3)
+    # Malformed raises — a typo must not silently disarm the audit.
+    with pytest.raises(ValueError):
+        numerics.parse_shadow_rate("lots")
+    with pytest.raises(ValueError):
+        numerics.parse_shadow_rate("0.5,many")
+
+
+def test_sampler_deterministic_and_rate_zero_arms_sentinels():
+    a = numerics.NumericsPlane(0.5, seed=7)
+    b = numerics.NumericsPlane(0.5, seed=7)
+    assert [a.pick() for _ in range(64)] == [b.pick() for _ in range(64)]
+    c = numerics.NumericsPlane(0.5, seed=8)
+    assert ([numerics.NumericsPlane(0.5, seed=7).pick()
+             for _ in range(64)]
+            != [c.pick() for _ in range(64)])
+    # Rate 0 never samples but still arms the plane (sentinels +
+    # monitor block).
+    z = numerics.NumericsPlane(0.0, seed=0)
+    assert not any(z.pick() for _ in range(32))
+    assert numerics.numerics_snapshot() is not None
+
+
+def test_reservoir_bounded_deterministic_tail():
+    r = numerics.Reservoir(cap=16, seed=3)
+    for i in range(1000):
+        r.add(float(i))
+    assert r.n == 1000 and len(r.values) == 16
+    r2 = numerics.Reservoir(cap=16, seed=3)
+    for i in range(1000):
+        r2.add(float(i))
+    assert r.values == r2.values
+    assert r.tail(4) == sorted(r.values)[-4:]
+    assert r.quantile(0.5) <= r.quantile(0.99)
+
+
+def test_judge_bucket_verdict_rules():
+    errs = [0.1] * 10
+    # Over budget x slack with enough samples -> drifting.
+    doc = numerics.judge_bucket(errs, 10, admitted=0.001, floor=1e-6,
+                                slack=8.0)
+    assert doc["drifting"] and doc["drift_ratio"] > 8.0
+    # Same errors, too few samples -> never fires.
+    doc = numerics.judge_bucket(errs[:3], 3, admitted=0.001, floor=1e-6,
+                                slack=8.0)
+    assert not doc["drifting"]
+    # Within slack -> quiet.
+    doc = numerics.judge_bucket([0.002] * 10, 10, admitted=0.001,
+                                floor=1e-6, slack=8.0)
+    assert not doc["drifting"]
+    # Exact plan (admitted 0): the floor keeps fp wiggle from reading
+    # as infinite drift.
+    doc = numerics.judge_bucket([1e-7] * 10, 10, admitted=0.0,
+                                floor=1.19e-5, slack=8.0)
+    assert not doc["drifting"]
+
+
+def test_realized_error_and_nonfinite_kind():
+    y = np.ones(8, np.complex64)
+    assert numerics.realized_error(y, y) == 0.0
+    assert numerics.realized_error(2 * y, y) == pytest.approx(1.0)
+    assert numerics.realized_error(np.full(8, np.nan, np.complex64),
+                                   y) == float("inf")
+    assert numerics.nonfinite_kind(y) is None
+    bad = y.copy()
+    bad[0] = np.nan
+    assert numerics.nonfinite_kind(bad) == "nan"
+    inf = y.copy()
+    inf[0] = np.inf
+    assert numerics.nonfinite_kind(inf) == "inf"
+    assert numerics.nonfinite_kind(np.arange(4)) is None  # ints: clean
+
+
+# ------------------------------------------------- serving: the audit
+
+
+def test_disarmed_pin_and_armed_bit_identical(monkeypatch):
+    """Unset -> plane None; arming changes nothing about the primary
+    outputs (the audit is an observer)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    xs = [_mk(rng) for _ in range(4)]
+
+    q0 = dfft.CoalescingQueue(dfft.make_mesh(8), dtype=jnp.complex64,
+                              policy="off")
+    assert q0._numerics is None
+    hs = [q0.submit(jnp.asarray(x)) for x in xs]
+    q0.flush()
+    base = [np.asarray(h.result(timeout=60)) for h in hs]
+    q0.close()
+    assert numerics.numerics_snapshot() is None  # plane never armed
+
+    monkeypatch.setenv("DFFT_SHADOW_RATE", "1,3")
+    q1 = dfft.CoalescingQueue(dfft.make_mesh(8), dtype=jnp.complex64,
+                              policy="off")
+    assert q1._numerics is not None and q1._numerics.rate == 1.0
+    hs = [q1.submit(jnp.asarray(x)) for x in xs]
+    q1.flush()
+    armed = [np.asarray(h.result(timeout=60)) for h in hs]
+    q1.close()
+    for a, b in zip(armed, base):
+        assert np.array_equal(a, b)
+
+    snap = numerics.numerics_snapshot()
+    assert snap is not None
+    assert snap["sampled"] == 4 and snap["audited"] == 4
+    assert snap["audit_failures"] == 0
+    (key, bucket), = snap["plans"].items()
+    # Exact plan: wire "exact" in the label, zero realized error.
+    assert key.endswith(":exact@-")
+    assert bucket["realized_p99"] == 0.0 and not bucket["drifting"]
+    assert bucket["n"] == 4
+
+
+def test_shadow_audit_int8_contamination_drifts(monkeypatch):
+    """One hot co-batched request poisons the cohort's shared pow2
+    wire scales; the audit realizes O(1) L2 error against an admitted
+    budget of ~5e-3 and the bucket judges drifting."""
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("DFFT_SHADOW_RATE", "1,3")
+    rng = np.random.default_rng(0)
+    hot = _mk(rng)
+    hot[:4, :4, :4] *= 1e4
+
+    q = dfft.CoalescingQueue(dfft.make_mesh(8), dtype=jnp.complex64,
+                             policy="off", max_batch=8,
+                             wire_dtype="int8")
+    hs = [q.submit(jnp.asarray(_mk(rng))) for _ in range(5)]
+    hs.append(q.submit(jnp.asarray(hot)))
+    q.flush()
+    for h in hs:
+        h.result(timeout=60)
+    q.close()
+
+    snap = numerics.numerics_snapshot()
+    assert snap["audited"] == 6
+    (key, bucket), = snap["plans"].items()
+    assert ":int8@" in key
+    assert bucket["n"] >= numerics.MIN_DRIFT_SAMPLES
+    assert bucket["admitted_err"] > 0.0
+    assert bucket["drifting"]
+    assert bucket["drift_ratio"] > numerics.DEFAULT_SLACK
+    # The contaminated cohort members read O(1) relative error.
+    assert bucket["realized_p99"] > 0.1
+
+
+def test_shadow_audit_charges_owning_tenant(monkeypatch):
+    """Shadow work is charged traffic: each audited request deducts
+    one extra transform from its tenant's quota bucket — the
+    recovery-work charge discipline (docs/SERVING_QOS.md)."""
+    import jax.numpy as jnp
+
+    from distributedfft_tpu.qos import QosPolicy, Tenant
+
+    monkeypatch.setenv("DFFT_SHADOW_RATE", "1,3")
+    rng = np.random.default_rng(0)
+    # Frozen clock: no refill, so the bucket balance is pure
+    # arithmetic.
+    pol = QosPolicy([Tenant("acme", rate=1000.0, burst=1000.0)],
+                    clock=lambda: 0.0)
+    q = dfft.CoalescingQueue(dfft.make_mesh(8), dtype=jnp.complex64,
+                             policy=pol)
+    hs = [q.submit(jnp.asarray(_mk(rng)), tenant="acme")
+          for _ in range(3)]
+    q.flush()
+    for h in hs:
+        h.result(timeout=60)
+    tokens = pol._buckets["acme"].tokens
+    q.close()
+    snap = numerics.numerics_snapshot()
+    assert snap["audited"] == 3
+    (key, bucket), = snap["plans"].items()
+    assert key.endswith("@acme") and bucket["tenant"] == "acme"
+    # 3 primary admissions + 3 shadow re-execution charges.
+    assert tokens == pytest.approx(1000.0 - 6.0)
+
+
+# --------------------------------------- serving: non-finite sentinels
+
+
+def test_quarantine_poisoned_request_fails_alone(monkeypatch):
+    """Finite input whose FFT overflows: the poisoned handle gets
+    NonFiniteResult via the bisect chain; the cohort completes
+    bit-correct; output-site sentinel counters advance."""
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("DFFT_SHADOW_RATE", "0")  # sentinels only
+    rng = np.random.default_rng(1)
+    clean = [_mk(rng) for _ in range(3)]
+    poison = np.full((8, 8, 8), 3e38 + 0j, np.complex64)
+    assert np.all(np.isfinite(poison))
+
+    q0 = dfft.CoalescingQueue(dfft.make_mesh(8), dtype=jnp.complex64,
+                              policy="off", retry_max=0)
+    hs0 = [q0.submit(jnp.asarray(c)) for c in clean]
+    q0.flush()
+    base = [np.asarray(h.result(timeout=60)) for h in hs0]
+    q0.close()
+    numerics.reset_numerics()
+
+    q = dfft.CoalescingQueue(dfft.make_mesh(8), dtype=jnp.complex64,
+                             policy="off", retry_max=0)
+    hs = [q.submit(jnp.asarray(c)) for c in clean]
+    hp = q.submit(jnp.asarray(poison))
+    q.flush()
+    outs = [np.asarray(h.result(timeout=60)) for h in hs]
+    with pytest.raises(dfft.NonFiniteResult) as ei:
+        hp.result(timeout=60)
+    q.close()
+    assert ei.value.site == "output" and ei.value.kind in ("nan", "inf")
+    # Cohort members match the no-poison baseline bit for bit (the
+    # bisect chain re-ran them solo, same plan, same math).
+    for a, b in zip(outs, base):
+        assert np.array_equal(a, b)
+    nf = numerics.numerics_snapshot()["nonfinite"]
+    # At least one output-site count; the chain re-detects per attempt
+    # (attempt -> degraded rebuild -> bisect), so never pin an exact
+    # total.
+    assert sum(v for k, v in nf.items()
+               if k.startswith("output:")) >= 1
+
+
+def test_nonfinite_input_delivered_never_retried(monkeypatch):
+    """A caller's NaN is the caller's: reported at the input site,
+    result delivered as-is, no error, no retry chain."""
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("DFFT_SHADOW_RATE", "0")
+    rng = np.random.default_rng(2)
+    bad = _mk(rng)
+    bad[0, 0, 0] = np.nan
+    q = dfft.CoalescingQueue(dfft.make_mesh(8), dtype=jnp.complex64,
+                             policy="off", retry_max=0)
+    h = q.submit(jnp.asarray(bad))
+    q.flush()
+    y = np.asarray(h.result(timeout=60))  # no raise
+    q.close()
+    assert not np.all(np.isfinite(y))
+    nf = numerics.numerics_snapshot()["nonfinite"]
+    assert nf.get("input:nan", 0) >= 1
+    assert not any(k.startswith("output:") for k in nf)
+
+
+def test_quarantine_through_concurrent_dispatch(monkeypatch):
+    """The concurrent fast path routes a poisoned chunk back to the
+    per-group chain; the poisoned handle alone fails."""
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("DFFT_SHADOW_RATE", "0")
+    rng = np.random.default_rng(3)
+    q = dfft.CoalescingQueue(dfft.make_mesh(8), dtype=jnp.complex64,
+                             policy="off", retry_max=0,
+                             concurrent_groups=2)
+    hs, shapes = [], [(8, 8, 8), (16, 8, 8)]
+    for sh in shapes:
+        for j in range(3):
+            x = _mk(rng, sh)
+            if sh == (8, 8, 8) and j == 1:
+                x = np.full(sh, 3e38 + 0j, np.complex64)
+            hs.append(q.submit(jnp.asarray(x)))
+    q.flush()
+    failures = 0
+    for h in hs:
+        try:
+            y = h.result(timeout=60)
+            assert bool(np.all(np.isfinite(np.asarray(y))))
+        except dfft.NonFiniteResult:
+            failures += 1
+    q.close()
+    assert failures == 1
+
+
+# ------------------------------------- adversarial dynamic-range parity
+
+
+def test_adversarial_range_parity_seeded_vs_realized():
+    """The seeded roundtrip figures are OPTIMISTIC for the block-scaled
+    codecs on heavy-tailed batches. Physics, not tuning: one pow2
+    scale per (tile, plane) is shared across the batch axis, so a hot
+    request re-scales its cohort's tiles and the absolute
+    contamination error is *level-count invariant* — int8 (127 levels)
+    and split (32767 levels) land in the same absolute place, which
+    reads as a far LARGER multiple of split's much smaller seeded
+    figure. The elementwise bf16 cast has no shared state and stays
+    within ~2x. (The ISSUE's prior of split staying ~2x is what this
+    test falsifies — measured here at >1000x.)"""
+    import jax.numpy as jnp
+
+    from distributedfft_tpu.parallel import exchange as ex
+
+    rng = np.random.default_rng(0)
+    normals = [_mk(rng) for _ in range(4)]
+    hot = _mk(rng)
+    hot[:4, :4, :4] *= 1e4
+    batch = np.stack(normals + [hot])
+
+    ratios = {}
+    for wd in ("bf16", "int8", "split"):
+        codec = ex.wire_codec(wd)
+        parts = codec.encode(jnp.asarray(batch), tile_axis=1, tiles=8)
+        y = np.asarray(codec.decode(parts, np.complex64,
+                                    tile_axis=1, tiles=8))
+        seeded = ex.wire_roundtrip_error(np.complex64, wd)
+        worst = max(
+            float(np.linalg.norm(y[i] - batch[i])
+                  / np.linalg.norm(batch[i]))
+            for i in range(len(normals)))
+        ratios[wd] = worst / seeded
+    assert ratios["int8"] > 10.0
+    assert ratios["bf16"] <= 2.0
+    assert ratios["split"] > 10.0  # measured ~1e4x; see docstring
+
+
+def test_roundtrip_error_sample_kwarg_digest_cache():
+    from distributedfft_tpu.ops.executors import executor_roundtrip_error
+    from distributedfft_tpu.parallel import exchange as ex
+
+    rng = np.random.default_rng(0)
+    x = (rng.standard_normal(4096)
+         + 1j * rng.standard_normal(4096)).astype(np.complex64)
+    seeded = ex.wire_roundtrip_error(np.complex64, "int8")
+    on_x = ex.wire_roundtrip_error(np.complex64, "int8", sample=x)
+    # Content-addressed: same bytes -> cache hit -> identical float.
+    assert ex.wire_roundtrip_error(np.complex64, "int8",
+                                   sample=x.copy()) == on_x
+    # A heavy-tailed sample measures worse than the seeded Gaussian.
+    hot = x.copy()
+    hot[:512] *= 1e4
+    on_hot = ex.wire_roundtrip_error(np.complex64, "int8", sample=hot)
+    assert on_hot > seeded
+    assert on_hot != on_x
+    # Executor figures accept samples the same way.
+    e = executor_roundtrip_error("matmul", np.complex64,
+                                 sample=x[:2048])
+    assert e >= 0.0
+    assert executor_roundtrip_error(
+        "matmul", np.complex64, sample=x[:2048].copy()) == e
+
+
+# ------------------------------------------------------------ surfacing
+
+
+def _sample(ts, numerics_block, seq=0):
+    return {"schema": 4, "ts": ts, "mono": ts - 950.0, "host": "h",
+            "pid": 1, "process_index": 0, "seq": seq,
+            "metrics": {"counters": {}},
+            "queue": {"kind": "c2c", "depth": 0, "groups": 0,
+                      "oldest_pending_age_s": 0.0, "flush_seq": seq,
+                      "stalls_total": 0},
+            "numerics": numerics_block}
+
+
+def _block(**kw):
+    base = {"schema": 1, "sampled": 10, "audited": 10,
+            "audit_failures": 0, "slack": 8.0, "nonfinite": {},
+            "plans": {}}
+    base.update(kw)
+    return base
+
+
+def test_monitor_sample_stamps_numerics_block(monkeypatch):
+    from distributedfft_tpu import monitor as mon
+
+    assert mon.MONITOR_SCHEMA == 4
+    m = mon.Monitor(interval_s=60.0)
+    doc = m.sample()
+    assert doc["schema"] == 4
+    assert "numerics" not in doc  # plane dark
+    numerics.NumericsPlane(0.0)  # arm
+    doc = m.sample()
+    assert doc["numerics"]["schema"] == numerics.NUMERICS_SCHEMA
+    assert doc["numerics"]["sampled"] == 0
+
+
+def test_health_from_samples_numerics_verdicts():
+    from distributedfft_tpu.monitor import health_from_samples
+
+    drifting_bucket = {
+        "plan": "c2c:8x8x8:complex64:fwd:xla:int8", "tenant": None,
+        "n": 20, "admitted_err": 0.005, "floor": 1e-5,
+        "realized_p50": 0.5, "realized_p99": 0.7, "drift_ratio": 140.0,
+        "drifting": True, "errors": [0.5, 0.7]}
+    samples = [
+        _sample(1000.0, _block(), seq=0),
+        _sample(1001.0, _block(
+            nonfinite={"output:nan": 2, "input:nan": 1},
+            plans={"c2c:8x8x8:complex64:fwd:xla:int8@-":
+                   drifting_bucket}), seq=1),
+    ]
+    h = health_from_samples(samples)
+    names = {a["name"]: a for a in h["alerts"]}
+    assert h["status"] == "alert"
+    assert names["accuracy_drift"]["severity"] == "alert"
+    assert names["accuracy_drift"]["drift_ratio"] == 140.0
+    assert names["nonfinite"]["severity"] == "alert"
+    assert names["nonfinite_input"]["severity"] == "warn"
+    assert h["totals"]["shadow_audited"] == 10.0
+    assert h["totals"]["nonfinite"] == 3.0
+    # Healthy armed ledger: no numerics alerts.
+    h0 = health_from_samples([_sample(1000.0, _block(), seq=0)])
+    assert not any(a["name"].startswith("nonfinite")
+                   or a["name"] == "accuracy_drift"
+                   for a in h0["alerts"])
+
+
+def test_prometheus_rows_for_numerics():
+    from distributedfft_tpu.monitor import prometheus_from_sample
+
+    bucket = {"plan": "p", "tenant": "acme", "n": 6,
+              "admitted_err": 0.005, "floor": 1e-5,
+              "realized_p50": 0.001, "realized_p99": 0.002,
+              "drift_ratio": 0.4, "drifting": False,
+              "errors": [0.001, 0.002]}
+    text = prometheus_from_sample(_sample(1000.0, _block(
+        sampled=4, audited=3,
+        nonfinite={"output:inf": 1},
+        plans={"p@acme": bucket})))
+    assert 'dfft_numerics_shadow_sampled_total 4' in text
+    assert 'dfft_numerics_shadow_audited_total 3' in text
+    assert ('dfft_numerics_nonfinite_total'
+            '{site="output",kind="inf"} 1') in text
+    assert ('dfft_numerics_drift_ratio'
+            '{plan="p",tenant="acme"} 0.4') in text
+    assert ('dfft_numerics_realized_err'
+            '{plan="p",tenant="acme",quantile="0.99"} 0.002') in text
+    # Dark plane: no numerics families at all.
+    dark = dict(_sample(1000.0, _block()))
+    dark.pop("numerics")
+    assert "dfft_numerics" not in prometheus_from_sample(dark)
+
+
+def test_fleet_merge_numerics_rank_not_average():
+    from distributedfft_tpu.fleet import _merge_numerics
+
+    b1 = _block(sampled=5, audited=5,
+                nonfinite={"output:nan": 1},
+                plans={"p@-": {"plan": "p", "tenant": None, "n": 5,
+                               "admitted_err": 0.004, "floor": 1e-5,
+                               "realized_p50": 0.001,
+                               "realized_p99": 0.001,
+                               "drift_ratio": 0.25, "drifting": False,
+                               "errors": [0.001] * 5}})
+    b2 = _block(sampled=7, audited=7,
+                nonfinite={"output:nan": 2, "input:inf": 1},
+                plans={"p@-": {"plan": "p", "tenant": None, "n": 7,
+                               "admitted_err": 0.005, "floor": 1e-5,
+                               "realized_p50": 0.9, "realized_p99": 0.9,
+                               "drift_ratio": 180.0, "drifting": True,
+                               "errors": [0.9] * 7}})
+    merged = _merge_numerics([b1, None, b2, "garbage"])
+    assert merged["sampled"] == 12 and merged["audited"] == 12
+    assert merged["nonfinite"] == {"output:nan": 3, "input:inf": 1}
+    b = merged["plans"]["p@-"]
+    assert b["n"] == 12
+    assert b["admitted_err"] == 0.005  # max, not sum
+    # Rank over the concatenated tails: p99 is an observed 0.9, not an
+    # averaged percentile.
+    assert b["realized_p99"] == 0.9
+    assert b["drifting"]
+    assert _merge_numerics([None, "x"]) is None
+
+
+def test_mixed_schema_fleet_merge_regression():
+    """A rolling-restart fleet (schema 2 + 3 + 4 members) merges; the
+    numerics block pools from the v4 member alone and the merged doc
+    keeps its own schema stamp."""
+    from distributedfft_tpu.fleet import (fleet_health, load_fleet,
+                                          merge_streams)
+
+    streams = load_fleet(os.path.join(DATA, "mixed_schema"))
+    assert {sid.split(":")[1].split("#")[0] for sid in streams} \
+        == {"201", "104", "105"}
+    merged = merge_streams(streams)
+    assert merged and merged[-1]["schema"] == 2
+    n = merged[-1]["numerics"]
+    assert n["sampled"] == 32
+    assert "c2c:8x8x8:complex64:fwd:xla:int8@acme" in n["plans"]
+    assert n["nonfinite"] == {"input:nan": 1}
+    # Pre-v4 members carry no block; earlier buckets where only they
+    # reported still merge (no numerics key or a None is tolerated).
+    h = fleet_health(streams)
+    assert h["status"] in ("ok", "warn")  # input-site is warn at most
+
+
+def test_report_numerics_cli(tmp_path, capsys):
+    from distributedfft_tpu import report
+
+    # Live ledger path: dark plane -> exit 2 with a hint.
+    assert report.main(["numerics"]) == 2
+    capsys.readouterr()
+
+    numerics.NumericsPlane(0.0)
+    numerics.record_audit("p", "acme", 0.9, 0.005, 1e-5)
+    for _ in range(5):
+        numerics.record_audit("p", "acme", 0.9, 0.005, 1e-5)
+    assert report.main(["numerics"]) == 0
+    out = capsys.readouterr().out
+    assert "p@acme" in out and "DRIFTING" in out
+    # --json emits the raw block; --gate exits 1 while drifting.
+    assert report.main(["numerics", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["plans"]["p@acme"]["drifting"]
+    assert report.main(["numerics", "--gate"]) == 1
+    capsys.readouterr()
+
+    # --dir: merged fleet ledger (the mixed-schema fixture is
+    # healthy -> gate 0).
+    assert report.main(["numerics", "--dir",
+                        os.path.join(DATA, "mixed_schema"),
+                        "--gate"]) == 0
+    out = capsys.readouterr().out
+    assert "int8@acme" in out
+
+
+def test_bench_and_regress_numerics_fold():
+    from distributedfft_tpu.regress import (compare_record,
+                                            make_run_record,
+                                            regressed_metrics)
+
+    numerics.NumericsPlane(0.0)
+    for _ in range(6):
+        numerics.record_audit("p", None, 0.9, 0.005, 1e-5)
+    numerics.record_nonfinite("output", "nan")
+    rec = make_run_record(
+        metric="gflops", value=100.0, unit="GF/s",
+        config={"shape": "8x8x8"}, device_kind="cpu",
+        numerics=numerics.numerics_snapshot())
+    assert rec["numerics"]["plans"]["p@-"]["drifting"]
+    res = compare_record(rec, [])
+    assert res["verdict"] == "no-baseline"
+    regressed = regressed_metrics(res)
+    assert "numerics:drift:p@-" in regressed
+    assert "numerics:nonfinite" in regressed
+    # A clean ledger folds nothing.
+    clean = make_run_record(metric="gflops", value=100.0, unit="GF/s",
+                            config={"shape": "8x8x8"},
+                            device_kind="cpu")
+    assert regressed_metrics(compare_record(clean, [])) == []
+
+
+def test_loadgen_spawn_forwards_hot_tail_and_mesh(monkeypatch,
+                                                  tmp_path):
+    """The parent forwards --hot-tail/--mesh to every worker argv (a
+    drill where only the parent knew the flags would silently run
+    healthy traffic)."""
+    import types
+
+    from distributedfft_tpu import loadgen
+
+    calls = {}
+
+    def fake_popen(argv, **kw):
+        calls["argv"] = argv
+        return "proc"
+
+    monkeypatch.setattr(loadgen.subprocess, "Popen", fake_popen)
+    ns = types.SimpleNamespace(
+        seed=1, duration=1.0, rate=10.0, mix="-", shapes="8x8x8",
+        dtypes="complex64", ops="fft", max_batch=8, max_wait=0.0,
+        flush_every=0.05, hot_tail=0.3, mesh=8, linger=0.0,
+        streaming=False, qos="", fault_rank=0, interval=0.25)
+    assert loadgen._spawn(ns, 1, str(tmp_path)) == "proc"
+    argv = calls["argv"]
+    assert argv[argv.index("--hot-tail") + 1] == "0.3"
+    assert argv[argv.index("--mesh") + 1] == "8"
+
+
+def test_loadgen_worker_hot_tail_reports_drift(tmp_path, monkeypatch,
+                                               capsys):
+    """One in-process worker with the shadow plane armed, int8 wire,
+    and --hot-tail: its stats line carries shadow_sampled and a
+    drift_ratio past the slack (the CI drift drill's physics)."""
+    from distributedfft_tpu import loadgen
+
+    monkeypatch.setenv("DFFT_MONITOR_DIR", str(tmp_path))
+    monkeypatch.setenv("DFFT_MONITOR", "0.05")
+    monkeypatch.setenv("DFFT_SHADOW_RATE", "1,7")
+    monkeypatch.setenv("DFFT_WIRE_DTYPE", "int8")
+    monkeypatch.delenv("DFFT_QOS", raising=False)
+    monkeypatch.delenv("DFFT_FAULT_INJECT", raising=False)
+    rc = loadgen.main(["--worker", "--rank", "0", "--seed", "3",
+                       "--duration", "1", "--rate", "80",
+                       "--shapes", "8x8x8", "--ops", "fft",
+                       "--flush-every", "0.2", "--mesh", "8",
+                       "--hot-tail", "0.4"])
+    assert rc == 0
+    stats = json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats["shadow_sampled"] > 0
+    assert stats["drift_ratio"] > numerics.DEFAULT_SLACK
